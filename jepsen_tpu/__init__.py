@@ -1,0 +1,25 @@
+"""jepsen_tpu — a TPU-native distributed-systems correctness-testing framework.
+
+A from-scratch rebuild of the capabilities of Jepsen (reference:
+tilakpatidar/jepsen): a harness that installs a distributed system on a
+cluster, drives concurrent client operations against it while a nemesis
+injects faults, records every operation into a history, and verifies that
+history against consistency models.  The expensive part — linearizability
+checking, which the reference delegates to the external knossos JVM library —
+is here a batched JAX/XLA frontier search that runs on TPU.
+
+Layer map (mirrors reference SURVEY.md §1):
+
+  control/        L0  remote execution (ssh subprocess backend + dummy stub)
+  os/, db.py      L1  environment automation
+  nemesis/        L2  fault injection
+  generator.py    L3  workload generation (combinator DSL)
+  client.py       L4  client protocol
+  core.py         L5  test runner
+  checker/        L6  analysis (incl. the TPU linearizability engine)
+  store.py        L7  persistence
+  cli.py, web.py  L8  UX
+  suites/         L9  per-database test suites
+"""
+
+__version__ = "0.1.0"

@@ -1,0 +1,16 @@
+"""Checker layer (reference L6): validity analysis over histories.
+
+See :mod:`jepsen_tpu.checker.core` for the Checker protocol,
+:mod:`jepsen_tpu.checker.basic` for the O(n) checkers,
+:mod:`jepsen_tpu.checker.seq` for the sequential linearizability oracle and
+:mod:`jepsen_tpu.checker.linearizable` for the TPU engine.
+"""
+
+from .core import (  # noqa: F401
+    Checker,
+    CheckerFn,
+    check_safe,
+    compose,
+    merge_valid,
+    unbridled_dionysus,
+)

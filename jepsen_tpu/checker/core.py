@@ -1,0 +1,108 @@
+"""Checker protocol and combinators.
+
+Reference: jepsen/src/jepsen/checker.clj — protocol ``Checker`` with a
+single method ``check [checker test model history opts]`` returning a map
+with mandatory ``:valid?`` (checker.clj:47-62); ``check-safe`` catches
+checker crashes and returns ``:valid? :unknown`` (checker.clj:64-75);
+``compose`` runs a named map of checkers (in parallel, checker.clj:77-89)
+and merges validity with ``merge-valid`` (checker.clj:31-45):
+
+    true < :unknown < false   (any false => false, else any unknown =>
+    unknown, else true)
+"""
+
+from __future__ import annotations
+
+import traceback
+from typing import Any, Callable, Iterable
+
+from ..util import bounded_pmap
+
+UNKNOWN = "unknown"
+
+
+class Checker:
+    """Validity analysis over a complete history.
+
+    check(test, history, opts) -> dict with at least {"valid": True|False|
+    "unknown"}.  ``test`` is the test map (the model rides in
+    test["model"], as in the reference's check signature); opts carries
+    e.g. the output subdirectory for artifact-writing checkers
+    (checker.clj:55-60).
+    """
+
+    def check(self, test: dict, history: list, opts: dict | None = None) -> dict:
+        raise NotImplementedError
+
+    def __call__(self, test, history, opts=None):
+        return self.check(test, history, opts)
+
+
+class CheckerFn(Checker):
+    """Adapt a plain function (test, history, opts) -> result."""
+
+    def __init__(self, f: Callable, name: str | None = None):
+        self.f = f
+        self.name = name or getattr(f, "__name__", "checker-fn")
+
+    def check(self, test, history, opts=None):
+        return self.f(test, history, opts)
+
+
+def merge_valid(valids: Iterable) -> Any:
+    """Merge validity values (checker.clj:31-45).
+
+    false dominates, then unknown, then true.  An empty collection is
+    vacuously true.  Anything that is not literally True (including a
+    missing :valid key, i.e. None) degrades the merge to unknown — a
+    checker that produced no verdict must not read as a pass.
+    """
+    out: Any = True
+    for v in valids:
+        if v is False:
+            return False
+        if v is not True:
+            out = UNKNOWN
+    return out
+
+
+def check_safe(checker: Checker, test: dict, history: list,
+               opts: dict | None = None) -> dict:
+    """Like check, never throws: crashes become {"valid": "unknown"}
+    (checker.clj:64-75)."""
+    try:
+        return checker.check(test, history, opts or {})
+    except Exception:
+        return {"valid": UNKNOWN, "error": traceback.format_exc()}
+
+
+class Compose(Checker):
+    """Run a named map of checkers over the same history, in parallel
+    (checker.clj:77-89).  Result: {"valid": merged, <name>: result...}."""
+
+    def __init__(self, checkers: dict):
+        self.checkers = dict(checkers)
+
+    def check(self, test, history, opts=None):
+        names = list(self.checkers)
+        results = bounded_pmap(
+            lambda name: check_safe(self.checkers[name], test, history, opts),
+            names)
+        out = dict(zip(names, results))
+        out["valid"] = merge_valid(r.get("valid") for r in results)
+        return out
+
+
+def compose(checkers: dict) -> Checker:
+    return Compose(checkers)
+
+
+class _Unbridled(Checker):
+    """A checker which is always happy (checker.clj:108-112)."""
+
+    def check(self, test, history, opts=None):
+        return {"valid": True}
+
+
+unbridled_dionysus = _Unbridled()
+noop = unbridled_dionysus

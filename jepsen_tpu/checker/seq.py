@@ -1,0 +1,159 @@
+"""Sequential linearizability oracle — host-side Wing-Gong/Lowe DFS.
+
+This is the CPU reference implementation of the search the TPU engine
+(checker/linearizable.py) vectorizes.  It plays three roles:
+
+  1. the differential-test oracle for the TPU engine (random histories must
+     agree — the analog of the reference racing knossos `linear` vs `wgl`
+     in `competition`, checker.clj:122-126);
+  2. the small-history fast path (device dispatch has fixed overhead);
+  3. witness reconstruction: when the TPU pass finds a history invalid,
+     this DFS re-derives a concrete longest-linearizable prefix for the
+     report (SURVEY.md §7 "witness reconstruction").
+
+Algorithm (knossos.wgl / Lowe "Testing for linearizability", see
+PAPERS.md): a *configuration* is (set of linearized ops, model state).
+From a configuration, any op j may be linearized next iff
+
+    j not linearized, and
+    inv[j] < ret[k]  for every other unlinearized op k
+    (no unlinearized op returned before j was invoked), and
+    model.step(state, j) is legal.
+
+The history is valid iff some configuration containing every ``ok`` op is
+reachable.  ``info`` (crashed/indeterminate) ops have ret = +inf: they
+never block anything and may linearize at any point after invocation, or
+never — exactly knossos's crashed-op semantics (core.clj:387-397 defines
+how crashed processes arise).
+
+The search is DFS with a visited memo on (linearized-set, state); sets are
+Python bigint bitmasks.  Worst case exponential — ``max_configs`` bounds
+work and yields {"valid": "unknown"} past it, the moral equivalent of the
+reference's -Xmx32g ceiling (jepsen/project.clj:25).
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from ..history import INF_RET, OpSeq
+from ..models import ModelSpec
+
+
+def check_opseq(seq: OpSeq, model: ModelSpec, *,
+                max_configs: int = 5_000_000) -> dict:
+    """Run the DFS over a columnar OpSeq.  Returns a knossos-style map:
+
+    valid        True | False | "unknown"
+    configs      number of configurations explored
+    linearization  (valid only) list of row indices in linearization order
+    max_depth    deepest prefix length reached
+    final_ops    (invalid only) row indices of candidate ops at the
+                 deepest frontier — the ops that could not be linearized
+    """
+    n = len(seq)
+    ok_mask = 0
+    for i in range(n):
+        if bool(seq.ok[i]):
+            ok_mask |= 1 << i
+    if n == 0:
+        return {"valid": True, "configs": 0, "linearization": [],
+                "max_depth": 0}
+
+    inv = [int(x) for x in seq.inv]
+    ret = [int(x) for x in seq.ret]
+    f = [int(x) for x in seq.f]
+    v1 = [int(x) for x in seq.v1]
+    v2 = [int(x) for x in seq.v2]
+    pystep = model.pystep
+
+    visited: set = set()
+    configs = 0
+    max_depth = -1
+    best_frontier: list[int] = []
+
+    # DFS stack entries: (mask, state); parent_of records (op, parent_key)
+    # so the linearization is rebuilt by walking parents on success.
+    init = model.init
+    stack: list[tuple[int, tuple]] = [(0, init)]
+    parent_of: dict[tuple[int, tuple], Optional[tuple]] = {(0, init): None}
+
+    while stack:
+        mask, state = stack.pop()
+        key = (mask, state)
+        if key in visited:
+            continue
+        visited.add(key)
+        configs += 1
+        if configs > max_configs:
+            return {"valid": "unknown", "configs": configs,
+                    "max_depth": max_depth,
+                    "info": f"exceeded max_configs={max_configs}"}
+
+        if (mask & ok_mask) == ok_mask:
+            # reconstruct linearization by following parents
+            lin = []
+            k: Optional[tuple[int, tuple]] = key
+            while k is not None:
+                p = parent_of[k]
+                if p is None:
+                    break
+                op, pk = p
+                lin.append(op)
+                k = pk
+            lin.reverse()
+            return {"valid": True, "configs": configs,
+                    "linearization": lin,
+                    "max_depth": len(lin)}
+
+        # Enabled candidates: scan unlinearized ops in invocation order,
+        # maintaining the min return among unlinearized seen so far.  Once
+        # inv[j] >= that min, no later op can be enabled (invocations are
+        # sorted), and the window min equals the global unlinearized min
+        # because any op past the stop point has ret > inv >= stop.
+        cand: list[int] = []
+        rets: list[int] = []
+        minret = INF_RET + 1
+        j = 0
+        m = mask
+        while j < n:
+            if not (m >> j) & 1:
+                if inv[j] >= minret:
+                    break
+                cand.append(j)
+                rets.append(ret[j])
+                if ret[j] < minret:
+                    minret = ret[j]
+            j += 1
+
+        depth = mask.bit_count()
+        if depth > max_depth:
+            max_depth = depth
+            best_frontier = list(cand)
+
+        # min-excluding-self via (min, second-min)
+        if rets:
+            m1 = min(rets)
+            m1_count = rets.count(m1)
+            m2 = INF_RET + 1
+            first = True
+            for r in rets:
+                if r == m1 and first:
+                    first = False
+                elif r < m2:
+                    m2 = r
+        for idx, j2 in enumerate(cand):
+            excl = m2 if rets[idx] == m1 and m1_count == 1 else m1
+            if inv[j2] >= excl:
+                continue
+            new_state = pystep(state, f[j2], v1[j2], v2[j2])
+            if new_state is None:
+                continue
+            nk = (mask | (1 << j2), new_state)
+            if nk not in visited:
+                if nk not in parent_of:
+                    parent_of[nk] = (j2, key)
+                stack.append(nk)
+
+    return {"valid": False, "configs": configs, "max_depth": max_depth,
+            "final_ops": best_frontier}

@@ -1,0 +1,332 @@
+"""Operation & history substrate — the device-facing data model.
+
+The reference represents an operation as a plain map ``{:process p, :type
+:invoke|:ok|:fail|:info, :f ..., :value ..., :time nanos, :index i}``
+(invariants asserted at jepsen/src/jepsen/core.clj:271-278) and a history as
+a vector of such maps with monotonically increasing ``:index`` assigned
+before checking (core.clj:600 via knossos.history/index).  Completion
+semantics (core.clj:248-281, 387-404):
+
+  * ``ok``   — the operation definitely happened
+  * ``fail`` — the operation definitely did NOT happen
+  * ``info`` — indeterminate; it may take effect at ANY time after its
+               invocation, forever (a crashed op never "returns")
+
+This module provides:
+
+  * :class:`Op` — the op record (attribute access, dict round-trip)
+  * event-level helpers: :func:`index`, :func:`pair_index`, :func:`complete`
+  * :class:`OpSeq` — the *merged, columnar* encoding the checker consumes:
+    one row per logical operation (invoke..completion pair), sorted by
+    invocation order, with numpy columns ready for ``jax.device_put``.
+    This is the "history substrate" of SURVEY.md §7 step 1: the columnar
+    layout ``process:int32, f:int8, type:int8, value packed, index:int32``
+    is designed for the TPU search engine, not for human reading.
+
+Value encoding: checker models operate on int32 lanes.  Arbitrary Python
+values are interned host-side via :class:`ValueEncoder`; ``None`` (an
+unknown read value, knossos.model register semantics) maps to :data:`NIL`.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field, replace
+from typing import Any, Iterable, Sequence
+
+import numpy as np
+
+# Op completion types
+INVOKE = "invoke"
+OK = "ok"
+FAIL = "fail"
+INFO = "info"
+
+# Sentinel int for "no value / unknown" in columnar encoding.
+NIL = np.int32(-(2**31)).item()
+
+# ret_index for ops that never complete (crashed / :info): effectively +inf.
+INF_RET = np.int32(2**31 - 1).item()
+
+
+@dataclass
+class Op:
+    """One history event.  Mirrors the reference op map (core.clj:271-278)."""
+
+    process: Any  # int client process, or "nemesis"
+    type: str  # invoke | ok | fail | info
+    f: Any  # operation function, e.g. "read", "write", "cas"
+    value: Any = None
+    time: int | None = None  # relative nanos
+    index: int | None = None  # event index in the history
+    error: Any = None
+
+    def to_dict(self) -> dict:
+        d = {"process": self.process, "type": self.type, "f": self.f,
+             "value": self.value}
+        if self.time is not None:
+            d["time"] = self.time
+        if self.index is not None:
+            d["index"] = self.index
+        if self.error is not None:
+            d["error"] = self.error
+        return d
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "Op":
+        return cls(process=d.get("process"), type=d.get("type"),
+                   f=d.get("f"), value=d.get("value"), time=d.get("time"),
+                   index=d.get("index"), error=d.get("error"))
+
+
+def invoke_op(process, f, value=None, **kw) -> Op:
+    return Op(process=process, type=INVOKE, f=f, value=value, **kw)
+
+
+def ok_op(process, f, value=None, **kw) -> Op:
+    return Op(process=process, type=OK, f=f, value=value, **kw)
+
+
+def fail_op(process, f, value=None, **kw) -> Op:
+    return Op(process=process, type=FAIL, f=f, value=value, **kw)
+
+
+def info_op(process, f, value=None, **kw) -> Op:
+    return Op(process=process, type=INFO, f=f, value=value, **kw)
+
+
+def is_invoke(op: Op) -> bool:
+    return op.type == INVOKE
+
+
+def is_ok(op: Op) -> bool:
+    return op.type == OK
+
+
+def is_fail(op: Op) -> bool:
+    return op.type == FAIL
+
+
+def is_info(op: Op) -> bool:
+    return op.type == INFO
+
+
+def is_client_op(op: Op) -> bool:
+    """Client processes are integers; the nemesis is :nemesis
+    (generator.clj:76-83)."""
+    return isinstance(op.process, int)
+
+
+def index(history: Iterable[Op]) -> list[Op]:
+    """Assign sequential :index to every event (knossos.history/index,
+    called at core.clj:600).  Returns new ops; does not mutate."""
+    return [replace(op, index=i) for i, op in enumerate(history)]
+
+
+def pair_index(history: Sequence[Op]) -> dict[int, int]:
+    """Map each event's index -> its partner's index (invoke<->completion).
+
+    A process has at most one outstanding op (the single-threaded-process
+    invariant, core.clj:387-404), so pairing is a per-process scan.
+    Crashed invokes (no completion) are absent from the map.
+    """
+    pairs: dict[int, int] = {}
+    open_by_process: dict[Any, int] = {}
+    for i, op in enumerate(history):
+        if op.type == INVOKE:
+            open_by_process[op.process] = i
+        else:
+            j = open_by_process.pop(op.process, None)
+            if j is not None:
+                pairs[j] = i
+                pairs[i] = j
+    return pairs
+
+
+def complete(history: Sequence[Op]) -> list[Op]:
+    """Fill in invoke values from ok completions (knossos.history/complete).
+
+    An ok'd read's invocation has value nil (or a compound value with nil
+    lanes, e.g. multi-register's ``(key, nil)``); the model must check the
+    value the read actually returned, so the completion's value is copied
+    back onto the invocation whenever the completion carries one.
+    """
+    out = list(history)
+    open_by_process: dict[Any, int] = {}
+    for i, op in enumerate(out):
+        if op.type == INVOKE:
+            open_by_process[op.process] = i
+        else:
+            j = open_by_process.pop(op.process, None)
+            if j is not None and op.type == OK and op.value is not None:
+                out[j] = replace(out[j], value=op.value)
+    return out
+
+
+def processes(history: Iterable[Op]) -> list:
+    """Distinct processes appearing in a history (knossos.history/processes)."""
+    seen: dict = {}
+    for op in history:
+        seen.setdefault(op.process, None)
+    return list(seen)
+
+
+class ValueEncoder:
+    """Interns arbitrary hashable values as dense int32 ids.
+
+    Models on device see only int32 lanes; the host keeps the id<->value
+    bijection for report rendering.  Integers that already fit int32 are
+    encoded as themselves when ``identity_ints`` (default), which keeps
+    encoded histories human-debuggable.
+    """
+
+    def __init__(self, identity_ints: bool = True):
+        self.identity_ints = identity_ints
+        self._fwd: dict = {}
+        self._rev: dict = {}
+        self._next = 0
+
+    def encode(self, v) -> int:
+        if v is None:
+            return NIL
+        if self.identity_ints and isinstance(v, int) and -(2**30) < v < 2**30:
+            return v
+        if v in self._fwd:
+            return self._fwd[v]
+        # Interned ids live in a high band to avoid colliding with identity
+        # ints.
+        vid = 2**30 + self._next
+        self._next += 1
+        self._fwd[v] = vid
+        self._rev[vid] = v
+        return vid
+
+    def decode(self, i: int):
+        if i == NIL:
+            return None
+        return self._rev.get(i, i)
+
+
+@dataclass
+class OpSeq:
+    """Columnar, merged operation sequence — the checker's input format.
+
+    One row per *logical operation* (invoke event merged with its
+    completion), retaining only ops that may have taken effect:
+
+      * ok ops    (must appear in any linearization)
+      * info ops  (may appear; ret is +inf — crashed ops stay eligible
+                   forever, matching knossos / core.clj:387-397)
+
+    fail ops are dropped: a :fail completion guarantees the op did not
+    happen.  Rows are sorted by invocation event index, so ``inv`` is
+    strictly increasing; real-time precedence "op i returned before op j
+    invoked" is exactly ``ret[i] < inv[j]`` on event ranks.
+
+    Columns (numpy, length n):
+      process : int32  — process id (client ops only)
+      f       : int32  — model-specific function code
+      v1, v2  : int32  — encoded argument lanes (v2 used by cas)
+      inv     : int64  — invocation event index within the original history
+      ret     : int64  — completion event index, or INF_RET if crashed
+      ok      : bool   — True for ok ops (must linearize)
+    """
+
+    process: np.ndarray
+    f: np.ndarray
+    v1: np.ndarray
+    v2: np.ndarray
+    inv: np.ndarray
+    ret: np.ndarray
+    ok: np.ndarray
+    # host-side row -> original invoke Op, for witness/report rendering
+    ops: list = field(default_factory=list)
+    encoder: ValueEncoder | None = None
+
+    def __len__(self) -> int:
+        return len(self.process)
+
+    @property
+    def n_must(self) -> int:
+        return int(self.ok.sum())
+
+
+def encode_ops(history: Sequence[Op], f_codes: dict, *,
+               encoder: ValueEncoder | None = None,
+               value_lanes=None) -> OpSeq:
+    """Build the columnar :class:`OpSeq` from an event-level history.
+
+    f_codes maps f names (e.g. "read"/"write"/"cas") to small ints — each
+    model publishes its own table (models/__init__.py).
+
+    value_lanes: optional fn (f, value, encoder) -> (v1, v2) for ops whose
+    value is not a scalar (cas takes a pair).  Default: cas -> pair, else
+    scalar.
+    """
+    enc = encoder or ValueEncoder()
+
+    def default_lanes(fname, value):
+        if isinstance(value, (tuple, list)) and len(value) == 2:
+            return enc.encode(value[0]), enc.encode(value[1])
+        return enc.encode(value), NIL
+
+    lanes = value_lanes or (lambda fname, value, e: default_lanes(fname, value))
+
+    completed = complete(history)
+    pairs = pair_index(completed)
+
+    rows = []  # (inv_idx, ret_idx, process, f, v1, v2, ok, op)
+    for i, op in enumerate(completed):
+        if op.type != INVOKE or not is_client_op(op):
+            continue
+        j = pairs.get(i)
+        if j is None:
+            ctype = INFO  # crashed: invoke with no completion
+            ret = INF_RET
+        else:
+            ctype = completed[j].type
+            ret = j if ctype != INFO else INF_RET
+        if ctype == FAIL:
+            continue  # definitely didn't happen
+        if op.f not in f_codes:
+            raise KeyError(f"op f={op.f!r} not in model f_codes {list(f_codes)}")
+        v1, v2 = lanes(op.f, op.value, enc)
+        rows.append((i, ret, op.process, f_codes[op.f], v1, v2,
+                     ctype == OK, op))
+
+    rows.sort(key=lambda r: r[0])
+    n = len(rows)
+    return OpSeq(
+        process=np.array([r[2] for r in rows], dtype=np.int32).reshape(n),
+        f=np.array([r[3] for r in rows], dtype=np.int32).reshape(n),
+        v1=np.array([r[4] for r in rows], dtype=np.int32).reshape(n),
+        v2=np.array([r[5] for r in rows], dtype=np.int32).reshape(n),
+        inv=np.array([r[0] for r in rows], dtype=np.int64).reshape(n),
+        ret=np.array([r[1] for r in rows], dtype=np.int64).reshape(n),
+        ok=np.array([r[6] for r in rows], dtype=bool).reshape(n),
+        ops=[r[7] for r in rows],
+        encoder=enc,
+    )
+
+
+def max_concurrency(seq: OpSeq) -> int:
+    """Maximum number of ops simultaneously open (invoked, not returned).
+
+    Bounds the enabled-candidate window of the search engine: an op can be
+    linearized next only if its invocation precedes every unlinearized
+    op's return, and at most this many ops overlap any point in time.
+    Crashed (:info) ops stay open forever, so each contributes to the
+    concurrency of every later instant — the window must absorb them.
+    """
+    events = []
+    for i in range(len(seq)):
+        events.append((int(seq.inv[i]), 1))
+        if int(seq.ret[i]) != INF_RET:
+            events.append((int(seq.ret[i]), -1))
+    events.sort()
+    cur = peak = 0
+    for _, d in events:
+        cur += d
+        peak = max(peak, cur)
+    # crashed ops overlap everything after their invoke; the sweep above
+    # already counts them (+1 with no -1), so peak is correct.
+    return peak

@@ -1,0 +1,31 @@
+"""Checker protocol / combinator tests (checker.clj merge-valid semantics)."""
+
+from jepsen_tpu.checker import CheckerFn, check_safe, compose, merge_valid
+
+
+def test_merge_valid_ordering():
+    assert merge_valid([True, True]) is True
+    assert merge_valid([True, "unknown"]) == "unknown"
+    assert merge_valid([False, "unknown"]) is False
+    assert merge_valid([]) is True
+    # a checker that produced no verdict must not read as a pass
+    assert merge_valid([True, None]) == "unknown"
+
+
+def test_check_safe_catches():
+    def boom(test, history, opts):
+        raise RuntimeError("kaboom")
+    r = check_safe(CheckerFn(boom), {}, [])
+    assert r["valid"] == "unknown"
+    assert "kaboom" in r["error"]
+
+
+def test_compose_merges():
+    ok = CheckerFn(lambda t, h, o: {"valid": True, "n": len(h)})
+    bad = CheckerFn(lambda t, h, o: {"valid": False})
+    broken = CheckerFn(lambda t, h, o: {})
+    r = compose({"ok": ok, "bad": bad}).check({}, [1, 2], {})
+    assert r["valid"] is False
+    assert r["ok"]["n"] == 2
+    r2 = compose({"ok": ok, "broken": broken}).check({}, [], {})
+    assert r2["valid"] == "unknown"

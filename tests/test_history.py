@@ -1,0 +1,114 @@
+"""History substrate tests (reference tier-1: checker_test/util_test style)."""
+
+import numpy as np
+
+from jepsen_tpu.history import (
+    INF_RET, NIL, Op, ValueEncoder, complete, encode_ops, index,
+    invoke_op, max_concurrency, ok_op, fail_op, info_op, pair_index,
+)
+from jepsen_tpu.models import cas_register
+
+FC = cas_register().f_codes
+
+
+def h(*ops):
+    return list(ops)
+
+
+def test_index_assigns_sequential():
+    hist = index(h(invoke_op(0, "read"), ok_op(0, "read", 1)))
+    assert [op.index for op in hist] == [0, 1]
+
+
+def test_pair_index_matches_invoke_completion():
+    hist = h(
+        invoke_op(0, "write", 1),   # 0
+        invoke_op(1, "read"),       # 1
+        ok_op(1, "read", None),     # 2
+        ok_op(0, "write", 1),       # 3
+    )
+    pairs = pair_index(hist)
+    assert pairs[0] == 3 and pairs[3] == 0
+    assert pairs[1] == 2 and pairs[2] == 1
+
+
+def test_complete_fills_read_values():
+    hist = complete(h(invoke_op(0, "read"), ok_op(0, "read", 42)))
+    assert hist[0].value == 42
+
+
+def test_encode_drops_fail_keeps_info():
+    hist = h(
+        invoke_op(0, "write", 1),
+        fail_op(0, "write", 1),     # definitely didn't happen -> dropped
+        invoke_op(1, "write", 2),
+        info_op(1, "write", 2),     # indeterminate -> kept, ret=inf
+        invoke_op(2, "write", 3),   # crashed without completion -> kept
+    )
+    seq = encode_ops(hist, FC)
+    assert len(seq) == 2
+    assert list(seq.ret) == [INF_RET, INF_RET]
+    assert list(seq.ok) == [False, False]
+    assert seq.n_must == 0
+
+
+def test_encode_cas_value_lanes():
+    hist = h(invoke_op(0, "cas", (1, 2)), ok_op(0, "cas", (1, 2)))
+    seq = encode_ops(hist, FC)
+    assert seq.v1[0] == 1 and seq.v2[0] == 2
+
+
+def test_encode_nil_read():
+    hist = h(invoke_op(0, "read"), info_op(0, "read"))
+    seq = encode_ops(hist, FC)
+    assert seq.v1[0] == NIL
+
+
+def test_encode_sorted_by_invocation():
+    hist = h(
+        invoke_op(0, "write", 1),
+        invoke_op(1, "write", 2),
+        ok_op(1, "write", 2),
+        ok_op(0, "write", 1),
+    )
+    seq = encode_ops(hist, FC)
+    assert list(seq.inv) == [0, 1]
+    assert list(seq.ret) == [3, 2]
+    # real-time: neither precedes the other (overlapping)
+    assert seq.ret[0] > seq.inv[1] and seq.ret[1] > seq.inv[0]
+
+
+def test_nemesis_ops_excluded():
+    hist = h(
+        Op("nemesis", "info", "start-partition", "all"),
+        invoke_op(0, "read"),
+        ok_op(0, "read", None),
+        Op("nemesis", "info", "stop-partition", None),
+    )
+    seq = encode_ops(hist, FC)
+    assert len(seq) == 1
+
+
+def test_value_encoder_interns_non_ints():
+    enc = ValueEncoder()
+    a = enc.encode("foo")
+    b = enc.encode("bar")
+    assert a != b
+    assert enc.encode("foo") == a
+    assert enc.decode(a) == "foo"
+    assert enc.encode(5) == 5
+    assert enc.decode(NIL) is None
+
+
+def test_max_concurrency():
+    hist = h(
+        invoke_op(0, "write", 1),   # 0 opens
+        invoke_op(1, "write", 2),   # 1 opens -> 2 concurrent
+        ok_op(0, "write", 1),
+        ok_op(1, "write", 2),
+        invoke_op(2, "write", 3),   # crashed: stays open forever
+        invoke_op(0, "write", 4),
+        ok_op(0, "write", 4),
+    )
+    seq = encode_ops(hist, FC)
+    assert max_concurrency(seq) == 2

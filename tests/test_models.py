@@ -1,0 +1,116 @@
+"""Model semantics + differential tests: pystep vs JAX jstep must agree."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from jepsen_tpu.history import NIL
+from jepsen_tpu.models import (
+    cas_register, multi_register, mutex, noop, register,
+)
+
+
+def jstep_eval(model, state, fname, v1, v2):
+    code = model.f_codes[fname]
+    s = jnp.asarray(state, dtype=jnp.int32)
+    s2, legal = jax.jit(model.jstep)(
+        s, jnp.int32(code), jnp.int32(v1), jnp.int32(v2))
+    return tuple(int(x) for x in s2), bool(legal)
+
+
+# --- register ---------------------------------------------------------------
+
+def test_register_read_write():
+    m = register(0)
+    assert m.step((0,), "read", 0) == (0,)
+    assert m.step((0,), "read", 1) is None
+    assert m.step((0,), "read", None) == (0,)   # unknown read always legal
+    assert m.step((0,), "write", 7) == (7,)
+
+
+# --- cas-register -----------------------------------------------------------
+
+def test_cas_register_semantics():
+    m = cas_register(0)
+    assert m.step((0,), "cas", (0, 5)) == (5,)
+    assert m.step((0,), "cas", (1, 5)) is None
+    assert m.step((3,), "write", 9) == (9,)
+    assert m.step((3,), "read", 3) == (3,)
+    assert m.step((3,), "read", 4) is None
+
+
+def test_cas_register_nil_initial():
+    m = cas_register()
+    assert m.init == (NIL,)
+    assert m.step(m.init, "read", None) == m.init
+    assert m.step(m.init, "read", 0) is None
+
+
+# --- mutex ------------------------------------------------------------------
+
+def test_mutex_semantics():
+    m = mutex()
+    assert m.step((0,), "acquire", None) == (1,)
+    assert m.step((1,), "acquire", None) is None
+    assert m.step((1,), "release", None) == (0,)
+    assert m.step((0,), "release", None) is None
+
+
+# --- multi-register ---------------------------------------------------------
+
+def test_multi_register():
+    m = multi_register(3)
+    s = m.init
+    assert s == (0, 0, 0)
+    s2 = m.step(s, "write", (1, 9))
+    assert s2 == (0, 9, 0)
+    assert m.step(s2, "read", (1, 9)) == s2
+    assert m.step(s2, "read", (1, 8)) is None
+    assert m.step(s2, "read", (5, 0)) is None  # out of range
+
+
+# --- differential: pystep vs jstep ------------------------------------------
+
+CASES = {
+    "register": (register(0), [
+        ("read", 0, NIL), ("read", 1, NIL), ("read", NIL, NIL),
+        ("write", 3, NIL), ("write", -1, NIL),
+    ]),
+    "cas-register": (cas_register(0), [
+        ("read", 0, NIL), ("read", 2, NIL), ("read", NIL, NIL),
+        ("write", 4, NIL), ("cas", 0, 9), ("cas", 7, 9),
+    ]),
+    "mutex": (mutex(), [
+        ("acquire", NIL, NIL), ("release", NIL, NIL),
+    ]),
+    "multi-register": (multi_register(4, 0), [
+        ("read", 0, 0), ("read", 2, 1), ("read", 1, NIL),
+        ("write", 3, 7), ("write", 0, -2),
+    ]),
+}
+
+
+@pytest.mark.parametrize("name", list(CASES))
+def test_pystep_jstep_agree(name):
+    model, ops = CASES[name]
+    rng = np.random.default_rng(0)
+    # random walk: apply random legal ops, compare both impls at each step
+    states = [model.init]
+    for _ in range(50):
+        state = states[rng.integers(len(states))]
+        fname, v1, v2 = ops[rng.integers(len(ops))]
+        code = model.f_codes[fname]
+        py = model.pystep(state, code, v1, v2)
+        js, legal = jstep_eval(model, state, fname, v1, v2)
+        if py is None:
+            assert not legal, (name, state, fname, v1, v2)
+        else:
+            assert legal, (name, state, fname, v1, v2)
+            assert js == py, (name, state, fname, v1, v2)
+            states.append(py)
+
+
+def test_noop_accepts_everything():
+    m = noop()
+    assert m.pystep((0,), 0, 1, 2) == (0,)

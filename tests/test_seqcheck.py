@@ -1,0 +1,216 @@
+"""Sequential linearizability oracle tests.
+
+Histories mirror the reference's checker_test.clj style: hand-written
+valid, invalid, and pathological cases, plus knossos's crashed-op
+semantics (:info ops may linearize at any later point, or never).
+"""
+
+from jepsen_tpu.history import (
+    encode_ops, fail_op, info_op, invoke_op, ok_op,
+)
+from jepsen_tpu.checker.seq import check_opseq
+from jepsen_tpu.models import cas_register, mutex, register
+
+
+def check(model, *ops):
+    seq = encode_ops(list(ops), model.f_codes)
+    return check_opseq(seq, model)
+
+
+def test_empty_history_valid():
+    r = check(register(0))
+    assert r["valid"] is True
+
+
+def test_sequential_read_write_valid():
+    r = check(
+        register(0),
+        invoke_op(0, "write", 1), ok_op(0, "write", 1),
+        invoke_op(0, "read"), ok_op(0, "read", 1),
+    )
+    assert r["valid"] is True
+    assert r["linearization"] == [0, 1]
+
+
+def test_stale_read_invalid():
+    r = check(
+        register(0),
+        invoke_op(0, "write", 1), ok_op(0, "write", 1),
+        invoke_op(0, "read"), ok_op(0, "read", 0),  # saw the old value
+    )
+    assert r["valid"] is False
+
+
+def test_concurrent_reads_may_reorder():
+    # write(1) overlaps two reads: one sees 0, one sees 1 — both orders
+    # exist, so valid.
+    r = check(
+        register(0),
+        invoke_op(0, "write", 1),
+        invoke_op(1, "read"), ok_op(1, "read", 0),
+        invoke_op(2, "read"), ok_op(2, "read", 1),
+        ok_op(0, "write", 1),
+    )
+    assert r["valid"] is True
+
+
+def test_read_before_overlap_must_see_old():
+    # read completes before write invokes -> must see 0
+    r = check(
+        register(0),
+        invoke_op(1, "read"), ok_op(1, "read", 1),
+        invoke_op(0, "write", 1), ok_op(0, "write", 1),
+    )
+    assert r["valid"] is False
+
+
+def test_cas_register_valid_chain():
+    r = check(
+        cas_register(0),
+        invoke_op(0, "cas", (0, 2)), ok_op(0, "cas", (0, 2)),
+        invoke_op(1, "cas", (2, 3)), ok_op(1, "cas", (2, 3)),
+        invoke_op(0, "read"), ok_op(0, "read", 3),
+    )
+    assert r["valid"] is True
+
+
+def test_cas_from_wrong_value_invalid():
+    r = check(
+        cas_register(0),
+        invoke_op(0, "cas", (5, 2)), ok_op(0, "cas", (5, 2)),
+    )
+    assert r["valid"] is False
+
+
+def test_failed_op_did_not_happen():
+    r = check(
+        register(0),
+        invoke_op(0, "write", 1), fail_op(0, "write", 1),
+        invoke_op(0, "read"), ok_op(0, "read", 0),
+    )
+    assert r["valid"] is True
+
+
+def test_info_op_may_have_happened():
+    # crashed write(1); later read sees 1 -> valid only if the crashed
+    # write is allowed to have taken effect
+    r = check(
+        register(0),
+        invoke_op(0, "write", 1), info_op(0, "write", 1),
+        invoke_op(1, "read"), ok_op(1, "read", 1),
+    )
+    assert r["valid"] is True
+
+
+def test_info_op_may_not_have_happened():
+    r = check(
+        register(0),
+        invoke_op(0, "write", 1), info_op(0, "write", 1),
+        invoke_op(1, "read"), ok_op(1, "read", 0),
+    )
+    assert r["valid"] is True
+
+
+def test_info_op_takes_effect_late():
+    # crashed write(1) invoked FIRST; reads see 0, 0, then 1: the crashed
+    # op may linearize arbitrarily late (knossos crashed-op semantics).
+    r = check(
+        register(0),
+        invoke_op(0, "write", 1), info_op(0, "write", 1),
+        invoke_op(1, "read"), ok_op(1, "read", 0),
+        invoke_op(1, "read"), ok_op(1, "read", 1),
+        invoke_op(1, "read"), ok_op(1, "read", 1),
+    )
+    assert r["valid"] is True
+
+
+def test_info_cannot_unhappen():
+    # 0 -> 1 -> 0 with only one crashed write(1): the final read of 0 is
+    # impossible once 1 was observed (no op writes 0 again).
+    r = check(
+        register(0),
+        invoke_op(0, "write", 1), info_op(0, "write", 1),
+        invoke_op(1, "read"), ok_op(1, "read", 1),
+        invoke_op(1, "read"), ok_op(1, "read", 0),
+    )
+    assert r["valid"] is False
+
+
+def test_mutex_valid():
+    r = check(
+        mutex(),
+        invoke_op(0, "acquire"), ok_op(0, "acquire"),
+        invoke_op(0, "release"), ok_op(0, "release"),
+        invoke_op(1, "acquire"), ok_op(1, "acquire"),
+    )
+    assert r["valid"] is True
+
+
+def test_mutex_double_acquire_invalid():
+    r = check(
+        mutex(),
+        invoke_op(0, "acquire"), ok_op(0, "acquire"),
+        invoke_op(1, "acquire"), ok_op(1, "acquire"),
+    )
+    assert r["valid"] is False
+
+
+def test_mutex_concurrent_handoff_valid():
+    # release overlaps the second acquire -> legal interleaving exists
+    r = check(
+        mutex(),
+        invoke_op(0, "acquire"), ok_op(0, "acquire"),
+        invoke_op(0, "release"),
+        invoke_op(1, "acquire"), ok_op(1, "acquire"),
+        ok_op(0, "release"),
+    )
+    assert r["valid"] is True
+
+
+def test_unknown_on_config_explosion():
+    # tiny cap forces the unknown path
+    ops = []
+    for i in range(8):
+        ops.append(invoke_op(i, "write", i))
+    for i in range(8):
+        ops.append(info_op(i, "write", i))
+    # an ok read forces the search to actually order the crashed writes
+    ops += [invoke_op(8, "read"), ok_op(8, "read", 3)]
+    seq = encode_ops(ops, register(0).f_codes)
+    r = check_opseq(seq, register(0), max_configs=2)
+    assert r["valid"] == "unknown"
+
+
+def test_invalid_reports_final_ops():
+    r = check(
+        register(0),
+        invoke_op(0, "write", 1), ok_op(0, "write", 1),
+        invoke_op(0, "read"), ok_op(0, "read", 5),
+    )
+    assert r["valid"] is False
+    assert r["final_ops"], "should report the stuck frontier ops"
+
+
+def test_multi_register_read_through_encode():
+    # regression: compound read values (key, nil) must be filled in from
+    # the ok completion, or a read of a never-written value passes.
+    from jepsen_tpu.models import multi_register
+    m = multi_register(3)
+    r = check(
+        m,
+        invoke_op(0, "write", (0, 5)), ok_op(0, "write", (0, 5)),
+        invoke_op(1, "read", (0, None)), ok_op(1, "read", (0, 7)),
+    )
+    assert r["valid"] is False
+    r2 = check(
+        m,
+        invoke_op(0, "write", (0, 5)), ok_op(0, "write", (0, 5)),
+        invoke_op(1, "read", (0, None)), ok_op(1, "read", (0, 5)),
+    )
+    assert r2["valid"] is True
+
+
+def test_invalid_at_depth_zero_reports_final_ops():
+    r = check(register(0), invoke_op(0, "read"), ok_op(0, "read", 5))
+    assert r["valid"] is False
+    assert r["final_ops"] == [0]

@@ -1,0 +1,638 @@
+"""TPU linearizability engine — batched JAX frontier search.
+
+This is the rebuild's replacement for the external ``knossos`` JVM library
+the reference delegates linearizability checking to (used from
+jepsen/src/jepsen/checker.clj:114-139; algorithms selected at
+checker.clj:122-126).  knossos explores configurations — (set of
+linearized ops, model state) — by depth-first search with a visited memo,
+sized at -Xmx32g (jepsen/project.clj:25).  Here the same configuration
+space is explored breadth-first on device: a frontier of configurations is
+expanded in lockstep under ``vmap`` (one lane per configuration ×
+candidate), deduplicated against a packed fingerprint table in HBM, and
+queued in a device ring buffer — all inside one ``lax.while_loop`` so XLA
+compiles the entire search into a single program with no host round-trips.
+
+Configuration encoding (the "hashing model states on TPU" problem,
+SURVEY.md §7): a naive linearized-set needs n bits per config.  Instead we
+exploit the real-time order:
+
+  * Determinate ops (ok completions; they MUST linearize) are kept sorted
+    by invocation.  In any reachable configuration, if ``p`` is the first
+    unlinearized determinate op, every linearized op j > p was linearized
+    while p was pending, so ``inv[j] < ret[p]``.  The number of such j is
+    bounded and host-computable (``window_width``); hence the set of
+    linearized determinate ops is exactly (prefix ``p``, bitmask over the
+    next W ops).
+  * Indeterminate ops (:info — crashed; ``ret = +inf``; they MAY linearize
+    at any point after invocation, forever — core.clj:387-397) break that
+    bound, so they live in their own bitmask of width ≤ 64; a history has
+    at most #processes of them.
+
+A config is then ``[p | window words | crash words | model state]`` — a
+handful of int32 lanes instead of n bits, so millions of configs fit in
+HBM and hash in a few vector ops.
+
+Soundness: a "valid" verdict always carries a real witness path (every
+transition was model-checked on device).  An "invalid" verdict could in
+principle be wrong if two distinct configs collide in the 64-bit
+fingerprint table (probability ~#configs²/2⁶⁴); callers that need
+certainty re-verify invalid verdicts with the exact host oracle
+(checker/seq.py), which is also how the failure witness is reconstructed.
+
+Batching: `search_batch` vmaps the whole search over a leading key axis —
+the TPU analog of the reference's independent-key sharding
+(jepsen/src/jepsen/independent.clj:247-298, bounded-pmap per key).  The
+key axis shards across a device mesh with `jax.sharding`; searches are
+embarrassingly parallel so the only collective is the final verdict
+gather.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+from ..history import INF_RET, NIL, OpSeq, encode_ops
+from ..models import ModelSpec
+
+# int32 value standing in for "+infinity" event rank on device.
+INF32 = np.int32(2**31 - 1)
+
+# ---------------------------------------------------------------------------
+# Host-side preprocessing
+# ---------------------------------------------------------------------------
+
+
+@dataclass
+class EncodedSearch:
+    """Device-ready arrays for one history (padded to static shapes)."""
+
+    det_f: np.ndarray  # int32 [n_det_pad]
+    det_v1: np.ndarray
+    det_v2: np.ndarray
+    det_inv: np.ndarray  # int32; INF32 padding
+    det_ret: np.ndarray  # int32; INF32 padding
+    suffix_min_ret: np.ndarray  # int32 [n_det_pad + 1]
+    crash_f: np.ndarray  # int32 [n_crash_pad]
+    crash_v1: np.ndarray
+    crash_v2: np.ndarray
+    crash_inv: np.ndarray
+    n_det: int
+    n_crash: int
+    window: int  # exact upper bound on linearized-beyond-prefix span
+    concurrency: int  # max simultaneously-enabled candidates
+
+
+def split_rows(seq: OpSeq):
+    """Partition OpSeq rows into determinate (ok) and crashed (info)."""
+    ok = np.asarray(seq.ok, dtype=bool)
+    det = np.nonzero(ok)[0]
+    crash = np.nonzero(~ok)[0]
+    return det, crash
+
+
+def window_width(det_inv: np.ndarray, det_ret: np.ndarray) -> int:
+    """Exact window bound: max over b of #{j >= b : inv[j] < ret[b]}.
+
+    det rows are sorted by invocation, so the count is a searchsorted.
+    Any linearized determinate op beyond the first unlinearized one b
+    satisfies inv[j] < ret[b]; the window must cover all such j plus b
+    itself.
+    """
+    n = len(det_inv)
+    if n == 0:
+        return 1
+    # positions with inv < ret[b], among indices >= b
+    upper = np.searchsorted(det_inv, det_ret, side="left")
+    spans = upper - np.arange(n)
+    return max(1, int(spans.max()))
+
+
+def max_enabled(seq: OpSeq) -> int:
+    """Upper bound on simultaneously-enabled candidates per config.
+
+    Enabled candidates pairwise overlap in real time (each invoked before
+    every other's return), and pairwise-intersecting intervals on a line
+    share a common point (Helly, d=1), so the count is bounded by the
+    history's max concurrency — crashed ops stay open forever and are
+    counted by the sweep in history.max_concurrency.
+    """
+    events = []
+    for i in range(len(seq)):
+        events.append((int(seq.inv[i]), 1))
+        if int(seq.ret[i]) != INF_RET:
+            events.append((int(seq.ret[i]), -1))
+    events.sort()
+    cur = peak = 0
+    for _, d in events:
+        cur += d
+        peak = max(peak, cur)
+    return max(1, peak)
+
+
+def encode_search(seq: OpSeq) -> EncodedSearch:
+    det_idx, crash_idx = split_rows(seq)
+    det_inv = np.asarray(seq.inv, dtype=np.int64)[det_idx]
+    det_ret64 = np.asarray(seq.ret, dtype=np.int64)[det_idx]
+
+    W = window_width(det_inv, det_ret64)
+    C = max_enabled(seq)
+
+    n_det = len(det_idx)
+    n_crash = len(crash_idx)
+
+    def i32(a):
+        return np.asarray(a, dtype=np.int32)
+
+    det = EncodedSearch(
+        det_f=i32(seq.f[det_idx]),
+        det_v1=i32(seq.v1[det_idx]),
+        det_v2=i32(seq.v2[det_idx]),
+        det_inv=i32(np.minimum(det_inv, INF32)),
+        det_ret=i32(np.minimum(det_ret64, INF32)),
+        suffix_min_ret=np.zeros(0, dtype=np.int32),  # filled below
+        crash_f=i32(seq.f[crash_idx]),
+        crash_v1=i32(seq.v1[crash_idx]),
+        crash_v2=i32(seq.v2[crash_idx]),
+        crash_inv=i32(np.minimum(np.asarray(seq.inv, np.int64)[crash_idx],
+                                 INF32)),
+        n_det=n_det,
+        n_crash=n_crash,
+        window=W,
+        concurrency=C,
+    )
+    # suffix minima of det returns; suffix_min_ret[i] = min(ret[i:]), with
+    # suffix_min_ret[n] = +inf
+    sfx = np.full(n_det + 1, INF32, dtype=np.int32)
+    for i in range(n_det - 1, -1, -1):
+        sfx[i] = min(int(det.det_ret[i]), int(sfx[i + 1]))
+    det.suffix_min_ret = sfx
+    return det
+
+
+def pad_search(es: EncodedSearch, n_det_pad: int, n_crash_pad: int
+               ) -> EncodedSearch:
+    """Pad arrays to static shapes (for jit caching / batching)."""
+
+    def pad(a, n, fill):
+        out = np.full(n, fill, dtype=np.int32)
+        out[: len(a)] = a
+        return out
+
+    return EncodedSearch(
+        det_f=pad(es.det_f, n_det_pad, 0),
+        det_v1=pad(es.det_v1, n_det_pad, NIL),
+        det_v2=pad(es.det_v2, n_det_pad, NIL),
+        det_inv=pad(es.det_inv, n_det_pad, INF32),
+        det_ret=pad(es.det_ret, n_det_pad, INF32),
+        suffix_min_ret=pad(es.suffix_min_ret, n_det_pad + 1, INF32),
+        crash_f=pad(es.crash_f, n_crash_pad, 0),
+        crash_v1=pad(es.crash_v1, n_crash_pad, NIL),
+        crash_v2=pad(es.crash_v2, n_crash_pad, NIL),
+        crash_inv=pad(es.crash_inv, n_crash_pad, INF32),
+        n_det=es.n_det,
+        n_crash=es.n_crash,
+        window=es.window,
+        concurrency=es.concurrency,
+    )
+
+
+# ---------------------------------------------------------------------------
+# Device kernel
+# ---------------------------------------------------------------------------
+
+
+def _hash_words(words, seed):
+    """Vector fnv/murmur-style mix of int32 config words -> uint32.
+
+    words: uint32 [..., w]; returns uint32 [...].
+    """
+    h = jnp.full(words.shape[:-1], np.uint32(seed), dtype=jnp.uint32)
+    w = words.shape[-1]
+    for i in range(w):
+        h = (h ^ words[..., i]) * np.uint32(0x85EBCA6B)
+        h = (h ^ (h >> 13)) * np.uint32(0xC2B2AE35)
+    h = h ^ (h >> 16)
+    return h
+
+
+@dataclass(frozen=True)
+class SearchDims:
+    """Static kernel dimensions (jit cache key)."""
+
+    n_det_pad: int
+    n_crash_pad: int  # multiple of 32, <= 64
+    window: int  # W, multiple of 32
+    k: int  # successor lanes per config (>= max concurrency)
+    state_width: int
+    frontier: int  # F: configs popped per iteration
+    queue: int  # Q: ring buffer capacity
+    table_bits: int  # H = 2**table_bits fingerprint slots
+
+    @property
+    def win_words(self) -> int:
+        return self.window // 32
+
+    @property
+    def crash_words(self) -> int:
+        return max(1, self.n_crash_pad // 32)
+
+    @property
+    def words(self) -> int:
+        # p | win | crash | state
+        return 1 + self.win_words + self.crash_words + self.state_width
+
+
+def _pack_bits(bits, n_words):
+    """bool [..., 32*n_words] -> int32 words [..., n_words]."""
+    shape = bits.shape[:-1]
+    b = bits.reshape(shape + (n_words, 32)).astype(jnp.uint32)
+    shifts = jnp.arange(32, dtype=jnp.uint32)
+    words = (b << shifts).sum(axis=-1, dtype=jnp.uint32)
+    return words.astype(jnp.int32)
+
+
+def _unpack_bits(words, n_words):
+    """int32 words [..., n_words] -> bool [..., 32*n_words]."""
+    shape = words.shape[:-1]
+    w = words.astype(jnp.uint32)[..., :, None]
+    shifts = jnp.arange(32, dtype=jnp.uint32)
+    bits = (w >> shifts) & np.uint32(1)
+    return bits.reshape(shape + (n_words * 32,)).astype(bool)
+
+
+def build_search_fn(model: ModelSpec, dims: SearchDims, budget: int):
+    """Compile the frontier search for one (model, dims) pair.
+
+    Returns fn(arrays...) -> (status, configs, max_depth) where status is
+    2=valid, 1=exhausted (invalid, sound unless overflowed), 0=unknown
+    (budget exceeded or queue overflow).
+    """
+    W = dims.window
+    K = dims.k
+    F = dims.frontier
+    Q = dims.queue
+    H = 1 << dims.table_bits
+    S = dims.state_width
+    WW = dims.win_words
+    CW = dims.crash_words
+    NC = dims.n_crash_pad
+    WORDS = dims.words
+    jstep = model.jstep
+
+    def unpack(cfg):
+        # cfg: int32 [WORDS]
+        p = cfg[0]
+        win = _unpack_bits(cfg[1:1 + WW], WW)  # bool [W]
+        crash = _unpack_bits(cfg[1 + WW:1 + WW + CW], CW)[:NC]  # bool [NC]
+        state = cfg[1 + WW + CW:]
+        return p, win, crash, state
+
+    def pack(p, win, crash, state):
+        crash_pad = jnp.zeros(CW * 32, dtype=bool).at[:NC].set(crash)
+        return jnp.concatenate([
+            p[None].astype(jnp.int32),
+            _pack_bits(win, WW),
+            _pack_bits(crash_pad, CW),
+            state.astype(jnp.int32),
+        ])
+
+    def expand_one(cfg, alive, det_f, det_v1, det_v2, det_inv, det_ret,
+                   sfx_min, crash_f, crash_v1, crash_v2, crash_inv, n_det,
+                   n_crash):
+        """One config -> K packed successors + valid mask + goal mask."""
+        p, win, crash, state = unpack(cfg)
+
+        # --- gather the determinate window ---------------------------------
+        pos = p + jnp.arange(W, dtype=jnp.int32)  # [W]
+        in_range = pos < n_det
+        w_ret = jnp.where(in_range & ~win,
+                          jnp.take(det_ret, pos, mode="clip"), INF32)
+        w_inv = jnp.where(in_range,
+                          jnp.take(det_inv, pos, mode="clip"), INF32)
+        # min/second-min of unlinearized det returns within the window
+        m1 = jnp.min(w_ret)
+        am = jnp.argmin(w_ret)
+        w_ret_excl = w_ret.at[am].set(INF32)
+        m2 = jnp.min(w_ret_excl)
+        sfx = jnp.take(sfx_min, jnp.minimum(p + W, n_det), mode="clip")
+        # total min over unlinearized det rets (crash rets are +inf)
+        m1_tot = jnp.minimum(m1, sfx)
+
+        # --- enabled determinate candidates --------------------------------
+        lanes = jnp.arange(W, dtype=jnp.int32)
+        excl_w = jnp.where(lanes == am, m2, m1)
+        excl_tot = jnp.minimum(excl_w, sfx)
+        det_enabled = in_range & ~win & (w_inv < excl_tot)
+
+        # --- enabled crashed candidates ------------------------------------
+        c_lanes = jnp.arange(NC, dtype=jnp.int32)
+        c_enabled = (c_lanes < n_crash) & ~crash & (crash_inv < m1_tot)
+
+        # --- compact candidates to K lanes ---------------------------------
+        enabled = jnp.concatenate([det_enabled, c_enabled])  # [W+NC]
+        # stable argsort puts enabled (0) before disabled (1)
+        order = jnp.argsort(jnp.where(enabled, 0, 1), stable=True)[:K]
+        cand = order  # candidate ids; < W => det lane, >= W => crash lane
+        cand_on = jnp.take(enabled, cand)
+
+        is_det = cand < W
+        det_pos = jnp.clip(p + cand, 0, dims.n_det_pad - 1)
+        c_id = jnp.clip(cand - W, 0, NC - 1)
+        cf = jnp.where(is_det, jnp.take(det_f, det_pos),
+                       jnp.take(crash_f, c_id))
+        cv1 = jnp.where(is_det, jnp.take(det_v1, det_pos),
+                        jnp.take(crash_v1, c_id))
+        cv2 = jnp.where(is_det, jnp.take(det_v2, det_pos),
+                        jnp.take(crash_v2, c_id))
+
+        # --- model step for each candidate ---------------------------------
+        st = jnp.broadcast_to(state, (K, S))
+        new_state, legal = jax.vmap(jstep)(st, cf, cv1, cv2)
+        valid = alive & cand_on & legal
+
+        # --- build successor configs ---------------------------------------
+        def succ(ci, ns):
+            lane = cand[ci]
+            d_lane = jnp.clip(lane, 0, W - 1)
+            new_win = win.at[d_lane].set(True)
+            # normalize: advance p past the run of linearized at window head
+            run = jnp.cumprod(new_win.astype(jnp.int32))
+            shift = jnp.sum(run).astype(jnp.int32)
+            rolled = jnp.roll(new_win, -shift)
+            tail_clear = jnp.arange(W) < (W - shift)
+            norm_win = rolled & tail_clear
+            is_d = lane < W
+            p2 = jnp.where(is_d, p + shift, p)
+            win2 = jnp.where(is_d, norm_win, win)
+            cl = jnp.clip(lane - W, 0, NC - 1)
+            crash2 = jnp.where(is_d, crash, crash.at[cl].set(True))
+            return pack(p2, win2, crash2, ns), p2
+
+        cfgs, p2s = jax.vmap(succ)(jnp.arange(K), new_state)
+        goal = valid & (p2s >= n_det)
+        return cfgs, valid, goal, p2s
+
+    expand = jax.vmap(expand_one, in_axes=(0, 0) + (None,) * 12)
+
+    def search(det_f, det_v1, det_v2, det_inv, det_ret, sfx_min,
+               crash_f, crash_v1, crash_v2, crash_inv, n_det, n_crash,
+               init_state):
+        # initial config
+        init_cfg = pack(jnp.int32(0), jnp.zeros(W, bool),
+                        jnp.zeros(NC, bool), init_state)
+        queue = jnp.zeros((Q, WORDS), dtype=jnp.int32).at[0].set(init_cfg)
+
+        words_u = init_cfg.astype(jnp.uint32)
+        h1 = _hash_words(words_u[None], 0x9E3779B1)[0]
+        h1 = jnp.where(h1 == 0, np.uint32(1), h1)
+        h2 = _hash_words(words_u[None], 0x5BD1E995)[0]
+        slot0 = (h1 & np.uint32(H - 1)).astype(jnp.int32)
+        th1 = jnp.zeros(H, dtype=jnp.uint32).at[slot0].set(h1)
+        th2 = jnp.zeros(H, dtype=jnp.uint32).at[slot0].set(h2)
+
+        # carried: queue, head, tail, th1, th2, status, configs, max_depth,
+        # overflow
+        # status: -1 running, 2 valid, 1 exhausted, 0 budget
+        carry0 = (queue, jnp.int32(0), jnp.int32(1), th1, th2,
+                  jnp.int32(-1), jnp.int32(0), jnp.int32(0), jnp.bool_(False))
+
+        def cond(c):
+            _, head, tail, _, _, status, configs, _, _ = c
+            return (status == -1) & (tail > head) & (configs < budget)
+
+        def body(c):
+            queue, head, tail, th1, th2, status, configs, max_depth, ovf = c
+            size = tail - head
+            take = jnp.minimum(size, F)
+            idx = (head + jnp.arange(F, dtype=jnp.int32)) % Q
+            alive = jnp.arange(F) < take
+            batch = queue[idx]  # [F, WORDS]
+
+            cfgs, valid, goal, p2s = expand(
+                batch, alive, det_f, det_v1, det_v2, det_inv, det_ret,
+                sfx_min, crash_f, crash_v1, crash_v2, crash_inv, n_det,
+                n_crash)
+            # flatten successor axis
+            cfgs = cfgs.reshape(F * K, WORDS)
+            valid = valid.reshape(F * K)
+            found = jnp.any(goal)
+
+            # --- fingerprints + batch dedup --------------------------------
+            wu = cfgs.astype(jnp.uint32)
+            h1 = _hash_words(wu, 0x9E3779B1)
+            h1 = jnp.where(h1 == 0, np.uint32(1), h1)
+            h2 = _hash_words(wu, 0x5BD1E995)
+            big = np.uint32(0xFFFFFFFF)
+            h1s = jnp.where(valid, h1, big)
+            h2s = jnp.where(valid, h2, big)
+            sh1, sh2, perm = lax.sort(
+                (h1s, h2s, jnp.arange(F * K, dtype=jnp.int32)), num_keys=2)
+            dup = jnp.concatenate([
+                jnp.zeros(1, bool),
+                (sh1[1:] == sh1[:-1]) & (sh2[1:] == sh2[:-1])])
+            svalid = jnp.take(valid, perm) & ~dup
+            scfgs = jnp.take(cfgs, perm, axis=0)
+            sp2 = jnp.take(p2s.reshape(F * K), perm)
+
+            # --- visited-table probe ---------------------------------------
+            slot = (sh1 & np.uint32(H - 1)).astype(jnp.int32)
+            hit = (th1[slot] == sh1) & (th2[slot] == sh2)
+            svalid = svalid & ~hit
+            ins = jnp.where(svalid, slot, H)
+            th1 = th1.at[ins].set(sh1, mode="drop")
+            th2 = th2.at[ins].set(sh2, mode="drop")
+
+            # --- compact + push into ring buffer ---------------------------
+            corder = jnp.argsort(jnp.where(svalid, 0, 1), stable=True)
+            ccfgs = jnp.take(scfgs, corder, axis=0)
+            count = jnp.sum(svalid, dtype=jnp.int32)
+            space = Q - (tail - head - take)  # free slots after this pop
+            push = jnp.minimum(count, space)
+            ovf = ovf | (count > space)
+            dest = jnp.where(jnp.arange(F * K) < push,
+                             (tail + jnp.arange(F * K, dtype=jnp.int32)) % Q,
+                             Q)
+            queue = queue.at[dest].set(ccfgs, mode="drop")
+
+            configs = configs + take
+            max_depth = jnp.maximum(max_depth, jnp.max(
+                jnp.where(svalid, sp2, 0)))
+            max_depth = jnp.maximum(
+                max_depth, jnp.max(jnp.where(alive, batch[:, 0], 0)))
+            status = jnp.where(found, 2, status)
+            return (queue, head + take, tail + push, th1, th2, status,
+                    configs, max_depth, ovf)
+
+        (queue, head, tail, th1, th2, status, configs, max_depth, ovf) = \
+            lax.while_loop(cond, body, carry0)
+
+        # exhausted queue with no goal: invalid if we never overflowed,
+        # otherwise unknown.  budget exceeded: unknown.
+        status = jnp.where(
+            status == -1,
+            jnp.where(tail <= head, jnp.where(ovf, 0, 1), 0),
+            status)
+        return status, configs, max_depth
+
+    return search
+
+
+# ---------------------------------------------------------------------------
+# Host driver
+# ---------------------------------------------------------------------------
+
+_KERNEL_CACHE: dict = {}
+
+
+def _round_up(x: int, m: int) -> int:
+    return ((max(1, x) + m - 1) // m) * m
+
+
+def get_kernel(model: ModelSpec, dims: SearchDims, budget: int):
+    key = (model.name, dims, budget)
+    fn = _KERNEL_CACHE.get(key)
+    if fn is None:
+        fn = jax.jit(build_search_fn(model, dims, budget))
+        _KERNEL_CACHE[key] = fn
+    return fn
+
+
+def _next_pow2(x: int) -> int:
+    return 1 << max(0, (x - 1)).bit_length()
+
+
+def choose_dims(es: EncodedSearch, model: ModelSpec, *,
+                frontier: int | None = None,
+                queue: int | None = None,
+                table_bits: int | None = None) -> SearchDims:
+    """Pick kernel dimensions, quantized (powers of two / multiples of 32)
+    so that differently-sized histories share compiled kernels."""
+    W = _round_up(es.window, 32)
+    NC = _round_up(es.n_crash, 32) if es.n_crash else 32
+    K = _next_pow2(min(es.concurrency, W + es.n_crash))
+    if frontier is None:
+        frontier = max(32, min(2048, _next_pow2(es.n_det + es.n_crash)))
+    if queue is None:
+        queue = frontier * 64
+    if table_bits is None:
+        table_bits = max(12, min(22, (frontier * 64).bit_length()))
+    return SearchDims(
+        n_det_pad=max(64, _next_pow2(es.n_det)),
+        n_crash_pad=NC,
+        window=W,
+        k=max(1, K),
+        state_width=model.state_width,
+        frontier=frontier,
+        queue=queue,
+        table_bits=table_bits,
+    )
+
+
+#: statuses
+VALID, INVALID, UNKNOWN = 2, 1, 0
+_STATUS = {2: True, 1: False, 0: "unknown"}
+
+#: refuse device search past these (fall back to host oracle)
+MAX_WINDOW = 512
+MAX_CRASH = 64
+
+
+def search_opseq(seq: OpSeq, model: ModelSpec, *,
+                 budget: int = 20_000_000,
+                 dims: SearchDims | None = None) -> dict:
+    """Check one columnar history on device.  Returns a knossos-style map
+    {"valid": True|False|"unknown", "configs": n, "max_depth": d}."""
+    es = encode_search(seq)
+    if es.n_det == 0 and es.n_crash == 0:
+        return {"valid": True, "configs": 0, "max_depth": 0,
+                "engine": "trivial"}
+    if es.window > MAX_WINDOW or es.n_crash > MAX_CRASH:
+        from . import seq as seqmod
+        out = seqmod.check_opseq(seq, model)
+        out["engine"] = "host-oracle(fallback)"
+        return out
+
+    dims = dims or choose_dims(es, model)
+    esp = pad_search(es, dims.n_det_pad, dims.n_crash_pad)
+    fn = get_kernel(model, dims, budget)
+    status, configs, max_depth = fn(
+        jnp.asarray(esp.det_f), jnp.asarray(esp.det_v1),
+        jnp.asarray(esp.det_v2), jnp.asarray(esp.det_inv),
+        jnp.asarray(esp.det_ret), jnp.asarray(esp.suffix_min_ret),
+        jnp.asarray(esp.crash_f), jnp.asarray(esp.crash_v1),
+        jnp.asarray(esp.crash_v2), jnp.asarray(esp.crash_inv),
+        jnp.int32(es.n_det), jnp.int32(es.n_crash),
+        jnp.asarray(np.asarray(model.init, dtype=np.int32)))
+    status = int(status)
+    return {"valid": _STATUS[status], "configs": int(configs),
+            "max_depth": int(max_depth), "engine": "tpu",
+            "window": es.window, "concurrency": es.concurrency}
+
+
+# ---------------------------------------------------------------------------
+# Checker wrapper (drop-in for checker/linearizable, checker.clj:114-139)
+# ---------------------------------------------------------------------------
+
+
+class Linearizable:
+    """Linearizability checker backed by the device engine.
+
+    The reference's `linearizable` checker hands the model + indexed
+    history to knossos and truncates the failure analysis for reporting
+    (checker.clj:114-139).  Here:
+
+      * histories below `host_threshold` logical ops run on the exact host
+        oracle (device dispatch has fixed overhead);
+      * larger histories run the device search;
+      * an invalid device verdict is re-verified (and a witness frontier
+        extracted) by the host oracle when the history is small enough to
+        afford it, closing the fingerprint-collision soundness hole.
+
+    ``model`` may be given at construction or ride in test["model"].
+    """
+
+    name = "linearizable"
+
+    def __init__(self, model: ModelSpec | None = None, *,
+                 budget: int = 20_000_000,
+                 host_threshold: int = 48,
+                 witness_threshold: int = 3000):
+        self.model = model
+        self.budget = budget
+        self.host_threshold = host_threshold
+        self.witness_threshold = witness_threshold
+
+    def check(self, test, history, opts=None):
+        from . import seq as seqmod
+
+        model = self.model or test.get("model")
+        if model is None:
+            raise ValueError("linearizable checker needs a model")
+        seq = history if isinstance(history, OpSeq) else \
+            encode_ops(history, model.f_codes)
+
+        if len(seq) <= self.host_threshold:
+            out = seqmod.check_opseq(seq, model)
+            out["engine"] = "host-oracle"
+            return out
+
+        out = search_opseq(seq, model, budget=self.budget)
+        if out["valid"] is False and len(seq) <= self.witness_threshold:
+            # exact confirmation + witness for the report
+            confirm = seqmod.check_opseq(seq, model)
+            confirm["engine"] = out["engine"] + "+host-witness"
+            confirm["device_configs"] = out["configs"]
+            return confirm
+        return out
+
+    def __call__(self, test, history, opts=None):
+        return self.check(test, history, opts)
+
+
+def linearizable(model: ModelSpec | None = None, **kw) -> Linearizable:
+    return Linearizable(model, **kw)

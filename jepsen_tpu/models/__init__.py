@@ -198,9 +198,23 @@ def _noop_jstep(state, f, v1, v2):
     return state, jnp.bool_(True)
 
 
+class _AnyFCodes(dict):
+    """f_codes table accepting every f name (all map to code 0), so the
+    noop model really does admit arbitrary histories through encode_ops."""
+
+    def __contains__(self, key):  # noqa: D105
+        return True
+
+    def __getitem__(self, key):
+        return super().get(key, 0)
+
+    def __missing__(self, key):
+        return 0
+
+
 def noop() -> ModelSpec:
     return ModelSpec(
-        name="noop", f_codes={}, state_width=1, init=(0,),
+        name="noop", f_codes=_AnyFCodes(), state_width=1, init=(0,),
         pystep=_noop_pystep, jstep=_noop_jstep,
         doc="accepts every operation",
     )
@@ -233,7 +247,8 @@ def multi_register(width: int, initial: int = 0) -> ModelSpec:
         cur = state[key]
         read_legal = in_range & ((v2 == NIL) | (v2 == cur))
         legal = jnp.where(f == R_READ, read_legal, in_range)
-        new_state = jnp.where(f == R_WRITE,
+        # illegal steps must leave state unchanged (the engine relies on it)
+        new_state = jnp.where((f == R_WRITE) & in_range,
                               state.at[key].set(v2), state)
         return new_state, legal
 

@@ -114,3 +114,25 @@ def test_pystep_jstep_agree(name):
 def test_noop_accepts_everything():
     m = noop()
     assert m.pystep((0,), 0, 1, 2) == (0,)
+
+
+def test_noop_accepts_any_f_through_encode_ops():
+    from jepsen_tpu.history import encode_ops, invoke_op, ok_op
+    from jepsen_tpu.models import noop
+
+    m = noop()
+    h = [invoke_op(0, "frobnicate", 1), ok_op(0, "frobnicate", 1)]
+    s = encode_ops(h, m.f_codes)
+    assert len(s) == 1
+    assert m.pystep(m.init, 0, 1, 1) == m.init
+
+
+def test_multi_register_illegal_write_leaves_state():
+    import jax.numpy as jnp
+    from jepsen_tpu.models import R_WRITE, multi_register
+
+    m = multi_register(3)
+    st = jnp.zeros(3, dtype=jnp.int32)
+    new, legal = m.jstep(st, jnp.int32(R_WRITE), jnp.int32(5), jnp.int32(9))
+    assert not bool(legal)
+    assert (new == st).all()

@@ -1,0 +1,91 @@
+"""Headline benchmark: linearizability-check throughput on device.
+
+Prints ONE JSON line:
+  {"metric": ..., "value": N, "unit": "ops/s", "vs_baseline": N}
+
+The BASELINE.md north star is a 10k-op, 32-process CAS-register history
+(the knossos worst case is the search, not the I/O).  The reference's
+checker is knossos on a JVM sized -Xmx32g (jepsen/project.clj:25); no JVM
+exists in this image, so the stand-in baseline is this repo's exact host
+oracle (checker/seq.py — the same Wing-Gong/Lowe configuration search
+knossos.wgl performs, with the same memoization), measured on the same
+history and normalized per-configuration:
+
+    vs_baseline = (device configs/sec) / (host-oracle configs/sec)
+
+Both engines dedup over the identical configuration space, so configs/sec
+is apples-to-apples; the history is corrupted near its end so both must
+sweep the space rather than lucky-dive (DFS on a valid history can dive
+straight to the goal, which measures luck, not throughput).
+"""
+
+import json
+import os
+import random
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+QUICK = "--quick" in sys.argv
+
+
+def main():
+    from jepsen_tpu.checker import linearizable as lin
+    from jepsen_tpu.checker import seq as oracle
+    from jepsen_tpu.history import encode_ops
+    from jepsen_tpu.models import cas_register
+    from jepsen_tpu.synth import corrupt_read, register_history
+
+    rng = random.Random(42)
+    n_ops = 1_000 if QUICK else 10_000
+    model = cas_register()
+    h = register_history(rng, n_ops=n_ops, n_procs=32, overlap=8,
+                         crash_p=0.002, max_crashes=8, n_values=4)
+    h = corrupt_read(rng, h, at=0.98)
+    seq = encode_ops(h, model.f_codes)
+
+    # --- device search (first run compiles; second run is timed) ----------
+    budget = 2_000_000 if QUICK else 50_000_000
+    out = lin.search_opseq(seq, model, budget=budget)
+    t0 = time.perf_counter()
+    out = lin.search_opseq(seq, model, budget=budget)
+    t_dev = time.perf_counter() - t0
+    dev_rate = out["configs"] / t_dev if t_dev > 0 else float("inf")
+
+    # --- host-oracle baseline (capped; throughput extrapolates) -----------
+    cap = 200_000 if QUICK else 1_000_000
+    t0 = time.perf_counter()
+    ref = oracle.check_opseq(seq, model, max_configs=cap)
+    t_ref = time.perf_counter() - t0
+    ref_rate = ref["configs"] / t_ref if t_ref > 0 else float("inf")
+
+    ops_per_sec = len(seq) / t_dev if t_dev > 0 else float("inf")
+    result = {
+        "metric": "ops-verified/sec, 10k-op 32-proc CAS-register history "
+                  "(invalid tail; full state-space sweep)",
+        "value": round(ops_per_sec, 1),
+        "unit": "ops/s",
+        "vs_baseline": round(dev_rate / ref_rate, 2) if ref_rate else None,
+        "detail": {
+            "n_ops": len(seq),
+            "device_seconds": round(t_dev, 3),
+            "device_configs": out["configs"],
+            "device_verdict": out["valid"],
+            "device_configs_per_sec": round(dev_rate, 1),
+            "oracle_seconds": round(t_ref, 3),
+            "oracle_configs": ref["configs"],
+            "oracle_verdict": ref["valid"],
+            "oracle_configs_per_sec": round(ref_rate, 1),
+            "window": out.get("window"),
+            "concurrency": out.get("concurrency"),
+            "backend": None,
+        },
+    }
+    import jax
+    result["detail"]["backend"] = jax.devices()[0].platform
+    print(json.dumps(result))
+
+
+if __name__ == "__main__":
+    main()

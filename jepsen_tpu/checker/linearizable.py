@@ -229,9 +229,7 @@ class SearchDims:
     window: int  # W, multiple of 32
     k: int  # successor lanes per config (>= max concurrency)
     state_width: int
-    frontier: int  # F: configs popped per iteration
-    queue: int  # Q: ring buffer capacity
-    table_bits: int  # H = 2**table_bits fingerprint slots
+    frontier: int  # F: max configs per BFS level
 
     @property
     def win_words(self) -> int:
@@ -268,15 +266,21 @@ def _unpack_bits(words, n_words):
 def build_search_fn(model: ModelSpec, dims: SearchDims, budget: int):
     """Compile the frontier search for one (model, dims) pair.
 
-    Returns fn(arrays...) -> (status, configs, max_depth) where status is
-    2=valid, 1=exhausted (invalid, sound unless overflowed), 0=unknown
-    (budget exceeded or queue overflow).
+    Level-synchronous BFS with a double-buffered frontier: a configuration
+    at depth d (d = ops linearized) can only ever be generated at level d,
+    so deduplication never needs to cross levels — there is no global
+    visited table, and per-level dedup is a sort plus an exact neighbor
+    compare on the full config words (no fingerprint-collision soundness
+    hole, and no random-index scatters, which TPUs serialize).
+
+    Returns fn(arrays...) -> (status, configs, max_depth, overflowed):
+    status 2=valid, 1=frontier died out (invalid; sound iff not
+    overflowed), 0=unknown (budget exceeded, or overflow made an
+    exhausted search inconclusive).
     """
     W = dims.window
     K = dims.k
     F = dims.frontier
-    Q = dims.queue
-    H = 1 << dims.table_bits
     S = dims.state_width
     WW = dims.win_words
     CW = dims.crash_words
@@ -382,102 +386,75 @@ def build_search_fn(model: ModelSpec, dims: SearchDims, budget: int):
     def search(det_f, det_v1, det_v2, det_inv, det_ret, sfx_min,
                crash_f, crash_v1, crash_v2, crash_inv, n_det, n_crash,
                init_state):
-        # initial config
+        # initial config occupies frontier row 0
         init_cfg = pack(jnp.int32(0), jnp.zeros(W, bool),
                         jnp.zeros(NC, bool), init_state)
-        queue = jnp.zeros((Q, WORDS), dtype=jnp.int32).at[0].set(init_cfg)
+        frontier = jnp.zeros((F, WORDS), dtype=jnp.int32).at[0].set(init_cfg)
 
-        words_u = init_cfg.astype(jnp.uint32)
-        h1 = _hash_words(words_u[None], 0x9E3779B1)[0]
-        h1 = jnp.where(h1 == 0, np.uint32(1), h1)
-        h2 = _hash_words(words_u[None], 0x5BD1E995)[0]
-        slot0 = (h1 & np.uint32(H - 1)).astype(jnp.int32)
-        th1 = jnp.zeros(H, dtype=jnp.uint32).at[slot0].set(h1)
-        th2 = jnp.zeros(H, dtype=jnp.uint32).at[slot0].set(h2)
-
-        # carried: queue, head, tail, th1, th2, status, configs, max_depth,
-        # overflow
-        # status: -1 running, 2 valid, 1 exhausted, 0 budget
-        carry0 = (queue, jnp.int32(0), jnp.int32(1), th1, th2,
-                  jnp.int32(-1), jnp.int32(0), jnp.int32(0), jnp.bool_(False))
+        # carried: frontier, count, status, configs, max_depth, overflow
+        # status: -1 running, 2 valid, 1 frontier died out, 0 budget
+        carry0 = (frontier, jnp.int32(1), jnp.int32(-1), jnp.int32(0),
+                  jnp.int32(0), jnp.bool_(False))
 
         def cond(c):
-            _, head, tail, _, _, status, configs, _, _ = c
-            return (status == -1) & (tail > head) & (configs < budget)
+            _, count, status, configs, _, _ = c
+            return (status == -1) & (count > 0) & (configs < budget)
 
         def body(c):
-            queue, head, tail, th1, th2, status, configs, max_depth, ovf = c
-            size = tail - head
-            take = jnp.minimum(size, F)
-            idx = (head + jnp.arange(F, dtype=jnp.int32)) % Q
-            alive = jnp.arange(F) < take
-            batch = queue[idx]  # [F, WORDS]
+            frontier, count, status, configs, max_depth, ovf = c
+            alive = jnp.arange(F) < count
 
             cfgs, valid, goal, p2s = expand(
-                batch, alive, det_f, det_v1, det_v2, det_inv, det_ret,
+                frontier, alive, det_f, det_v1, det_v2, det_inv, det_ret,
                 sfx_min, crash_f, crash_v1, crash_v2, crash_inv, n_det,
                 n_crash)
-            # flatten successor axis
             cfgs = cfgs.reshape(F * K, WORDS)
             valid = valid.reshape(F * K)
             found = jnp.any(goal)
 
-            # --- fingerprints + batch dedup --------------------------------
+            # --- level dedup: hash-sort, then exact neighbor compare -------
+            # Identical configs share (h1,h2) and sort adjacent (up to
+            # hash collisions, which only cost duplicate work, never
+            # correctness: dedup requires full-word equality).
             wu = cfgs.astype(jnp.uint32)
             h1 = _hash_words(wu, 0x9E3779B1)
-            h1 = jnp.where(h1 == 0, np.uint32(1), h1)
             h2 = _hash_words(wu, 0x5BD1E995)
             big = np.uint32(0xFFFFFFFF)
             h1s = jnp.where(valid, h1, big)
             h2s = jnp.where(valid, h2, big)
             sh1, sh2, perm = lax.sort(
                 (h1s, h2s, jnp.arange(F * K, dtype=jnp.int32)), num_keys=2)
-            dup = jnp.concatenate([
-                jnp.zeros(1, bool),
-                (sh1[1:] == sh1[:-1]) & (sh2[1:] == sh2[:-1])])
-            svalid = jnp.take(valid, perm) & ~dup
+            svalid = jnp.take(valid, perm)
             scfgs = jnp.take(cfgs, perm, axis=0)
-            sp2 = jnp.take(p2s.reshape(F * K), perm)
+            same_hash = (sh1[1:] == sh1[:-1]) & (sh2[1:] == sh2[:-1])
+            same_cfg = jnp.all(scfgs[1:] == scfgs[:-1], axis=1)
+            dup = jnp.concatenate([jnp.zeros(1, bool), same_hash & same_cfg])
+            svalid = svalid & ~dup
 
-            # --- visited-table probe ---------------------------------------
-            slot = (sh1 & np.uint32(H - 1)).astype(jnp.int32)
-            hit = (th1[slot] == sh1) & (th2[slot] == sh2)
-            svalid = svalid & ~hit
-            ins = jnp.where(svalid, slot, H)
-            th1 = th1.at[ins].set(sh1, mode="drop")
-            th2 = th2.at[ins].set(sh2, mode="drop")
-
-            # --- compact + push into ring buffer ---------------------------
+            # --- compact into the next frontier ----------------------------
             corder = jnp.argsort(jnp.where(svalid, 0, 1), stable=True)
             ccfgs = jnp.take(scfgs, corder, axis=0)
-            count = jnp.sum(svalid, dtype=jnp.int32)
-            space = Q - (tail - head - take)  # free slots after this pop
-            push = jnp.minimum(count, space)
-            ovf = ovf | (count > space)
-            dest = jnp.where(jnp.arange(F * K) < push,
-                             (tail + jnp.arange(F * K, dtype=jnp.int32)) % Q,
-                             Q)
-            queue = queue.at[dest].set(ccfgs, mode="drop")
+            new_count = jnp.sum(svalid, dtype=jnp.int32)
+            ovf = ovf | (new_count > F)
+            new_count = jnp.minimum(new_count, F)
+            new_frontier = ccfgs[:F]
 
-            configs = configs + take
+            configs = configs + count
             max_depth = jnp.maximum(max_depth, jnp.max(
-                jnp.where(svalid, sp2, 0)))
-            max_depth = jnp.maximum(
-                max_depth, jnp.max(jnp.where(alive, batch[:, 0], 0)))
+                jnp.where(alive, frontier[:, 0], 0)))
             status = jnp.where(found, 2, status)
-            return (queue, head + take, tail + push, th1, th2, status,
-                    configs, max_depth, ovf)
+            return (new_frontier, new_count, status, configs, max_depth, ovf)
 
-        (queue, head, tail, th1, th2, status, configs, max_depth, ovf) = \
+        (frontier, count, status, configs, max_depth, ovf) = \
             lax.while_loop(cond, body, carry0)
 
-        # exhausted queue with no goal: invalid if we never overflowed,
+        # frontier died out with no goal: invalid if we never overflowed,
         # otherwise unknown.  budget exceeded: unknown.
         status = jnp.where(
             status == -1,
-            jnp.where(tail <= head, jnp.where(ovf, 0, 1), 0),
+            jnp.where(count <= 0, jnp.where(ovf, 0, 1), 0),
             status)
-        return status, configs, max_depth
+        return status, configs, max_depth, ovf
 
     return search
 
@@ -507,20 +484,14 @@ def _next_pow2(x: int) -> int:
 
 
 def choose_dims(es: EncodedSearch, model: ModelSpec, *,
-                frontier: int | None = None,
-                queue: int | None = None,
-                table_bits: int | None = None) -> SearchDims:
+                frontier: int | None = None) -> SearchDims:
     """Pick kernel dimensions, quantized (powers of two / multiples of 32)
     so that differently-sized histories share compiled kernels."""
     W = _round_up(es.window, 32)
     NC = _round_up(es.n_crash, 32) if es.n_crash else 32
     K = _next_pow2(min(es.concurrency, W + es.n_crash))
     if frontier is None:
-        frontier = max(32, min(2048, _next_pow2(es.n_det + es.n_crash)))
-    if queue is None:
-        queue = frontier * 64
-    if table_bits is None:
-        table_bits = max(12, min(22, (frontier * 64).bit_length()))
+        frontier = max(64, min(4096, _next_pow2(es.n_det + es.n_crash)))
     return SearchDims(
         n_det_pad=max(64, _next_pow2(es.n_det)),
         n_crash_pad=NC,
@@ -528,8 +499,6 @@ def choose_dims(es: EncodedSearch, model: ModelSpec, *,
         k=max(1, K),
         state_width=model.state_width,
         frontier=frontier,
-        queue=queue,
-        table_bits=table_bits,
     )
 
 
@@ -540,6 +509,24 @@ _STATUS = {2: True, 1: False, 0: "unknown"}
 #: refuse device search past these (fall back to host oracle)
 MAX_WINDOW = 512
 MAX_CRASH = 64
+
+
+#: frontier escalation ladder: retry with a wider frontier when a level
+#: overflowed and the verdict came back inconclusive
+MAX_FRONTIER = 1 << 17
+
+
+def _run_kernel(esp: EncodedSearch, es: EncodedSearch, model: ModelSpec,
+                dims: SearchDims, budget: int):
+    fn = get_kernel(model, dims, budget)
+    return fn(
+        jnp.asarray(esp.det_f), jnp.asarray(esp.det_v1),
+        jnp.asarray(esp.det_v2), jnp.asarray(esp.det_inv),
+        jnp.asarray(esp.det_ret), jnp.asarray(esp.suffix_min_ret),
+        jnp.asarray(esp.crash_f), jnp.asarray(esp.crash_v1),
+        jnp.asarray(esp.crash_v2), jnp.asarray(esp.crash_inv),
+        jnp.int32(es.n_det), jnp.int32(es.n_crash),
+        jnp.asarray(np.asarray(model.init, dtype=np.int32)))
 
 
 def search_opseq(seq: OpSeq, model: ModelSpec, *,
@@ -559,24 +546,127 @@ def search_opseq(seq: OpSeq, model: ModelSpec, *,
 
     dims = dims or choose_dims(es, model)
     esp = pad_search(es, dims.n_det_pad, dims.n_crash_pad)
-    fn = get_kernel(model, dims, budget)
-    status, configs, max_depth = fn(
-        jnp.asarray(esp.det_f), jnp.asarray(esp.det_v1),
-        jnp.asarray(esp.det_v2), jnp.asarray(esp.det_inv),
-        jnp.asarray(esp.det_ret), jnp.asarray(esp.suffix_min_ret),
-        jnp.asarray(esp.crash_f), jnp.asarray(esp.crash_v1),
-        jnp.asarray(esp.crash_v2), jnp.asarray(esp.crash_inv),
-        jnp.int32(es.n_det), jnp.int32(es.n_crash),
-        jnp.asarray(np.asarray(model.init, dtype=np.int32)))
-    status = int(status)
+    while True:
+        status, configs, max_depth, ovf = _run_kernel(
+            esp, es, model, dims, budget)
+        status = int(status)
+        # a level overflowed the frontier and the search didn't prove
+        # validity: escalate to a wider frontier and re-run
+        if status == UNKNOWN and bool(ovf) and dims.frontier < MAX_FRONTIER:
+            dims = SearchDims(**{**dims.__dict__,
+                                 "frontier": min(dims.frontier * 8,
+                                                 MAX_FRONTIER)})
+            continue
+        break
     return {"valid": _STATUS[status], "configs": int(configs),
             "max_depth": int(max_depth), "engine": "tpu",
+            "frontier": dims.frontier,
             "window": es.window, "concurrency": es.concurrency}
 
 
 # ---------------------------------------------------------------------------
 # Checker wrapper (drop-in for checker/linearizable, checker.clj:114-139)
 # ---------------------------------------------------------------------------
+
+
+# ---------------------------------------------------------------------------
+# Batched search — vmap over independent keys, sharded over a device mesh
+# ---------------------------------------------------------------------------
+
+
+def batch_dims(ess: list[EncodedSearch], model: ModelSpec, *,
+               frontier: int = 256) -> SearchDims:
+    """Common static dims covering every history in the batch."""
+    W = _round_up(max(e.window for e in ess), 32)
+    ncr = max(e.n_crash for e in ess)
+    NC = _round_up(ncr, 32) if ncr else 32
+    K = _next_pow2(max(1, min(max(e.concurrency for e in ess),
+                              W + ncr)))
+    nd = max(64, _next_pow2(max(e.n_det for e in ess)))
+    return SearchDims(
+        n_det_pad=nd, n_crash_pad=NC, window=W, k=K,
+        state_width=model.state_width, frontier=frontier)
+
+
+def get_batch_kernel(model: ModelSpec, dims: SearchDims, budget: int):
+    key = ("batch", model.name, dims, budget)
+    fn = _KERNEL_CACHE.get(key)
+    if fn is None:
+        fn = jax.jit(jax.vmap(build_search_fn(model, dims, budget)))
+        _KERNEL_CACHE[key] = fn
+    return fn
+
+
+def stack_batch(seqs: list[OpSeq], model: ModelSpec, dims: SearchDims):
+    """Encode + pad every history and stack along a leading key axis."""
+    ess = [pad_search(encode_search(s), dims.n_det_pad, dims.n_crash_pad)
+           for s in seqs]
+
+    def st(attr):
+        return jnp.asarray(np.stack([getattr(e, attr) for e in ess]))
+
+    init = np.broadcast_to(
+        np.asarray(model.init, dtype=np.int32),
+        (len(ess), model.state_width))
+    return (st("det_f"), st("det_v1"), st("det_v2"), st("det_inv"),
+            st("det_ret"), st("suffix_min_ret"), st("crash_f"),
+            st("crash_v1"), st("crash_v2"), st("crash_inv"),
+            jnp.asarray(np.array([e.n_det for e in ess], np.int32)),
+            jnp.asarray(np.array([e.n_crash for e in ess], np.int32)),
+            jnp.asarray(init))
+
+
+def search_batch(seqs: list[OpSeq], model: ModelSpec, *,
+                 budget: int = 2_000_000,
+                 dims: SearchDims | None = None,
+                 sharding=None) -> list[dict]:
+    """Check a batch of independent per-key histories in one device call.
+
+    This is the TPU analog of jepsen.independent's bounded-pmap over
+    per-key subhistories (independent.clj:247-298): the key axis becomes a
+    batch dimension, vmap'd in one compiled search; pass a
+    ``jax.sharding.NamedSharding`` (key axis) to spread the batch over a
+    mesh — searches are embarrassingly parallel, so XLA partitions them
+    with no communication beyond the verdict gather.
+    """
+    if not seqs:
+        return []
+    ess = [encode_search(s) for s in seqs]
+    hard = [i for i, e in enumerate(ess)
+            if e.window > MAX_WINDOW or e.n_crash > MAX_CRASH]
+    if hard:
+        # outliers fall back to individual host checks
+        from . import seq as seqmod
+        out = []
+        for i, s in enumerate(seqs):
+            if i in hard:
+                r = seqmod.check_opseq(s, model)
+                r["engine"] = "host-oracle(fallback)"
+                out.append(r)
+            else:
+                out.append(search_opseq(s, model, budget=budget))
+        return out
+
+    dims = dims or batch_dims(ess, model)
+    args = stack_batch(seqs, model, dims)
+    if sharding is not None:
+        args = tuple(jax.device_put(a, sharding) for a in args)
+    fn = get_batch_kernel(model, dims, budget)
+    status, configs, depth, ovf = fn(*args)
+    status = np.asarray(status)
+    ovf = np.asarray(ovf)
+    out = []
+    for i in range(len(seqs)):
+        if int(status[i]) == UNKNOWN and bool(ovf[i]):
+            # this key's search overflowed the shared frontier: redo it
+            # solo with the escalation ladder
+            out.append(search_opseq(seqs[i], model, budget=budget))
+        else:
+            out.append({"valid": _STATUS[int(status[i])],
+                        "configs": int(configs[i]),
+                        "max_depth": int(depth[i]),
+                        "engine": "tpu-batch"})
+    return out
 
 
 class Linearizable:

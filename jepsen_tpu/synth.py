@@ -1,0 +1,95 @@
+"""Synthetic history generation — benchmark + self-test workloads.
+
+Simulates N logically single-threaded processes (the reference's worker
+model, core.clj:329-407) against an in-memory register/mutex, emitting a
+history that is valid by construction: each op takes effect atomically at
+its completion event, which is a legal linearization point.  Knobs:
+
+  * ``overlap``  — target number of simultaneously pending ops; drives the
+    real-time-order ambiguity the checker must search through (the
+    generator analog of `delay-til` racing, generator.clj:134-157).
+  * ``crash_p``  — probability a pending op crashes (:info) instead of
+    completing; crashed effects are applied with probability .5, matching
+    the "maybe happened" semantics the checker must cope with
+    (core.clj:387-397).
+  * ``corrupt_at`` — fraction; rewrites one ok read near that point of the
+    history to a bogus value, which (almost always) makes the history
+    non-linearizable so the checker must sweep the full state space.
+"""
+
+from __future__ import annotations
+
+import random
+from dataclasses import replace
+
+from .history import Op, fail_op, info_op, invoke_op, ok_op
+
+
+def register_history(rng: random.Random, *, n_ops: int, n_procs: int,
+                     overlap: int = 4, crash_p: float = 0.0,
+                     max_crashes: int = 16, n_values: int = 5,
+                     cas: bool = True) -> list[Op]:
+    """Concurrent CAS-register history, valid by construction."""
+    state = None
+    h: list[Op] = []
+    pending: dict[int, tuple] = {}
+    n_crashed = 0
+    done = 0
+    crashed_procs: set[int] = set()
+    while done < n_ops or pending:
+        free = [p for p in range(n_procs)
+                if p not in pending and p not in crashed_procs]
+        want_invoke = (done < n_ops and free
+                       and (len(pending) < overlap or not pending))
+        if want_invoke:
+            p = rng.choice(free)
+            fs = ["read", "write"] + (["cas"] if cas else [])
+            f = rng.choice(fs)
+            v = (None if f == "read"
+                 else rng.randrange(n_values) if f == "write"
+                 else (rng.randrange(n_values), rng.randrange(n_values)))
+            h.append(invoke_op(p, f, v))
+            pending[p] = (f, v)
+            done += 1
+            continue
+        if not pending:
+            break
+        p = rng.choice(list(pending))
+        f, v = pending.pop(p)
+        if crash_p and rng.random() < crash_p and n_crashed < max_crashes:
+            n_crashed += 1
+            crashed_procs.add(p)  # a crashed process id is retired
+            if rng.random() < 0.5:
+                if f == "write":
+                    state = v
+                elif f == "cas" and state == v[0]:
+                    state = v[1]
+            h.append(info_op(p, f, v if f != "read" else None))
+            continue
+        if f == "read":
+            h.append(ok_op(p, f, state))
+        elif f == "write":
+            state = v
+            h.append(ok_op(p, f, v))
+        else:
+            if state == v[0]:
+                state = v[1]
+                h.append(ok_op(p, f, v))
+            else:
+                h.append(fail_op(p, f, v))
+    return h
+
+
+def corrupt_read(rng: random.Random, h: list[Op], *,
+                 at: float = 1.0) -> list[Op]:
+    """Rewrite the ok read nearest fraction ``at`` of the way through to a
+    value nothing wrote; the result is (almost certainly) invalid."""
+    h = list(h)
+    idx = [i for i, op in enumerate(h)
+           if op.type == "ok" and op.f == "read" and op.value is not None]
+    if not idx:
+        return h
+    target = int(at * (len(h) - 1))
+    i = min(idx, key=lambda j: abs(j - target))
+    h[i] = replace(h[i], value=(h[i].value or 0) + 1_000_003)
+    return h

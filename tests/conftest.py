@@ -12,3 +12,11 @@ flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (
         flags + " --xla_force_host_platform_device_count=8").strip()
+
+# The image's sitecustomize registers a TPU PJRT plugin and imports jax
+# before any conftest runs, so the env vars above are not enough on their
+# own — pin the platform via config too (backends are not yet initialized
+# when conftest loads, so this still takes effect).
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")

@@ -22,7 +22,7 @@ from jepsen_tpu.models import cas_register, mutex, register
 
 # Shared generous dims so all differential cases reuse one compiled kernel.
 DIMS = lin.SearchDims(n_det_pad=128, n_crash_pad=32, window=96, k=16,
-                      state_width=1, frontier=256, queue=8192, table_bits=14)
+                      state_width=1, frontier=256)
 
 
 def random_register_history(rng: random.Random, n_procs=4, n_ops=40, *,
@@ -148,7 +148,7 @@ def test_mutex_history():
     s = encode_ops(h, m.f_codes)
     b = lin.search_opseq(s, m, dims=lin.SearchDims(
         n_det_pad=64, n_crash_pad=32, window=32, k=4, state_width=1,
-        frontier=64, queue=2048, table_bits=12))
+        frontier=64))
     assert b["valid"] is True
 
     # double acquire with no release: invalid
@@ -158,7 +158,7 @@ def test_mutex_history():
     assert oracle.check_opseq(s2, m)["valid"] is False
     b2 = lin.search_opseq(s2, m, dims=lin.SearchDims(
         n_det_pad=64, n_crash_pad=32, window=32, k=4, state_width=1,
-        frontier=64, queue=2048, table_bits=12))
+        frontier=64))
     assert b2["valid"] is False
 
 

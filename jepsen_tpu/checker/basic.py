@@ -1,0 +1,347 @@
+"""O(n) checkers — ports of the reference's cheap validity analyses.
+
+Reference: jepsen/src/jepsen/checker.clj — ``queue`` (141), ``set`` (163),
+``expand-queue-drain-ops`` (213), ``total-queue`` (246), ``unique-ids``
+(305), ``counter`` (353); jepsen/src/jepsen/tests/bank.clj (checker at 41);
+jepsen/src/jepsen/adya.clj (g2-checker at 57).  These are linear scans over
+the history; they run host-side in plain Python/numpy — the TPU is for the
+exponential search (checker/linearizable.py), not for O(n) bookkeeping.
+
+All checkers here consume event-level histories (lists of history.Op) and
+return dicts with at least {"valid": True|False|"unknown"}.
+"""
+
+from __future__ import annotations
+
+from collections import Counter
+from dataclasses import replace
+
+from ..history import Op, is_invoke, is_ok
+from ..util import integer_interval_set_str
+from .core import Checker
+
+# ---------------------------------------------------------------------------
+# helpers
+# ---------------------------------------------------------------------------
+
+
+def fraction(a: int, b: int):
+    """a/b, or 1 when b is zero (util.clj fraction semantics)."""
+    return a / b if b else 1
+
+
+class Inconsistent:
+    """Host-model inconsistency marker (knossos.model/inconsistent)."""
+
+    def __init__(self, msg: str):
+        self.msg = msg
+
+    def __repr__(self):
+        return f"Inconsistent({self.msg!r})"
+
+
+class UnorderedQueue:
+    """knossos.model/unordered-queue: enqueue always legal; dequeue legal
+    iff the element is present (any order)."""
+
+    def __init__(self, contents: Counter | None = None):
+        self.contents = contents if contents is not None else Counter()
+
+    def step(self, op: Op):
+        if op.f == "enqueue":
+            c = Counter(self.contents)
+            c[op.value] += 1
+            return UnorderedQueue(c)
+        if op.f == "dequeue":
+            if self.contents.get(op.value, 0) <= 0:
+                return Inconsistent(
+                    f"can't dequeue {op.value!r}: not in queue")
+            c = Counter(self.contents)
+            c[op.value] -= 1
+            if c[op.value] == 0:
+                del c[op.value]
+            return UnorderedQueue(c)
+        return Inconsistent(f"unordered-queue: unknown op f={op.f!r}")
+
+
+class FIFOQueue:
+    """knossos.model/fifo-queue: dequeue must return the oldest element."""
+
+    def __init__(self, contents: tuple = ()):
+        self.contents = contents
+
+    def step(self, op: Op):
+        if op.f == "enqueue":
+            return FIFOQueue(self.contents + (op.value,))
+        if op.f == "dequeue":
+            if not self.contents:
+                return Inconsistent("can't dequeue an empty queue")
+            if self.contents[0] != op.value:
+                return Inconsistent(
+                    f"expecting {self.contents[0]!r}, got {op.value!r}")
+            return FIFOQueue(self.contents[1:])
+        return Inconsistent(f"fifo-queue: unknown op f={op.f!r}")
+
+
+# ---------------------------------------------------------------------------
+# queue — reduce a queue model over enqueue-invokes + dequeue-oks
+# (checker.clj:140-160)
+# ---------------------------------------------------------------------------
+
+
+class QueueChecker(Checker):
+    """Every dequeue must come from somewhere: assume every non-failing
+    enqueue succeeded and only ok dequeues happened, then reduce the model.
+    Use with an unordered queue model (checker.clj:141-147)."""
+
+    def __init__(self, model=None):
+        self.model = model
+
+    def check(self, test, history, opts=None):
+        model = self.model or test.get("model") or UnorderedQueue()
+        for op in history:
+            take = (is_invoke(op) if op.f == "enqueue"
+                    else is_ok(op) if op.f == "dequeue" else False)
+            if not take:
+                continue
+            model = model.step(op)
+            if isinstance(model, Inconsistent):
+                return {"valid": False, "error": model.msg}
+        return {"valid": True,
+                "final_queue": getattr(model, "contents", None)}
+
+
+def queue(model=None) -> Checker:
+    return QueueChecker(model)
+
+
+# ---------------------------------------------------------------------------
+# set — adds followed by a final read (checker.clj:162-211)
+# ---------------------------------------------------------------------------
+
+
+class SetChecker(Checker):
+    def check(self, test, history, opts=None):
+        attempts = {op.value for op in history
+                    if is_invoke(op) and op.f == "add"}
+        adds = {op.value for op in history if is_ok(op) and op.f == "add"}
+        final_read = None
+        for op in history:
+            if is_ok(op) and op.f == "read":
+                final_read = op.value
+        if final_read is None:
+            return {"valid": "unknown", "error": "Set was never read"}
+        final_read = set(final_read)
+
+        ok = final_read & attempts          # read values we tried to add
+        unexpected = final_read - attempts  # never attempted!
+        lost = adds - final_read            # definitely added, not read
+        recovered = ok - adds               # indeterminate adds that showed
+
+        return {
+            "valid": not lost and not unexpected,
+            "ok": integer_interval_set_str(ok),
+            "lost": integer_interval_set_str(lost),
+            "unexpected": integer_interval_set_str(unexpected),
+            "recovered": integer_interval_set_str(recovered),
+            "ok_frac": fraction(len(ok), len(attempts)),
+            "unexpected_frac": fraction(len(unexpected), len(attempts)),
+            "lost_frac": fraction(len(lost), len(attempts)),
+            "recovered_frac": fraction(len(recovered), len(attempts)),
+        }
+
+
+def set_checker() -> Checker:
+    return SetChecker()
+
+
+# ---------------------------------------------------------------------------
+# total-queue — what goes in must come out (checker.clj:213-303)
+# ---------------------------------------------------------------------------
+
+
+def expand_queue_drain_ops(history) -> list:
+    """Expand ok :drain ops (value = list of elements) into dequeue
+    invoke/ok pairs (checker.clj:213-244)."""
+    out = []
+    for op in history:
+        if op.f != "drain":
+            out.append(op)
+        elif is_invoke(op) or op.type == "fail":
+            continue
+        elif is_ok(op):
+            for element in op.value or []:
+                out.append(replace(op, type="invoke", f="dequeue",
+                                   value=None))
+                out.append(replace(op, type="ok", f="dequeue",
+                                   value=element))
+        else:
+            raise ValueError(
+                f"not sure how to handle a crashed drain operation: {op}")
+    return out
+
+
+class TotalQueueChecker(Checker):
+    def check(self, test, history, opts=None):
+        history = expand_queue_drain_ops(history)
+        attempts = Counter(op.value for op in history
+                           if is_invoke(op) and op.f == "enqueue")
+        enqueues = Counter(op.value for op in history
+                           if is_ok(op) and op.f == "enqueue")
+        dequeues = Counter(op.value for op in history
+                           if is_ok(op) and op.f == "dequeue")
+
+        ok = dequeues & attempts  # multiset intersection
+        unexpected = Counter({v: n for v, n in dequeues.items()
+                              if v not in attempts})
+        duplicated = dequeues - attempts - unexpected
+        lost = enqueues - dequeues
+        recovered = ok - enqueues
+
+        def total(ms):
+            return sum(ms.values())
+
+        n_att = total(attempts)
+        return {
+            "valid": not lost and not unexpected,
+            "lost": dict(lost),
+            "unexpected": dict(unexpected),
+            "duplicated": dict(duplicated),
+            "recovered": dict(recovered),
+            "ok_frac": fraction(total(ok), n_att),
+            "unexpected_frac": fraction(total(unexpected), n_att),
+            "duplicated_frac": fraction(total(duplicated), n_att),
+            "lost_frac": fraction(total(lost), n_att),
+            "recovered_frac": fraction(total(recovered), n_att),
+        }
+
+
+def total_queue() -> Checker:
+    return TotalQueueChecker()
+
+
+# ---------------------------------------------------------------------------
+# unique-ids (checker.clj:305-351)
+# ---------------------------------------------------------------------------
+
+
+class UniqueIdsChecker(Checker):
+    def check(self, test, history, opts=None):
+        attempted = sum(1 for op in history
+                        if is_invoke(op) and op.f == "generate")
+        acks = [op.value for op in history
+                if is_ok(op) and op.f == "generate"]
+        counts = Counter(acks)
+        dups = {k: n for k, n in counts.items() if n > 1}
+        rng = [min(acks), max(acks)] if acks else None
+        return {
+            "valid": not dups,
+            "attempted_count": attempted,
+            "acknowledged_count": len(acks),
+            "duplicated_count": len(dups),
+            "duplicated": dict(sorted(dups.items(), key=lambda kv: -kv[1])
+                               [:48]),
+            "range": rng,
+        }
+
+
+def unique_ids() -> Checker:
+    return UniqueIdsChecker()
+
+
+# ---------------------------------------------------------------------------
+# counter — reads bounded by [sum of ok adds, sum of attempted adds]
+# (checker.clj:353-406)
+# ---------------------------------------------------------------------------
+
+
+class CounterChecker(Checker):
+    def check(self, test, history, opts=None):
+        lower = 0            # sum of ok increments
+        upper = 0            # sum of attempted increments
+        pending = {}         # process -> [lower-at-invoke, read-value]
+        reads = []           # [lower, value, upper]
+        for op in history:
+            key = (op.type, op.f)
+            if key == ("invoke", "read"):
+                pending[op.process] = [lower, op.value]
+            elif key == ("ok", "read"):
+                r = pending.pop(op.process, None)
+                if r is not None:
+                    # the ok's value is authoritative (invoke carried nil)
+                    reads.append([r[0], op.value, upper])
+            elif key == ("invoke", "add"):
+                upper += op.value
+            elif key == ("ok", "add"):
+                lower += op.value
+        errors = [r for r in reads
+                  if r[1] is None or not (r[0] <= r[1] <= r[2])]
+        return {"valid": not errors, "reads": reads, "errors": errors}
+
+
+def counter() -> Checker:
+    return CounterChecker()
+
+
+# ---------------------------------------------------------------------------
+# bank — transfers conserve the total and never go negative
+# (jepsen/src/jepsen/tests/bank.clj:41-64)
+# ---------------------------------------------------------------------------
+
+
+class BankChecker(Checker):
+    def check(self, test, history, opts=None):
+        total = test.get("total_amount", 100)
+        bad_reads = []
+        for op in history:
+            if not (is_ok(op) and op.f == "read"):
+                continue
+            balances = list((op.value or {}).values())
+            if sum(balances) != total:
+                bad_reads.append({"type": "wrong-total",
+                                  "total": sum(balances),
+                                  "op": op.to_dict()})
+            elif any(b < 0 for b in balances):
+                bad_reads.append({"type": "negative-value",
+                                  "negative": [b for b in balances if b < 0],
+                                  "op": op.to_dict()})
+        return {"valid": not bad_reads, "bad_reads": bad_reads}
+
+
+def bank() -> Checker:
+    return BankChecker()
+
+
+# ---------------------------------------------------------------------------
+# Adya G2 — at most one insert per key succeeds (adya.clj:57-83)
+# ---------------------------------------------------------------------------
+
+
+class G2Checker(Checker):
+    """History values are KV tuples [key, [a_id, b_id]]; at most one
+    :insert may succeed per key."""
+
+    def check(self, test, history, opts=None):
+        keys: dict = {}
+        for op in history:
+            if op.f != "insert" or op.value is None:
+                continue
+            k = op.value[0] if isinstance(op.value, (tuple, list)) else \
+                getattr(op.value, "key", None)
+            if op.type == "ok":
+                keys[k] = keys.get(k, 0) + 1
+            else:
+                keys.setdefault(k, 0)
+        illegal = {k: n for k, n in keys.items() if n > 1}
+        insert_count = sum(1 for n in keys.values() if n > 0)
+        return {
+            "valid": not illegal,
+            "key_count": len(keys),
+            "legal_count": insert_count - len(illegal),
+            "illegal_count": len(illegal),
+            "illegal": illegal,
+        }
+
+
+def g2() -> Checker:
+    return G2Checker()

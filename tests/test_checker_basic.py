@@ -1,0 +1,248 @@
+"""Tier-1 tests for the O(n) checkers, mirroring the reference's
+checker_test.clj cases (valid, invalid, pathological)."""
+
+import pytest
+
+from jepsen_tpu.checker import basic
+from jepsen_tpu.history import fail_op, info_op, invoke_op, ok_op
+from jepsen_tpu import independent
+
+
+def ops(*specs):
+    """(type, process, f, value) shorthand."""
+    mk = {"invoke": invoke_op, "ok": ok_op, "fail": fail_op,
+          "info": info_op}
+    return [mk[t](p, f, v) for t, p, f, v in specs]
+
+
+# --- queue ----------------------------------------------------------------
+
+
+def test_queue_valid():
+    h = ops(("invoke", 0, "enqueue", 1), ("ok", 0, "enqueue", 1),
+            ("invoke", 1, "dequeue", None), ("ok", 1, "dequeue", 1))
+    assert basic.queue().check({}, h)["valid"] is True
+
+
+def test_queue_dequeue_from_nowhere():
+    h = ops(("invoke", 1, "dequeue", None), ("ok", 1, "dequeue", 7))
+    out = basic.queue().check({}, h)
+    assert out["valid"] is False
+    assert "7" in out["error"]
+
+
+def test_queue_unordered_ok():
+    # enqueue 1 2, dequeue 2 1 — fine for an unordered queue
+    h = ops(("invoke", 0, "enqueue", 1), ("ok", 0, "enqueue", 1),
+            ("invoke", 0, "enqueue", 2), ("ok", 0, "enqueue", 2),
+            ("invoke", 1, "dequeue", None), ("ok", 1, "dequeue", 2),
+            ("invoke", 1, "dequeue", None), ("ok", 1, "dequeue", 1))
+    assert basic.queue().check({}, h)["valid"] is True
+
+
+def test_queue_counts_indeterminate_enqueue():
+    # an enqueue that crashed still counts (invoke taken), so the dequeue
+    # is legal
+    h = ops(("invoke", 0, "enqueue", 5), ("info", 0, "enqueue", 5),
+            ("invoke", 1, "dequeue", None), ("ok", 1, "dequeue", 5))
+    assert basic.queue().check({}, h)["valid"] is True
+
+
+def test_fifo_queue_order():
+    h = ops(("invoke", 0, "enqueue", 1), ("ok", 0, "enqueue", 1),
+            ("invoke", 0, "enqueue", 2), ("ok", 0, "enqueue", 2),
+            ("invoke", 1, "dequeue", None), ("ok", 1, "dequeue", 2))
+    out = basic.queue(basic.FIFOQueue()).check({}, h)
+    assert out["valid"] is False
+
+
+# --- set ------------------------------------------------------------------
+
+
+def test_set_never_read():
+    h = ops(("invoke", 0, "add", 1), ("ok", 0, "add", 1))
+    assert basic.set_checker().check({}, h)["valid"] == "unknown"
+
+
+def test_set_valid_with_recovered():
+    h = ops(("invoke", 0, "add", 1), ("ok", 0, "add", 1),
+            ("invoke", 0, "add", 2), ("info", 0, "add", 2),  # indeterminate
+            ("invoke", 1, "read", None), ("ok", 1, "read", [1, 2]))
+    out = basic.set_checker().check({}, h)
+    assert out["valid"] is True
+    assert out["recovered"] == "#{2}"
+
+
+def test_set_lost_and_unexpected():
+    h = ops(("invoke", 0, "add", 1), ("ok", 0, "add", 1),
+            ("invoke", 1, "read", None), ("ok", 1, "read", [99]))
+    out = basic.set_checker().check({}, h)
+    assert out["valid"] is False
+    assert out["lost"] == "#{1}"
+    assert out["unexpected"] == "#{99}"
+
+
+# --- total-queue ----------------------------------------------------------
+
+
+def test_total_queue_valid_with_drain():
+    h = ops(("invoke", 0, "enqueue", 1), ("ok", 0, "enqueue", 1),
+            ("invoke", 0, "enqueue", 2), ("ok", 0, "enqueue", 2),
+            ("invoke", 1, "drain", None), ("ok", 1, "drain", [1, 2]))
+    out = basic.total_queue().check({}, h)
+    assert out["valid"] is True
+
+
+def test_total_queue_pathological():
+    # duplicated and unexpected dequeues (checker_test.clj:57-81 analog)
+    h = ops(("invoke", 0, "enqueue", 1), ("ok", 0, "enqueue", 1),
+            ("invoke", 1, "dequeue", None), ("ok", 1, "dequeue", 1),
+            ("invoke", 1, "dequeue", None), ("ok", 1, "dequeue", 1),
+            ("invoke", 1, "dequeue", None), ("ok", 1, "dequeue", 9))
+    out = basic.total_queue().check({}, h)
+    assert out["valid"] is False
+    assert out["duplicated"] == {1: 1}
+    assert out["unexpected"] == {9: 1}
+
+
+def test_total_queue_lost():
+    h = ops(("invoke", 0, "enqueue", 3), ("ok", 0, "enqueue", 3),
+            ("invoke", 1, "drain", None), ("ok", 1, "drain", []))
+    out = basic.total_queue().check({}, h)
+    assert out["valid"] is False
+    assert out["lost"] == {3: 1}
+
+
+# --- unique-ids -----------------------------------------------------------
+
+
+def test_unique_ids():
+    h = ops(("invoke", 0, "generate", None), ("ok", 0, "generate", 10),
+            ("invoke", 0, "generate", None), ("ok", 0, "generate", 11))
+    out = basic.unique_ids().check({}, h)
+    assert out["valid"] is True and out["range"] == [10, 11]
+
+    h2 = h + ops(("invoke", 1, "generate", None), ("ok", 1, "generate", 10))
+    out2 = basic.unique_ids().check({}, h2)
+    assert out2["valid"] is False
+    assert out2["duplicated"] == {10: 2}
+
+
+# --- counter --------------------------------------------------------------
+
+
+def test_counter_valid_concurrent_read():
+    h = ops(("invoke", 0, "add", 5), ("invoke", 1, "read", None),
+            ("ok", 0, "add", 5), ("ok", 1, "read", 3))
+    # read of 3 is within [0, 5]
+    assert basic.counter().check({}, h)["valid"] is True
+
+
+def test_counter_read_too_high():
+    h = ops(("invoke", 0, "add", 5), ("ok", 0, "add", 5),
+            ("invoke", 1, "read", None), ("ok", 1, "read", 9))
+    out = basic.counter().check({}, h)
+    assert out["valid"] is False
+    assert out["errors"] == [[5, 9, 5]]
+
+
+# --- bank -----------------------------------------------------------------
+
+
+def test_bank():
+    test = {"total_amount": 100}
+    good = ops(("invoke", 0, "read", None),
+               ("ok", 0, "read", {0: 60, 1: 40}))
+    assert basic.bank().check(test, good)["valid"] is True
+
+    bad = ops(("invoke", 0, "read", None),
+              ("ok", 0, "read", {0: 70, 1: 40}))
+    out = basic.bank().check(test, bad)
+    assert out["valid"] is False
+    assert out["bad_reads"][0]["type"] == "wrong-total"
+
+    neg = ops(("invoke", 0, "read", None),
+              ("ok", 0, "read", {0: 150, 1: -50}))
+    out = basic.bank().check(test, neg)
+    assert out["valid"] is False
+    assert out["bad_reads"][0]["type"] == "negative-value"
+
+
+# --- G2 -------------------------------------------------------------------
+
+
+def test_g2():
+    h = ops(("invoke", 0, "insert", (0, (1, None))),
+            ("ok", 0, "insert", (0, (1, None))),
+            ("invoke", 1, "insert", (0, (None, 2))),
+            ("fail", 1, "insert", (0, (None, 2))))
+    assert basic.g2().check({}, h)["valid"] is True
+
+    h2 = ops(("invoke", 0, "insert", (0, (1, None))),
+             ("ok", 0, "insert", (0, (1, None))),
+             ("invoke", 1, "insert", (0, (None, 2))),
+             ("ok", 1, "insert", (0, (None, 2))))
+    out = basic.g2().check({}, h2)
+    assert out["valid"] is False and out["illegal"] == {0: 2}
+
+
+# --- independent lift -----------------------------------------------------
+
+
+def test_independent_subhistory_and_keys():
+    kv = independent.tuple_
+    h = [invoke_op(0, "write", kv("a", 1)), ok_op(0, "write", kv("a", 1)),
+         invoke_op(1, "write", kv("b", 2)), ok_op(1, "write", kv("b", 2)),
+         info_op("nemesis", "partition", None)]
+    assert independent.history_keys(h) == ["a", "b"]
+    sub = independent.subhistory("a", h)
+    assert [op.value for op in sub] == [1, 1, None]
+    assert sub[2].process == "nemesis"  # un-keyed ops kept
+
+
+def test_independent_checker_host_path():
+    from jepsen_tpu.checker import linearizable as lin
+    from jepsen_tpu.models import cas_register
+
+    kv = independent.tuple_
+    model = cas_register()
+    h = []
+    # key a: valid; key b: invalid read
+    h += [invoke_op(0, "write", kv("a", 1)), ok_op(0, "write", kv("a", 1)),
+          invoke_op(0, "read", kv("a", None)), ok_op(0, "read", kv("a", 1))]
+    h += [invoke_op(1, "write", kv("b", 1)), ok_op(1, "write", kv("b", 1)),
+          invoke_op(1, "read", kv("b", None)), ok_op(1, "read", kv("b", 9))]
+    chk = independent.checker(lin.linearizable(model))
+    out = chk.check({}, h)
+    assert out["valid"] is False
+    assert out["failures"] == ["b"]
+    assert out["results"]["a"]["valid"] is True
+
+
+def test_independent_checker_device_batch():
+    import random
+
+    from jepsen_tpu.checker import linearizable as lin
+    from jepsen_tpu.models import cas_register
+    from jepsen_tpu.synth import corrupt_read, register_history
+
+    kv = independent.tuple_
+    model = cas_register()
+    rng = random.Random(3)
+    h = []
+    bad_keys = set()
+    for k in range(6):
+        sub = register_history(rng, n_ops=30, n_procs=3, overlap=2)
+        if k % 3 == 0:
+            sub = corrupt_read(rng, sub, at=0.9)
+            bad_keys.add(k)
+        for op in sub:
+            h.append(
+                __import__("dataclasses").replace(
+                    op, process=op.process + 3 * k, value=kv(k, op.value)))
+    chk = independent.checker(lin.linearizable(model, host_threshold=5))
+    out = chk.check({}, h)
+    assert out["valid"] is False
+    assert set(out["failures"]) == bad_keys
+    for k in range(6):
+        assert out["results"][k]["valid"] is (k not in bad_keys)

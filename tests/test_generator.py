@@ -1,0 +1,251 @@
+"""Tier-1 generator tests — the analog of the reference's
+generator_test.clj (fake threads/futures harness, deterministic op-stream
+assertions) and independent_test.clj (key scheduling properties)."""
+
+import random
+import threading
+
+import pytest
+
+from jepsen_tpu import generator as gen
+from jepsen_tpu import independent
+
+TEST = {"concurrency": 4, "nodes": ["n1", "n2", "n3"]}
+
+
+def pull_all(g, test, processes, max_ops=10_000):
+    """Single-threaded harness: round-robin processes until exhausted."""
+    out = []
+    active = list(processes)
+    while active and len(out) < max_ops:
+        progressed = False
+        for p in list(active):
+            op = gen.op_and_validate(g, test, p)
+            if op is None:
+                active.remove(p)
+            else:
+                out.append((p, op))
+                progressed = True
+        if not progressed:
+            break
+    return out
+
+
+def test_lifting_plain_objects():
+    # a dict constantly yields itself
+    g = gen.limit(3, {"type": "invoke", "f": "read", "value": None})
+    ops = pull_all(g, TEST, [0])
+    assert len(ops) == 3
+    assert all(op["f"] == "read" for _, op in ops)
+
+    # functions of (test, process) and of no args
+    g2 = gen.limit(2, lambda test, process: {"type": "invoke", "f": "w",
+                                             "value": process})
+    assert [op["value"] for _, op in pull_all(g2, TEST, [7])] == [7, 7]
+
+    g3 = gen.limit(2, lambda: {"type": "invoke", "f": "z", "value": 1})
+    assert len(pull_all(g3, TEST, [0])) == 2
+
+
+def test_process_thread_node_mapping():
+    # process mod concurrency; thread mod node count (generator.clj:69-83)
+    assert gen.process_to_thread(TEST, 6) == 2
+    assert gen.process_to_thread(TEST, "nemesis") == "nemesis"
+    assert gen.process_to_node(TEST, 4) == "n1"
+    assert gen.process_to_node(TEST, 5) == "n2"
+    assert gen.process_to_node(TEST, "nemesis") is None
+
+
+def test_seq_one_op_per_element():
+    g = gen.seq([{"type": "invoke", "f": "a"},
+                 {"type": "invoke", "f": "b"},
+                 {"type": "invoke", "f": "c"}])
+    ops = [op["f"] for _, op in pull_all(g, TEST, [0])]
+    assert ops == ["a", "b", "c"]
+
+
+def test_once_and_concat():
+    g = gen.concat(gen.once({"type": "invoke", "f": "first"}),
+                   gen.limit(2, {"type": "invoke", "f": "rest"}))
+    ops = [op["f"] for _, op in pull_all(g, TEST, [0])]
+    assert ops == ["first", "rest", "rest"]
+
+
+def test_f_map():
+    g = gen.f_map({"start": "kill"},
+                  gen.limit(1, {"type": "info", "f": "start"}))
+    assert pull_all(g, TEST, [0])[0][1]["f"] == "kill"
+
+
+def test_filter():
+    src = gen.seq([{"type": "invoke", "f": "a", "value": i}
+                   for i in range(6)])
+    g = gen.filter(lambda op: op["value"] % 2 == 0, src)
+    assert [op["value"] for _, op in pull_all(g, TEST, [0])] == [0, 2, 4]
+
+
+def test_each_gives_independent_copies():
+    g = gen.each(lambda: gen.seq([{"type": "invoke", "f": "x", "value": 1},
+                                  {"type": "invoke", "f": "x", "value": 2}]))
+    ops = pull_all(g, TEST, [0, 1])
+    by_proc = {}
+    for p, op in ops:
+        by_proc.setdefault(p, []).append(op["value"])
+    assert by_proc == {0: [1, 2], 1: [1, 2]}
+
+
+def test_drain_queue():
+    enq = gen.seq([{"type": "invoke", "f": "enqueue", "value": i}
+                   for i in range(3)])
+    g = gen.drain_queue(enq)
+    ops = [op["f"] for _, op in pull_all(g, TEST, [0])]
+    assert ops == ["enqueue"] * 3 + ["dequeue"] * 3
+
+
+def test_reserve_partitions_threads():
+    with gen.with_threads([0, 1, 2, 3, "nemesis"]):
+        seen = {}
+
+        def mk(tag):
+            def f(test, process):
+                # record the *threads* binding each pool sees
+                seen[tag] = gen.current_threads()
+                return {"type": "invoke", "f": tag}
+            return f
+
+        g = gen.reserve(2, mk("write"), 1, mk("cas"), mk("read"))
+        assert g.op(TEST, 0)["f"] == "write"
+        assert g.op(TEST, 1)["f"] == "write"
+        assert g.op(TEST, 2)["f"] == "cas"
+        assert g.op(TEST, 3)["f"] == "read"
+        assert seen["write"] == [0, 1]
+        assert seen["cas"] == [2]
+        assert seen["read"] == [3, "nemesis"]
+
+
+def test_on_nemesis_clients_routing():
+    with gen.with_threads([0, 1, 2, 3, "nemesis"]):
+        g = gen.nemesis({"type": "info", "f": "start"},
+                        {"type": "invoke", "f": "read"})
+        assert g.op(TEST, "nemesis")["f"] == "start"
+        assert g.op(TEST, 2)["f"] == "read"
+        c = gen.clients({"type": "invoke", "f": "read"})
+        assert c.op(TEST, "nemesis") is None
+        assert c.op(TEST, 1)["f"] == "read"
+
+
+def test_phases_barrier_ordering():
+    """All threads must finish phase a before any emits phase b
+    (generator.clj:458-462)."""
+    test = {"concurrency": 3, "nodes": ["n1"]}
+    g = gen.phases(gen.limit(3, {"type": "invoke", "f": "a"}),
+                   gen.limit(3, {"type": "invoke", "f": "b"}))
+    order = []
+    lock = threading.Lock()
+
+    def worker(p):
+        with gen.with_threads([0, 1, 2]):
+            while True:
+                op = gen.gen_op(g, test, p)
+                if op is None:
+                    return
+                with lock:
+                    order.append(op["f"])
+
+    ts = [threading.Thread(target=worker, args=(p,)) for p in range(3)]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join(timeout=30)
+    assert not any(t.is_alive() for t in ts), "phase barrier deadlocked"
+    assert len(order) == 6
+    # every a precedes every b
+    assert order[:3] == ["a"] * 3 and order[3:] == ["b"] * 3
+
+
+def test_time_limit():
+    g = gen.time_limit(0.2, {"type": "invoke", "f": "read"})
+    assert g.op(TEST, 0) is not None
+    import time
+
+    time.sleep(0.25)
+    assert g.op(TEST, 0) is None
+
+
+def test_stagger_and_delay_still_emit():
+    g = gen.stagger(0.001, gen.limit(2, {"type": "invoke", "f": "r"}))
+    assert len(pull_all(g, TEST, [0])) == 2
+    g2 = gen.delay(0.001, gen.limit(1, {"type": "invoke", "f": "r"}))
+    assert len(pull_all(g2, TEST, [0])) == 1
+
+
+def test_mix_seeded():
+    random.seed(0)
+    g = gen.limit(20, gen.mix([{"type": "invoke", "f": "a"},
+                               {"type": "invoke", "f": "b"}]))
+    fs = {op["f"] for _, op in pull_all(g, TEST, [0])}
+    assert fs == {"a", "b"}
+
+
+# --- independent generators ----------------------------------------------
+
+
+def test_sequential_generator():
+    g = independent.sequential_generator(
+        ["k1", "k2"],
+        lambda k: gen.limit(2, {"type": "invoke", "f": "w", "value": 1}))
+    ops = [op for _, op in pull_all(g, TEST, [0])]
+    assert len(ops) == 4
+    assert [op["value"].key for op in ops] == ["k1", "k1", "k2", "k2"]
+    assert all(op["value"].value == 1 for op in ops)
+
+
+def test_concurrent_generator_groups_and_coverage():
+    """10 threads in groups of 2 work 50 keys; each key's ops come from
+    exactly one group and every key is fully processed
+    (independent_test.clj:35-45 analog)."""
+    n_threads, group_size, n_keys, ops_per_key = 10, 2, 50, 6
+    test = {"concurrency": n_threads, "nodes": ["n1"]}
+    g = independent.concurrent_generator(
+        group_size, range(n_keys),
+        lambda k: gen.limit(ops_per_key,
+                            {"type": "invoke", "f": "w", "value": k}))
+    ops = []
+    lock = threading.Lock()
+
+    def worker(p):
+        with gen.with_threads(list(range(n_threads))):
+            while True:
+                op = gen.gen_op(g, test, p)
+                if op is None:
+                    return
+                with lock:
+                    ops.append((p, op))
+
+    ts = [threading.Thread(target=worker, args=(p,))
+          for p in range(n_threads)]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join(timeout=60)
+    assert not any(t.is_alive() for t in ts)
+
+    per_key: dict = {}
+    for p, op in ops:
+        kv = op["value"]
+        per_key.setdefault(kv.key, []).append(p)
+    assert set(per_key) == set(range(n_keys))
+    for k, procs in per_key.items():
+        assert len(procs) == ops_per_key
+        groups = {p // group_size for p in procs}
+        assert len(groups) == 1, f"key {k} served by groups {groups}"
+
+
+def test_concurrent_generator_rejects_nemesis():
+    test = {"concurrency": 2, "nodes": ["n1"]}
+    g = independent.concurrent_generator(
+        2, [1], lambda k: {"type": "invoke", "f": "w"})
+    with gen.with_threads([0, 1, "nemesis"]):
+        g.op(test, 0)  # init
+        with pytest.raises(AssertionError):
+            g.op(test, "nemesis")

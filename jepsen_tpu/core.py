@@ -1,0 +1,466 @@
+"""Test runner (reference L5) — orchestrates a whole run.
+
+Reference: jepsen/src/jepsen/core.clj.  A test is a plain dict (schema
+documented at core.clj:500-549).  `run` proceeds: logging → sessions →
+OS setup → DB cycle (+ primary) → worker threads (one logically
+single-threaded *process* per client thread + one nemesis) pulling ops
+from the generator, journaling invocations and completions into the
+history → log snarfing → checker → persistence.
+
+Key semantics preserved exactly:
+
+  * op shape invariants (core.clj:271-278): completions must be
+    ok/fail/info with matching process and f;
+  * client crash handling (core.clj:348-407): an invoke exception becomes
+    an :info completion — the op *may* have happened — and the process id
+    retires, its successor being process + concurrency, so the
+    single-threaded-process invariant holds;
+  * worker abort protocol (core.clj:155-245): any worker's setup/run
+    failure aborts every worker; barrier-parked workers are released via
+    the test's abort event;
+  * nemesis ops journal into every active history (core.clj:315-327).
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+import traceback
+from dataclasses import replace
+from typing import Optional
+
+from . import checker as checker_mod
+from . import control, db as db_mod, store
+from . import generator as gen
+from . import os as os_mod
+from .history import Op, index as index_history
+from .util import (AbortableBarrier, WithThreadName, WorkerAbort, fcatch,
+                   real_pmap, relative_time, relative_time_nanos)
+
+log = logging.getLogger("jepsen")
+
+
+def synchronize(test: dict) -> None:
+    """Block until all nodes arrive (core.clj:38-43)."""
+    b = test.get("barrier")
+    if b is not None and b != "no-barrier":
+        b.wait()
+
+
+def primary(test: dict):
+    """The primary node (core.clj:51-54)."""
+    return test["nodes"][0]
+
+
+def conj_op(test: dict, op: Op) -> Op:
+    """Append to the test's history (core.clj:45-49)."""
+    hist = test["history"]
+    with test["_history_lock"]:
+        hist.append(op)
+    return op
+
+
+def log_op(op: Op) -> None:
+    log.info("%s\t%s\t%s\t%s", op.process, op.type, op.f, op.value)
+
+
+# ---------------------------------------------------------------------------
+# Worker lifecycle (core.clj:145-245)
+# ---------------------------------------------------------------------------
+
+
+class Worker:
+    """Synchronized setup/run/teardown with error recovery
+    (core.clj:145-153)."""
+
+    name = "worker"
+
+    def abort(self) -> None:
+        raise NotImplementedError
+
+    def setup(self) -> None:
+        pass
+
+    def run(self) -> None:
+        pass
+
+    def teardown(self) -> None:
+        pass
+
+
+def do_worker(abort_all, worker: Worker) -> Optional[BaseException]:
+    """setup → run → teardown; any phase's error aborts the fleet and is
+    returned (core.clj:155-202)."""
+    with WithThreadName(f"jepsen {worker.name}"):
+        try:
+            log.info("Starting %s", worker.name)
+            worker.setup()
+        except BaseException as t:
+            log.warning("Error setting up %s: %s", worker.name, t)
+            abort_all(worker)
+            _teardown_quietly(worker)
+            return t
+        try:
+            log.info("Running %s", worker.name)
+            worker.run()
+        except BaseException as t:
+            if not isinstance(t, WorkerAbort):
+                log.warning("Error running %s: %s", worker.name,
+                            traceback.format_exc())
+            abort_all(worker)
+            _teardown_quietly(worker)
+            return t
+        return _teardown_quietly(worker)
+
+
+def _teardown_quietly(worker: Worker) -> Optional[BaseException]:
+    try:
+        log.info("Stopping %s", worker.name)
+        worker.teardown()
+        return None
+    except BaseException as t:
+        log.warning("Error tearing down %s: %s", worker.name, t)
+        return t
+
+
+def run_workers(test: dict, workers: list[Worker]) -> None:
+    """Spawn a thread per worker; if any crashed (other than via cascade
+    abort), raise its error (core.clj:204-245)."""
+    results: list = [None] * len(workers)
+    aborting: dict = {}
+    lock = threading.Lock()
+
+    def abort_all(w):
+        with lock:
+            aborting.setdefault("worker", w)
+        test["__abort__"].set()
+        for other in workers:
+            other.abort()
+
+    # propagate the calling thread's *threads* binding into workers (the
+    # reference's bound-fn, core.clj:219-224)
+    bound_threads = getattr(gen._ctx, "threads", None)
+
+    def run_one(i, w):
+        if bound_threads is not None:
+            with gen.with_threads(bound_threads):
+                results[i] = do_worker(abort_all, w)
+        else:
+            results[i] = do_worker(abort_all, w)
+
+    threads = [threading.Thread(target=run_one, args=(i, w), daemon=True)
+               for i, w in enumerate(workers)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+
+    w = aborting.get("worker")
+    if w is not None:
+        err = results[workers.index(w)]
+        if err is not None:
+            raise err
+
+
+# ---------------------------------------------------------------------------
+# Client worker (core.clj:329-417)
+# ---------------------------------------------------------------------------
+
+
+def invoke_op(op: Op, test: dict, client, aborting) -> Op:
+    """client.invoke with crash → :info conversion (core.clj:248-281)."""
+    try:
+        completion = client.invoke(test, op)
+        completion = replace(completion, time=relative_time_nanos())
+    except BaseException as e:
+        if aborting.is_set():
+            raise
+        log.warning("Process %s crashed: %s", op.process, e)
+        completion = replace(op, type="info", time=relative_time_nanos(),
+                             error=f"indeterminate: {e}")
+    assert completion.type in ("ok", "fail", "info"), (
+        f"expected client invoke to return type ok/fail/info, got "
+        f"{completion!r}")
+    assert completion.process == op.process
+    assert completion.f == op.f
+    return completion
+
+
+class ClientWorker(Worker):
+    def __init__(self, test: dict, process: int, node):
+        self.test = test
+        self.node = node
+        self.worker_number = process
+        self.process = process
+        self.client = None
+        self.aborting = threading.Event()
+        self.name = f"worker {process}"
+
+    def abort(self):
+        self.aborting.set()
+
+    def setup(self):
+        self.client = self.test["client"].open(self.test, self.node)
+        self.client.setup(self.test)
+
+    def run(self):
+        test = self.test
+        g = test["generator"]
+        while True:
+            if self.aborting.is_set():
+                raise WorkerAbort("worker aborted")
+            opd = gen.op_and_validate(g, test, self.process)
+            if opd is None:
+                return
+            op = Op(process=self.process, type=opd.get("type", "invoke"),
+                    f=opd.get("f"), value=opd.get("value"),
+                    time=relative_time_nanos())
+            log_op(op)
+
+            if self.client is None:
+                # lazily reopen after a crash (core.clj:362-377)
+                try:
+                    self.client = test["client"].open(test, self.node)
+                except Exception as e:
+                    log.warning("Error opening client: %s", e)
+                    fail = replace(op, type="fail",
+                                   error=["no-client", str(e)],
+                                   time=relative_time_nanos())
+                    conj_op(test, op)
+                    conj_op(test, fail)
+                    log_op(fail)
+                    self.client = None
+                    continue
+
+            conj_op(test, op)
+            completion = invoke_op(op, test, self.client, self.aborting)
+            conj_op(test, completion)
+            log_op(completion)
+            if completion.type == "info":
+                # indeterminate: this process is hung; cycle to a new
+                # process id (core.clj:387-404)
+                self.process += test["concurrency"]
+                try:
+                    self.client.close(test)
+                except Exception:
+                    pass
+                self.client = None
+
+    def teardown(self):
+        if self.client is not None:
+            self.client.teardown(self.test)
+            self.client.close(self.test)
+
+
+class NemesisWorker(Worker):
+    """core.clj:419-441; ops journal into every active history."""
+
+    name = "nemesis"
+
+    def __init__(self, test: dict):
+        self.test = test
+        self.nemesis = None
+        self.aborting = threading.Event()
+
+    def abort(self):
+        self.aborting.set()
+
+    def setup(self):
+        self.nemesis = self.test["nemesis"].setup(self.test) or \
+            self.test["nemesis"]
+
+    def _apply(self, op: Op) -> Op:
+        test = self.test
+        log_op(op)
+        for hist, lock in list(test["active_histories"]):
+            with lock:
+                hist.append(op)
+        try:
+            completion = self.nemesis.invoke(test, op)
+            completion = replace(completion, time=relative_time_nanos())
+        except BaseException as e:
+            if self.aborting.is_set():
+                raise
+            log.warning("Nemesis crashed: %s", traceback.format_exc())
+            completion = replace(op, type="info",
+                                 time=relative_time_nanos(),
+                                 error=f"indeterminate: {e}")
+        assert completion.type == "info", (
+            f"expected nemesis invoke to return type info, got "
+            f"{completion!r}")
+        for hist, lock in list(test["active_histories"]):
+            with lock:
+                hist.append(completion)
+        log_op(completion)
+        return completion
+
+    def run(self):
+        test = self.test
+        g = test["generator"]
+        while True:
+            if self.aborting.is_set():
+                raise WorkerAbort("nemesis aborted")
+            opd = gen.op_and_validate(g, test, "nemesis")
+            if opd is None:
+                return
+            op = Op(process="nemesis", type=opd.get("type", "info"),
+                    f=opd.get("f"), value=opd.get("value"),
+                    time=relative_time_nanos())
+            self._apply(op)
+
+    def teardown(self):
+        if self.nemesis is not None:
+            self.nemesis.teardown(self.test)
+
+
+# ---------------------------------------------------------------------------
+# Environment scaffolding (core.clj:56-143)
+# ---------------------------------------------------------------------------
+
+
+def setup_primary(test: dict) -> None:
+    """Primary protocol setup on node 1 (core.clj:88-94)."""
+    d = test.get("db")
+    if isinstance(d, db_mod.Primary):
+        d.setup_primary(test, primary(test))
+
+
+def snarf_logs(test: dict) -> None:
+    """Download db log files into the store (core.clj:96-127)."""
+    d = test.get("db")
+    if not isinstance(d, db_mod.LogFiles):
+        return
+    log.info("Snarfing log files")
+
+    def snarf(test, node):
+        sess = control.session(node, test)
+        for remote_path in d.log_files(test, node):
+            local = store.path_mkdirs(
+                test, str(node), remote_path.lstrip("/"))
+            try:
+                sess.download(remote_path, local)
+            except Exception as e:
+                log.info("%s couldn't be downloaded: %s", remote_path, e)
+
+    control.on_nodes(test, snarf)
+
+
+def with_os(test: dict):
+    control.on_nodes(test,
+                     lambda t, n: t["os"].setup(t, n))
+
+
+def teardown_os(test: dict):
+    control.on_nodes(test,
+                     lambda t, n: t["os"].teardown(t, n))
+
+
+def with_db(test: dict):
+    control.on_nodes(test,
+                     lambda t, n: db_mod.cycle(t["db"], t, n))
+    setup_primary(test)
+
+
+def teardown_db(test: dict):
+    control.on_nodes(test,
+                     lambda t, n: t["db"].teardown(t, n))
+
+
+# ---------------------------------------------------------------------------
+# run-case! and run! (core.clj:452-610)
+# ---------------------------------------------------------------------------
+
+
+def run_case(test: dict) -> list[Op]:
+    """Spawn nemesis + clients, run one case, snarf logs, return history
+    (core.clj:452-484)."""
+    history: list[Op] = []
+    lock = threading.RLock()
+    test["history"] = history
+    test["_history_lock"] = lock
+    test["active_histories"].append((history, lock))
+
+    nodes = test.get("nodes") or []
+    client_nodes = ([None] * test["concurrency"] if not nodes else
+                    [nodes[i % len(nodes)]
+                     for i in range(test["concurrency"])])
+    clients = [ClientWorker(test, i, n) for i, n in enumerate(client_nodes)]
+    workers: list[Worker] = [NemesisWorker(test)] + clients
+    try:
+        run_workers(test, workers)
+    finally:
+        snarf_logs(test)
+        test["active_histories"].remove((history, lock))
+    return history
+
+
+def prepare_test(test: dict) -> dict:
+    """Fill in defaults (core.clj:550-566)."""
+    test = dict(test)
+    test.setdefault("start_time", store.time_str())
+    test.setdefault("concurrency", len(test.get("nodes") or []) or 1)
+    test.setdefault("os", os_mod.noop)
+    test.setdefault("db", db_mod.noop)
+    nodes = test.get("nodes") or []
+    test.setdefault("barrier",
+                    AbortableBarrier(len(nodes)) if nodes else "no-barrier")
+    test["active_histories"] = []
+    test["__abort__"] = threading.Event()
+    return test
+
+
+def run(test: dict) -> dict:
+    """Run a complete test; returns the test dict with :history and
+    :results (core.clj:500-610)."""
+    test = prepare_test(test)
+    store.start_logging(test)
+    try:
+        log.info("Running test: %s", test.get("name"))
+        try:
+            control.setup_sessions(test)
+            with_os(test)
+            try:
+                with_db(test)
+                try:
+                    threads = list(range(test["concurrency"])) + ["nemesis"]
+                    with gen.with_threads(threads):
+                        with relative_time():
+                            test["history"] = run_case(test)
+                    log.info("Run complete, writing")
+                    if test.get("name"):
+                        store.save_1(test, test["history"])
+                finally:
+                    teardown_db(test)
+            finally:
+                teardown_os(test)
+        finally:
+            for s in (test.get("sessions") or {}).values():
+                try:
+                    s.remote.disconnect(s.node)
+                except Exception:
+                    pass
+
+        log.info("Analyzing")
+        test["history"] = index_history(test["history"])
+        test["results"] = checker_mod.check_safe(
+            test["checker"], test, test["history"], {})
+        log.info("Analysis complete")
+        if test.get("name"):
+            store.save_2(test, test["results"])
+        log_results(test)
+        return test
+    finally:
+        store.stop_logging(test)
+
+
+def log_results(test: dict) -> dict:
+    """core.clj:486-498, table flip included."""
+    valid = test.get("results", {}).get("valid")
+    if valid is True:
+        log.info("Everything looks good! ヽ('ー`)ノ")
+    elif valid == "unknown":
+        log.info("Errors occurred during analysis, but no anomalies found. "
+                 "ಠ~ಠ")
+    else:
+        log.info("Analysis invalid! (ﾉಥ益ಥ）ﾉ ┻━┻")
+    return test

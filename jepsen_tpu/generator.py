@@ -608,7 +608,9 @@ await_fn = Await
 
 class Synchronize(Generator):
     """All of *threads* must arrive before any proceeds; synchronizes once
-    (generator.clj:440-456)."""
+    (generator.clj:440-456).  Workers blocked here are released (with
+    WorkerAbort) if the test aborts — the analog of the reference breaking
+    barriers via thread interrupts (core.clj:204-245)."""
 
     def __init__(self, gen):
         self.gen = gen
@@ -618,16 +620,17 @@ class Synchronize(Generator):
 
     def op(self, test, process):
         if not self._clear:
+            from .util import AbortableBarrier
+
             with self._lock:
                 if self._barrier is None and not self._clear:
-                    def clear():
-                        self._clear = True
-
-                    self._barrier = threading.Barrier(
-                        len(current_threads()), action=clear)
+                    self._barrier = AbortableBarrier(
+                        len(current_threads()),
+                        abort_event=test.get("__abort__"))
                 barrier = self._barrier
             if not self._clear and barrier is not None:
                 barrier.wait()
+                self._clear = True
         return gen_op(self.gen, test, process)
 
 

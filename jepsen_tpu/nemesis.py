@@ -1,0 +1,40 @@
+"""Nemesis protocol — fault injection (reference L2).
+
+Reference: jepsen/src/jepsen/nemesis.clj:9-12 — a Nemesis is a special
+client whose ops act on the environment instead of the database:
+
+  setup(test)       -> ready nemesis
+  invoke(test, op)  -> completion op (always type :info in practice)
+  teardown(test)
+
+Stock nemeses (partitioner, clock-scrambler, hammer-time, ...) live here
+too; grudge topology math is pure and unit-testable
+(nemesis.clj:52-149).  See nemesis_time.py for clock fault tooling.
+"""
+
+from __future__ import annotations
+
+from dataclasses import replace
+
+from .history import Op
+
+
+class Nemesis:
+    def setup(self, test: dict) -> "Nemesis":
+        return self
+
+    def invoke(self, test: dict, op: Op) -> Op:
+        raise NotImplementedError
+
+    def teardown(self, test: dict) -> None:
+        pass
+
+
+class _Noop(Nemesis):
+    """Does nothing (nemesis.clj noop)."""
+
+    def invoke(self, test, op):
+        return replace(op, type="info")
+
+
+noop = _Noop()

@@ -1,0 +1,222 @@
+"""Results persistence (reference L7).
+
+Reference: jepsen/src/jepsen/store.clj — runs persist under
+``store/<test-name>/<start-time>/`` with the history, analysis results,
+the full test map, and the run log; ``latest`` symlinks point at the most
+recent run (store.clj:237-249); a load/browse API supports offline
+re-analysis (store.clj:165-234).
+
+Differences from the reference, by design: Fressian becomes JSON-lines for
+the history (human-greppable, streamable) and JSON for results/test maps;
+non-serializable test entries (clients, generators, checkers — function
+objects) are dropped exactly like the reference's nonserializable-keys
+(store.clj:155-163).
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import os
+import time as _time
+from typing import Any, Iterable
+
+from .history import Op
+
+BASE = "store"
+
+#: test-map keys that hold live objects and never serialize
+#: (store.clj:155-163)
+NONSERIALIZABLE_KEYS = [
+    "db", "os", "net", "client", "checker", "nemesis", "generator", "model",
+    "remote", "barrier", "active_histories", "sessions", "history",
+]
+
+
+def _sanitize(name: str) -> str:
+    return "".join(c if c.isalnum() or c in "-_. " else "_" for c in name)
+
+
+def time_str(t: float | None = None) -> str:
+    return _time.strftime("%Y%m%dT%H%M%S", _time.localtime(t))
+
+
+def base_dir(test: dict) -> str:
+    return test.get("store_base", BASE)
+
+
+def path(test: dict, *more: str) -> str:
+    """store/<name>/<start-time>/<more...> (store.clj:121-135)."""
+    name = _sanitize(test.get("name", "noname"))
+    t = test.get("start_time") or time_str()
+    return os.path.join(base_dir(test), name, t, *[str(m) for m in more])
+
+
+def path_mkdirs(test: dict, *more: str) -> str:
+    p = path(test, *more)
+    os.makedirs(os.path.dirname(p) or ".", exist_ok=True)
+    return p
+
+
+def _jsonable(v: Any):
+    if isinstance(v, Op):
+        return v.to_dict()
+    if isinstance(v, (list, tuple)):
+        return [_jsonable(x) for x in v]
+    if isinstance(v, dict):
+        return {str(k): _jsonable(x) for k, x in v.items()}
+    if isinstance(v, (str, int, float, bool)) or v is None:
+        return v
+    if isinstance(v, (set, frozenset)):
+        return sorted(_jsonable(x) for x in v)
+    try:
+        import numpy as np
+
+        if isinstance(v, np.generic):
+            return v.item()
+    except Exception:
+        pass
+    return repr(v)
+
+
+def serializable_test(test: dict) -> dict:
+    return {k: _jsonable(v) for k, v in test.items()
+            if k not in NONSERIALIZABLE_KEYS}
+
+
+def write_history(test: dict, history: Iterable[Op],
+                  fname: str = "history.jsonl") -> str:
+    """One op per line (the analog of history.txt + history.edn,
+    store.clj:267-279)."""
+    p = path_mkdirs(test, fname)
+    with open(p, "w") as f:
+        for op in history:
+            d = op.to_dict() if isinstance(op, Op) else op
+            f.write(json.dumps(_jsonable(d)) + "\n")
+    return p
+
+
+def read_history(p: str) -> list[Op]:
+    with open(p) as f:
+        return [Op.from_dict(json.loads(line)) for line in f if line.strip()]
+
+
+def save_1(test: dict, history: Iterable[Op]) -> str:
+    """Post-run save: history + test map (store.clj:281-292)."""
+    write_history(test, history)
+    p = path_mkdirs(test, "test.json")
+    with open(p, "w") as f:
+        json.dump(serializable_test(test), f, indent=2, default=repr)
+    update_symlinks(test)
+    return p
+
+
+def save_2(test: dict, results: dict) -> str:
+    """Post-analysis save: results.json (store.clj:294-304)."""
+    p = path_mkdirs(test, "results.json")
+    with open(p, "w") as f:
+        json.dump(_jsonable(results), f, indent=2, default=repr)
+    update_symlinks(test)
+    return p
+
+
+def update_symlinks(test: dict) -> None:
+    """store/latest and store/<name>/latest (store.clj:237-249)."""
+    run_dir = os.path.dirname(path(test, "x"))
+
+    def relink(link: str, target: str):
+        try:
+            if os.path.islink(link):
+                os.unlink(link)
+            elif os.path.exists(link):
+                return
+            os.symlink(os.path.relpath(target, os.path.dirname(link)), link)
+        except OSError:
+            pass
+
+    name_dir = os.path.dirname(run_dir)
+    relink(os.path.join(name_dir, "latest"), run_dir)
+    relink(os.path.join(base_dir(test), "latest"), run_dir)
+
+
+def tests(name: str | None = None, base: str = BASE) -> dict:
+    """Map of test name -> {start-time -> run dir} (store.clj:216-234)."""
+    out: dict = {}
+    if not os.path.isdir(base):
+        return out
+    for n in sorted(os.listdir(base)):
+        d = os.path.join(base, n)
+        if not os.path.isdir(d) or n == "latest":
+            continue
+        if name is not None and n != name:
+            continue
+        runs = {t: os.path.join(d, t) for t in sorted(os.listdir(d))
+                if t != "latest" and os.path.isdir(os.path.join(d, t))}
+        out[n] = runs
+    return out
+
+
+def load(name: str, start_time: str, base: str = BASE) -> dict:
+    """Reload a saved test: test map + history + results
+    (store.clj:165-181)."""
+    d = os.path.join(base, name, start_time)
+    out: dict = {}
+    tj = os.path.join(d, "test.json")
+    if os.path.exists(tj):
+        out = json.load(open(tj))
+    hj = os.path.join(d, "history.jsonl")
+    if os.path.exists(hj):
+        out["history"] = read_history(hj)
+    rj = os.path.join(d, "results.json")
+    if os.path.exists(rj):
+        out["results"] = json.load(open(rj))
+    return out
+
+
+def latest(base: str = BASE) -> dict | None:
+    """The most recent run, via the latest symlink (repl.clj:6-13)."""
+    link = os.path.join(base, "latest")
+    if not os.path.exists(link):
+        return None
+    d = os.path.realpath(link)
+    name = os.path.basename(os.path.dirname(d))
+    return load(name, os.path.basename(d), base)
+
+
+# ---------------------------------------------------------------------------
+# logging (store.clj:306-328): console + per-test jepsen.log file
+# ---------------------------------------------------------------------------
+
+_handlers: dict = {}
+
+
+def start_logging(test: dict) -> None:
+    logger = logging.getLogger("jepsen")
+    logger.setLevel(logging.INFO)
+    if not logger.handlers:
+        sh = logging.StreamHandler()
+        sh.setFormatter(logging.Formatter(
+            "%(asctime)s %(threadName)s %(levelname)s: %(message)s"))
+        logger.addHandler(sh)
+    if not test.get("name"):
+        return  # unnamed tests don't persist anything
+    p = path_mkdirs(test, "jepsen.log")
+    fh = logging.FileHandler(p)
+    fh.setFormatter(logging.Formatter(
+        "%(asctime)s %(threadName)s %(levelname)s: %(message)s"))
+    logger.addHandler(fh)
+    _handlers[id(test)] = fh
+
+
+def stop_logging(test: dict | None = None) -> None:
+    logger = logging.getLogger("jepsen")
+    if test is not None:
+        fh = _handlers.pop(id(test), None)
+        if fh:
+            logger.removeHandler(fh)
+            fh.close()
+        return
+    for fh in _handlers.values():
+        logger.removeHandler(fh)
+        fh.close()
+    _handlers.clear()

@@ -247,3 +247,49 @@ def fcatch(f: Callable) -> Callable:
             return e
 
     return wrapper
+
+
+class WorkerAbort(Exception):
+    """Raised in worker threads when the run is aborting."""
+
+
+class AbortableBarrier:
+    """A cyclic barrier whose waiters can be released by an abort event.
+
+    The reference parks workers on CyclicBarriers and breaks them with
+    thread interrupts (core.clj:204-245); Python threads can't be
+    interrupted, so waiters poll an abort event while blocked.
+    """
+
+    def __init__(self, parties: int, abort_event=None):
+        self.parties = parties
+        self.abort_event = abort_event
+        self._cond = threading.Condition()
+        self._count = 0
+        self._generation = 0
+        self._aborted = False
+
+    def abort(self) -> None:
+        with self._cond:
+            self._aborted = True
+            self._cond.notify_all()
+
+    def _is_aborted(self) -> bool:
+        return self._aborted or (self.abort_event is not None
+                                 and self.abort_event.is_set())
+
+    def wait(self, poll: float = 0.05) -> None:
+        with self._cond:
+            if self._is_aborted():
+                raise WorkerAbort("barrier aborted")
+            gen = self._generation
+            self._count += 1
+            if self._count >= self.parties:
+                self._count = 0
+                self._generation += 1
+                self._cond.notify_all()
+                return
+            while self._generation == gen and not self._is_aborted():
+                self._cond.wait(poll)
+            if self._is_aborted() and self._generation == gen:
+                raise WorkerAbort("barrier aborted")

@@ -1,0 +1,194 @@
+"""Tier-2 harness self-tests against the in-process atom DB, mirroring
+core_test.clj: a full run + linearizability check (basic-cas-test,
+core_test.clj:18-30), crash-looping clients consuming exactly their ops
+(worker-recovery-test, 88-104), and a generator exception unblocking
+barrier-stuck workers (generator-recovery-test, 127-149)."""
+
+import random
+import threading
+from dataclasses import replace
+
+import pytest
+
+from jepsen_tpu import client as client_mod
+from jepsen_tpu import core, fixtures, generator as gen, independent
+from jepsen_tpu.checker import linearizable as lin
+from jepsen_tpu.models import cas_register
+
+
+def cas_test(state, n_ops=60, concurrency=5):
+    return fixtures.noop_test() | {
+        "name": None,  # no store writes in unit tests
+        "db": fixtures.atom_db(state),
+        "client": fixtures.atom_client(state),
+        "model": cas_register(0),  # atom-db resets the register to 0
+        "checker": lin.linearizable(),
+        "generator": gen.clients(
+            gen.limit(n_ops, gen.mix([
+                {"type": "invoke", "f": "read", "value": None},
+                lambda t, p: {"type": "invoke", "f": "write",
+                              "value": random.randrange(5)},
+                lambda t, p: {"type": "invoke", "f": "cas",
+                              "value": (random.randrange(5),
+                                        random.randrange(5))},
+            ]))),
+        "concurrency": concurrency,
+    }
+
+
+def test_basic_cas_run():
+    state = fixtures.AtomRegister()
+    test = core.run(cas_test(state))
+    assert test["results"]["valid"] is True
+    h = test["history"]
+    assert len(h) == 2 * 60  # every op completed
+    assert all(op.index == i for i, op in enumerate(h))
+    # atom-db teardown ran
+    assert state.read() == "done"
+
+
+class CrashyClient(client_mod.Client):
+    """Crashes on every other invoke (worker-recovery-test analog)."""
+
+    def __init__(self, state):
+        self.state = state
+        self.n = 0
+        self.lock = threading.Lock()
+
+    def open(self, test, node):
+        return self
+
+    def invoke(self, test, op):
+        with self.lock:
+            self.n += 1
+            if self.n % 2 == 0:
+                raise RuntimeError("client crashed!")
+        return replace(op, type="ok", value=self.state.read())
+
+
+def test_worker_recovery():
+    """Crash-looping clients still consume exactly n ops
+    (core_test.clj:88-104)."""
+    state = fixtures.AtomRegister()
+    test = cas_test(state) | {
+        "client": CrashyClient(state),
+        "checker": __import__("jepsen_tpu.checker",
+                              fromlist=["unbridled_dionysus"]
+                              ).unbridled_dionysus,
+        "generator": gen.clients(
+            gen.limit(40, {"type": "invoke", "f": "read", "value": None})),
+    }
+    test = core.run(test)
+    h = test["history"]
+    invokes = [op for op in h if op.type == "invoke"]
+    assert len(invokes) == 40
+    infos = [op for op in h if op.type == "info" and op.process != "nemesis"]
+    assert infos, "expected some crashed ops"
+    # crashed processes retired: successor ids appear
+    procs = {op.process for op in invokes}
+    assert any(p >= test["concurrency"] for p in procs)
+
+
+class ExplodingGen(gen.Generator):
+    """Yields a few ops, then throws (generator-recovery-test analog)."""
+
+    def __init__(self, n):
+        self.n = n
+        self.lock = threading.Lock()
+
+    def op(self, test, process):
+        with self.lock:
+            self.n -= 1
+            if self.n < 0:
+                raise RuntimeError("generator exploded!")
+        return {"type": "invoke", "f": "read", "value": None}
+
+
+def test_generator_recovery_unblocks_barriers():
+    """One worker's generator exception must unblock workers parked on a
+    synchronize barrier and close all clients (core_test.clj:127-149)."""
+    state = fixtures.AtomRegister()
+    # phase 1: 3 ops (one per worker on average); phase 2 barrier; the
+    # exploding generator blows up while some workers wait on the barrier
+    g = gen.clients(
+        gen.phases(ExplodingGen(2),
+                   gen.limit(10, {"type": "invoke", "f": "read",
+                                  "value": None})))
+    test = cas_test(state) | {"generator": g, "concurrency": 3,
+                              "checker": __import__(
+                                  "jepsen_tpu.checker",
+                                  fromlist=["unbridled_dionysus"]
+                              ).unbridled_dionysus}
+    with pytest.raises(RuntimeError, match="generator exploded"):
+        core.run(test)
+
+
+def test_nemesis_ops_in_history():
+    state = fixtures.AtomRegister()
+    test = cas_test(state, n_ops=10) | {
+        "generator": gen.nemesis(
+            gen.limit(2, {"type": "info", "f": "start", "value": None}),
+            gen.limit(10, {"type": "invoke", "f": "read", "value": None})),
+    }
+    test = core.run(test)
+    nem_ops = [op for op in test["history"] if op.process == "nemesis"]
+    assert len(nem_ops) == 4  # 2 invocations + 2 completions
+    assert all(op.type == "info" for op in nem_ops)
+
+
+def test_run_with_independent_workload_and_store(tmp_path):
+    """End-to-end: concurrent independent keys + store persistence."""
+    state_by_key = {}
+    lock = threading.Lock()
+
+    class MapClient(client_mod.Client):
+        def open(self, test, node):
+            return self
+
+        def invoke(self, test, op):
+            k, v = op.value.key, op.value.value
+            with lock:
+                reg = state_by_key.setdefault(k, fixtures.AtomRegister(0))
+            if op.f == "write":
+                reg.write(v)
+                return replace(op, type="ok")
+            if op.f == "read":
+                return replace(op, type="ok",
+                               value=independent.tuple_(k, reg.read()))
+            cur, new = v
+            return replace(op, type="ok" if reg.cas(cur, new) else "fail")
+
+    test = fixtures.noop_test() | {
+        "name": "independent-cas",
+        "store_base": str(tmp_path / "store"),
+        "client": MapClient(),
+        "model": cas_register(0),
+        "checker": independent.checker(lin.linearizable()),
+        "concurrency": 4,
+        "generator": gen.clients(independent.concurrent_generator(
+            2, range(4),
+            lambda k: gen.limit(12, gen.mix([
+                {"type": "invoke", "f": "read", "value": None},
+                lambda t, p: {"type": "invoke", "f": "write",
+                              "value": random.randrange(5)},
+            ])))),
+    }
+    test = core.run(test)
+    assert test["results"]["valid"] is True
+    assert set(test["results"]["results"].keys()) == {0, 1, 2, 3}
+
+    # store layout (store.clj:121-135 analog)
+    import os
+
+    base = test["store_base"]
+    d = os.path.join(base, "independent-cas", test["start_time"])
+    assert os.path.exists(os.path.join(d, "history.jsonl"))
+    assert os.path.exists(os.path.join(d, "results.json"))
+    assert os.path.exists(os.path.join(d, "test.json"))
+    assert os.path.islink(os.path.join(base, "latest"))
+
+    from jepsen_tpu import store as store_mod
+
+    loaded = store_mod.load("independent-cas", test["start_time"], base)
+    assert loaded["results"]["valid"] is True
+    assert len(loaded["history"]) == len(test["history"])

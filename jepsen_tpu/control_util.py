@@ -1,0 +1,209 @@
+"""Remote scripting toolkit — install/daemon utilities.
+
+Reference: jepsen/src/jepsen/control/util.clj: exists? (18), ls (25),
+tmp-dir! (43), cached-wget! (79: cache filenames are base64 URLs so
+same-name different-version downloads can't alias), install-archive!
+(106), ensure-user! (182), grepkill! (191), start-daemon!
+(208, start-stop-daemon), stop-daemon! (238).
+
+All functions take a :class:`control.Session` first — the reference used
+ambient dynamic session state; explicit sessions compose better with the
+thread-pooled runner.
+"""
+
+from __future__ import annotations
+
+import base64
+import logging
+import random
+from typing import Optional
+
+from .control import Lit, RemoteError, Session, lit
+
+log = logging.getLogger("jepsen")
+
+TMP_DIR_BASE = "/tmp/jepsen"
+WGET_CACHE_DIR = f"{TMP_DIR_BASE}/wget-cache"
+
+STD_WGET_OPTS = ["--tries", "20", "--waitretry", "60",
+                 "--retry-connrefused", "--dns-timeout", "60",
+                 "--connect-timeout", "60", "--read-timeout", "60"]
+
+
+def exists(sess: Session, filename: str) -> bool:
+    """Is a path present? (control/util.clj:18-23)"""
+    try:
+        sess.exec("stat", filename)
+        return True
+    except RemoteError:
+        return False
+
+
+def ls(sess: Session, d: str = ".") -> list[str]:
+    out = sess.exec("ls", "-A", d)
+    return [x for x in out.split("\n") if x.strip()]
+
+
+def ls_full(sess: Session, d: str) -> list[str]:
+    d = d if d.endswith("/") else d + "/"
+    return [d + e for e in ls(sess, d)]
+
+
+def tmp_dir(sess: Session) -> str:
+    """A fresh directory under /tmp/jepsen (control/util.clj:43-51)."""
+    while True:
+        d = f"{TMP_DIR_BASE}/{random.randrange(2**31)}"
+        if not exists(sess, d):
+            sess.exec("mkdir", "-p", d)
+            return d
+
+
+def wget(sess: Session, url: str, force: bool = False) -> str:
+    """Download into the cwd; skip if present (control/util.clj:62-72)."""
+    filename = url.rstrip("/").rsplit("/", 1)[-1]
+    if force:
+        sess.exec("rm", "-f", filename)
+    if not exists(sess, filename):
+        sess.exec("wget", *STD_WGET_OPTS, url)
+    return filename
+
+
+def cached_wget(sess: Session, url: str, force: bool = False) -> str:
+    """Download to the cache dir keyed by base64(url)
+    (control/util.clj:79-104)."""
+    encoded = base64.b64encode(url.encode()).decode()
+    dest = f"{WGET_CACHE_DIR}/{encoded}"
+    if force:
+        log.info("Clearing cached copy of %s", url)
+        sess.exec("rm", "-rf", dest)
+    if not exists(sess, dest):
+        log.info("Downloading %s", url)
+        sess.exec("mkdir", "-p", WGET_CACHE_DIR)
+        sess.cd(WGET_CACHE_DIR).exec("wget", *STD_WGET_OPTS, "-O", dest, url)
+    return dest
+
+
+def expand_path(sess: Session, path: str) -> str:
+    if path.startswith("~"):
+        return sess.exec("readlink", "-f", path)
+    return path
+
+
+def install_archive(sess: Session, url: str, dest: str,
+                    force: bool = False) -> str:
+    """Fetch a tarball/zip (cached), extract its sole top-level directory
+    (or all files) to dest (control/util.clj:106-173)."""
+    local = url[len("file://"):] if url.startswith("file://") else None
+    f = local or cached_wget(sess, url, force)
+    tmpdir = tmp_dir(sess)
+    dest = expand_path(sess, dest)
+    sess.exec("rm", "-rf", dest)
+    parent = sess.exec("dirname", dest)
+    sess.exec("mkdir", "-p", parent)
+    try:
+        at = sess.cd(tmpdir)
+        if url.endswith(".zip"):
+            at.exec("unzip", f)
+        else:
+            at.exec("tar", "--no-same-owner", "--no-same-permissions",
+                    "--extract", "--file", f)
+        if sess.sudo_user == "root":
+            at.exec("chown", "-R", "root:root", ".")
+        roots = ls(sess, tmpdir)
+        assert roots, "Archive contained no files"
+        if len(roots) == 1:
+            at.exec("mv", roots[0], dest)
+        else:
+            sess.exec("mv", tmpdir, dest)
+    except RemoteError as e:
+        if "Unexpected EOF" in str(e):
+            if local:
+                raise RemoteError(
+                    f"local archive {local} is corrupt: unexpected EOF",
+                    1, "", "") from e
+            log.info("Retrying corrupt archive download")
+            sess.exec("rm", "-rf", f)
+            return install_archive(sess, url, dest, force)
+        raise
+    finally:
+        sess.exec("rm", "-rf", tmpdir)
+    return dest
+
+
+def ensure_user(sess: Session, username: str) -> str:
+    """Make sure a user exists (control/util.clj:182-189)."""
+    try:
+        sess.su().exec("adduser", "--disabled-password", "--gecos",
+                       lit("''"), username)
+    except RemoteError as e:
+        if "already exists" not in str(e):
+            raise
+    return username
+
+
+def grepkill(sess: Session, pattern: str, signal: int = 9) -> None:
+    """Kill processes matching a pattern (control/util.clj:191-206)."""
+    try:
+        sess.exec("ps", "aux", lit("|"), "grep", pattern, lit("|"),
+                  "grep", "-v", "grep", lit("|"), "awk", "{print $2}",
+                  lit("|"), "xargs", "kill", f"-{signal}")
+    except RemoteError as e:
+        if str(e.err or "").strip() or str(e.out or "").strip():
+            raise
+
+
+def start_daemon(sess: Session, bin_path: str, *args,
+                 logfile: str, pidfile: str, chdir: str = "/",
+                 background: bool = True, make_pidfile: bool = True,
+                 match_executable: bool = True,
+                 match_process_name: bool = False,
+                 process_name: Optional[str] = None) -> None:
+    """Start a daemon via start-stop-daemon, logging to logfile
+    (control/util.clj:208-236)."""
+    log.info("starting %s", bin_path.rsplit("/", 1)[-1])
+    sess.exec("echo", lit("`date +'%Y-%m-%d %H:%M:%S'`"),
+              "Jepsen starting", bin_path, " ".join(map(str, args)),
+              lit(">>"), logfile)
+    argv: list = ["start-stop-daemon", "--start"]
+    if background:
+        argv += ["--background", "--no-close"]
+    if make_pidfile:
+        argv += ["--make-pidfile"]
+    if match_executable:
+        argv += ["--exec", bin_path]
+    if match_process_name:
+        argv += ["--name", process_name or bin_path.rsplit("/", 1)[-1]]
+    argv += ["--pidfile", pidfile, "--chdir", chdir, "--oknodo",
+             "--startas", bin_path, "--", *map(str, args),
+             lit(">>"), logfile, lit("2>&1")]
+    sess.exec(*argv)
+
+
+def stop_daemon(sess: Session, pidfile: str, cmd: str | None = None) -> None:
+    """Kill by pidfile, or by command name (control/util.clj:238-251)."""
+    if cmd is not None:
+        log.info("Stopping %s", cmd)
+        for c in (("killall", "-9", "-w", cmd), ("rm", "-rf", pidfile)):
+            try:
+                sess.exec(*c)
+            except RemoteError:
+                pass
+        return
+    if exists(sess, pidfile):
+        log.info("Stopping %s", pidfile)
+        pid = sess.exec("cat", pidfile).strip()
+        for c in (("kill", "-9", pid), ("rm", "-rf", pidfile)):
+            try:
+                sess.exec(*c)
+            except RemoteError:
+                pass
+
+
+def daemon_running(sess: Session, pidfile: str) -> bool:
+    """Is the pidfile's process alive?"""
+    try:
+        pid = sess.exec("cat", pidfile).strip()
+        sess.exec("kill", "-0", pid)
+        return True
+    except RemoteError:
+        return False

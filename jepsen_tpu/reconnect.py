@@ -1,0 +1,89 @@
+"""Auto-reconnecting connection wrapper.
+
+Reference: jepsen/src/jepsen/reconnect.clj — a read/write-lock guarded
+wrapper around a connection: `with_conn` hands out the live connection;
+on error the caller (or the wrapper) closes and reopens it
+(reconnect.clj:16-129).  Used by database clients whose connections die
+during partitions.
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+from typing import Any, Callable, Optional
+
+log = logging.getLogger("jepsen")
+
+
+class Wrapper:
+    """reconnect.clj:16-56: open/close/name/log? policy functions."""
+
+    def __init__(self, open: Callable[[], Any],
+                 close: Callable[[Any], None] = lambda c: None,
+                 name: str = "conn", log_errors: bool = True):
+        self._open = open
+        self._close = close
+        self.name = name
+        self.log_errors = log_errors
+        self._lock = threading.RLock()
+        self._conn: Optional[Any] = None
+        self._closed = True
+
+    def open(self) -> "Wrapper":
+        """reconnect.clj:58-66."""
+        with self._lock:
+            if self._closed:
+                self._conn = self._open()
+                self._closed = False
+        return self
+
+    def conn(self):
+        with self._lock:
+            if self._closed:
+                self.open()
+            return self._conn
+
+    def reopen(self) -> "Wrapper":
+        """Close (ignoring errors) and open a fresh conn
+        (reconnect.clj:77-90)."""
+        with self._lock:
+            try:
+                if self._conn is not None:
+                    self._close(self._conn)
+            except Exception as e:
+                if self.log_errors:
+                    log.warning("error closing %s: %s", self.name, e)
+            self._conn = self._open()
+            self._closed = False
+        return self
+
+    def close(self) -> None:
+        """reconnect.clj:103-112."""
+        with self._lock:
+            try:
+                if self._conn is not None:
+                    self._close(self._conn)
+            finally:
+                self._conn = None
+                self._closed = True
+
+    def with_conn(self, f: Callable[[Any], Any]):
+        """Run f(conn); on error, reopen the conn and re-raise
+        (reconnect.clj:92-101)."""
+        c = self.conn()
+        try:
+            return f(c)
+        except Exception as e:
+            if self.log_errors:
+                log.warning("error on %s: %s; reopening", self.name, e)
+            try:
+                self.reopen()
+            except Exception as e2:
+                if self.log_errors:
+                    log.warning("error reopening %s: %s", self.name, e2)
+            raise e
+
+
+def wrapper(**kw) -> Wrapper:
+    return Wrapper(**kw)

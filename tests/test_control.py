@@ -1,0 +1,177 @@
+"""Control-plane tests: command construction against the dummy remote
+(the analog of control.clj's *dummy* mode, control.clj:16,288-300) and
+real execution via LocalRemote."""
+
+import pytest
+
+from jepsen_tpu import control, control_util as cu, net, reconnect
+from jepsen_tpu.control import (DummyRemote, LocalRemote, RemoteError,
+                                Session, SSHRemote, lit)
+from jepsen_tpu.os import debian
+
+
+def dummy_session(responses=None):
+    r = DummyRemote(responses)
+    return Session(node="n1", remote=r), r
+
+
+def test_exec_escaping_and_output():
+    s, r = dummy_session({"echo": (0, "  hello\n", "")})
+    out = s.exec("echo", "hello world")
+    assert out == "hello"
+    assert r.log == [("n1", "exec", "echo 'hello world'")]
+
+
+def test_exec_nonzero_raises():
+    s, r = dummy_session({"false": (1, "", "boom")})
+    with pytest.raises(RemoteError, match="boom"):
+        s.exec("false")
+
+
+def test_sudo_and_cd_wrapping():
+    s, r = dummy_session()
+    s.su().exec("whoami")
+    assert r.log[-1][2] == "sudo -S -u root sh -c whoami"
+    s.cd("/tmp").exec("ls")
+    assert r.log[-1][2] == "cd /tmp && ls"
+    s.su("admin").cd("/x").exec("ls")
+    assert r.log[-1][2] == "sudo -S -u admin sh -c 'cd /x && ls'"
+
+
+def test_lit_unescaped():
+    s, r = dummy_session()
+    s.exec("ls", lit("|"), "wc")
+    assert r.log[-1][2] == "ls | wc"
+
+
+def test_local_remote_real_commands(tmp_path):
+    s = Session(node="local", remote=LocalRemote())
+    assert s.exec("echo", "hi") == "hi"
+    p = tmp_path / "f.txt"
+    s.exec("sh", "-c", f"echo data > {p}")
+    assert cu.exists(s, str(p))
+    assert not cu.exists(s, str(tmp_path / "nope"))
+    assert "f.txt" in cu.ls(s, str(tmp_path))
+
+
+def test_on_nodes_parallel_fanout():
+    r = DummyRemote()
+    test = {"nodes": ["n1", "n2", "n3"],
+            "sessions": {n: Session(node=n, remote=r)
+                         for n in ["n1", "n2", "n3"]}}
+    out = control.on_nodes(
+        test, lambda t, n: control.session(n, t).exec("hostname"))
+    assert set(out) == {"n1", "n2", "n3"}
+    assert {e[0] for e in r.log} == {"n1", "n2", "n3"}
+
+
+def test_cached_wget_key_is_base64():
+    s, r = dummy_session({"stat": (1, "", "no such file")})
+    path = cu.cached_wget(s, "https://x.example/v1.2/foo.tar")
+    assert path.startswith(cu.WGET_CACHE_DIR + "/")
+    # base64 of the URL, not the basename — versioned URLs can't alias
+    import base64
+
+    assert base64.b64decode(
+        path.rsplit("/", 1)[1]).decode() == "https://x.example/v1.2/foo.tar"
+    assert any("wget" in e[2] for e in r.log if e[1] == "exec")
+
+
+def test_start_stop_daemon_command_shape():
+    s, r = dummy_session()
+    cu.start_daemon(s, "/opt/etcd/etcd", "--name", "n1",
+                    logfile="/var/log/etcd.log", pidfile="/var/run/etcd.pid",
+                    chdir="/opt/etcd")
+    cmd = r.log[-1][2]
+    assert "start-stop-daemon --start" in cmd
+    assert "--background" in cmd and "--make-pidfile" in cmd
+    assert "--exec /opt/etcd/etcd" in cmd
+    assert ">> /var/log/etcd.log 2>&1" in cmd
+
+    r.responses["stat"] = (0, "", "")
+    r.responses["cat"] = (0, "1234", "")
+    cu.stop_daemon(s, "/var/run/etcd.pid")
+    assert any("kill -9 1234" in e[2] for e in r.log)
+
+
+def test_grepkill():
+    s, r = dummy_session()
+    cu.grepkill(s, "etcd")
+    cmd = r.log[-1][2]
+    assert "ps aux | grep etcd | grep -v grep" in cmd
+    assert "xargs kill -9" in cmd
+
+
+def test_iptables_net_commands():
+    r = DummyRemote({"getent": (0, "192.168.1.2  STREAM n2\n", "")})
+    nodes = ["n1", "n2"]
+    test = {"nodes": nodes, "net": net.iptables,
+            "sessions": {n: Session(node=n, remote=r) for n in nodes}}
+    net.iptables.drop(test, "n2", "n1")
+    assert any("iptables -A INPUT -s 192.168.1.2 -j DROP -w" in e[2]
+               for e in r.log if e[0] == "n1")
+    net.iptables.heal(test)
+    assert any("iptables -F -w" in e[2] for e in r.log)
+    net.iptables.slow(test)
+    assert any("netem delay 50ms 10ms distribution normal" in e[2]
+               for e in r.log)
+    net.iptables.flaky(test)
+    assert any("loss 20% 75%" in e[2] for e in r.log)
+
+    # batch grudge fast path: one rule with joined IPs per victim
+    r.log.clear()
+    net.drop_all(test, {"n1": ["n2"]})
+    rules = [e for e in r.log if "iptables -A INPUT" in e[2]]
+    assert len(rules) == 1 and rules[0][0] == "n1"
+
+
+def test_reconnect_wrapper():
+    opens = []
+
+    class Conn:
+        def __init__(self):
+            self.closed = False
+            opens.append(self)
+
+    w = reconnect.Wrapper(open=Conn, close=lambda c: setattr(
+        c, "closed", True), log_errors=False)
+    c1 = w.conn()
+    assert w.with_conn(lambda c: c) is c1
+
+    def boom(c):
+        raise RuntimeError("conn died")
+
+    with pytest.raises(RuntimeError):
+        w.with_conn(boom)
+    c2 = w.conn()
+    assert c2 is not c1 and c1.closed
+    w.close()
+    assert c2.closed and len(opens) == 2
+
+
+def test_debian_install_only_missing():
+    listing = ("ii  wget  1.21  amd64  retrieves files\n"
+               "ii  curl  7.88  amd64  transfers data\n")
+    s, r = dummy_session({"dpkg": (0, listing, "")})
+    debian.install(s, ["wget", "curl", "vim"])
+    installs = [e[2] for e in r.log if "apt-get install" in e[2]]
+    assert len(installs) == 1 and "vim" in installs[0]
+    assert "wget" not in installs[0]
+
+
+def test_debian_install_pinned_versions():
+    s, r = dummy_session({"apt-cache": (0, "  Installed: 1.0\n", "")})
+    debian.install(s, {"etcd": "3.1.5", "wget": "1.0"})
+    installs = [e[2] for e in r.log if "apt-get install" in e[2]]
+    assert len(installs) == 1 and "etcd=3.1.5" in installs[0]
+
+
+def test_ssh_remote_command_construction():
+    ssh = SSHRemote(control.SSHConfig(username="admin", port=2222,
+                                      private_key_path="/k"))
+    args = ssh._base("n1")
+    assert args[0] == "ssh"
+    assert "admin@n1" in args
+    assert "-p" in args and "2222" in args[args.index("-p") + 1]
+    assert "-i" in args and "/k" in args
+    assert any("ControlMaster" in a for a in args)

@@ -293,3 +293,12 @@ class AbortableBarrier:
                 self._cond.wait(poll)
             if self._is_aborted() and self._generation == gen:
                 raise WorkerAbort("barrier aborted")
+
+
+def random_nonempty_subset(coll):
+    """A random non-empty subset of coll (util.clj random-nonempty-subset)."""
+    import random as _r
+
+    coll = list(coll)
+    n = _r.randint(1, len(coll))
+    return _r.sample(coll, n)

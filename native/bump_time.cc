@@ -1,0 +1,50 @@
+// bump_time — jump the system wall clock by a signed delta in milliseconds.
+//
+// Usage: bump_time DELTA_MS
+//
+// TPU-native rebuild of the capability in the reference's
+// jepsen/resources/bump-time.c (settimeofday-based clock jump): the
+// harness uploads this source and compiles it on each db node
+// (nemesis/time.clj:12-43 does the same with on-node gcc), then invokes
+// it to inject clock-skew faults.  Fresh implementation, C++17.
+
+#include <cerrno>
+#include <cstdio>
+#include <cstdlib>
+#include <cstring>
+#include <sys/time.h>
+
+int main(int argc, char **argv) {
+  if (argc != 2) {
+    std::fprintf(stderr, "usage: %s delta-ms\n", argv[0]);
+    return 2;
+  }
+  char *end = nullptr;
+  const long long delta_ms = std::strtoll(argv[1], &end, 10);
+  if (end == argv[1] || *end != '\0') {
+    std::fprintf(stderr, "%s: not a number: %s\n", argv[0], argv[1]);
+    return 2;
+  }
+
+  struct timeval tv;
+  if (gettimeofday(&tv, nullptr) != 0) {
+    std::perror("gettimeofday");
+    return 1;
+  }
+
+  long long usec = static_cast<long long>(tv.tv_usec) + delta_ms * 1000LL;
+  long long sec = static_cast<long long>(tv.tv_sec) + usec / 1000000LL;
+  usec %= 1000000LL;
+  if (usec < 0) {  // renormalize for negative deltas
+    usec += 1000000LL;
+    sec -= 1;
+  }
+  tv.tv_sec = static_cast<time_t>(sec);
+  tv.tv_usec = static_cast<suseconds_t>(usec);
+
+  if (settimeofday(&tv, nullptr) != 0) {
+    std::perror("settimeofday");
+    return 1;
+  }
+  return 0;
+}

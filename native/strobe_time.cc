@@ -1,0 +1,81 @@
+// strobe_time — flip the wall clock between "real" and "real + delta"
+// every PERIOD_MS milliseconds for DURATION_S seconds.
+//
+// Usage: strobe_time DELTA_MS PERIOD_MS DURATION_S
+//
+// TPU-native rebuild of the capability in the reference's
+// jepsen/resources/strobe-time.c: phases are anchored to CLOCK_MONOTONIC
+// so the strobe cadence is immune to the very wall-clock jumps it makes
+// (the reference anchors the same way, strobe-time.c:117-171).  The
+// harness compiles this on each db node (nemesis/time.clj:12-43 pattern).
+// Fresh implementation, C++17.
+
+#include <cstdio>
+#include <cstdlib>
+#include <ctime>
+#include <sys/time.h>
+
+namespace {
+
+long long monotonic_ms() {
+  struct timespec ts;
+  clock_gettime(CLOCK_MONOTONIC, &ts);
+  return ts.tv_sec * 1000LL + ts.tv_nsec / 1000000LL;
+}
+
+// Shift the wall clock by delta milliseconds.
+int shift_wall_clock(long long delta_ms) {
+  struct timeval tv;
+  if (gettimeofday(&tv, nullptr) != 0) return -1;
+  long long usec = static_cast<long long>(tv.tv_usec) + delta_ms * 1000LL;
+  long long sec = static_cast<long long>(tv.tv_sec) + usec / 1000000LL;
+  usec %= 1000000LL;
+  if (usec < 0) {
+    usec += 1000000LL;
+    sec -= 1;
+  }
+  tv.tv_sec = static_cast<time_t>(sec);
+  tv.tv_usec = static_cast<suseconds_t>(usec);
+  return settimeofday(&tv, nullptr);
+}
+
+}  // namespace
+
+int main(int argc, char **argv) {
+  if (argc != 4) {
+    std::fprintf(stderr, "usage: %s delta-ms period-ms duration-s\n",
+                 argv[0]);
+    return 2;
+  }
+  const long long delta = std::atoll(argv[1]);
+  const long long period = std::atoll(argv[2]);
+  const double duration = std::atof(argv[3]);
+  if (period <= 0) {
+    std::fprintf(stderr, "period must be positive\n");
+    return 2;
+  }
+
+  const long long start = monotonic_ms();
+  const long long end = start + static_cast<long long>(duration * 1000.0);
+  bool offset = false;  // is the clock currently shifted forward?
+
+  while (monotonic_ms() < end) {
+    if (shift_wall_clock(offset ? -delta : delta) != 0) {
+      std::perror("settimeofday");
+      return 1;
+    }
+    offset = !offset;
+
+    // sleep to the next period boundary on the monotonic clock
+    const long long now = monotonic_ms();
+    const long long next = start + ((now - start) / period + 1) * period;
+    struct timespec ts;
+    ts.tv_sec = (next - now) / 1000;
+    ts.tv_nsec = ((next - now) % 1000) * 1000000L;
+    nanosleep(&ts, nullptr);
+  }
+
+  // leave the clock un-shifted
+  if (offset) shift_wall_clock(-delta);
+  return 0;
+}

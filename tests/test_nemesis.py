@@ -1,0 +1,193 @@
+"""Nemesis tests: pure grudge topology properties (the analog of
+nemesis_test.clj:18-60) and command-shape checks against the dummy
+remote."""
+
+import random
+from dataclasses import replace
+
+import pytest
+
+from jepsen_tpu import faketime, nemesis, nemesis_time, net
+from jepsen_tpu.control import DummyRemote, Session
+from jepsen_tpu.history import info_op
+from jepsen_tpu.util import majority
+
+NODES = ["n1", "n2", "n3", "n4", "n5"]
+
+
+def mk_test(responses=None):
+    r = DummyRemote(responses or {"getent": (0, "10.0.0.9 STREAM x\n", "")})
+    return {"nodes": list(NODES), "net": net.iptables,
+            "sessions": {n: Session(node=n, remote=r) for n in NODES}}, r
+
+
+# --- topology math --------------------------------------------------------
+
+
+def test_bisect():
+    assert nemesis.bisect([1, 2, 3, 4, 5]) == ([1, 2], [3, 4, 5])
+    assert nemesis.bisect([]) == ([], [])
+
+
+def test_split_one():
+    loner, rest = nemesis.split_one(NODES, loner="n3")
+    assert loner == ["n3"] and "n3" not in rest
+    assert set(rest) | {"n3"} == set(NODES)
+
+
+def test_complete_grudge():
+    g = nemesis.complete_grudge(nemesis.bisect(NODES))
+    assert g["n1"] == {"n3", "n4", "n5"}
+    assert g["n4"] == {"n1", "n2"}
+    # nobody grudges their own component
+    for node, dropped in g.items():
+        assert node not in dropped
+
+
+def test_bridge():
+    g = nemesis.bridge(NODES)
+    # n3 is the bridge: appears in no grudge, has no grudge
+    assert "n3" not in g
+    for node, dropped in g.items():
+        assert "n3" not in dropped
+    assert g["n1"] == {"n4", "n5"}
+    assert g["n4"] == {"n1", "n2"}
+
+
+@pytest.mark.parametrize("n", [3, 5, 7, 9])
+def test_majorities_ring_properties(n):
+    """Every node sees a majority; no two nodes see the same majority
+    (nemesis_test.clj:40-60)."""
+    nodes = [f"m{i}" for i in range(n)]
+    random.seed(n)
+    g = nemesis.majorities_ring(nodes)
+    m = majority(n)
+    assert len(g) == n  # every node has an entry
+    views = set()
+    for node, dropped in g.items():
+        visible = set(nodes) - set(dropped)
+        assert node in visible
+        assert len(visible) >= m, f"{node} sees a minority"
+        views.add(frozenset(visible))
+    assert len(views) == n, "two nodes see the same majority"
+
+
+# --- partitioner ----------------------------------------------------------
+
+
+def test_partitioner_start_stop():
+    test, r = mk_test()
+    p = nemesis.partition_halves().setup(test)
+    out = p.invoke(test, info_op("nemesis", "start"))
+    assert out.type == "info" and out.value[0] == "isolated"
+    drops = [e for e in r.log if "iptables -A INPUT" in e[2]]
+    assert len(drops) == len(NODES)  # one batched rule per node
+    out2 = p.invoke(test, info_op("nemesis", "stop"))
+    assert out2.value == "network-healed"
+    assert any("iptables -F" in e[2] for e in r.log)
+
+
+# --- compose --------------------------------------------------------------
+
+
+def test_compose_routes_and_renames():
+    class Recorder(nemesis.Nemesis):
+        def __init__(self):
+            self.seen = []
+
+        def invoke(self, test, op):
+            self.seen.append(op.f)
+            return replace(op, type="info")
+
+    a, b = Recorder(), Recorder()
+    comp = nemesis.compose([
+        (frozenset({"start", "stop"}), a),
+        ({"kill-start": "start", "kill-stop": "stop"}, b),
+    ])
+    test, _ = mk_test()
+    out = comp.invoke(test, info_op("nemesis", "start"))
+    assert a.seen == ["start"] and out.f == "start"
+    out2 = comp.invoke(test, info_op("nemesis", "kill-start"))
+    assert b.seen == ["start"], "inner nemesis sees renamed f"
+    assert out2.f == "kill-start", "outer f restored on the completion"
+    with pytest.raises(ValueError, match="no nemesis"):
+        comp.invoke(test, info_op("nemesis", "what"))
+
+
+# --- node start/stop + hammer-time ----------------------------------------
+
+
+def test_hammer_time_commands_and_state():
+    test, r = mk_test()
+    h = nemesis.hammer_time("mongod", targeter=lambda ns: ns[0])
+    out = h.invoke(test, info_op("nemesis", "start"))
+    assert out.value == {"n1": ["paused", "mongod"]}
+    assert any("killall -s STOP mongod" in e[2] for e in r.log
+               if e[0] == "n1")
+    # double start: refuses while already disrupting
+    out2 = h.invoke(test, info_op("nemesis", "start"))
+    assert "already disrupting" in str(out2.value)
+    out3 = h.invoke(test, info_op("nemesis", "stop"))
+    assert out3.value == {"n1": ["resumed", "mongod"]}
+    assert any("killall -s CONT mongod" in e[2] for e in r.log)
+    # stop again: not started
+    assert h.invoke(test, info_op("nemesis", "stop")).value == "not-started"
+
+
+def test_truncate_file():
+    test, r = mk_test()
+    op = info_op("nemesis", "truncate",
+                 {"n2": {"file": "/var/lib/db/wal", "drop": 64}})
+    nemesis.truncate_file().invoke(test, op)
+    assert any("truncate -c -s -64 /var/lib/db/wal" in e[2]
+               for e in r.log if e[0] == "n2")
+
+
+# --- clock nemesis --------------------------------------------------------
+
+
+def test_clock_nemesis_ops():
+    test, r = mk_test()
+    cn = nemesis_time.clock_nemesis()
+    cn.invoke(test, info_op("nemesis", "bump", {"n1": 8000, "n3": -4000}))
+    assert any("/opt/jepsen/bump-time 8000" in e[2] for e in r.log
+               if e[0] == "n1")
+    assert any("/opt/jepsen/bump-time -4000" in e[2] for e in r.log
+               if e[0] == "n3")
+    cn.invoke(test, info_op("nemesis", "strobe",
+                            {"n2": {"delta": 100, "period": 5,
+                                    "duration": 10}}))
+    assert any("/opt/jepsen/strobe-time 100 5 10" in e[2] for e in r.log
+               if e[0] == "n2")
+    cn.invoke(test, info_op("nemesis", "reset", ["n4"]))
+    assert any("ntpdate -b pool.ntp.org" in e[2] for e in r.log
+               if e[0] == "n4")
+
+
+def test_clock_gens():
+    test = {"nodes": NODES}
+    random.seed(1)
+    op = nemesis_time.bump_gen(test, "nemesis")
+    assert op["f"] == "bump" and op["value"]
+    for delta in op["value"].values():
+        assert 4 <= abs(delta) <= 2**18
+    op2 = nemesis_time.strobe_gen(test, "nemesis")
+    for s in op2["value"].values():
+        assert s["period"] >= 1 and 0 <= s["duration"] <= 32
+
+
+# --- faketime -------------------------------------------------------------
+
+
+def test_faketime_script_and_wrap():
+    s = faketime.script("/usr/bin/etcd", -30, 1.5)
+    assert s.startswith("#!/bin/bash")
+    assert 'faketime -m -f "-30s x1.5" /usr/bin/etcd "$@"' in s
+
+    r = DummyRemote({"stat": (1, "", "nope")})
+    sess = Session(node="n1", remote=r)
+    faketime.wrap(sess, "/usr/bin/etcd", 10, 2.0)
+    cmds = [e[2] for e in r.log if e[1] == "exec"]
+    assert any(c.startswith("mv /usr/bin/etcd /usr/bin/etcd.no-faketime")
+               for c in cmds)
+    assert any("chmod a+x /usr/bin/etcd" in c for c in cmds)

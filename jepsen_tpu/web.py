@@ -1,0 +1,165 @@
+"""Results web UI (reference L8) — browse the store over HTTP.
+
+Reference: jepsen/src/jepsen/web.clj — http-kit server with a home table
+of runs (validity color-coded, web.clj:47-128), a file browser with
+text/image previews (web.clj:194-248), and zip downloads of whole runs
+(web.clj:250-292).  Rebuilt on the stdlib http.server (no extra deps);
+same surface: `/` home, `/files/...` browser, `?zip` downloads.
+"""
+
+from __future__ import annotations
+
+import html
+import io
+import json
+import logging
+import os
+import urllib.parse
+import zipfile
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+from . import store
+
+log = logging.getLogger("jepsen")
+
+STYLE = """
+body { font-family: sans-serif; margin: 2em; }
+table { border-collapse: collapse; }
+th, td { padding: .3em .8em; text-align: left; }
+tr:nth-child(even) { background: #f4f4f4; }
+.valid-true { background: #c7f0c2; }
+.valid-false { background: #f0c2c2; }
+.valid-unknown { background: #f0e9c2; }
+a { text-decoration: none; }
+pre { background: #f8f8f8; padding: 1em; overflow-x: auto; }
+"""
+
+
+def _read_valid(run_dir: str):
+    p = os.path.join(run_dir, "results.json")
+    try:
+        with open(p) as f:
+            return json.load(f).get("valid")
+    except Exception:
+        return None
+
+
+def home_html(base: str) -> str:
+    """The run table (web.clj:47-128)."""
+    rows = []
+    for name, runs in sorted(store.tests(base=base).items()):
+        for t, d in sorted(runs.items(), reverse=True):
+            valid = _read_valid(d)
+            cls = {True: "valid-true", False: "valid-false",
+                   "unknown": "valid-unknown"}.get(valid, "")
+            rel = urllib.parse.quote(f"{name}/{t}")
+            rows.append(
+                f'<tr class="{cls}"><td><a href="/files/{rel}/">{html.escape(name)}'
+                f"</a></td><td>{html.escape(t)}</td>"
+                f"<td>{html.escape(str(valid))}</td>"
+                f'<td><a href="/files/{rel}/?zip">zip</a></td></tr>')
+    return (f"<html><head><title>Jepsen</title><style>{STYLE}</style></head>"
+            f"<body><h1>Jepsen results</h1><table>"
+            f"<tr><th>test</th><th>time</th><th>valid?</th><th></th></tr>"
+            f"{''.join(rows)}</table></body></html>")
+
+
+def dir_html(base: str, rel: str) -> str:
+    """Directory browser (web.clj:194-248)."""
+    d = os.path.join(base, rel)
+    entries = sorted(os.listdir(d))
+    items = []
+    for e in entries:
+        q = urllib.parse.quote(e)
+        full = os.path.join(d, e)
+        suffix = "/" if os.path.isdir(full) else ""
+        items.append(f'<li><a href="{q}{suffix}">{html.escape(e)}{suffix}'
+                     f"</a></li>")
+    return (f"<html><head><style>{STYLE}</style></head><body>"
+            f"<h1>{html.escape(rel)}</h1><p><a href='/'>home</a> | "
+            f"<a href='?zip'>zip</a></p><ul>{''.join(items)}</ul>"
+            f"</body></html>")
+
+
+def zip_bytes(base: str, rel: str) -> bytes:
+    """Zip a run directory (web.clj:250-292)."""
+    d = os.path.join(base, rel)
+    buf = io.BytesIO()
+    with zipfile.ZipFile(buf, "w", zipfile.ZIP_DEFLATED) as z:
+        for root, _dirs, files in os.walk(d):
+            for f in files:
+                full = os.path.join(root, f)
+                z.write(full, os.path.relpath(full, d))
+    return buf.getvalue()
+
+
+CONTENT_TYPES = {".html": "text/html", ".txt": "text/plain",
+                 ".log": "text/plain", ".json": "application/json",
+                 ".jsonl": "text/plain", ".edn": "text/plain",
+                 ".png": "image/png", ".svg": "image/svg+xml",
+                 ".jpg": "image/jpeg"}
+
+
+class Handler(BaseHTTPRequestHandler):
+    base = store.BASE
+
+    def log_message(self, fmt, *args):  # quiet
+        log.debug("web: " + fmt, *args)
+
+    def _send(self, code: int, body: bytes, ctype: str = "text/html",
+              extra: dict | None = None):
+        self.send_response(code)
+        self.send_header("Content-Type", ctype)
+        self.send_header("Content-Length", str(len(body)))
+        for k, v in (extra or {}).items():
+            self.send_header(k, v)
+        self.end_headers()
+        self.wfile.write(body)
+
+    def do_GET(self):  # noqa: N802 (stdlib API)
+        parsed = urllib.parse.urlparse(self.path)
+        path = urllib.parse.unquote(parsed.path)
+        if path == "/":
+            self._send(200, home_html(self.base).encode())
+            return
+        if not path.startswith("/files/"):
+            self._send(404, b"not found", "text/plain")
+            return
+        rel = os.path.normpath(path[len("/files/"):]).lstrip("/")
+        if rel.startswith(".."):
+            self._send(403, b"forbidden", "text/plain")
+            return
+        full = os.path.join(self.base, rel)
+        if parsed.query == "zip" and os.path.isdir(full):
+            name = rel.replace("/", "-") + ".zip"
+            self._send(200, zip_bytes(self.base, rel), "application/zip",
+                       {"Content-Disposition":
+                        f'attachment; filename="{name}"'})
+            return
+        if os.path.isdir(full):
+            self._send(200, dir_html(self.base, rel).encode())
+            return
+        if os.path.isfile(full):
+            ext = os.path.splitext(full)[1]
+            ctype = CONTENT_TYPES.get(ext, "application/octet-stream")
+            with open(full, "rb") as f:
+                self._send(200, f.read(), ctype)
+            return
+        self._send(404, b"not found", "text/plain")
+
+
+def make_server(host: str = "0.0.0.0", port: int = 8080,
+                base: str | None = None) -> ThreadingHTTPServer:
+    handler = type("H", (Handler,), {"base": base or store.BASE})
+    return ThreadingHTTPServer((host, port), handler)
+
+
+def serve(host: str = "0.0.0.0", port: int = 8080,
+          base: str | None = None) -> None:
+    """web.clj:322-335."""
+    srv = make_server(host, port, base)
+    log.info("Web server running on http://%s:%d", host, port)
+    try:
+        srv.serve_forever()
+    except KeyboardInterrupt:
+        srv.shutdown()

@@ -1,0 +1,133 @@
+"""CLI + web + codec + report tests (cli exit-code contract
+cli.clj:103-114; web surface web.clj)."""
+
+import json
+import threading
+import urllib.request
+
+import pytest
+
+from jepsen_tpu import cli, codec, fixtures, repl, report, store, web
+from jepsen_tpu import generator as gen
+from jepsen_tpu.checker import linearizable as lin
+from jepsen_tpu.models import cas_register
+
+
+def test_parse_concurrency():
+    opts = {"concurrency": "3n", "nodes": ["a", "b", "c"]}
+    assert cli.parse_concurrency(opts)["concurrency"] == 9
+    opts = {"concurrency": "7", "nodes": ["a"]}
+    assert cli.parse_concurrency(opts)["concurrency"] == 7
+    with pytest.raises(ValueError):
+        cli.parse_concurrency({"concurrency": "x2", "nodes": []})
+
+
+def test_parse_nodes_file(tmp_path):
+    f = tmp_path / "nodes"
+    f.write_text("h1\nh2\n\n")
+    opts = cli.parse_nodes({"nodes_file": str(f), "nodes": ["ignored"]})
+    assert opts["nodes"] == ["h1", "h2"]
+    assert cli.parse_nodes({"nodes": None,
+                            "nodes_file": None})["nodes"] == \
+        cli.DEFAULT_NODES
+
+
+def test_rename_ssh_options():
+    opts = cli.rename_ssh_options({"username": "admin", "password": "pw",
+                                   "strict_host_key_checking": True,
+                                   "ssh_private_key": "/k"})
+    assert opts["ssh"] == {"username": "admin", "password": "pw",
+                           "strict_host_key_checking": True,
+                           "private_key_path": "/k"}
+
+
+def make_test_fn(state_box, store_base):
+    def test_fn(opts):
+        state = fixtures.AtomRegister()
+        state_box.append(state)
+        return fixtures.noop_test() | {
+            "name": "cli-demo",
+            "store_base": store_base,
+            "nodes": opts["nodes"],
+            "concurrency": min(opts["concurrency"], 4),
+            "db": fixtures.atom_db(state),
+            "client": fixtures.atom_client(state),
+            "model": cas_register(0),
+            "checker": lin.linearizable(),
+            "generator": gen.clients(gen.limit(
+                20, {"type": "invoke", "f": "read", "value": None})),
+        }
+    return test_fn
+
+
+def test_cli_end_to_end_exit_codes(tmp_path):
+    boxes = []
+    cmds = cli.single_test_cmd(make_test_fn(boxes, str(tmp_path / "store")))
+    rc = cli.run(cmds, ["test", "-n", "a", "-n", "b", "--concurrency", "2n",
+                        "--dummy"])
+    assert rc == cli.EXIT_OK
+    assert len(boxes) == 1
+
+    rc = cli.run(cmds, ["bogus-subcommand"])
+    assert rc == cli.EXIT_BAD_ARGS
+    rc = cli.run(cmds, [])
+    assert rc == cli.EXIT_BAD_ARGS
+
+
+def test_web_serves_store(tmp_path):
+    base = str(tmp_path / "store")
+    test = {"name": "webdemo", "start_time": "20260729T120000",
+            "store_base": base}
+    store.save_1(test, [])
+    store.save_2(test, {"valid": True})
+
+    srv = web.make_server(host="127.0.0.1", port=0, base=base)
+    port = srv.server_address[1]
+    t = threading.Thread(target=srv.serve_forever, daemon=True)
+    t.start()
+    try:
+        home = urllib.request.urlopen(
+            f"http://127.0.0.1:{port}/").read().decode()
+        assert "webdemo" in home and "valid-true" in home
+        d = urllib.request.urlopen(
+            f"http://127.0.0.1:{port}/files/webdemo/20260729T120000/"
+        ).read().decode()
+        assert "results.json" in d
+        r = urllib.request.urlopen(
+            f"http://127.0.0.1:{port}/files/webdemo/20260729T120000/"
+            f"results.json").read()
+        assert json.loads(r)["valid"] is True
+        z = urllib.request.urlopen(
+            f"http://127.0.0.1:{port}/files/webdemo/20260729T120000/?zip"
+        ).read()
+        assert z[:2] == b"PK"
+        # path traversal is refused
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{port}/files/../../etc/passwd")
+        with pytest.raises(urllib.error.HTTPError):
+            urllib.request.urlopen(req)
+    finally:
+        srv.shutdown()
+
+
+def test_codec_roundtrip():
+    for v in [None, 42, "hi", [1, 2, {"a": True}], {"k": [1, None]}]:
+        assert codec.decode(codec.encode(v)) == v
+
+
+def test_report_to(tmp_path, capsys):
+    p = tmp_path / "out.txt"
+    with report.to(str(p)):
+        print("hello report")
+    assert "hello report" in p.read_text()
+    assert "hello report" in capsys.readouterr().out
+
+
+def test_repl_last_test(tmp_path):
+    base = str(tmp_path / "store")
+    test = {"name": "t1", "start_time": "20260729T110000",
+            "store_base": base}
+    store.save_1(test, [])
+    store.save_2(test, {"valid": False})
+    out = repl.last_test(base)
+    assert out["results"]["valid"] is False

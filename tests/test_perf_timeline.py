@@ -1,0 +1,80 @@
+"""Perf graph + timeline rendering tests (the analog of perf_test.clj:
+fixed history, exercise rendering, assert artifacts exist)."""
+
+import os
+import random
+
+from jepsen_tpu.checker import perf, timeline
+from jepsen_tpu.history import index as index_history
+from jepsen_tpu.history import info_op, invoke_op, ok_op
+from jepsen_tpu.synth import register_history
+
+
+def fixed_history():
+    rng = random.Random(0)
+    h = register_history(rng, n_ops=60, n_procs=4, overlap=3, crash_p=0.05)
+    # nemesis window mid-test
+    h.insert(len(h) // 3, info_op("nemesis", "start", "partition!"))
+    h.insert(2 * len(h) // 3, info_op("nemesis", "stop", "healed"))
+    # timestamps: 0.5s apart
+    out = []
+    for i, op in enumerate(h):
+        from dataclasses import replace
+
+        out.append(replace(op, time=int(i * 0.5e9)))
+    return index_history(out)
+
+
+def test_quantiles():
+    assert perf.quantiles([0.5, 1.0], [1, 2, 3, 4]) == {0.5: 3, 1.0: 4}
+    assert perf.quantiles([0.5], []) == {}
+
+
+def test_latencies_to_quantiles():
+    pts = [(0.0, 10.0), (1.0, 20.0), (11.0, 5.0)]
+    out = perf.latencies_to_quantiles(10.0, [1.0], pts)
+    assert out[1.0] == [(5.0, 20.0), (15.0, 5.0)]
+
+
+def test_nemesis_regions():
+    h = fixed_history()
+    regions = perf.nemesis_regions(h)
+    assert len(regions) == 1
+    t0, t1 = regions[0]
+    assert t0 < t1
+
+
+def test_graphs_render(tmp_path):
+    test = {"name": "perfdemo", "store_base": str(tmp_path),
+            "start_time": "20260729T000000"}
+    h = fixed_history()
+    out = perf.perf().check(test, h, {})
+    assert out["valid"] is True
+    d = os.path.join(str(tmp_path), "perfdemo", "20260729T000000")
+    assert os.path.exists(os.path.join(d, "latency-raw.png"))
+    assert os.path.exists(os.path.join(d, "latency-quantiles.png"))
+    assert os.path.exists(os.path.join(d, "rate.png"))
+
+
+def test_timeline_pairs():
+    h = [invoke_op(0, "read", None), invoke_op(1, "write", 1),
+         ok_op(1, "write", 1), info_op(0, "read", None),
+         info_op("nemesis", "start", None)]
+    ps = timeline.pairs(h)
+    assert len(ps) == 3
+    # invoke+info pair for process 0; lone nemesis info
+    assert any(a.process == 0 and b is not None and b.type == "info"
+               for a, b in ps)
+    assert any(a.process == "nemesis" and b is None for a, b in ps)
+
+
+def test_timeline_html(tmp_path):
+    test = {"name": "tldemo", "store_base": str(tmp_path),
+            "start_time": "20260729T000000"}
+    h = fixed_history()
+    out = timeline.timeline().check(test, h, {})
+    assert out["valid"] is True
+    p = os.path.join(str(tmp_path), "tldemo", "20260729T000000",
+                     "timeline.html")
+    content = open(p).read()
+    assert "op ok" in content and "class=\"ops\"" in content

@@ -400,6 +400,9 @@ def prepare_test(test: dict) -> dict:
     test.setdefault("start_time", store.time_str())
     test.setdefault("concurrency", len(test.get("nodes") or []) or 1)
     test.setdefault("os", os_mod.noop)
+    from . import net as net_mod
+
+    test.setdefault("net", net_mod.noop)
     test.setdefault("db", db_mod.noop)
     nodes = test.get("nodes") or []
     test.setdefault("barrier",

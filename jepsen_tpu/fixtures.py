@@ -17,6 +17,7 @@ from . import client as client_mod
 from . import db as db_mod
 from . import generator as gen
 from . import nemesis as nemesis_mod
+from . import net as net_mod
 from . import os as os_mod
 
 
@@ -27,6 +28,7 @@ def noop_test() -> dict:
         "name": "noop",
         "os": os_mod.noop,
         "db": db_mod.noop,
+        "net": net_mod.iptables,
         "client": client_mod.noop,
         "nemesis": nemesis_mod.noop,
         "generator": gen.void,

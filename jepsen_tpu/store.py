@@ -47,7 +47,7 @@ def base_dir(test: dict) -> str:
 
 def path(test: dict, *more: str) -> str:
     """store/<name>/<start-time>/<more...> (store.clj:121-135)."""
-    name = _sanitize(test.get("name", "noname"))
+    name = _sanitize(test.get("name") or "noname")
     t = test.get("start_time") or time_str()
     return os.path.join(base_dir(test), name, t, *[str(m) for m in more])
 

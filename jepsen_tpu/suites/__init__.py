@@ -1,0 +1,24 @@
+"""Test suites (reference L9) — per-database applications of the harness.
+
+The reference ships ~24 leiningen projects, each wiring a DB's install
+automation, clients, workloads, and nemeses into the core library
+(SURVEY.md §2.5).  The first tranche here covers the BASELINE configs:
+
+  etcdemo   — the tutorial suite: etcd CAS register on independent keys +
+              set workload (jepsen.etcdemo)
+  zookeeper — single linearizable CAS register (zookeeper/)
+  hazelcast — distributed lock checked as a mutex (hazelcast/)
+  atomdemo  — in-process atom-backed suite runnable with zero cluster
+              infrastructure (the jepsen.tests/atom-db fixture promoted
+              to a demo suite)
+  registry  — cockroachdb-style named workload/nemesis registry runner
+              (cockroachdb/src/jepsen/cockroach/runner.clj)
+"""
+
+from importlib import import_module
+
+SUITES = ["atomdemo", "etcdemo", "zookeeper", "hazelcast", "registry"]
+
+
+def suite(name: str):
+    return import_module(f"jepsen_tpu.suites.{name}")

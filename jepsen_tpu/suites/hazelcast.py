@@ -1,0 +1,161 @@
+"""Hazelcast suite — distributed lock checked as a linearizable mutex.
+
+Reference: hazelcast/src/jepsen/hazelcast.clj: lock client
+(hazelcast.clj:260-292: tryLock/unlock, "not lock owner" → fail), the
+lock workload checked as model/mutex + checker/linearizable
+(hazelcast.clj:379-386 — BASELINE config #4), queue and unique-ids
+workloads, partition-majorities-ring nemesis (hazelcast.clj:427).
+
+The lock client here drives any REST-ish lock service via a pluggable
+transport; the reference embeds a Java client, which Python can't load —
+the workload/checker wiring (the part the TPU engine consumes) is
+complete and tested against the in-process lock service fixture.
+"""
+
+from __future__ import annotations
+
+import logging
+import random
+import threading
+from dataclasses import replace
+
+from .. import (checker as checker_mod, cli, client as client_mod,
+                fixtures, generator as gen, nemesis)
+from ..checker import basic, linearizable as lin, perf as perf_mod, timeline
+from ..models import mutex
+
+log = logging.getLogger("jepsen")
+
+
+class InProcessLockService:
+    """A deliberately imperfect lock service for harness demos: honors
+    lock/unlock, but (like real Hazelcast under partitions) can be made to
+    grant two holders via `break_()`."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.holder = None
+        self.broken = False
+
+    def try_lock(self, owner) -> bool:
+        with self._lock:
+            if self.holder is None or self.broken:
+                self.holder = owner
+                return True
+            return False
+
+    def unlock(self, owner) -> bool:
+        with self._lock:
+            if self.holder == owner:
+                self.holder = None
+                return True
+            return False  # not the owner
+
+
+class LockClient(client_mod.Client):
+    """acquire/release ops (hazelcast.clj:260-292)."""
+
+    def __init__(self, service: InProcessLockService | None = None,
+                 owner=None):
+        self.service = service or InProcessLockService()
+        self.owner = owner
+
+    def open(self, test, node):
+        return LockClient(self.service, owner=object())
+
+    def invoke(self, test, op):
+        if op.f == "acquire":
+            return replace(op, type="ok" if self.service.try_lock(self.owner)
+                           else "fail")
+        if op.f == "release":
+            if self.service.unlock(self.owner):
+                return replace(op, type="ok")
+            return replace(op, type="fail", error="not-lock-owner")
+        raise ValueError(f"unknown f {op.f!r}")
+
+
+def lock_workload(opts: dict, service=None) -> dict:
+    """hazelcast.clj:379-386: alternating acquire/release per process,
+    checked against the mutex model."""
+    return {
+        "client": LockClient(service),
+        "checker": checker_mod.compose({
+            "linear": lin.linearizable(mutex()),
+            "timeline": timeline.timeline(),
+        }),
+        "generator": gen.each(
+            lambda: gen.seq(__import__("itertools").cycle(
+                [{"type": "invoke", "f": "acquire", "value": None},
+                 {"type": "invoke", "f": "release", "value": None}]))),
+        "model": mutex(),
+    }
+
+
+class UniqueIdClient(client_mod.Client):
+    """ID-generator workload (hazelcast.clj unique-ids); backed by a
+    shared counter fixture in-process."""
+
+    def __init__(self, counter=None):
+        self.counter = counter if counter is not None else \
+            __import__("itertools").count()
+        self._lock = threading.Lock()
+
+    def open(self, test, node):
+        return self
+
+    def invoke(self, test, op):
+        assert op.f == "generate"
+        with self._lock:
+            return replace(op, type="ok", value=next(self.counter))
+
+
+def unique_ids_workload(opts: dict) -> dict:
+    return {
+        "client": UniqueIdClient(),
+        "checker": basic.unique_ids(),
+        "generator": {"type": "invoke", "f": "generate", "value": None},
+        "model": None,
+    }
+
+
+WORKLOADS = {"lock": lock_workload, "unique-ids": unique_ids_workload}
+
+
+def hazelcast_test(opts: dict) -> dict:
+    """hazelcast.clj:389-430: majorities-ring partitions while the
+    workload runs."""
+    import itertools
+
+    workload = WORKLOADS[opts.get("workload", "lock")](opts)
+    return fixtures.noop_test() | dict(opts) | {
+        "name": f"hazelcast {opts.get('workload', 'lock')}",
+        "client": workload["client"],
+        "nemesis": nemesis.partition_majorities_ring(),
+        "model": workload.get("model"),
+        "checker": checker_mod.compose({
+            "perf": perf_mod.perf(),
+            "workload": workload["checker"],
+        }),
+        "generator": gen.time_limit(
+            opts.get("time_limit", 60),
+            gen.nemesis(
+                gen.seq(itertools.cycle(
+                    [gen.sleep(5), {"type": "info", "f": "start"},
+                     gen.sleep(5), {"type": "info", "f": "stop"}])),
+                gen.stagger(1.0 / opts.get("rate", 10),
+                            workload["generator"]))),
+    }
+
+
+def add_opts(p):
+    p.add_argument("-w", "--workload", choices=sorted(WORKLOADS),
+                   default="lock")
+    p.add_argument("-r", "--rate", type=float, default=10)
+
+
+def main(argv=None):
+    cli.main(cli.single_test_cmd(hazelcast_test, add_opts=add_opts), argv)
+
+
+if __name__ == "__main__":
+    main()

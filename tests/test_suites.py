@@ -1,0 +1,199 @@
+"""Suite tests: test-map construction, db automation command shapes
+(dummy remote), workload wiring, and the atomdemo end-to-end run."""
+
+import itertools
+
+import pytest
+
+from jepsen_tpu import cli, generator as gen, independent
+from jepsen_tpu.control import DummyRemote, Session
+from jepsen_tpu.history import invoke_op, ok_op
+from jepsen_tpu.suites import atomdemo, etcdemo, hazelcast, registry
+from jepsen_tpu.suites import zookeeper as zk
+
+
+def dummy_test(nodes=("n1", "n2", "n3"), responses=None):
+    r = DummyRemote(responses)
+    return {"nodes": list(nodes),
+            "sessions": {n: Session(node=n, remote=r) for n in nodes}}, r
+
+
+# --- etcdemo --------------------------------------------------------------
+
+
+def test_etcd_urls_and_cluster():
+    test = {"nodes": ["n1", "n2"]}
+    assert etcdemo.peer_url("n1") == "http://n1:2380"
+    assert etcdemo.client_url("n2") == "http://n2:2379"
+    assert etcdemo.initial_cluster(test) == \
+        "n1=http://n1:2380,n2=http://n2:2380"
+
+
+def test_etcd_db_commands():
+    test, r = dummy_test(responses={
+        "stat /": (1, "", "none"),
+        "ls -A": (0, "etcd-v3.1.5-linux-amd64\n", ""),
+        "dirname": (0, "/opt", "")})
+    db = etcdemo.db("v3.1.5")
+    import time as time_mod
+
+    orig_sleep = time_mod.sleep
+    time_mod.sleep = lambda s: None  # skip the 10s cluster-join wait
+    try:
+        db.setup(test, "n1")
+    finally:
+        time_mod.sleep = orig_sleep
+    cmds = [e[2] for e in r.log if e[1] == "exec" and e[0] == "n1"]
+    assert any("wget" in c and "etcd-v3.1.5-linux-amd64.tar.gz" in c
+               for c in cmds)
+    assert any("start-stop-daemon --start" in c and
+               "--initial-cluster n1=http://n1:2380" in c
+               for c in cmds)
+    db.teardown(test, "n1")
+    cmds = [e[2] for e in r.log if e[1] == "exec" and e[0] == "n1"]
+    assert any("killall -9 -w etcd" in c for c in cmds)
+    assert any("rm -rf /opt/etcd" in c for c in cmds)
+    assert db.log_files(test, "n1") == ["/opt/etcd/etcd.log"]
+
+
+def test_etcd_test_map_and_workloads():
+    opts = {"nodes": ["n1", "n2", "n3"], "concurrency": 10,
+            "workload": "register", "ops_per_key": 10, "rate": 100,
+            "time_limit": 1}
+    test = etcdemo.etcd_test(opts)
+    assert test["name"] == "etcd q=False register"
+    assert test["quorum"] is False
+    assert isinstance(test["checker"], object)
+    # set workload wires the set checker and a final read
+    opts["workload"] = "set"
+    test2 = etcdemo.etcd_test(opts)
+    assert "set" in test2["name"]
+
+
+def test_etcd_cli_parses():
+    cmds = cli.single_test_cmd(etcdemo.etcd_test,
+                               add_opts=etcdemo.add_opts)
+    # invalid workload name -> bad args
+    rc = cli.run(cmds, ["test", "-w", "nope"])
+    assert rc == cli.EXIT_BAD_ARGS
+
+
+# --- zookeeper ------------------------------------------------------------
+
+
+def test_zk_cfg_generation():
+    test = {"nodes": ["a", "b", "c"]}
+    assert zk.zk_node_id(test, "b") == 1
+    cfg = zk.zoo_cfg_servers(test)
+    assert "server.0=a:2888:3888" in cfg and "server.2=c:2888:3888" in cfg
+
+
+def test_zk_db_commands():
+    listing = "ii  zookeeper  3.4.13-2  all  coordination\n"
+    test, r = dummy_test(responses={"dpkg": (0, listing, ""),
+                                    "apt-cache":
+                                        (0, "  Installed: 3.4.13-2\n", "")})
+    zk.db().setup(test, "n2")
+    cmds = [e[2] for e in r.log if e[1] == "exec" and e[0] == "n2"]
+    assert any("echo 1 > /etc/zookeeper/conf/myid" in c for c in cmds)
+    assert any("zoo.cfg" in c and "server.0=n1:2888:3888" in c
+               for c in cmds)
+    assert any("service zookeeper restart" in c for c in cmds)
+
+
+def test_zk_test_map():
+    test = zk.zk_test({"nodes": ["n1"], "concurrency": 2, "time_limit": 1})
+    assert test["name"] == "zookeeper"
+    assert test["model"].name == "cas-register"
+
+
+# --- hazelcast lock -------------------------------------------------------
+
+
+def test_lock_service_and_client():
+    svc = hazelcast.InProcessLockService()
+    c1 = hazelcast.LockClient(svc).open({}, "n1")
+    c2 = hazelcast.LockClient(svc).open({}, "n2")
+    acq = c1.invoke({}, invoke_op(0, "acquire", None))
+    assert acq.type == "ok"
+    assert c2.invoke({}, invoke_op(1, "acquire", None)).type == "fail"
+    rel = c2.invoke({}, invoke_op(1, "release", None))
+    assert rel.type == "fail" and rel.error == "not-lock-owner"
+    assert c1.invoke({}, invoke_op(0, "release", None)).type == "ok"
+    assert c2.invoke({}, invoke_op(1, "acquire", None)).type == "ok"
+
+
+def test_hazelcast_lock_end_to_end_valid_and_broken():
+    """Run the lock workload in-process; a broken lock service must be
+    caught by the mutex linearizability check (BASELINE config #4
+    shape)."""
+    from jepsen_tpu import core
+
+    def make(broken):
+        svc = hazelcast.InProcessLockService()
+        svc.broken = broken
+        opts = {"nodes": ["n1", "n2"], "concurrency": 3, "time_limit": 2,
+                "rate": 200, "workload": "lock", "name": None}
+        test = hazelcast.hazelcast_test(opts)
+        test["client"] = hazelcast.LockClient(svc)
+        test["name"] = None  # no store writes
+        # drop perf graphs for unit-test speed
+        test["checker"] = hazelcast.lock_workload(opts, svc)["checker"]
+        return test
+
+    good = core.run(make(False))
+    assert good["results"]["valid"] is True
+
+    bad = core.run(make(True))
+    assert bad["results"]["valid"] is False
+
+
+def test_unique_ids_workload():
+    wl = hazelcast.unique_ids_workload({})
+    c = wl["client"].open({}, "n1")
+    vals = {c.invoke({}, invoke_op(0, "generate", None)).value
+            for _ in range(10)}
+    assert len(vals) == 10
+
+
+# --- registry -------------------------------------------------------------
+
+
+def test_registry_builds_tests():
+    reg = registry.Registry()
+
+    @reg.workload("demo")
+    def demo(opts):
+        return {"client": atomdemo.AtomMapClient(),
+                "generator": gen.limit(5, {"type": "invoke", "f": "read",
+                                           "value": None}),
+                "checker": __import__("jepsen_tpu.checker",
+                                      fromlist=["unbridled_dionysus"]
+                                      ).unbridled_dionysus}
+
+    test = reg.build_test({"workload": "demo", "nemesis": "parts",
+                           "nodes": ["n1"], "concurrency": 2,
+                           "time_limit": 1})
+    assert test["name"] == "demo nemesis=parts"
+    assert "majority-ring" in reg.nemeses
+    assert test["nemesis"].__class__.__name__ == "Partitioner"
+
+
+# --- atomdemo end-to-end --------------------------------------------------
+
+
+def test_atomdemo_end_to_end(tmp_path):
+    from jepsen_tpu import core
+
+    opts = {"nodes": ["n1", "n2"], "concurrency": 4, "time_limit": 2,
+            "rate": 300, "ops_per_key": 20, "group_size": 2,
+            "store_base": str(tmp_path / "store")}
+    test = atomdemo.atom_test(opts)
+    test = core.run(test)
+    assert test["results"]["valid"] is True
+    workload = test["results"]["workload"]
+    assert workload["valid"] is True
+    assert len(workload["results"]) >= 1  # checked at least one key
+    import os
+
+    assert os.path.exists(os.path.join(str(tmp_path / "store"), "latest"))

@@ -1,0 +1,447 @@
+// faultfs — a passthrough FUSE filesystem with runtime fault injection.
+//
+// Usage: faultfs REALDIR MOUNTPOINT [fuse options...]
+//
+// TPU-native rebuild of the capability provided by the reference's
+// CharybdeFS integration (charybdefs/src/jepsen/charybdefs.clj: a FUSE
+// passthrough fs mounted at /faulty over /real, with an RPC control
+// plane driving fault recipes — break-all EIO, probabilistic failure,
+// clear; charybdefs.clj:38-92).  Fresh implementation: libfuse3
+// high-level API, and instead of Thrift the control plane is a unix
+// socket at <realdir>/.faultfs.sock speaking a one-line text protocol:
+//
+//   set errno=EIO p=1.0 methods=read,write,*   -> inject
+//   set errno=EIO p=0.01 delay_us=500000       -> 1% failures + latency
+//   clear                                      -> stop injecting
+//   status                                     -> current config
+//
+// Build (on the db node; driven by jepsen_tpu/faultfs.py):
+//   g++ -O2 -std=c++17 faultfs.cc -o faultfs $(pkg-config fuse3 --cflags --libs) -lpthread
+
+#define FUSE_USE_VERSION 31
+
+#ifdef FAULTFS_SYNTAX_TEST
+#include "mock_fuse3.h"
+#else
+#include <fuse3/fuse.h>
+#endif
+
+#include <atomic>
+#include <cerrno>
+#include <cstdio>
+#include <cstdlib>
+#include <cstring>
+#include <mutex>
+#include <random>
+#include <set>
+#include <string>
+#include <thread>
+
+#include <dirent.h>
+#include <fcntl.h>
+#include <sys/socket.h>
+#include <sys/stat.h>
+#include <sys/statvfs.h>
+#include <sys/time.h>
+#include <sys/un.h>
+#include <unistd.h>
+
+namespace {
+
+std::string g_real;  // backing directory
+
+// ---------------------------------------------------------------------------
+// fault configuration
+// ---------------------------------------------------------------------------
+
+struct FaultConfig {
+  bool active = false;
+  int err = EIO;
+  double probability = 1.0;
+  long delay_us = 0;
+  bool all_methods = true;
+  std::set<std::string> methods;
+};
+
+std::mutex g_mutex;
+FaultConfig g_fault;
+thread_local std::mt19937_64 g_rng{std::random_device{}()};
+
+// Returns 0, or a negative errno to inject for this method.
+int check_fault(const char *method) {
+  FaultConfig cfg;
+  {
+    std::lock_guard<std::mutex> lock(g_mutex);
+    if (!g_fault.active) return 0;
+    cfg = g_fault;
+  }
+  if (!cfg.all_methods && cfg.methods.count(method) == 0) return 0;
+  std::uniform_real_distribution<double> dist(0.0, 1.0);
+  if (dist(g_rng) >= cfg.probability) return 0;
+  if (cfg.delay_us > 0) usleep(static_cast<useconds_t>(cfg.delay_us));
+  return -cfg.err;
+}
+
+#define FAULT(method)                       \
+  do {                                      \
+    int fault_err_ = check_fault(method);   \
+    if (fault_err_ != 0) return fault_err_; \
+  } while (0)
+
+std::string real_path(const char *path) { return g_real + path; }
+
+// ---------------------------------------------------------------------------
+// control server
+// ---------------------------------------------------------------------------
+
+int parse_errno(const std::string &name) {
+  static const struct { const char *n; int e; } table[] = {
+      {"EIO", EIO},       {"ENOSPC", ENOSPC}, {"EACCES", EACCES},
+      {"ENOENT", ENOENT}, {"EDQUOT", EDQUOT}, {"EROFS", EROFS},
+      {"EMFILE", EMFILE}, {"ENOMEM", ENOMEM}, {"EAGAIN", EAGAIN},
+      {"EBADF", EBADF},
+  };
+  for (const auto &row : table)
+    if (name == row.n) return row.e;
+  return atoi(name.c_str()) > 0 ? atoi(name.c_str()) : EIO;
+}
+
+std::string handle_command(const std::string &line) {
+  // tokenize on spaces; first token is the verb
+  std::lock_guard<std::mutex> lock(g_mutex);
+  if (line.rfind("clear", 0) == 0) {
+    g_fault = FaultConfig{};
+    return "ok cleared\n";
+  }
+  if (line.rfind("status", 0) == 0) {
+    char buf[256];
+    snprintf(buf, sizeof buf, "active=%d errno=%d p=%g delay_us=%ld\n",
+             g_fault.active ? 1 : 0, g_fault.err, g_fault.probability,
+             g_fault.delay_us);
+    return buf;
+  }
+  if (line.rfind("set", 0) == 0) {
+    FaultConfig cfg;
+    cfg.active = true;
+    size_t pos = 3;
+    while (pos < line.size()) {
+      while (pos < line.size() && line[pos] == ' ') pos++;
+      size_t end = line.find(' ', pos);
+      if (end == std::string::npos) end = line.size();
+      std::string kv = line.substr(pos, end - pos);
+      pos = end;
+      size_t eq = kv.find('=');
+      if (eq == std::string::npos) continue;
+      std::string key = kv.substr(0, eq), val = kv.substr(eq + 1);
+      if (key == "errno") {
+        cfg.err = parse_errno(val);
+      } else if (key == "p") {
+        cfg.probability = atof(val.c_str());
+      } else if (key == "delay_us") {
+        cfg.delay_us = atol(val.c_str());
+      } else if (key == "methods") {
+        cfg.all_methods = false;
+        size_t mp = 0;
+        while (mp < val.size()) {
+          size_t comma = val.find(',', mp);
+          if (comma == std::string::npos) comma = val.size();
+          std::string m = val.substr(mp, comma - mp);
+          if (m == "*") cfg.all_methods = true;
+          if (!m.empty()) cfg.methods.insert(m);
+          mp = comma + 1;
+        }
+      }
+    }
+    g_fault = cfg;
+    return "ok set\n";
+  }
+  return "err unknown command\n";
+}
+
+void control_server(const std::string &sock_path) {
+  unlink(sock_path.c_str());
+  int srv = socket(AF_UNIX, SOCK_STREAM, 0);
+  if (srv < 0) {
+    perror("faultfs control socket");
+    return;
+  }
+  sockaddr_un addr{};
+  addr.sun_family = AF_UNIX;
+  snprintf(addr.sun_path, sizeof addr.sun_path, "%s", sock_path.c_str());
+  if (bind(srv, reinterpret_cast<sockaddr *>(&addr), sizeof addr) != 0 ||
+      listen(srv, 8) != 0) {
+    perror("faultfs control bind/listen");
+    close(srv);
+    return;
+  }
+  chmod(sock_path.c_str(), 0777);
+  for (;;) {
+    int conn = accept(srv, nullptr, nullptr);
+    if (conn < 0) continue;
+    char buf[1024];
+    ssize_t n = read(conn, buf, sizeof buf - 1);
+    if (n > 0) {
+      buf[n] = '\0';
+      // strip trailing newline
+      while (n > 0 && (buf[n - 1] == '\n' || buf[n - 1] == '\r'))
+        buf[--n] = '\0';
+      std::string reply = handle_command(buf);
+      ssize_t ignored = write(conn, reply.data(), reply.size());
+      (void)ignored;
+    }
+    close(conn);
+  }
+}
+
+// ---------------------------------------------------------------------------
+// passthrough operations
+// ---------------------------------------------------------------------------
+
+int ffs_getattr(const char *path, struct stat *st, fuse_file_info *fi) {
+  FAULT("getattr");
+  (void)fi;
+  if (lstat(real_path(path).c_str(), st) == -1) return -errno;
+  return 0;
+}
+
+int ffs_readlink(const char *path, char *buf, size_t size) {
+  FAULT("readlink");
+  ssize_t n = readlink(real_path(path).c_str(), buf, size - 1);
+  if (n == -1) return -errno;
+  buf[n] = '\0';
+  return 0;
+}
+
+int ffs_readdir(const char *path, void *buf, fuse_fill_dir_t filler,
+                off_t offset, fuse_file_info *fi,
+                fuse_readdir_flags flags) {
+  FAULT("readdir");
+  (void)offset;
+  (void)fi;
+  (void)flags;
+  DIR *dp = opendir(real_path(path).c_str());
+  if (dp == nullptr) return -errno;
+  struct dirent *de;
+  while ((de = readdir(dp)) != nullptr) {
+    struct stat st {};
+    st.st_ino = de->d_ino;
+    st.st_mode = static_cast<mode_t>(de->d_type) << 12;
+    if (filler(buf, de->d_name, &st, 0, static_cast<fuse_fill_dir_flags>(0)))
+      break;
+  }
+  closedir(dp);
+  return 0;
+}
+
+int ffs_mknod(const char *path, mode_t mode, dev_t rdev) {
+  FAULT("mknod");
+  if (mknod(real_path(path).c_str(), mode, rdev) == -1) return -errno;
+  return 0;
+}
+
+int ffs_mkdir(const char *path, mode_t mode) {
+  FAULT("mkdir");
+  if (mkdir(real_path(path).c_str(), mode) == -1) return -errno;
+  return 0;
+}
+
+int ffs_unlink(const char *path) {
+  FAULT("unlink");
+  if (unlink(real_path(path).c_str()) == -1) return -errno;
+  return 0;
+}
+
+int ffs_rmdir(const char *path) {
+  FAULT("rmdir");
+  if (rmdir(real_path(path).c_str()) == -1) return -errno;
+  return 0;
+}
+
+int ffs_symlink(const char *from, const char *to) {
+  FAULT("symlink");
+  if (symlink(from, real_path(to).c_str()) == -1) return -errno;
+  return 0;
+}
+
+int ffs_rename(const char *from, const char *to, unsigned int flags) {
+  FAULT("rename");
+  if (flags) return -EINVAL;
+  if (rename(real_path(from).c_str(), real_path(to).c_str()) == -1)
+    return -errno;
+  return 0;
+}
+
+int ffs_link(const char *from, const char *to) {
+  FAULT("link");
+  if (link(real_path(from).c_str(), real_path(to).c_str()) == -1)
+    return -errno;
+  return 0;
+}
+
+int ffs_chmod(const char *path, mode_t mode, fuse_file_info *fi) {
+  FAULT("chmod");
+  (void)fi;
+  if (chmod(real_path(path).c_str(), mode) == -1) return -errno;
+  return 0;
+}
+
+int ffs_chown(const char *path, uid_t uid, gid_t gid, fuse_file_info *fi) {
+  FAULT("chown");
+  (void)fi;
+  if (lchown(real_path(path).c_str(), uid, gid) == -1) return -errno;
+  return 0;
+}
+
+int ffs_truncate(const char *path, off_t size, fuse_file_info *fi) {
+  FAULT("truncate");
+  int res = (fi != nullptr) ? ftruncate(static_cast<int>(fi->fh), size)
+                            : truncate(real_path(path).c_str(), size);
+  if (res == -1) return -errno;
+  return 0;
+}
+
+int ffs_utimens(const char *path, const struct timespec ts[2],
+                fuse_file_info *fi) {
+  FAULT("utimens");
+  (void)fi;
+  if (utimensat(AT_FDCWD, real_path(path).c_str(), ts,
+                AT_SYMLINK_NOFOLLOW) == -1)
+    return -errno;
+  return 0;
+}
+
+int ffs_create(const char *path, mode_t mode, fuse_file_info *fi) {
+  FAULT("create");
+  int fd = open(real_path(path).c_str(), fi->flags, mode);
+  if (fd == -1) return -errno;
+  fi->fh = static_cast<uint64_t>(fd);
+  return 0;
+}
+
+int ffs_open(const char *path, fuse_file_info *fi) {
+  FAULT("open");
+  int fd = open(real_path(path).c_str(), fi->flags);
+  if (fd == -1) return -errno;
+  fi->fh = static_cast<uint64_t>(fd);
+  return 0;
+}
+
+int ffs_read(const char *path, char *buf, size_t size, off_t offset,
+             fuse_file_info *fi) {
+  FAULT("read");
+  (void)path;
+  ssize_t n = pread(static_cast<int>(fi->fh), buf, size, offset);
+  if (n == -1) return -errno;
+  return static_cast<int>(n);
+}
+
+int ffs_write(const char *path, const char *buf, size_t size, off_t offset,
+              fuse_file_info *fi) {
+  FAULT("write");
+  (void)path;
+  ssize_t n = pwrite(static_cast<int>(fi->fh), buf, size, offset);
+  if (n == -1) return -errno;
+  return static_cast<int>(n);
+}
+
+int ffs_statfs(const char *path, struct statvfs *st) {
+  FAULT("statfs");
+  if (statvfs(real_path(path).c_str(), st) == -1) return -errno;
+  return 0;
+}
+
+int ffs_flush(const char *path, fuse_file_info *fi) {
+  FAULT("flush");
+  (void)path;
+  // emulate close-without-closing via dup
+  int dup_fd = dup(static_cast<int>(fi->fh));
+  if (dup_fd == -1) return -errno;
+  if (close(dup_fd) == -1) return -errno;
+  return 0;
+}
+
+int ffs_release(const char *path, fuse_file_info *fi) {
+  (void)path;
+  close(static_cast<int>(fi->fh));
+  return 0;
+}
+
+int ffs_fsync(const char *path, int datasync, fuse_file_info *fi) {
+  FAULT("fsync");
+  (void)path;
+  int res = datasync ? fdatasync(static_cast<int>(fi->fh))
+                     : fsync(static_cast<int>(fi->fh));
+  if (res == -1) return -errno;
+  return 0;
+}
+
+int ffs_fallocate(const char *path, int mode, off_t offset, off_t length,
+                  fuse_file_info *fi) {
+  FAULT("fallocate");
+  (void)path;
+  if (mode != 0) return -EOPNOTSUPP;
+  int res = posix_fallocate(static_cast<int>(fi->fh), offset, length);
+  return res == 0 ? 0 : -res;
+}
+
+fuse_operations make_ops() {
+  fuse_operations ops{};
+  ops.getattr = ffs_getattr;
+  ops.readlink = ffs_readlink;
+  ops.readdir = ffs_readdir;
+  ops.mknod = ffs_mknod;
+  ops.mkdir = ffs_mkdir;
+  ops.unlink = ffs_unlink;
+  ops.rmdir = ffs_rmdir;
+  ops.symlink = ffs_symlink;
+  ops.rename = ffs_rename;
+  ops.link = ffs_link;
+  ops.chmod = ffs_chmod;
+  ops.chown = ffs_chown;
+  ops.truncate = ffs_truncate;
+  ops.utimens = ffs_utimens;
+  ops.create = ffs_create;
+  ops.open = ffs_open;
+  ops.read = ffs_read;
+  ops.write = ffs_write;
+  ops.statfs = ffs_statfs;
+  ops.flush = ffs_flush;
+  ops.release = ffs_release;
+  ops.fsync = ffs_fsync;
+  ops.fallocate = ffs_fallocate;
+  return ops;
+}
+
+}  // namespace
+
+#ifndef FAULTFS_SYNTAX_TEST_NO_MAIN
+int main(int argc, char *argv[]) {
+  if (argc < 3) {
+    fprintf(stderr,
+            "usage: %s REALDIR MOUNTPOINT [fuse options...]\n"
+            "control socket: REALDIR/.faultfs.sock\n",
+            argv[0]);
+    return 2;
+  }
+  g_real = argv[1];
+  while (!g_real.empty() && g_real.back() == '/') g_real.pop_back();
+
+  // FAULTFS_CONTROL_ONLY=1 runs just the control plane (tests, and
+  // debugging the protocol without mounting anything)
+  if (getenv("FAULTFS_CONTROL_ONLY") != nullptr) {
+    control_server(g_real + "/.faultfs.sock");
+    return 0;
+  }
+
+  std::thread server(control_server, g_real + "/.faultfs.sock");
+  server.detach();
+
+  // hand fuse_main argv without REALDIR
+  std::string self = argv[0];
+  char **fuse_argv = new char *[argc - 1];
+  fuse_argv[0] = argv[0];
+  for (int i = 2; i < argc; i++) fuse_argv[i - 1] = argv[i];
+  fuse_operations ops = make_ops();
+  return fuse_main(argc - 1, fuse_argv, &ops, nullptr);
+}
+#endif

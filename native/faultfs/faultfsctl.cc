@@ -1,0 +1,56 @@
+// faultfsctl — control client for faultfs.
+//
+// Usage: faultfsctl SOCKET_PATH COMMAND [ARGS...]
+//   faultfsctl /real/.faultfs.sock set errno=EIO p=1.0
+//   faultfsctl /real/.faultfs.sock set errno=EIO p=0.01
+//   faultfsctl /real/.faultfs.sock clear
+//   faultfsctl /real/.faultfs.sock status
+//
+// The control-plane analog of the reference's charybdefs cookbook
+// recipes (charybdefs.clj:72-92).
+
+#include <cstdio>
+#include <cstring>
+#include <string>
+
+#include <sys/socket.h>
+#include <sys/un.h>
+#include <unistd.h>
+
+int main(int argc, char *argv[]) {
+  if (argc < 3) {
+    fprintf(stderr, "usage: %s SOCKET COMMAND [ARGS...]\n", argv[0]);
+    return 2;
+  }
+  std::string line;
+  for (int i = 2; i < argc; i++) {
+    if (i > 2) line += ' ';
+    line += argv[i];
+  }
+  line += '\n';
+
+  int fd = socket(AF_UNIX, SOCK_STREAM, 0);
+  if (fd < 0) {
+    perror("socket");
+    return 1;
+  }
+  sockaddr_un addr{};
+  addr.sun_family = AF_UNIX;
+  snprintf(addr.sun_path, sizeof addr.sun_path, "%s", argv[1]);
+  if (connect(fd, reinterpret_cast<sockaddr *>(&addr), sizeof addr) != 0) {
+    perror("connect");
+    return 1;
+  }
+  if (write(fd, line.data(), line.size()) < 0) {
+    perror("write");
+    return 1;
+  }
+  shutdown(fd, SHUT_WR);
+  char buf[4096];
+  ssize_t n;
+  while ((n = read(fd, buf, sizeof buf)) > 0) {
+    fwrite(buf, 1, static_cast<size_t>(n), stdout);
+  }
+  close(fd);
+  return 0;
+}

@@ -1,0 +1,60 @@
+// Minimal mock of the libfuse3 API surface faultfs.cc uses, so CI can
+// syntax/type-check the filesystem without libfuse installed (the real
+// build happens on db nodes, driven by jepsen_tpu/faultfs.py).  Kept in
+// sync with <fuse3/fuse.h> FUSE_USE_VERSION 31 signatures.
+#pragma once
+
+#include <cstdint>
+#include <sys/stat.h>
+#include <sys/statvfs.h>
+#include <sys/types.h>
+
+struct fuse_file_info {
+  int flags;
+  uint64_t fh;
+};
+
+enum fuse_readdir_flags { FUSE_READDIR_PLUS = (1 << 0) };
+enum fuse_fill_dir_flags { FUSE_FILL_DIR_PLUS = (1 << 1) };
+
+typedef int (*fuse_fill_dir_t)(void *buf, const char *name,
+                               const struct stat *stbuf, off_t off,
+                               enum fuse_fill_dir_flags flags);
+
+struct fuse_config;
+struct fuse_conn_info;
+
+struct fuse_operations {
+  int (*getattr)(const char *, struct stat *, struct fuse_file_info *);
+  int (*readlink)(const char *, char *, size_t);
+  int (*mknod)(const char *, mode_t, dev_t);
+  int (*mkdir)(const char *, mode_t);
+  int (*unlink)(const char *);
+  int (*rmdir)(const char *);
+  int (*symlink)(const char *, const char *);
+  int (*rename)(const char *, const char *, unsigned int);
+  int (*link)(const char *, const char *);
+  int (*chmod)(const char *, mode_t, struct fuse_file_info *);
+  int (*chown)(const char *, uid_t, gid_t, struct fuse_file_info *);
+  int (*truncate)(const char *, off_t, struct fuse_file_info *);
+  int (*open)(const char *, struct fuse_file_info *);
+  int (*read)(const char *, char *, size_t, off_t,
+              struct fuse_file_info *);
+  int (*write)(const char *, const char *, size_t, off_t,
+               struct fuse_file_info *);
+  int (*statfs)(const char *, struct statvfs *);
+  int (*flush)(const char *, struct fuse_file_info *);
+  int (*release)(const char *, struct fuse_file_info *);
+  int (*fsync)(const char *, int, struct fuse_file_info *);
+  int (*readdir)(const char *, void *, fuse_fill_dir_t, off_t,
+                 struct fuse_file_info *, enum fuse_readdir_flags);
+  int (*create)(const char *, mode_t, struct fuse_file_info *);
+  int (*utimens)(const char *, const struct timespec[2],
+                 struct fuse_file_info *);
+  int (*fallocate)(const char *, int, off_t, off_t,
+                   struct fuse_file_info *);
+};
+
+inline int fuse_main(int, char **, const fuse_operations *, void *) {
+  return 0;
+}

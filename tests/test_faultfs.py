@@ -1,0 +1,124 @@
+"""faultfs tests: C++ syntax check against the mock fuse3 header, a live
+control-plane round trip (control server + ctl client compiled for real,
+no FUSE needed), and driver command shapes via the dummy remote."""
+
+import os
+import shutil
+import subprocess
+import time
+
+import pytest
+
+from jepsen_tpu import faultfs
+from jepsen_tpu.control import DummyRemote, Session
+
+NATIVE = faultfs.NATIVE_DIR
+
+pytestmark = pytest.mark.skipif(shutil.which("g++") is None,
+                                reason="no C++ toolchain")
+
+
+def test_faultfs_syntax_against_mock_fuse():
+    subprocess.run(
+        ["g++", "-std=c++17", "-DFAULTFS_SYNTAX_TEST", "-fsyntax-only",
+         "-Wall", "-Werror", "-I.", "faultfs.cc"],
+        cwd=NATIVE, check=True)
+
+
+@pytest.fixture(scope="module")
+def built(tmp_path_factory):
+    d = tmp_path_factory.mktemp("faultfs-build")
+    subprocess.run(
+        ["g++", "-std=c++17", "-O1", "-DFAULTFS_SYNTAX_TEST", "-I", NATIVE,
+         "-o", str(d / "faultfs"), os.path.join(NATIVE, "faultfs.cc"),
+         "-lpthread"],
+        check=True)
+    subprocess.run(
+        ["g++", "-std=c++17", "-O1", "-o", str(d / "faultfsctl"),
+         os.path.join(NATIVE, "faultfsctl.cc")],
+        check=True)
+    return d
+
+
+def test_control_plane_round_trip(built, tmp_path):
+    """Start the control server (no FUSE), drive it with faultfsctl."""
+    real = tmp_path / "real"
+    real.mkdir()
+    env = dict(os.environ, FAULTFS_CONTROL_ONLY="1")
+    proc = subprocess.Popen([str(built / "faultfs"), str(real), "/dev/null"],
+                            env=env)
+    sock = str(real / ".faultfs.sock")
+    try:
+        for _ in range(100):
+            if os.path.exists(sock):
+                break
+            time.sleep(0.05)
+        assert os.path.exists(sock), "control socket never appeared"
+
+        def ctl(*args):
+            out = subprocess.run([str(built / "faultfsctl"), sock, *args],
+                                 capture_output=True, text=True, timeout=10)
+            assert out.returncode == 0, out.stderr
+            return out.stdout
+
+        assert "active=0" in ctl("status")
+        assert "ok set" in ctl("set", "errno=EIO", "p=1.0")
+        st = ctl("status")
+        assert "active=1" in st and "errno=5" in st and "p=1" in st
+        assert "ok set" in ctl("set", "errno=ENOSPC", "p=0.01",
+                               "methods=write,fsync")
+        st = ctl("status")
+        assert "errno=28" in st and "p=0.01" in st
+        assert "ok cleared" in ctl("clear")
+        assert "active=0" in ctl("status")
+        assert "err unknown" in ctl("frobnicate")
+    finally:
+        proc.kill()
+        proc.wait()
+
+
+def test_driver_command_shapes():
+    r = DummyRemote({"stat /": (1, "", "no"),
+                     "dpkg": (0, "", ""),
+                     "apt-get": (0, "", "")})
+    nodes = ["n1"]
+    test = {"nodes": nodes,
+            "sessions": {n: Session(node=n, remote=r) for n in nodes}}
+    sess = Session(node="n1", remote=r)
+    faultfs.break_all(sess)
+    faultfs.break_one_percent(sess)
+    faultfs.clear(sess)
+    faultfs.break_methods(sess, ["write", "fsync"], err="ENOSPC", p=0.5)
+    cmds = [e[2] for e in r.log if e[1] == "exec"]
+    ctl = faultfs.CTL
+    assert any(f"{ctl} {faultfs.SOCK} set errno=EIO p=1.0" in c
+               for c in cmds)
+    assert any("p=0.01" in c for c in cmds)
+    assert any(" clear" in c for c in cmds)
+    assert any("methods=write,fsync" in c and "errno=ENOSPC" in c
+               for c in cmds)
+
+    # nemesis surface
+    from jepsen_tpu.history import info_op
+
+    r.log.clear()
+    nem = faultfs.nemesis()
+    out = nem.invoke(test, info_op("nemesis", "break-all", None))
+    assert out.type == "info"
+    assert any("set errno=EIO p=1.0" in e[2] for e in r.log)
+    with pytest.raises(ValueError):
+        nem.invoke(test, info_op("nemesis", "what", None))
+
+
+def test_install_commands():
+    r = DummyRemote({"stat /": (1, "", "no"), "dpkg": (0, "", "")})
+    sess = Session(node="n1", remote=r)
+    faultfs.install(sess)
+    cmds = [e[2] for e in r.log if e[1] == "exec"]
+    ups = [e for e in r.log if e[1] == "upload"]
+    assert any("libfuse3-dev" in c for c in cmds)
+    assert {os.path.basename(u[2][0]) for u in ups} == \
+        {"faultfs.cc", "faultfsctl.cc", "CMakeLists.txt"}
+    assert any("cmake -B build" in c for c in cmds)
+    assert any(f"{faultfs.BIN} /real /faulty -o allow_other" in c
+               for c in cmds)

@@ -263,7 +263,20 @@ def _unpack_bits(words, n_words):
     return bits.reshape(shape + (n_words * 32,)).astype(bool)
 
 
-def build_search_fn(model: ModelSpec, dims: SearchDims, budget: int):
+def _compact_indices(mask, k_out: int):
+    """Indices of the first k_out set lanes of a bool mask (stable), plus
+    the total count.  Sort-free stream compaction: cumsum + binary-search
+    gather — O(n + k log n) instead of an argsort (XLA sorts are the
+    bottleneck on both CPU and TPU backends)."""
+    csum = jnp.cumsum(mask.astype(jnp.int32))
+    targets = jnp.arange(1, k_out + 1, dtype=jnp.int32)
+    idx = jnp.searchsorted(csum, targets, side="left")
+    n = mask.shape[0]
+    return jnp.minimum(idx, n - 1).astype(jnp.int32), csum[-1]
+
+
+def build_search_fn(model: ModelSpec, dims: SearchDims, budget: int,
+                    bail_on_overflow: bool = False):
     """Compile the frontier search for one (model, dims) pair.
 
     Level-synchronous BFS with a double-buffered frontier: a configuration
@@ -339,10 +352,8 @@ def build_search_fn(model: ModelSpec, dims: SearchDims, budget: int):
 
         # --- compact candidates to K lanes ---------------------------------
         enabled = jnp.concatenate([det_enabled, c_enabled])  # [W+NC]
-        # stable argsort puts enabled (0) before disabled (1)
-        order = jnp.argsort(jnp.where(enabled, 0, 1), stable=True)[:K]
-        cand = order  # candidate ids; < W => det lane, >= W => crash lane
-        cand_on = jnp.take(enabled, cand)
+        cand, n_enabled = _compact_indices(enabled, K)
+        cand_on = jnp.arange(K) < n_enabled
 
         is_det = cand < W
         det_pos = jnp.clip(p + cand, 0, dims.n_det_pad - 1)
@@ -397,8 +408,13 @@ def build_search_fn(model: ModelSpec, dims: SearchDims, budget: int):
                   jnp.int32(0), jnp.bool_(False))
 
         def cond(c):
-            _, count, status, configs, _, _ = c
-            return (status == -1) & (count > 0) & (configs < budget)
+            _, count, status, configs, _, ovf = c
+            go = (status == -1) & (count > 0) & (configs < budget)
+            if bail_on_overflow:
+                # a wider re-run is coming; don't waste time on a
+                # truncated (unsound-for-invalid) frontier
+                go = go & ~ovf
+            return go
 
         def body(c):
             frontier, count, status, configs, max_depth, ovf = c
@@ -412,32 +428,38 @@ def build_search_fn(model: ModelSpec, dims: SearchDims, budget: int):
             valid = valid.reshape(F * K)
             found = jnp.any(goal)
 
-            # --- level dedup: hash-sort, then exact neighbor compare -------
-            # Identical configs share (h1,h2) and sort adjacent (up to
-            # hash collisions, which only cost duplicate work, never
-            # correctness: dedup requires full-word equality).
-            wu = cfgs.astype(jnp.uint32)
+            # --- pre-compact valid successors ------------------------------
+            # most candidate lanes are dead (narrow levels, disabled
+            # candidates, illegal steps); shrink to S rows before the
+            # sort, which dominates per-level cost
+            S = 4 * F
+            vsrc, n_valid = _compact_indices(valid, S)
+            ovf = ovf | (n_valid > S)
+            ccfgs = jnp.take(cfgs, vsrc, axis=0)  # [S, WORDS]
+            cvalid = jnp.arange(S) < n_valid
+
+            # --- level dedup: single-key hash sort + exact neighbor
+            # compare.  Identical configs share h1 and sort adjacent (up
+            # to h1 collisions, which only cost duplicate work, never
+            # correctness: dropping requires full-word equality).
+            wu = ccfgs.astype(jnp.uint32)
             h1 = _hash_words(wu, 0x9E3779B1)
-            h2 = _hash_words(wu, 0x5BD1E995)
             big = np.uint32(0xFFFFFFFF)
-            h1s = jnp.where(valid, h1, big)
-            h2s = jnp.where(valid, h2, big)
-            sh1, sh2, perm = lax.sort(
-                (h1s, h2s, jnp.arange(F * K, dtype=jnp.int32)), num_keys=2)
-            svalid = jnp.take(valid, perm)
-            scfgs = jnp.take(cfgs, perm, axis=0)
-            same_hash = (sh1[1:] == sh1[:-1]) & (sh2[1:] == sh2[:-1])
+            h1s = jnp.where(cvalid, h1, big)
+            sh1, perm = lax.sort(
+                (h1s, jnp.arange(S, dtype=jnp.int32)), num_keys=1)
+            svalid = jnp.take(cvalid, perm)
+            scfgs = jnp.take(ccfgs, perm, axis=0)
+            same_hash = sh1[1:] == sh1[:-1]
             same_cfg = jnp.all(scfgs[1:] == scfgs[:-1], axis=1)
             dup = jnp.concatenate([jnp.zeros(1, bool), same_hash & same_cfg])
             svalid = svalid & ~dup
 
-            # --- compact into the next frontier ----------------------------
-            corder = jnp.argsort(jnp.where(svalid, 0, 1), stable=True)
-            ccfgs = jnp.take(scfgs, corder, axis=0)
-            new_count = jnp.sum(svalid, dtype=jnp.int32)
+            # --- compact into the next frontier (sort-free) ----------------
+            src, new_count = _compact_indices(svalid, F)
+            new_frontier = jnp.take(scfgs, src, axis=0)
             ovf = ovf | (new_count > F)
             new_count = jnp.minimum(new_count, F)
-            new_frontier = ccfgs[:F]
 
             configs = configs + count
             max_depth = jnp.maximum(max_depth, jnp.max(
@@ -470,11 +492,12 @@ def _round_up(x: int, m: int) -> int:
     return ((max(1, x) + m - 1) // m) * m
 
 
-def get_kernel(model: ModelSpec, dims: SearchDims, budget: int):
-    key = (model.name, dims, budget)
+def get_kernel(model: ModelSpec, dims: SearchDims, budget: int,
+               bail_on_overflow: bool = False):
+    key = (model.name, dims, budget, bail_on_overflow)
     fn = _KERNEL_CACHE.get(key)
     if fn is None:
-        fn = jax.jit(build_search_fn(model, dims, budget))
+        fn = jax.jit(build_search_fn(model, dims, budget, bail_on_overflow))
         _KERNEL_CACHE[key] = fn
     return fn
 
@@ -491,7 +514,10 @@ def choose_dims(es: EncodedSearch, model: ModelSpec, *,
     NC = _round_up(es.n_crash, 32) if es.n_crash else 32
     K = _next_pow2(min(es.concurrency, W + es.n_crash))
     if frontier is None:
-        frontier = max(64, min(4096, _next_pow2(es.n_det + es.n_crash)))
+        # start narrow: most BFS levels are far smaller than the history;
+        # the escalation ladder widens on overflow
+        frontier = max(64, min(4096,
+                               _next_pow2((es.n_det + es.n_crash) // 8)))
     return SearchDims(
         n_det_pad=max(64, _next_pow2(es.n_det)),
         n_crash_pad=NC,
@@ -517,8 +543,9 @@ MAX_FRONTIER = 1 << 17
 
 
 def _run_kernel(esp: EncodedSearch, es: EncodedSearch, model: ModelSpec,
-                dims: SearchDims, budget: int):
-    fn = get_kernel(model, dims, budget)
+                dims: SearchDims, budget: int,
+                bail_on_overflow: bool = False):
+    fn = get_kernel(model, dims, budget, bail_on_overflow)
     return fn(
         jnp.asarray(esp.det_f), jnp.asarray(esp.det_v1),
         jnp.asarray(esp.det_v2), jnp.asarray(esp.det_inv),
@@ -527,6 +554,25 @@ def _run_kernel(esp: EncodedSearch, es: EncodedSearch, model: ModelSpec,
         jnp.asarray(esp.crash_v2), jnp.asarray(esp.crash_inv),
         jnp.int32(es.n_det), jnp.int32(es.n_crash),
         jnp.asarray(np.asarray(model.init, dtype=np.int32)))
+
+
+def greedy_witness(seq: OpSeq, model: ModelSpec) -> bool:
+    """Try ONE deterministic linearization host-side: ok ops in completion
+    order, skipping crashed ops entirely.  Ops that returned earlier
+    linearized earlier is always real-time consistent, so if every model
+    step is legal this is a valid witness and the search is over — the
+    O(n) analog of a DFS diving straight to the goal on a well-behaved
+    history."""
+    rows = sorted(range(len(seq)), key=lambda i: int(seq.ret[i]))
+    state = model.init
+    for i in rows:
+        if not bool(seq.ok[i]):
+            continue  # crashed ops may never linearize
+        state = model.pystep(state, int(seq.f[i]), int(seq.v1[i]),
+                             int(seq.v2[i]))
+        if state is None:
+            return False
+    return True
 
 
 def search_opseq(seq: OpSeq, model: ModelSpec, *,
@@ -538,6 +584,9 @@ def search_opseq(seq: OpSeq, model: ModelSpec, *,
     if es.n_det == 0 and es.n_crash == 0:
         return {"valid": True, "configs": 0, "max_depth": 0,
                 "engine": "trivial"}
+    if greedy_witness(seq, model):
+        return {"valid": True, "configs": es.n_det, "max_depth": es.n_det,
+                "engine": "greedy-witness"}
     if es.window > MAX_WINDOW or es.n_crash > MAX_CRASH:
         from . import seq as seqmod
         out = seqmod.check_opseq(seq, model)
@@ -548,7 +597,8 @@ def search_opseq(seq: OpSeq, model: ModelSpec, *,
     esp = pad_search(es, dims.n_det_pad, dims.n_crash_pad)
     while True:
         status, configs, max_depth, ovf = _run_kernel(
-            esp, es, model, dims, budget)
+            esp, es, model, dims, budget,
+            bail_on_overflow=dims.frontier < MAX_FRONTIER)
         status = int(status)
         # a level overflowed the frontier and the search didn't prove
         # validity: escalate to a wider frontier and re-run
@@ -631,6 +681,26 @@ def search_batch(seqs: list[OpSeq], model: ModelSpec, *,
     """
     if not seqs:
         return []
+    # greedy completion-order witnesses dispose of well-behaved keys
+    # host-side in O(n); only contentious keys ride to the device
+    results_by_idx: dict = {}
+    rest = []
+    for i, s in enumerate(seqs):
+        if greedy_witness(s, model):
+            results_by_idx[i] = {"valid": True, "configs": s.n_must,
+                                 "max_depth": s.n_must,
+                                 "engine": "greedy-witness"}
+        else:
+            rest.append(i)
+    if not rest:
+        return [results_by_idx[i] for i in range(len(seqs))]
+    if results_by_idx:
+        sub = search_batch([seqs[i] for i in rest], model, budget=budget,
+                           dims=dims, sharding=sharding)
+        for i, r in zip(rest, sub):
+            results_by_idx[i] = r
+        return [results_by_idx[i] for i in range(len(seqs))]
+
     ess = [encode_search(s) for s in seqs]
     hard = [i for i, e in enumerate(ess)
             if e.window > MAX_WINDOW or e.n_crash > MAX_CRASH]

@@ -30,7 +30,32 @@ sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
 QUICK = "--quick" in sys.argv
 
 
+def ensure_live_backend(probe_timeout: int = 90) -> None:
+    """The TPU is reached through a tunnel that can be down; probing it
+    in-process hangs jax backend init forever.  Probe via a subprocess
+    with a timeout and force the CPU backend if the accelerator is
+    unreachable, so bench always produces its JSON line."""
+    import subprocess
+
+    try:
+        out = subprocess.run(
+            [sys.executable, "-c",
+             "import jax; print(jax.devices()[0].platform)"],
+            capture_output=True, text=True, timeout=probe_timeout)
+        platform = out.stdout.strip().splitlines()[-1] if out.stdout else ""
+        if out.returncode == 0 and platform:
+            return  # backend comes up fine; use it as-is
+    except subprocess.TimeoutExpired:
+        pass
+    print("accelerator unreachable; falling back to CPU", file=sys.stderr)
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+
+
 def main():
+    ensure_live_backend()
     from jepsen_tpu.checker import linearizable as lin
     from jepsen_tpu.checker import seq as oracle
     from jepsen_tpu.history import encode_ops

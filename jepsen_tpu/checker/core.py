@@ -97,6 +97,26 @@ def compose(checkers: dict) -> Checker:
     return Compose(checkers)
 
 
+class ConcurrencyLimit(Checker):
+    """Cap concurrent executions of a memory-hungry checker with a
+    semaphore (checker.clj:91-106); used when many independent keys fan
+    out over one expensive checker."""
+
+    def __init__(self, limit: int, checker: Checker):
+        import threading
+
+        self.checker = checker
+        self._sem = threading.Semaphore(limit)
+
+    def check(self, test, history, opts=None):
+        with self._sem:
+            return self.checker.check(test, history, opts)
+
+
+def concurrency_limit(limit: int, checker: Checker) -> Checker:
+    return ConcurrencyLimit(limit, checker)
+
+
 class _Unbridled(Checker):
     """A checker which is always happy (checker.clj:108-112)."""
 

@@ -246,3 +246,85 @@ def test_independent_checker_device_batch():
     assert set(out["failures"]) == bad_keys
     for k in range(6):
         assert out["results"][k]["valid"] is (k not in bad_keys)
+
+
+# --- sequential + monotonic (cockroach suite checkers) --------------------
+
+
+def test_trailing_nil():
+    from jepsen_tpu.checker import extra
+
+    assert not extra.trailing_nil([None, None, 1, 2])
+    assert extra.trailing_nil([1, None])
+    assert extra.trailing_nil([None, 1, None])
+    assert not extra.trailing_nil([])
+
+
+def test_sequential_checker():
+    from jepsen_tpu.checker import extra
+
+    test = {"key_count": 2}
+    # read vectors are in reverse insert order: later subkey first
+    good = ops(("invoke", 0, "read", None),
+               ("ok", 0, "read", ("k", [None, "k_0"])),  # y missing, x seen? -> wait
+               )
+    # y=None then x="k_0" means later insert invisible, earlier visible: fine
+    out = extra.sequential().check(test, good)
+    assert out["valid"] is True
+
+    bad = ops(("invoke", 0, "read", None),
+              ("ok", 0, "read", ("k", ["k_1", None])))  # y seen, x missing
+    out = extra.sequential().check(test, bad)
+    assert out["valid"] is False and out["bad_count"] == 1
+
+    full = ops(("invoke", 0, "read", None),
+               ("ok", 0, "read", ("k", ["k_1", "k_0"])))
+    out = extra.sequential().check(test, full)
+    assert out["valid"] is True and out["all_count"] == 1
+
+
+def test_monotonic_checker():
+    from jepsen_tpu.checker import extra
+
+    def row(v, sts, proc=0, node="n1", tb=0):
+        return {"val": v, "sts": sts, "proc": proc, "node": node, "tb": tb}
+
+    h = ops(("invoke", 0, "add", {"val": 0}), ("ok", 0, "add", {"val": 0}),
+            ("invoke", 0, "add", {"val": 1}), ("ok", 0, "add", {"val": 1}),
+            ("invoke", 1, "read", None),
+            ("ok", 1, "read", [row(0, 10), row(1, 20)]))
+    assert extra.monotonic().check({}, h)["valid"] is True
+
+    # reversed values: off-order
+    h2 = ops(("invoke", 0, "add", {"val": 0}), ("ok", 0, "add", {"val": 0}),
+             ("invoke", 0, "add", {"val": 1}), ("ok", 0, "add", {"val": 1}),
+             ("invoke", 1, "read", None),
+             ("ok", 1, "read", [row(1, 10), row(0, 20)]))
+    out = extra.monotonic().check({}, h2)
+    assert out["valid"] is False and out["off_order_vals"]
+
+    # lost element
+    h3 = ops(("invoke", 0, "add", {"val": 0}), ("ok", 0, "add", {"val": 0}),
+             ("invoke", 0, "add", {"val": 1}), ("ok", 0, "add", {"val": 1}),
+             ("invoke", 1, "read", None), ("ok", 1, "read", [row(0, 10)]))
+    out = extra.monotonic().check({}, h3)
+    assert out["valid"] is False and out["lost"] == [1]
+
+    # never read -> unknown
+    h4 = ops(("invoke", 0, "add", {"val": 0}), ("ok", 0, "add", {"val": 0}))
+    assert extra.monotonic().check({}, h4)["valid"] == "unknown"
+
+
+def test_concurrency_limit():
+    from jepsen_tpu.checker import core as ccore
+
+    calls = []
+
+    class Slow(ccore.Checker):
+        def check(self, test, history, opts=None):
+            calls.append(1)
+            return {"valid": True}
+
+    chk = ccore.concurrency_limit(2, Slow())
+    out = chk.check({}, [])
+    assert out["valid"] is True and calls == [1]

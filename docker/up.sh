@@ -1,0 +1,18 @@
+#!/bin/bash
+# Bring up the cluster (reference: docker/up.sh).  Generates a dev-only
+# SSH keypair on first run, builds, and starts everything.
+set -euo pipefail
+cd "$(dirname "$0")"
+
+if [ ! -f control/id_rsa ]; then
+  echo "Generating dev SSH keypair..."
+  ssh-keygen -t ed25519 -N "" -f control/id_rsa -C jepsen-dev
+  cp control/id_rsa.pub node/authorized_keys
+fi
+
+docker compose build
+docker compose up -d
+echo
+echo "Cluster up.  Run a test with:"
+echo "  docker exec -it jepsen-control \\"
+echo "    python -m jepsen_tpu.suites.etcdemo test -w register --time-limit 30"

@@ -294,105 +294,10 @@ def build_search_fn(model: ModelSpec, dims: SearchDims, budget: int,
     W = dims.window
     K = dims.k
     F = dims.frontier
-    S = dims.state_width
-    WW = dims.win_words
-    CW = dims.crash_words
     NC = dims.n_crash_pad
     WORDS = dims.words
-    jstep = model.jstep
-
-    def unpack(cfg):
-        # cfg: int32 [WORDS]
-        p = cfg[0]
-        win = _unpack_bits(cfg[1:1 + WW], WW)  # bool [W]
-        crash = _unpack_bits(cfg[1 + WW:1 + WW + CW], CW)[:NC]  # bool [NC]
-        state = cfg[1 + WW + CW:]
-        return p, win, crash, state
-
-    def pack(p, win, crash, state):
-        crash_pad = jnp.zeros(CW * 32, dtype=bool).at[:NC].set(crash)
-        return jnp.concatenate([
-            p[None].astype(jnp.int32),
-            _pack_bits(win, WW),
-            _pack_bits(crash_pad, CW),
-            state.astype(jnp.int32),
-        ])
-
-    def expand_one(cfg, alive, det_f, det_v1, det_v2, det_inv, det_ret,
-                   sfx_min, crash_f, crash_v1, crash_v2, crash_inv, n_det,
-                   n_crash):
-        """One config -> K packed successors + valid mask + goal mask."""
-        p, win, crash, state = unpack(cfg)
-
-        # --- gather the determinate window ---------------------------------
-        pos = p + jnp.arange(W, dtype=jnp.int32)  # [W]
-        in_range = pos < n_det
-        w_ret = jnp.where(in_range & ~win,
-                          jnp.take(det_ret, pos, mode="clip"), INF32)
-        w_inv = jnp.where(in_range,
-                          jnp.take(det_inv, pos, mode="clip"), INF32)
-        # min/second-min of unlinearized det returns within the window
-        m1 = jnp.min(w_ret)
-        am = jnp.argmin(w_ret)
-        w_ret_excl = w_ret.at[am].set(INF32)
-        m2 = jnp.min(w_ret_excl)
-        sfx = jnp.take(sfx_min, jnp.minimum(p + W, n_det), mode="clip")
-        # total min over unlinearized det rets (crash rets are +inf)
-        m1_tot = jnp.minimum(m1, sfx)
-
-        # --- enabled determinate candidates --------------------------------
-        lanes = jnp.arange(W, dtype=jnp.int32)
-        excl_w = jnp.where(lanes == am, m2, m1)
-        excl_tot = jnp.minimum(excl_w, sfx)
-        det_enabled = in_range & ~win & (w_inv < excl_tot)
-
-        # --- enabled crashed candidates ------------------------------------
-        c_lanes = jnp.arange(NC, dtype=jnp.int32)
-        c_enabled = (c_lanes < n_crash) & ~crash & (crash_inv < m1_tot)
-
-        # --- compact candidates to K lanes ---------------------------------
-        enabled = jnp.concatenate([det_enabled, c_enabled])  # [W+NC]
-        cand, n_enabled = _compact_indices(enabled, K)
-        cand_on = jnp.arange(K) < n_enabled
-
-        is_det = cand < W
-        det_pos = jnp.clip(p + cand, 0, dims.n_det_pad - 1)
-        c_id = jnp.clip(cand - W, 0, NC - 1)
-        cf = jnp.where(is_det, jnp.take(det_f, det_pos),
-                       jnp.take(crash_f, c_id))
-        cv1 = jnp.where(is_det, jnp.take(det_v1, det_pos),
-                        jnp.take(crash_v1, c_id))
-        cv2 = jnp.where(is_det, jnp.take(det_v2, det_pos),
-                        jnp.take(crash_v2, c_id))
-
-        # --- model step for each candidate ---------------------------------
-        st = jnp.broadcast_to(state, (K, S))
-        new_state, legal = jax.vmap(jstep)(st, cf, cv1, cv2)
-        valid = alive & cand_on & legal
-
-        # --- build successor configs ---------------------------------------
-        def succ(ci, ns):
-            lane = cand[ci]
-            d_lane = jnp.clip(lane, 0, W - 1)
-            new_win = win.at[d_lane].set(True)
-            # normalize: advance p past the run of linearized at window head
-            run = jnp.cumprod(new_win.astype(jnp.int32))
-            shift = jnp.sum(run).astype(jnp.int32)
-            rolled = jnp.roll(new_win, -shift)
-            tail_clear = jnp.arange(W) < (W - shift)
-            norm_win = rolled & tail_clear
-            is_d = lane < W
-            p2 = jnp.where(is_d, p + shift, p)
-            win2 = jnp.where(is_d, norm_win, win)
-            cl = jnp.clip(lane - W, 0, NC - 1)
-            crash2 = jnp.where(is_d, crash, crash.at[cl].set(True))
-            return pack(p2, win2, crash2, ns), p2
-
-        cfgs, p2s = jax.vmap(succ)(jnp.arange(K), new_state)
-        goal = valid & (p2s >= n_det)
-        return cfgs, valid, goal, p2s
-
-    expand = jax.vmap(expand_one, in_axes=(0, 0) + (None,) * 12)
+    pieces = _make_kernel_pieces(model, dims)
+    pack, expand = pieces["pack"], pieces["expand"]
 
     def search(det_f, det_v1, det_v2, det_inv, det_ret, sfx_min,
                crash_f, crash_v1, crash_v2, crash_inv, n_det, n_crash,
@@ -479,6 +384,295 @@ def build_search_fn(model: ModelSpec, dims: SearchDims, budget: int,
         return status, configs, max_depth, ovf
 
     return search
+
+
+# ---------------------------------------------------------------------------
+# Mesh-sharded search — one big history's frontier across many devices
+# ---------------------------------------------------------------------------
+
+
+def build_sharded_search_fn(model: ModelSpec, dims: SearchDims, budget: int,
+                            mesh, axis: str = "shard",
+                            bail_on_overflow: bool = False):
+    """The frontier of ONE search sharded over a device mesh.
+
+    Each device owns the hash partition ``h1 % D`` of the configuration
+    space.  Per BFS level: devices expand their local frontier slice,
+    route successors to their home shard with `lax.all_to_all`
+    (identical configs hash alike, so global dedup reduces to local
+    dedup after the exchange), then dedup and compact locally.
+    Termination and the goal test are `psum` reductions.  This is the
+    scale-out path for histories whose levels outgrow one chip's
+    frontier — the reference's analog is simply "buy a bigger JVM heap"
+    (-Xmx32g, jepsen/project.clj:25).
+
+    dims.frontier is the PER-DEVICE frontier width.
+    """
+    from jax.experimental.shard_map import shard_map
+    from jax.sharding import PartitionSpec as P
+
+    W = dims.window
+    K = dims.k
+    F = dims.frontier
+    S = 4 * F
+    NC = dims.n_crash_pad
+    WW = dims.win_words
+    CW = dims.crash_words
+    WORDS = dims.words
+    D = mesh.shape[axis]
+    # per-destination-device routing capacity per level
+    C_CAP = max(64, _round_up(S // D, 32))
+    jstep = model.jstep
+
+    inner = _make_kernel_pieces(model, dims)
+    pack, expand = inner["pack"], inner["expand"]
+
+    def search_device(det_f, det_v1, det_v2, det_inv, det_ret, sfx_min,
+                      crash_f, crash_v1, crash_v2, crash_inv, n_det,
+                      n_crash, init_state):
+        me = lax.axis_index(axis)
+        init_cfg = pack(jnp.int32(0), jnp.zeros(W, bool),
+                        jnp.zeros(NC, bool), init_state)
+        frontier = jnp.zeros((F, WORDS), dtype=jnp.int32).at[0].set(init_cfg)
+        count = jnp.where(me == 0, jnp.int32(1), jnp.int32(0))
+
+        # Loop control state (total, any_ovf, status) is psum'd in the
+        # BODY so it is replicated across devices; the cond is then a
+        # pure local test — collectives inside a while cond can diverge
+        # between devices and deadlock/corrupt the all_to_alls.
+        carry0 = (frontier, count, jnp.int32(-1), jnp.int32(0),
+                  jnp.int32(0), jnp.bool_(False), jnp.int32(1))
+
+        def cond(c):
+            _, _, status, configs, _, any_ovf, total = c
+            go = (status == -1) & (total > 0) & (configs < budget)
+            if bail_on_overflow:
+                go = go & ~any_ovf
+            return go
+
+        def body(c):
+            frontier, count, status, configs, max_depth, ovf, _total = c
+            alive = jnp.arange(F) < count
+            cfgs, valid, goal, p2s = expand(
+                frontier, alive, det_f, det_v1, det_v2, det_inv, det_ret,
+                sfx_min, crash_f, crash_v1, crash_v2, crash_inv, n_det,
+                n_crash)
+            cfgs = cfgs.reshape(F * K, WORDS)
+            valid = valid.reshape(F * K)
+            found = lax.psum(jnp.any(goal).astype(jnp.int32), axis) > 0
+
+            # --- route successors to their home shard ----------------------
+            wu = cfgs.astype(jnp.uint32)
+            h1 = _hash_words(wu, 0x9E3779B1)
+            owner = (h1 % np.uint32(D)).astype(jnp.int32)
+
+            def bucket(d):
+                mask = valid & (owner == d)
+                idx, cnt = _compact_indices(mask, C_CAP)
+                return jnp.take(cfgs, idx, axis=0), cnt
+
+            send_cfgs, send_cnt = jax.vmap(bucket)(
+                jnp.arange(D, dtype=jnp.int32))  # [D, C_CAP, WORDS], [D]
+            ovf = ovf | jnp.any(send_cnt > C_CAP)
+            send_cnt = jnp.minimum(send_cnt, C_CAP)
+            recv_cfgs = lax.all_to_all(send_cfgs, axis, 0, 0, tiled=False)
+            recv_cnt = lax.all_to_all(send_cnt, axis, 0, 0, tiled=False)
+
+            rcfgs = recv_cfgs.reshape(D * C_CAP, WORDS)
+            lane = jnp.arange(D * C_CAP) % C_CAP
+            rvalid = lane < jnp.repeat(recv_cnt, C_CAP)
+
+            # --- local dedup (global, since owners partition by hash) -----
+            rh1 = _hash_words(rcfgs.astype(jnp.uint32), 0x9E3779B1)
+            big = np.uint32(0xFFFFFFFF)
+            h1s = jnp.where(rvalid, rh1, big)
+            sh1, perm = lax.sort(
+                (h1s, jnp.arange(D * C_CAP, dtype=jnp.int32)), num_keys=1)
+            svalid = jnp.take(rvalid, perm)
+            scfgs = jnp.take(rcfgs, perm, axis=0)
+            same_hash = sh1[1:] == sh1[:-1]
+            same_cfg = jnp.all(scfgs[1:] == scfgs[:-1], axis=1)
+            dup = jnp.concatenate([jnp.zeros(1, bool), same_hash & same_cfg])
+            svalid = svalid & ~dup
+
+            src, new_count = _compact_indices(svalid, F)
+            new_frontier = jnp.take(scfgs, src, axis=0)
+            ovf = ovf | (new_count > F)
+            new_count = jnp.minimum(new_count, F)
+
+            configs = configs + lax.psum(count, axis)
+            max_depth = jnp.maximum(max_depth, jnp.max(
+                jnp.where(alive, frontier[:, 0], 0)))
+            status = jnp.where(found, 2, status)
+            total = lax.psum(new_count, axis)
+            any_ovf = lax.psum(ovf.astype(jnp.int32), axis) > 0
+            return (new_frontier, new_count, status, configs, max_depth,
+                    any_ovf, total)
+
+        (frontier, count, status, configs, max_depth, any_ovf, total) = \
+            lax.while_loop(cond, body, carry0)
+
+        status = jnp.where(
+            status == -1,
+            jnp.where(total <= 0, jnp.where(any_ovf, 0, 1), 0),
+            status)
+        max_depth = lax.pmax(max_depth, axis)
+        return status, configs, max_depth, any_ovf
+
+    specs = (P(),) * 13
+    return shard_map(search_device, mesh=mesh, in_specs=specs,
+                     out_specs=(P(), P(), P(), P()), check_rep=False)
+
+
+def _make_kernel_pieces(model: ModelSpec, dims: SearchDims):
+    """Expose build_search_fn's internal pack/expand for the sharded
+    kernel (same closure construction, no search loop)."""
+    out = {}
+    W, K, NC = dims.window, dims.k, dims.n_crash_pad
+    WW, CW, S = dims.win_words, dims.crash_words, dims.state_width
+    WORDS = dims.words
+    jstep = model.jstep
+
+    def unpack(cfg):
+        p = cfg[0]
+        win = _unpack_bits(cfg[1:1 + WW], WW)
+        crash = _unpack_bits(cfg[1 + WW:1 + WW + CW], CW)[:NC]
+        state = cfg[1 + WW + CW:]
+        return p, win, crash, state
+
+    def pack(p, win, crash, state):
+        crash_pad = jnp.zeros(CW * 32, dtype=bool).at[:NC].set(crash)
+        return jnp.concatenate([
+            p[None].astype(jnp.int32),
+            _pack_bits(win, WW),
+            _pack_bits(crash_pad, CW),
+            state.astype(jnp.int32),
+        ])
+
+    def expand_one(cfg, alive, det_f, det_v1, det_v2, det_inv, det_ret,
+                   sfx_min, crash_f, crash_v1, crash_v2, crash_inv, n_det,
+                   n_crash):
+        p, win, crash, state = unpack(cfg)
+        pos = p + jnp.arange(W, dtype=jnp.int32)
+        in_range = pos < n_det
+        w_ret = jnp.where(in_range & ~win,
+                          jnp.take(det_ret, pos, mode="clip"), INF32)
+        w_inv = jnp.where(in_range,
+                          jnp.take(det_inv, pos, mode="clip"), INF32)
+        m1 = jnp.min(w_ret)
+        am = jnp.argmin(w_ret)
+        w_ret_excl = w_ret.at[am].set(INF32)
+        m2 = jnp.min(w_ret_excl)
+        sfx = jnp.take(sfx_min, jnp.minimum(p + W, n_det), mode="clip")
+        m1_tot = jnp.minimum(m1, sfx)
+
+        lanes = jnp.arange(W, dtype=jnp.int32)
+        excl_w = jnp.where(lanes == am, m2, m1)
+        excl_tot = jnp.minimum(excl_w, sfx)
+        det_enabled = in_range & ~win & (w_inv < excl_tot)
+
+        c_lanes = jnp.arange(NC, dtype=jnp.int32)
+        c_enabled = (c_lanes < n_crash) & ~crash & (crash_inv < m1_tot)
+
+        enabled = jnp.concatenate([det_enabled, c_enabled])
+        cand, n_enabled = _compact_indices(enabled, K)
+        cand_on = jnp.arange(K) < n_enabled
+
+        is_det = cand < W
+        det_pos = jnp.clip(p + cand, 0, dims.n_det_pad - 1)
+        c_id = jnp.clip(cand - W, 0, NC - 1)
+        cf = jnp.where(is_det, jnp.take(det_f, det_pos),
+                       jnp.take(crash_f, c_id))
+        cv1 = jnp.where(is_det, jnp.take(det_v1, det_pos),
+                        jnp.take(crash_v1, c_id))
+        cv2 = jnp.where(is_det, jnp.take(det_v2, det_pos),
+                        jnp.take(crash_v2, c_id))
+
+        st = jnp.broadcast_to(state, (K, S))
+        new_state, legal = jax.vmap(jstep)(st, cf, cv1, cv2)
+        valid = alive & cand_on & legal
+
+        def succ(ci, ns):
+            lane = cand[ci]
+            d_lane = jnp.clip(lane, 0, W - 1)
+            new_win = win.at[d_lane].set(True)
+            run = jnp.cumprod(new_win.astype(jnp.int32))
+            shift = jnp.sum(run).astype(jnp.int32)
+            rolled = jnp.roll(new_win, -shift)
+            tail_clear = jnp.arange(W) < (W - shift)
+            norm_win = rolled & tail_clear
+            is_d = lane < W
+            p2 = jnp.where(is_d, p + shift, p)
+            win2 = jnp.where(is_d, norm_win, win)
+            cl = jnp.clip(lane - W, 0, NC - 1)
+            crash2 = jnp.where(is_d, crash, crash.at[cl].set(True))
+            return pack(p2, win2, crash2, ns), p2
+
+        cfgs, p2s = jax.vmap(succ)(jnp.arange(K), new_state)
+        goal = valid & (p2s >= n_det)
+        return cfgs, valid, goal, p2s
+
+    out["pack"] = pack
+    out["expand"] = jax.vmap(expand_one, in_axes=(0, 0) + (None,) * 12)
+    return out
+
+
+_SHARDED_CACHE: dict = {}
+
+
+def search_opseq_sharded(seq: OpSeq, model: ModelSpec, mesh, *,
+                         axis: str = "shard",
+                         budget: int = 20_000_000,
+                         frontier_per_device: int = 1024) -> dict:
+    """Check one history with its frontier sharded over `mesh`."""
+    es = encode_search(seq)
+    if es.n_det == 0 and es.n_crash == 0:
+        return {"valid": True, "configs": 0, "max_depth": 0,
+                "engine": "trivial"}
+    if greedy_witness(seq, model):
+        return {"valid": True, "configs": es.n_det, "max_depth": es.n_det,
+                "engine": "greedy-witness"}
+    if es.window > MAX_WINDOW or es.n_crash > MAX_CRASH:
+        from . import seq as seqmod
+
+        out = seqmod.check_opseq(seq, model)
+        out["engine"] = "host-oracle(fallback)"
+        return out
+
+    dims = choose_dims(es, model, frontier=frontier_per_device)
+    esp = pad_search(es, dims.n_det_pad, dims.n_crash_pad)
+    while True:
+        mesh_key = (tuple(mesh.shape.items()),
+                    tuple(d.id for d in mesh.devices.flat))
+        key = (model.name, dims, budget, axis, mesh_key,
+               dims.frontier < MAX_FRONTIER)
+        fn = _SHARDED_CACHE.get(key)
+        if fn is None:
+            fn = jax.jit(build_sharded_search_fn(
+                model, dims, budget, mesh, axis,
+                bail_on_overflow=dims.frontier < MAX_FRONTIER))
+            _SHARDED_CACHE[key] = fn
+        status, configs, max_depth, ovf = fn(
+            jnp.asarray(esp.det_f), jnp.asarray(esp.det_v1),
+            jnp.asarray(esp.det_v2), jnp.asarray(esp.det_inv),
+            jnp.asarray(esp.det_ret), jnp.asarray(esp.suffix_min_ret),
+            jnp.asarray(esp.crash_f), jnp.asarray(esp.crash_v1),
+            jnp.asarray(esp.crash_v2), jnp.asarray(esp.crash_inv),
+            jnp.int32(es.n_det), jnp.int32(es.n_crash),
+            jnp.asarray(np.asarray(model.init, dtype=np.int32)))
+        status = int(np.asarray(status).reshape(-1)[0])
+        if status == UNKNOWN and bool(np.asarray(ovf).reshape(-1)[0]) \
+                and dims.frontier < MAX_FRONTIER:
+            dims = SearchDims(**{**dims.__dict__,
+                                 "frontier": min(dims.frontier * 8,
+                                                 MAX_FRONTIER)})
+            continue
+        break
+    return {"valid": _STATUS[status],
+            "configs": int(np.asarray(configs).reshape(-1)[0]),
+            "max_depth": int(np.asarray(max_depth).reshape(-1)[0]),
+            "engine": f"tpu-sharded-x{mesh.shape[axis]}",
+            "frontier_per_device": dims.frontier}
 
 
 # ---------------------------------------------------------------------------

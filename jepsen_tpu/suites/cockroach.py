@@ -1,0 +1,351 @@
+"""CockroachDB suite — the registry-runner application.
+
+Reference: cockroachdb/ (the largest suite, 2,495 LoC).  Workload registry
+(runner.clj:25-34): bank, register (independent CAS), monotonic, sets,
+sequential, g2; composable named nemeses with :during/:final generators
+(nemesis.clj:63-151) including clock skews of graded severity driven by
+an on-node bumptime binary (nemesis.clj:153-271 — ours rides
+jepsen_tpu.nemesis_time); db automation installs the official tarball and
+runs `cockroach start` per node (auto.clj).
+
+SQL clients are gated on psycopg2 (cockroach speaks the postgres wire
+protocol); everything else — db automation, generators, checkers,
+nemeses — is importable and unit-tested without it.
+"""
+
+from __future__ import annotations
+
+import itertools
+import logging
+import random
+import threading
+from dataclasses import replace
+
+from .. import (checker as checker_mod, cli, client as client_mod, control,
+                control_util as cu, generator as gen, independent,
+                nemesis as nemesis_mod, nemesis_time)
+from ..checker import basic, extra, linearizable as lin, timeline
+from ..models import cas_register
+from ..os import debian
+from . import registry as registry_mod
+
+log = logging.getLogger("jepsen")
+
+DIR = "/opt/cockroach"
+BINARY = f"{DIR}/cockroach"
+PIDFILE = f"{DIR}/cockroach.pid"
+LOGFILE = f"{DIR}/cockroach.log"
+STORE = f"{DIR}/data"
+TARBALL = ("https://binaries.cockroachdb.com/"
+           "cockroach-v2.0.0.linux-amd64.tgz")
+
+
+class CockroachDB:
+    """Tarball install + cockroach start with a join list (auto.clj)."""
+
+    def __init__(self, tarball: str = TARBALL):
+        self.tarball = tarball
+
+    def setup(self, test, node):
+        from .. import core as core_mod
+
+        sess = control.session(node, test).su()
+        cu.install_archive(sess, self.tarball, DIR)
+        join = ",".join(str(n) for n in test["nodes"])
+        cu.start_daemon(
+            sess, BINARY, "start", "--insecure",
+            f"--store={STORE}", f"--host={node}", f"--join={join}",
+            "--cache=.25", "--max-sql-memory=.25",
+            logfile=LOGFILE, pidfile=PIDFILE, chdir=DIR)
+        if node == core_mod.primary(test):
+            import time
+
+            time.sleep(5)
+
+    def teardown(self, test, node):
+        sess = control.session(node, test).su()
+        cu.stop_daemon(sess, PIDFILE, cmd="cockroach")
+        sess.exec("rm", "-rf", STORE)
+
+    def log_files(self, test, node):
+        return [LOGFILE]
+
+
+def db(tarball: str = TARBALL) -> CockroachDB:
+    return CockroachDB(tarball)
+
+
+class SQLClient(client_mod.Client):
+    """Base: a psycopg2 connection to the local gateway node with
+    reconnect + retry (cockroach client.clj semantics)."""
+
+    def __init__(self, node=None):
+        self.node = node
+        self.conn = None
+
+    def open(self, test, node):
+        try:
+            import psycopg2
+        except ImportError as e:
+            raise RuntimeError(
+                "cockroach clients need psycopg2 (postgres wire protocol); "
+                "pip install psycopg2-binary on the control node") from e
+        c = type(self)(node)
+        c.conn = psycopg2.connect(host=str(node), port=26257,
+                                  user="root", dbname="jepsen",
+                                  connect_timeout=5)
+        c.conn.autocommit = False
+        return c
+
+    def close(self, test):
+        if self.conn is not None:
+            self.conn.close()
+
+    def txn(self, f):
+        try:
+            with self.conn:
+                with self.conn.cursor() as cur:
+                    return f(cur)
+        except Exception:
+            self.conn.rollback()
+            raise
+
+
+class RegisterClient(SQLClient):
+    """Independent-key CAS registers in one table (register.clj)."""
+
+    def setup(self, test):
+        def f(cur):
+            cur.execute("CREATE TABLE IF NOT EXISTS registers "
+                        "(id INT PRIMARY KEY, value INT)")
+        self.txn(f)
+
+    def invoke(self, test, op):
+        k, v = op.value.key, op.value.value
+        try:
+            if op.f == "read":
+                def f(cur):
+                    cur.execute("SELECT value FROM registers WHERE id=%s",
+                                (k,))
+                    row = cur.fetchone()
+                    return row[0] if row else None
+                return replace(op, type="ok",
+                               value=independent.tuple_(k, self.txn(f)))
+            if op.f == "write":
+                def f(cur):
+                    cur.execute("UPSERT INTO registers (id, value) "
+                                "VALUES (%s, %s)", (k, v))
+                self.txn(f)
+                return replace(op, type="ok")
+            if op.f == "cas":
+                old, new = v
+
+                def f(cur):
+                    cur.execute("UPDATE registers SET value=%s "
+                                "WHERE id=%s AND value=%s", (new, k, old))
+                    return cur.rowcount == 1
+                return replace(op, type="ok" if self.txn(f) else "fail")
+            raise ValueError(f"unknown f {op.f!r}")
+        except Exception as e:
+            return replace(op, type="fail" if op.f == "read" else "info",
+                           error=str(e))
+
+
+class BankClient(SQLClient):
+    """Random transfers, total-preserving reads (bank.clj)."""
+
+    def setup(self, test):
+        accounts = test.get("accounts", list(range(8)))
+        total = test.get("total_amount", 100)
+        per = total // len(accounts)
+
+        def f(cur):
+            cur.execute("CREATE TABLE IF NOT EXISTS accounts "
+                        "(id INT PRIMARY KEY, balance INT)")
+            for a in accounts:
+                cur.execute("UPSERT INTO accounts (id, balance) "
+                            "VALUES (%s, %s)", (a, per))
+        self.txn(f)
+
+    def invoke(self, test, op):
+        try:
+            if op.f == "read":
+                def f(cur):
+                    cur.execute("SELECT id, balance FROM accounts")
+                    return dict(cur.fetchall())
+                return replace(op, type="ok", value=self.txn(f))
+            if op.f == "transfer":
+                v = op.value
+
+                def f(cur):
+                    cur.execute("SELECT balance FROM accounts WHERE id=%s",
+                                (v["from"],))
+                    b = cur.fetchone()[0]
+                    if b < v["amount"]:
+                        return False
+                    cur.execute("UPDATE accounts SET balance=balance-%s "
+                                "WHERE id=%s", (v["amount"], v["from"]))
+                    cur.execute("UPDATE accounts SET balance=balance+%s "
+                                "WHERE id=%s", (v["amount"], v["to"]))
+                    return True
+                return replace(op,
+                               type="ok" if self.txn(f) else "fail")
+            raise ValueError(f"unknown f {op.f!r}")
+        except Exception as e:
+            return replace(op, type="fail" if op.f == "read" else "info",
+                           error=str(e))
+
+
+def bank_generator(test, process):
+    """tests/bank.clj:20-38: transfers between distinct accounts + reads."""
+    accounts = test.get("accounts", list(range(8)))
+    if random.random() < 0.5:
+        return {"type": "invoke", "f": "read", "value": None}
+    a, b = random.sample(accounts, 2)
+    return {"type": "invoke", "f": "transfer",
+            "value": {"from": a, "to": b,
+                      "amount": 1 + random.randrange(
+                          test.get("max_transfer", 5))}}
+
+
+def _naturals():
+    k = 0
+    while True:
+        yield k
+        k += 1
+
+
+REGISTRY = registry_mod.Registry()
+
+
+@REGISTRY.workload("register")
+def register_workload(opts):
+    def r(t, p):
+        return {"type": "invoke", "f": "read", "value": None}
+
+    def w(t, p):
+        return {"type": "invoke", "f": "write",
+                "value": random.randrange(5)}
+
+    def cas(t, p):
+        return {"type": "invoke", "f": "cas",
+                "value": (random.randrange(5), random.randrange(5))}
+
+    return {
+        "client": RegisterClient(),
+        "model": cas_register(),
+        "checker": independent.checker(checker_mod.compose({
+            "linear": lin.linearizable(cas_register()),
+            "timeline": timeline.timeline(),
+        })),
+        "generator": independent.concurrent_generator(
+            min(4, opts.get("concurrency", 4)), _naturals(),
+            lambda k: gen.limit(opts.get("ops_per_key", 100),
+                                gen.mix([r, w, cas]))),
+    }
+
+
+@REGISTRY.workload("bank")
+def bank_workload(opts):
+    return {
+        "client": BankClient(),
+        "checker": basic.bank(),
+        "generator": bank_generator,
+    }
+
+
+@REGISTRY.workload("monotonic")
+def monotonic_workload(opts):
+    counter = {"n": -1}
+    lock = threading.Lock()
+
+    def add(test, process):
+        with lock:
+            counter["n"] += 1
+        return {"type": "invoke", "f": "add",
+                "value": {"val": counter["n"]}}
+
+    return {
+        "client": client_mod.noop,  # site-specific; see monotonic.clj
+        "checker": extra.monotonic(),
+        "generator": add,
+        "final_generator": gen.once({"type": "invoke", "f": "read",
+                                     "value": None}),
+    }
+
+
+@REGISTRY.workload("sequential")
+def sequential_workload(opts):
+    return {
+        "client": client_mod.noop,  # site-specific; see sequential.clj
+        "checker": extra.sequential(),
+        "generator": gen.void,
+    }
+
+
+@REGISTRY.workload("g2")
+def g2_workload(opts):
+    ids = {"n": 0}
+    lock = threading.Lock()
+
+    def fgen(k):
+        def a(t, p):
+            with lock:
+                ids["n"] += 1
+                return {"type": "invoke", "f": "insert",
+                        "value": (None, ids["n"])}
+
+        def b(t, p):
+            with lock:
+                ids["n"] += 1
+                return {"type": "invoke", "f": "insert",
+                        "value": (ids["n"], None)}
+        return gen.seq([a, b])
+
+    return {
+        "client": client_mod.noop,  # adya G2 txn client is db-specific
+        "checker": basic.g2(),
+        "generator": independent.concurrent_generator(
+            2, _naturals(), fgen),
+    }
+
+
+# graded clock-skew nemeses (cockroach nemesis.clj:153-271) on top of the
+# standard partition menu
+def _reset_gen(test, process):
+    return {"type": "info", "f": "reset", "value": list(test["nodes"])}
+
+
+REGISTRY.nemesis(registry_mod.NamedNemesis(
+    "skews", nemesis_time.clock_nemesis(),
+    during=gen.seq(itertools.cycle(
+        [gen.sleep(5), nemesis_time.bump_gen, gen.sleep(5), _reset_gen])),
+    final=gen.once(_reset_gen)))
+REGISTRY.nemesis(registry_mod.NamedNemesis(
+    "strobe-skews", nemesis_time.clock_nemesis(),
+    during=gen.seq(itertools.cycle(
+        [gen.sleep(5), nemesis_time.strobe_gen])),
+    final=gen.once(_reset_gen)))
+
+
+def base_test(opts: dict) -> dict:
+    from .. import fixtures
+
+    return fixtures.noop_test() | {
+        "os": debian.os,
+        "db": db(opts.get("tarball", TARBALL)),
+        "accounts": list(range(8)),
+        "total_amount": 100,
+        "max_transfer": 5,
+    }
+
+
+REGISTRY.base_test = base_test
+
+
+def main(argv=None):
+    REGISTRY.main(argv)
+
+
+if __name__ == "__main__":
+    main()

@@ -197,3 +197,96 @@ def test_atomdemo_end_to_end(tmp_path):
     import os
 
     assert os.path.exists(os.path.join(str(tmp_path / "store"), "latest"))
+
+
+# --- consul ---------------------------------------------------------------
+
+
+def test_consul_db_commands():
+    responses = {"getent": (0, "10.1.1.1  STREAM x\n", "")}
+    test, r = dummy_test(responses=responses)
+    db = __import__("jepsen_tpu.suites.consul",
+                    fromlist=["db"]).db()
+    import time as time_mod
+
+    orig = time_mod.sleep
+    time_mod.sleep = lambda s: None
+    try:
+        db.setup(test, "n1")   # primary: -bootstrap
+        db.setup(test, "n2")   # secondary: -join
+    finally:
+        time_mod.sleep = orig
+    n1 = [e[2] for e in r.log if e[0] == "n1" and e[1] == "exec"]
+    n2 = [e[2] for e in r.log if e[0] == "n2" and e[1] == "exec"]
+    assert any("-bootstrap" in c for c in n1)
+    assert any("-join 10.1.1.1" in c for c in n2)
+    db.teardown(test, "n1")
+    assert any("killall -9 consul" in e[2] for e in r.log)
+
+
+def test_consul_test_map():
+    from jepsen_tpu.suites import consul
+
+    t = consul.consul_test({"nodes": ["n1"], "concurrency": 2,
+                            "time_limit": 1})
+    assert t["name"] == "consul"
+    assert t["model"].name == "cas-register"
+
+
+# --- rabbitmq -------------------------------------------------------------
+
+
+def test_rabbitmq_test_map_and_db():
+    from jepsen_tpu.suites import rabbitmq
+
+    t = rabbitmq.rabbit_test({"nodes": ["n1", "n2"], "concurrency": 2,
+                              "time_limit": 1})
+    assert t["name"] == "rabbitmq-simple-partition"
+
+    test, r = dummy_test(("n1", "n2"), responses={"dpkg": (0, "", "")})
+    rabbitmq.db().setup(test, "n2")
+    cmds = [e[2] for e in r.log if e[0] == "n2" and e[1] == "exec"]
+    assert any("rabbitmqctl join_cluster rabbit@n1" in c for c in cmds)
+
+
+# --- cockroach registry ---------------------------------------------------
+
+
+def test_cockroach_registry_workloads():
+    from jepsen_tpu.suites import cockroach
+
+    assert set(cockroach.REGISTRY.workloads) >= \
+        {"register", "bank", "monotonic", "sequential", "g2"}
+    assert "skews" in cockroach.REGISTRY.nemeses
+    t = cockroach.REGISTRY.build_test(
+        {"workload": "bank", "nemesis": "parts", "nodes": ["n1"],
+         "concurrency": 2, "time_limit": 1})
+    assert "bank" in t["name"]
+
+    import random
+
+    random.seed(0)
+    op = cockroach.bank_generator(t, 0)
+    assert op["f"] in ("read", "transfer")
+    if op["f"] == "transfer":
+        assert op["value"]["from"] != op["value"]["to"]
+
+
+def test_cockroach_db_commands():
+    from jepsen_tpu.suites import cockroach
+
+    test, r = dummy_test(responses={
+        "stat /": (1, "", "no"),
+        "ls -A": (0, "cockroach-v2.0.0.linux-amd64\n", ""),
+        "dirname": (0, "/opt", "")})
+    import time as time_mod
+
+    orig = time_mod.sleep
+    time_mod.sleep = lambda s: None
+    try:
+        cockroach.db().setup(test, "n1")
+    finally:
+        time_mod.sleep = orig
+    cmds = [e[2] for e in r.log if e[0] == "n1" and e[1] == "exec"]
+    assert any("--startas /opt/cockroach/cockroach -- start --insecure" in c
+               and "--join=n1,n2,n3" in c for c in cmds)

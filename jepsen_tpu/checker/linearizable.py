@@ -933,6 +933,51 @@ def search_batch(seqs: list[OpSeq], model: ModelSpec, *,
     return out
 
 
+def truncate_to_failure(seq: OpSeq, depth: int, window: int
+                        ) -> OpSeq | None:
+    """Cut the history just past the failure region, at a point where
+    every kept determinate op returned before any removed op invoked.
+
+    The device search localizes an invalid history's obstruction near
+    determinate position `depth` (+ window).  The cut must be *closed*:
+    if no removed op can linearize among the kept ones (kept det rets all
+    precede removed invs; crashed rows before the cut are kept), then any
+    valid linearization of the full history restricts to one of the
+    prefix — so prefix-invalid ⟹ full-invalid, and the host oracle can
+    confirm + extract a witness on the (much shorter) prefix
+    (SURVEY.md §7 "witness reconstruction").
+
+    Returns None when no quiescent cut exists before the end.
+    """
+    ok = np.asarray(seq.ok, dtype=bool)
+    det_rows = np.nonzero(ok)[0]
+    n_det = len(det_rows)
+    want = min(depth + window + 1, n_det)
+    if want >= n_det:
+        return None
+    det_inv = np.asarray(seq.inv)[det_rows]
+    det_ret = np.asarray(seq.ret)[det_rows]
+    run_max = np.maximum.accumulate(det_ret)
+    # boundary after det i iff max ret of dets 0..i < inv of det i+1
+    cut = None
+    for i in range(want, n_det - 1):
+        if run_max[i] < det_inv[i + 1]:
+            cut = i
+            break
+    if cut is None:
+        return None
+    t = det_inv[cut + 1]  # first removed det's invocation rank
+    keep = np.asarray(seq.inv) < t
+    idx = np.nonzero(keep)[0]
+    if len(idx) >= len(seq):
+        return None
+    return OpSeq(
+        process=seq.process[idx], f=seq.f[idx], v1=seq.v1[idx],
+        v2=seq.v2[idx], inv=seq.inv[idx], ret=seq.ret[idx],
+        ok=seq.ok[idx], ops=[seq.ops[i] for i in idx],
+        encoder=seq.encoder)
+
+
 class Linearizable:
     """Linearizability checker backed by the device engine.
 
@@ -976,12 +1021,23 @@ class Linearizable:
             return out
 
         out = search_opseq(seq, model, budget=self.budget)
-        if out["valid"] is False and len(seq) <= self.witness_threshold:
-            # exact confirmation + witness for the report
-            confirm = seqmod.check_opseq(seq, model)
-            confirm["engine"] = out["engine"] + "+host-witness"
-            confirm["device_configs"] = out["configs"]
-            return confirm
+        if out["valid"] is False:
+            # exact confirmation + witness for the report, on the
+            # shortest sound prefix covering the failure region
+            target = seq
+            trunc = truncate_to_failure(seq, out.get("max_depth", 0),
+                                        out.get("window", 1))
+            if trunc is not None:
+                target = trunc
+            if len(target) <= self.witness_threshold:
+                confirm = seqmod.check_opseq(target, model)
+                if confirm["valid"] is False:
+                    confirm["engine"] = out["engine"] + "+host-witness"
+                    confirm["device_configs"] = out["configs"]
+                    confirm["witness_prefix_ops"] = len(target)
+                    return confirm
+                # prefix came back valid: fall through to the full
+                # device verdict (obstruction lies past the cut)
         return out
 
     def __call__(self, test, history, opts=None):

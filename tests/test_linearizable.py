@@ -190,3 +190,42 @@ def test_larger_history_smoke():
     s = encode_ops(h, model.f_codes)
     out = lin.search_opseq(s, model)
     assert out["valid"] is True
+
+
+def test_truncate_to_failure_soundness():
+    """The witness prefix must agree with the full-history verdict on
+    corrupted histories (the cut is closed, so prefix-invalid implies
+    full-invalid)."""
+    model = cas_register()
+    for seed in range(6):
+        rng = random.Random(400 + seed)
+        from jepsen_tpu.synth import corrupt_read, register_history
+
+        h = register_history(rng, n_ops=200, n_procs=6, overlap=3,
+                             crash_p=0.02)
+        h = corrupt_read(rng, h, at=0.3)  # fail early: big truncation win
+        s = encode_ops(h, model.f_codes)
+        full = oracle.check_opseq(s, model)
+        if full["valid"] is not False:
+            continue
+        out = lin.search_opseq(s, model)
+        assert out["valid"] is False
+        trunc = lin.truncate_to_failure(s, out["max_depth"], out["window"])
+        if trunc is not None:
+            assert len(trunc) < len(s)
+            assert oracle.check_opseq(trunc, model)["valid"] is False
+
+
+def test_wrapper_witness_prefix():
+    from jepsen_tpu.synth import corrupt_read, register_history
+
+    model = cas_register()
+    rng = random.Random(77)
+    h = register_history(rng, n_ops=300, n_procs=6, overlap=3)
+    h = corrupt_read(rng, h, at=0.2)
+    chk = lin.linearizable(model, host_threshold=10)
+    out = chk.check({}, h)
+    ref = oracle.check_opseq(encode_ops(h, model.f_codes), model)
+    assert out["valid"] == ref["valid"]
+    if out["valid"] is False and "witness_prefix_ops" in out:
+        assert out["witness_prefix_ops"] < 300

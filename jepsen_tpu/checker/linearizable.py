@@ -33,11 +33,15 @@ handful of int32 lanes instead of n bits, so millions of configs fit in
 HBM and hash in a few vector ops.
 
 Soundness: a "valid" verdict always carries a real witness path (every
-transition was model-checked on device).  An "invalid" verdict could in
-principle be wrong if two distinct configs collide in the 64-bit
-fingerprint table (probability ~#configs²/2⁶⁴); callers that need
-certainty re-verify invalid verdicts with the exact host oracle
-(checker/seq.py), which is also how the failure witness is reconstructed.
+transition was model-checked on device).  Dedup is *exact*: candidate
+fingerprints are sorted and equal-fingerprint neighbors are compared on
+their full config words before dropping either, so distinct configs are
+never merged and an "invalid" verdict is not subject to hash collisions.
+The residual escalation ladder is about capacity, not hashing: if the
+frontier ring or the fingerprint table overflows, the search bails to the
+exact host oracle (checker/seq.py); Linearizable.check additionally
+re-runs short failing prefixes (≤ witness_threshold ops) on the host
+oracle to reconstruct a human-readable witness.
 
 Batching: `search_batch` vmaps the whole search over a leading key axis —
 the TPU analog of the reference's independent-key sharding
@@ -408,7 +412,7 @@ def build_sharded_search_fn(model: ModelSpec, dims: SearchDims, budget: int,
 
     dims.frontier is the PER-DEVICE frontier width.
     """
-    from jax.experimental.shard_map import shard_map
+    from jax import shard_map
     from jax.sharding import PartitionSpec as P
 
     W = dims.window
@@ -521,7 +525,7 @@ def build_sharded_search_fn(model: ModelSpec, dims: SearchDims, budget: int,
 
     specs = (P(),) * 13
     return shard_map(search_device, mesh=mesh, in_specs=specs,
-                     out_specs=(P(), P(), P(), P()), check_rep=False)
+                     out_specs=(P(), P(), P(), P()), check_vma=False)
 
 
 def _make_kernel_pieces(model: ModelSpec, dims: SearchDims):

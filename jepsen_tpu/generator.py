@@ -115,29 +115,39 @@ def gen_op(gen, test: dict, process) -> Optional[OpDict]:
     return gen
 
 
-_ARITY_CACHE: dict = {}
+import weakref
+
+# Keyed by weakref so entries die with the callable; an id()-keyed cache
+# can hand a new function a dead function's arity after id reuse.
+_ARITY_CACHE: "weakref.WeakKeyDictionary" = weakref.WeakKeyDictionary()
+
+
+def _compute_arity_two(f) -> bool:
+    import inspect
+
+    try:
+        sig = inspect.signature(f)
+        pos = [p for p in sig.parameters.values()
+               if p.kind in (p.POSITIONAL_ONLY, p.POSITIONAL_OR_KEYWORD)]
+        var = any(p.kind == p.VAR_POSITIONAL
+                  for p in sig.parameters.values())
+        required = [p for p in pos if p.default is p.empty]
+        return var or (len(required) <= 2 and len(pos) >= 2)
+    except (ValueError, TypeError):
+        return True
 
 
 def _arity_two(f) -> bool:
     """Can f be called with (test, process)?  (The reference dispatches on
     ArityException, generator.clj:46-52; we inspect the signature.)"""
-    key = id(f)
-    hit = _ARITY_CACHE.get(key)
-    if hit is None:
-        import inspect
-
-        try:
-            sig = inspect.signature(f)
-            pos = [p for p in sig.parameters.values()
-                   if p.kind in (p.POSITIONAL_ONLY, p.POSITIONAL_OR_KEYWORD)]
-            var = any(p.kind == p.VAR_POSITIONAL
-                      for p in sig.parameters.values())
-            required = [p for p in pos if p.default is p.empty]
-            hit = var or (len(required) <= 2 and len(pos) >= 2)
-        except (ValueError, TypeError):
-            hit = True
-        _ARITY_CACHE[key] = hit
-    return hit
+    try:
+        hit = _ARITY_CACHE.get(f)
+        if hit is None:
+            hit = _compute_arity_two(f)
+            _ARITY_CACHE[f] = hit
+        return hit
+    except TypeError:  # not weak-referenceable; compute uncached
+        return _compute_arity_two(f)
 
 
 class InvalidOp(Exception):

@@ -17,7 +17,8 @@ automation, clients, workloads, and nemeses into the core library
 
 from importlib import import_module
 
-SUITES = ["atomdemo", "etcdemo", "zookeeper", "hazelcast", "registry"]
+SUITES = ["atomdemo", "etcdemo", "zookeeper", "hazelcast", "registry",
+          "consul", "rabbitmq", "cockroach"]
 
 
 def suite(name: str):

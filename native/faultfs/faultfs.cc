@@ -103,7 +103,14 @@ int parse_errno(const std::string &name) {
   };
   for (const auto &row : table)
     if (name == row.n) return row.e;
-  return atoi(name.c_str()) > 0 ? atoi(name.c_str()) : EIO;
+  // A purely numeric value is authoritative — including "0", which means
+  // "no error" (delay-only injection, see faultfs.py slow()).  Only an
+  // unparseable symbolic name falls back to EIO.
+  char *end = nullptr;
+  long v = strtol(name.c_str(), &end, 10);
+  if (end != name.c_str() && *end == '\0' && v >= 0 && v <= 4096)
+    return (int)v;
+  return EIO;
 }
 
 std::string handle_command(const std::string &line) {

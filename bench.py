@@ -16,12 +16,25 @@ history and normalized per-configuration:
 Both engines dedup over the identical configuration space, so configs/sec
 is apples-to-apples; the history is corrupted near its end so both must
 sweep the space rather than lucky-dive (DFS on a valid history can dive
-straight to the goal, which measures luck, not throughput).
+straight to the goal, which measures luck, not throughput).  NOTE on
+methodology: the host oracle is single-threaded Python; knossos on a
+16-core JVM would be faster than it, so vs_baseline OVERSTATES the speedup
+against knossos — the absolute configs/sec figures are printed so an
+offline knossos comparison can be made.
+
+Robustness contract (VERDICT r1 item 1): this script ALWAYS emits its
+JSON line.  The TPU (axon PJRT plugin) can take many minutes of wall
+clock on first backend touch, or hang forever when the tunnel is down, so
+the backend is probed in a subprocess while the host-oracle baseline runs
+in parallel; benchmark tiers run smallest-first under a wall-clock budget;
+and SIGTERM/SIGALRM print the best completed tier before exiting.
 """
 
 import json
 import os
 import random
+import signal
+import subprocess
 import sys
 import time
 
@@ -29,88 +42,234 @@ sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
 
 QUICK = "--quick" in sys.argv
 
+T0 = time.time()
+# Total wall-clock budget for the whole script.  The driver's own timeout
+# is unknown; stay comfortably inside a 30-minute envelope by default.
+BUDGET_S = float(os.environ.get("BENCH_BUDGET_S", "300" if QUICK else "1500"))
+# Backend probe budget: axon first touch has been observed to take ~9min.
+PROBE_S = float(os.environ.get("BENCH_PROBE_S", "60" if QUICK else "680"))
 
-def ensure_live_backend(probe_timeout: int = 90) -> None:
-    """The TPU is reached through a tunnel that can be down; probing it
-    in-process hangs jax backend init forever.  Probe via a subprocess
-    with a timeout and force the CPU backend if the accelerator is
-    unreachable, so bench always produces its JSON line."""
-    import subprocess
+_BEST: dict | None = None
+_EMITTED = False
+_PROBE: "subprocess.Popen | None" = None
 
+
+def _remaining() -> float:
+    return BUDGET_S - (time.time() - T0)
+
+
+def _emit():
+    global _EMITTED
+    if _EMITTED:
+        return
+    result = _BEST or {
+        "metric": "ops-verified/sec, CAS-register history",
+        "value": None, "unit": "ops/s", "vs_baseline": None,
+        "detail": {"error": "no tier completed within budget"},
+    }
+    _EMITTED = True
+    print(json.dumps(result), flush=True)
+
+
+def _reap_probe():
+    if _PROBE is not None and _PROBE.poll() is None:
+        try:
+            _PROBE.kill()
+            _PROBE.wait(timeout=5)
+        except Exception:
+            pass
+
+
+def _bail(why: str):
+    print(f"bench: {why} after {time.time()-T0:.0f}s; emitting "
+          "best-so-far", file=sys.stderr)
+    _emit()
+    _reap_probe()
+    os._exit(0)
+
+
+def _on_signal(signum, frame):
+    _bail(f"signal {signum}")
+
+
+for _sig in (signal.SIGTERM, signal.SIGINT, signal.SIGALRM, signal.SIGHUP):
     try:
-        out = subprocess.run(
-            [sys.executable, "-c",
-             "import jax; print(jax.devices()[0].platform)"],
-            capture_output=True, text=True, timeout=probe_timeout)
-        platform = out.stdout.strip().splitlines()[-1] if out.stdout else ""
-        if out.returncode == 0 and platform:
-            return  # backend comes up fine; use it as-is
-    except subprocess.TimeoutExpired:
+        signal.signal(_sig, _on_signal)
+    except (OSError, ValueError):
         pass
-    print("accelerator unreachable; falling back to CPU", file=sys.stderr)
-    os.environ["JAX_PLATFORMS"] = "cpu"
-    import jax
 
-    jax.config.update("jax_platforms", "cpu")
+# Two layers of deadline enforcement: an alarm (covers pure-Python
+# blocking) and a watchdog thread (covers the main thread being stuck in
+# non-interruptible C code — e.g. this process's own first PJRT backend
+# touch, where Python signal handlers never get to run).
+signal.alarm(max(10, int(BUDGET_S - 5)))
+
+
+def _watchdog():
+    time.sleep(max(10, BUDGET_S - 2))
+    _bail("watchdog deadline")
+
+
+import threading  # noqa: E402
+
+threading.Thread(target=_watchdog, daemon=True).start()
+
+
+def start_probe() -> subprocess.Popen:
+    """Warm/probe the accelerator backend in a subprocess (it may block
+    for minutes; it may never return if the tunnel is down)."""
+    return subprocess.Popen(
+        [sys.executable, "-c",
+         "import jax; d=jax.devices()[0]; print('PLATFORM', d.platform);"
+         "import jax.numpy as jnp;"
+         "x=jnp.ones((128,128));(x@x).block_until_ready();print('WARM')"],
+        stdout=subprocess.PIPE, stderr=subprocess.DEVNULL, text=True)
+
+
+def finish_probe(proc: subprocess.Popen, timeout: float) -> str | None:
+    """Wait for the probe; returns the platform name or None."""
+    try:
+        out, _ = proc.communicate(timeout=max(1.0, timeout))
+    except subprocess.TimeoutExpired:
+        proc.kill()
+        try:
+            proc.wait(timeout=5)
+        except subprocess.TimeoutExpired:
+            pass
+        return None
+    if proc.returncode != 0 or not out:
+        return None
+    platform = None
+    for line in out.splitlines():
+        if line.startswith("PLATFORM "):
+            platform = line.split(None, 1)[1].strip()
+    return platform
 
 
 def main():
-    ensure_live_backend()
-    from jepsen_tpu.checker import linearizable as lin
+    global _BEST, _PROBE
+
+    probe = _PROBE = start_probe()
+
+    # --- host-side work that needs no jax: histories + oracle baseline ---
     from jepsen_tpu.checker import seq as oracle
     from jepsen_tpu.history import encode_ops
     from jepsen_tpu.models import cas_register
     from jepsen_tpu.synth import corrupt_read, register_history
 
     rng = random.Random(42)
-    n_ops = 1_000 if QUICK else 10_000
     model = cas_register()
-    h = register_history(rng, n_ops=n_ops, n_procs=32, overlap=8,
-                         crash_p=0.002, max_crashes=8, n_values=4)
-    h = corrupt_read(rng, h, at=0.98)
-    seq = encode_ops(h, model.f_codes)
 
-    # --- device search (first run compiles; second run is timed) ----------
-    budget = 2_000_000 if QUICK else 50_000_000
-    out = lin.search_opseq(seq, model, budget=budget)
-    t0 = time.perf_counter()
-    out = lin.search_opseq(seq, model, budget=budget)
-    t_dev = time.perf_counter() - t0
-    dev_rate = out["configs"] / t_dev if t_dev > 0 else float("inf")
+    tiers = [  # (name, n_ops, n_procs, device budget, oracle cap)
+        ("1k", 1_000, 32, 2_000_000, 200_000),
+    ]
+    if not QUICK:
+        tiers.append(("10k", 10_000, 32, 50_000_000, 1_000_000))
 
-    # --- host-oracle baseline (capped; throughput extrapolates) -----------
-    cap = 200_000 if QUICK else 1_000_000
+    seqs = {}
+    for name, n_ops, n_procs, _, _ in tiers:
+        h = register_history(rng, n_ops=n_ops, n_procs=n_procs, overlap=8,
+                             crash_p=0.002, max_crashes=8, n_values=4)
+        h = corrupt_read(rng, h, at=0.98)
+        seqs[name] = encode_ops(h, model.f_codes)
+
+    # Oracle baseline on the largest tier's history (runs while the
+    # backend probe warms the tunnel in the subprocess).
+    big = tiers[-1][0]
+    cap = tiers[-1][4]
     t0 = time.perf_counter()
-    ref = oracle.check_opseq(seq, model, max_configs=cap)
+    ref = oracle.check_opseq(seqs[big], model, max_configs=cap)
     t_ref = time.perf_counter() - t0
     ref_rate = ref["configs"] / t_ref if t_ref > 0 else float("inf")
+    print(f"bench: oracle {ref['configs']} configs in {t_ref:.1f}s "
+          f"({ref_rate:,.0f}/s)", file=sys.stderr)
 
-    ops_per_sec = len(seq) / t_dev if t_dev > 0 else float("inf")
-    result = {
-        "metric": "ops-verified/sec, 10k-op 32-proc CAS-register history "
-                  "(invalid tail; full state-space sweep)",
-        "value": round(ops_per_sec, 1),
-        "unit": "ops/s",
-        "vs_baseline": round(dev_rate / ref_rate, 2) if ref_rate else None,
-        "detail": {
-            "n_ops": len(seq),
-            "device_seconds": round(t_dev, 3),
-            "device_configs": out["configs"],
-            "device_verdict": out["valid"],
-            "device_configs_per_sec": round(dev_rate, 1),
-            "oracle_seconds": round(t_ref, 3),
-            "oracle_configs": ref["configs"],
-            "oracle_verdict": ref["valid"],
-            "oracle_configs_per_sec": round(ref_rate, 1),
-            "window": out.get("window"),
-            "concurrency": out.get("concurrency"),
-            "backend": None,
-        },
-    }
+    # --- bring up the backend ------------------------------------------
+    platform = finish_probe(probe, min(PROBE_S, _remaining() - 60))
+    if platform is None:
+        print("bench: accelerator unreachable within probe budget; "
+              "forcing CPU backend", file=sys.stderr)
+        os.environ["JAX_PLATFORMS"] = "cpu"
+        import jax
+        jax.config.update("jax_platforms", "cpu")
+        platform = "cpu"
+    else:
+        print(f"bench: backend '{platform}' is up "
+              f"({time.time()-T0:.0f}s in)", file=sys.stderr)
     import jax
-    result["detail"]["backend"] = jax.devices()[0].platform
-    print(json.dumps(result))
+
+    from jepsen_tpu.checker import linearizable as lin
+
+    # --- tiered device ladder: smallest first, best completed wins ------
+    measured_rate = None
+    for name, n_ops, n_procs, budget, _ in tiers:
+        seq = seqs[name]
+        # compile + measure in one run first (counts against budget),
+        # then re-run timed if time allows.
+        if _remaining() < 30:
+            print(f"bench: skipping tier {name} (out of budget)",
+                  file=sys.stderr)
+            break
+        if measured_rate:
+            est = budget / measured_rate + 60  # + compile slack
+            if est > _remaining():
+                print(f"bench: skipping tier {name} (est {est:.0f}s > "
+                      f"{_remaining():.0f}s left at "
+                      f"{measured_rate:,.0f} configs/s)", file=sys.stderr)
+                break
+        t0 = time.perf_counter()
+        out = lin.search_opseq(seq, model, budget=budget)
+        t_first = time.perf_counter() - t0
+        t_dev = t_first  # compile-inclusive, as a floor
+        if _remaining() > t_first * 1.3 + 20:
+            t0 = time.perf_counter()
+            out = lin.search_opseq(seq, model, budget=budget)
+            t_dev = time.perf_counter() - t0
+        dev_rate = out["configs"] / t_dev if t_dev > 0 else float("inf")
+        measured_rate = dev_rate
+        ops_per_sec = len(seq) / t_dev if t_dev > 0 else float("inf")
+        print(f"bench: tier {name}: {out['configs']} configs in "
+              f"{t_dev:.2f}s ({dev_rate:,.0f}/s), verdict={out['valid']}",
+              file=sys.stderr)
+        _BEST = {
+            "metric": f"ops-verified/sec, {name}-op {n_procs}-proc "
+                      "CAS-register history (invalid tail; full "
+                      "state-space sweep)",
+            "value": round(ops_per_sec, 1),
+            "unit": "ops/s",
+            "vs_baseline": round(dev_rate / ref_rate, 2) if ref_rate
+            else None,
+            "detail": {
+                "n_ops": len(seq),
+                "backend": platform,
+                "device_seconds": round(t_dev, 3),
+                "device_seconds_incl_compile": round(t_first, 3),
+                "device_configs": out["configs"],
+                "device_verdict": out["valid"],
+                "device_configs_per_sec": round(dev_rate, 1),
+                "oracle_history": big,
+                "oracle_seconds": round(t_ref, 3),
+                "oracle_configs": ref["configs"],
+                "oracle_verdict": ref["valid"],
+                "oracle_configs_per_sec": round(ref_rate, 1),
+                "window": out.get("window"),
+                "concurrency": out.get("concurrency"),
+                "engine": out.get("engine"),
+                "baseline_note": "oracle is this repo's single-threaded "
+                                 "exact WGL host checker, not knossos on "
+                                 "16 cores; vs_baseline overstates the "
+                                 "speedup vs knossos — compare absolute "
+                                 "configs/sec offline",
+            },
+        }
+
+    _emit()
 
 
 if __name__ == "__main__":
-    main()
+    try:
+        main()
+    except Exception as e:  # noqa: BLE001 — always emit the JSON line
+        print(f"bench: fatal {e!r}", file=sys.stderr)
+        _emit()
+        raise

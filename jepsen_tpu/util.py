@@ -302,3 +302,25 @@ def random_nonempty_subset(coll):
     coll = list(coll)
     n = _r.randint(1, len(coll))
     return _r.sample(coll, n)
+
+
+def force_cpu_platform(n_devices: int = 8) -> None:
+    """Pin JAX to the host CPU platform with `n_devices` virtual devices.
+
+    Must run BEFORE the first backend touch in this process (jax backends
+    initialize once; env vars and `jax_platforms` are read at init — see
+    tests/conftest.py).  The image's TPU PJRT plugin can block for minutes
+    on first touch, so every CPU-only entry point (tests, multichip
+    dryrun, bench fallback) pins through this one helper.
+    """
+    import os
+
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in flags:
+        os.environ["XLA_FLAGS"] = (
+            flags + f" --xla_force_host_platform_device_count="
+                    f"{n_devices}").strip()
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")

@@ -18,7 +18,7 @@ automation, clients, workloads, and nemeses into the core library
 from importlib import import_module
 
 SUITES = ["atomdemo", "etcdemo", "zookeeper", "hazelcast", "registry",
-          "consul", "rabbitmq", "cockroach"]
+          "consul", "rabbitmq", "cockroach", "galera", "elasticsearch"]
 
 
 def suite(name: str):

@@ -1,0 +1,175 @@
+"""Dirty-read checker family (checker/dirty.py) + galera/elasticsearch
+suite wiring tests (dummy-remote command shapes)."""
+
+from jepsen_tpu.checker import dirty
+from jepsen_tpu.history import fail_op, info_op, invoke_op, ok_op
+
+from test_suites import dummy_test
+
+
+# --- galera-flavor dirty_reads --------------------------------------------
+
+
+def test_dirty_reads_clean():
+    h = [invoke_op(0, "write", 1), ok_op(0, "write", 1),
+         invoke_op(1, "read"), ok_op(1, "read", [1, 1, 1]),
+         invoke_op(0, "write", 2), fail_op(0, "write", 2),
+         invoke_op(1, "read"), ok_op(1, "read", [1, 1, 1])]
+    out = dirty.dirty_reads().check({}, h)
+    assert out["valid"] is True
+    assert out["dirty_reads"] == []
+    assert out["inconsistent_reads"] == []
+
+
+def test_dirty_reads_catches_failed_write_visible():
+    h = [invoke_op(0, "write", 7), fail_op(0, "write", 7),
+         invoke_op(1, "read"), ok_op(1, "read", [7, 7, 7])]
+    out = dirty.dirty_reads().check({}, h)
+    assert out["valid"] is False
+    assert out["dirty_reads"] == [[7, 7, 7]]
+
+
+def test_dirty_reads_inconsistent_but_not_dirty():
+    h = [invoke_op(0, "write", 1), ok_op(0, "write", 1),
+         invoke_op(0, "write", 2), ok_op(0, "write", 2),
+         invoke_op(1, "read"), ok_op(1, "read", [1, 2, 2])]
+    out = dirty.dirty_reads().check({}, h)
+    assert out["valid"] is True  # non-atomic, but no failed txn seen
+    assert out["inconsistent_reads"] == [[1, 2, 2]]
+
+
+# --- elasticsearch-flavor strong_dirty_read -------------------------------
+
+
+def _es_history(strong_sets, reads_ok=(), writes_ok=()):
+    h = []
+    for v in writes_ok:
+        h += [invoke_op(0, "write", v), ok_op(0, "write", v)]
+    for v in reads_ok:
+        h += [invoke_op(1, "read", v), ok_op(1, "read", v)]
+    for i, s in enumerate(strong_sets):
+        h += [invoke_op(i, "strong-read"),
+              ok_op(i, "strong-read", sorted(s))]
+    return h
+
+
+def test_strong_dirty_read_clean():
+    h = _es_history([{1, 2}, {1, 2}], reads_ok=[1], writes_ok=[1, 2])
+    out = dirty.strong_dirty_read().check({}, h)
+    assert out["valid"] is True
+    assert out["nodes_agree"] is True
+
+
+def test_strong_dirty_read_detects_dirty():
+    # read 9 succeeded but 9 is absent from every strong read
+    h = _es_history([{1}, {1}], reads_ok=[9], writes_ok=[1])
+    out = dirty.strong_dirty_read().check({}, h)
+    assert out["valid"] is False
+    assert out["dirty"] == [9]
+
+
+def test_strong_dirty_read_detects_lost():
+    h = _es_history([{1}, {1}], writes_ok=[1, 5])
+    out = dirty.strong_dirty_read().check({}, h)
+    assert out["valid"] is False
+    assert out["lost"] == [5]
+
+
+def test_strong_dirty_read_divergence():
+    h = _es_history([{1, 2}, {1}], writes_ok=[1])
+    out = dirty.strong_dirty_read().check({}, h)
+    assert out["valid"] is False
+    assert out["nodes_agree"] is False
+    assert out["not_on_all"] == [2]
+
+
+def test_strong_dirty_read_no_strong_reads():
+    out = dirty.strong_dirty_read().check({}, [])
+    assert out["valid"] == "unknown"
+
+
+# --- galera suite ---------------------------------------------------------
+
+
+def test_galera_db_commands():
+    from jepsen_tpu.suites import galera
+    from jepsen_tpu.util import AbortableBarrier
+
+    test, r = dummy_test(nodes=("n1",), responses={
+        "stat /": (1, "", "no")})
+    test["barrier"] = AbortableBarrier(1)
+    import time as time_mod
+
+    orig = time_mod.sleep
+    time_mod.sleep = lambda s: None
+    try:
+        galera.db().setup(test, "n1")
+    finally:
+        time_mod.sleep = orig
+    cmds = [e[2] for e in r.log if e[1] == "exec"]
+    assert any("debconf-set-selections" in c for c in cmds)
+    assert any("wsrep_cluster_address=gcomm://n1" in c for c in cmds)
+    assert any("service mysql start --wsrep-new-cluster" in c
+               for c in cmds)
+    assert any("GRANT ALL PRIVILEGES" in c for c in cmds)
+
+
+def test_galera_dirty_reads_test_map():
+    from jepsen_tpu.suites import galera
+
+    t = galera.galera_test({"workload": "dirty-reads",
+                            "nodes": ["n1", "n2", "n3"]})
+    assert isinstance(t["client"], galera.DirtyReadsClient)
+    g = galera.dirty_reads_generator()
+    from jepsen_tpu import generator as gen
+
+    ops = [gen.gen_op(g, t, 0) for _ in range(20)]
+    writes = [o["value"] for o in ops if o["f"] == "write"]
+    assert writes == sorted(writes)  # unique ascending write values
+    assert len(set(writes)) == len(writes)
+
+
+# --- elasticsearch suite --------------------------------------------------
+
+
+def test_es_config_and_db_commands():
+    from jepsen_tpu.suites import elasticsearch as es
+
+    test, r = dummy_test(responses={"stat /": (1, "", "no"),
+                                    "ls -A": (0, "elasticsearch-5.0.0\n", ""),
+                                    "dirname": (0, "/opt", ""),
+                                    "id -u": (1, "", "no such user")})
+    yml = es.config_yml(test, "n2")
+    assert "minimum_master_nodes: 2" in yml
+    assert '"n1", "n2", "n3"' in yml
+
+    db = es.db()
+    db.wait_healthy = lambda *a, **kw: None
+    db.setup(test, "n1")
+    cmds = [e[2] for e in r.log if e[0] == "n1" and e[1] == "exec"]
+    assert any("vm.max_map_count=262144" in c for c in cmds)
+    assert any("start-stop-daemon --start" in c and "elasticsearch" in c
+               for c in cmds)
+
+
+def test_es_rw_gen():
+    from jepsen_tpu import generator as gen
+    from jepsen_tpu.suites import elasticsearch as es
+
+    g = es.RWGen(writers=1)
+    test = {"nodes": ["n1", "n2"], "concurrency": 4}
+    with gen.with_threads([0, 1, 2, 3]):
+        w = g.op(test, 0)
+        assert w == {"type": "invoke", "f": "write", "value": 0}
+        r = g.op(test, 2)  # reader; node index 2 % 2 = 0 (writer's node)
+        assert r["f"] == "read" and r["value"] == 0
+
+
+def test_es_dirty_read_test_map():
+    from jepsen_tpu.suites import elasticsearch as es
+
+    t = es.es_test({"workload": "dirty-read",
+                    "nodes": ["n1", "n2", "n3"], "concurrency": 6,
+                    "time_limit": 1})
+    assert isinstance(t["client"], es.DirtyReadClient)
+    assert t["checker"] is not None

@@ -290,3 +290,129 @@ def test_cockroach_db_commands():
     cmds = [e[2] for e in r.log if e[0] == "n1" and e[1] == "exec"]
     assert any("--startas /opt/cockroach/cockroach -- start --insecure" in c
                and "--join=n1,n2,n3" in c for c in cmds)
+
+
+def test_cockroach_no_noop_clients():
+    """VERDICT r1 item 4: every registered workload must construct a
+    runnable test with a real client (no client_mod.noop stubs)."""
+    from jepsen_tpu import client as client_mod
+    from jepsen_tpu.suites import cockroach
+
+    for name in cockroach.REGISTRY.workloads:
+        t = cockroach.REGISTRY.build_test(
+            {"workload": name, "nemesis": "none",
+             "nodes": ["n1", "n2", "n3"], "concurrency": 4,
+             "time_limit": 1})
+        assert t["client"] is not client_mod.noop, name
+        assert t["generator"] is not None, name
+
+
+def test_cockroach_nemesis_menu():
+    from jepsen_tpu.suites import cockroach
+
+    want = {"skews", "strobe-skews", "small-skews", "subcritical-skews",
+            "critical-skews", "big-skews", "huge-skews", "startstop",
+            "startstop2", "startkill", "startkill2", "parts", "majring",
+            "split"}
+    assert want <= set(cockroach.REGISTRY.nemeses)
+
+
+def test_cockroach_monotonic_generator_and_final_read():
+    from jepsen_tpu.suites import cockroach
+
+    w = cockroach.monotonic_workload({"concurrency": 4})
+    t = {"nodes": ["n1"]}
+    op = gen.gen_op(w["generator"], t, 0)
+    assert op["f"] == "add" and op["value"] is None
+    fin = gen.gen_op(w["final_generator"], t, 0)
+    assert fin["f"] == "read"
+
+
+def test_cockroach_sequential_generator():
+    from jepsen_tpu.suites import cockroach
+
+    w = cockroach.sequential_workload({"concurrency": 4})
+    test = {"nodes": ["n1", "n2"], "concurrency": 4}
+    with gen.with_threads([0, 1, 2, 3]):
+        # thread 0/1 are writers (n=2), 2+ read
+        ops = [gen.gen_op(w["generator"], test, p) for p in (0, 1, 0, 1)]
+    assert all(o["f"] == "write" for o in ops)
+    assert [o["value"] for o in ops] == [0, 1, 2, 3]
+    with gen.with_threads([0, 1, 2, 3]):
+        r = gen.gen_op(w["generator"], test, 3)
+    assert r["f"] == "read" and r["value"] in (0, 1, 2, 3)
+
+
+def test_cockroach_sequential_client_tables():
+    from jepsen_tpu.suites import cockroach
+
+    c = cockroach.SequentialClient()
+    sks = c._subkeys(3, 7)
+    assert sks == ["7_0", "7_1", "7_2"]
+    # stable hashing across processes (not Python's randomized hash)
+    assert c._table_for("7_0") == c._table_for("7_0")
+    assert all(c._table_for(s).startswith("seq_") for s in sks)
+
+
+def test_cockroach_kill_start_node_commands():
+    from jepsen_tpu.suites import cockroach
+
+    test, r = dummy_test()
+    cockroach.kill_node(test, "n2")
+    cmds = [e[2] for e in r.log if e[0] == "n2" and e[1] == "exec"]
+    assert any("kill" in c and "-9" in c and "cockroach" in c
+               for c in cmds)
+    cockroach.start_node(test, "n2")
+    cmds = [e[2] for e in r.log if e[0] == "n2" and e[1] == "exec"]
+    assert any("start-stop-daemon --start" in c and "--join=n1,n2,n3" in c
+               for c in cmds)
+
+
+def test_cockroach_split_nemesis_no_keyrange():
+    from dataclasses import dataclass as dc
+
+    from jepsen_tpu.suites import cockroach
+
+    @dc
+    class Op:
+        f: str
+        type: str = "invoke"
+        value: object = None
+        process: object = "nemesis"
+
+    nem = cockroach.SplitNemesis()
+    test, _ = dummy_test()
+    out = nem.invoke(test, Op(f="split"))
+    assert out.type == "info" and out.value == "nothing-to-split"
+    cockroach.update_keyrange(test, "seq_0", "3_1")
+    assert test["keyrange"] == {"seq_0": {"3_1"}}
+
+
+def test_cockroach_bump_time_targeting():
+    """BumpTimeNemesis start bumps each node w/ p=0.5; stop resets +
+    restarts (nemesis.clj:232-255 semantics)."""
+    from dataclasses import dataclass as dc
+
+    import random as random_mod
+
+    from jepsen_tpu.suites import cockroach
+
+    @dc
+    class Op:
+        f: str
+        type: str = "invoke"
+        value: object = None
+        process: object = "nemesis"
+
+    test, r = dummy_test(responses={"stat /": (0, "yes", "")})
+    nem = cockroach.BumpTimeNemesis(0.25)
+    random_mod.seed(1)
+    out = nem.invoke(test, Op(f="start"))
+    assert out.type == "info"
+    assert set(out.value) == {"n1", "n2", "n3"}
+    assert all(v in (0, 0.25) for v in out.value.values())
+    out = nem.invoke(test, Op(f="stop"))
+    assert out.type == "info"
+    cmds = [e[2] for e in r.log if e[1] == "exec"]
+    assert any("ntpdate" in c for c in cmds)
+    assert any("start-stop-daemon --start" in c for c in cmds)

@@ -1022,6 +1022,8 @@ class Linearizable:
         if len(seq) <= self.host_threshold:
             out = seqmod.check_opseq(seq, model)
             out["engine"] = "host-oracle"
+            if out["valid"] is False:
+                self._render_failure(test, seq, out, opts)
             return out
 
         out = search_opseq(seq, model, budget=self.budget)
@@ -1039,10 +1041,22 @@ class Linearizable:
                     confirm["engine"] = out["engine"] + "+host-witness"
                     confirm["device_configs"] = out["configs"]
                     confirm["witness_prefix_ops"] = len(target)
+                    self._render_failure(test, target, confirm, opts)
                     return confirm
                 # prefix came back valid: fall through to the full
                 # device verdict (obstruction lies past the cut)
         return out
+
+    @staticmethod
+    def _render_failure(test, seq, result, opts):
+        """linear.html — the knossos linear.svg analog
+        (checker.clj:128-135); reporting never affects the verdict."""
+        from . import linear_report
+
+        path = linear_report.write_linear_html(test or {}, seq, result,
+                                               opts)
+        if path is not None:
+            result["report_file"] = path
 
     def __call__(self, test, history, opts=None):
         return self.check(test, history, opts)

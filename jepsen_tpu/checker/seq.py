@@ -71,6 +71,7 @@ def check_opseq(seq: OpSeq, model: ModelSpec, *,
     configs = 0
     max_depth = -1
     best_frontier: list[int] = []
+    best_keys: list[tuple] = []
 
     # DFS stack entries: (mask, state); parent_of records (op, parent_key)
     # so the linearization is rebuilt by walking parents on success.
@@ -130,6 +131,9 @@ def check_opseq(seq: OpSeq, model: ModelSpec, *,
         if depth > max_depth:
             max_depth = depth
             best_frontier = list(cand)
+            best_keys = [key]
+        elif depth == max_depth and len(best_keys) < 10:
+            best_keys.append(key)  # checker.clj:136-139 keeps 10 configs
 
         # min-excluding-self via (min, second-min)
         if rets:
@@ -155,5 +159,21 @@ def check_opseq(seq: OpSeq, model: ModelSpec, *,
                     parent_of[nk] = (j2, key)
                 stack.append(nk)
 
+    # reconstruct up to 10 deepest partial linearizations — the analog of
+    # knossos's :final-paths, truncated exactly as checker.clj:136-139
+    # ("writing these can take *hours*") truncates for the report
+    final_paths = []
+    for bkey in best_keys[:10]:
+        lin = []
+        k: Optional[tuple[int, tuple]] = bkey
+        while k is not None:
+            p = parent_of.get(k)
+            if p is None:
+                break
+            op, pk = p
+            lin.append(op)
+            k = pk
+        lin.reverse()
+        final_paths.append({"linearized": lin, "state": bkey[1]})
     return {"valid": False, "configs": configs, "max_depth": max_depth,
-            "final_ops": best_frontier}
+            "final_ops": best_frontier, "final_paths": final_paths}

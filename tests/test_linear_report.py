@@ -1,0 +1,58 @@
+"""Failure-analysis rendering (checker/linear_report.py) — the
+knossos linear.svg analog (checker.clj:128-139)."""
+
+import os
+
+from jepsen_tpu.checker import linear_report, seq as oracle
+from jepsen_tpu.checker.linearizable import Linearizable
+from jepsen_tpu.history import encode_ops, invoke_op, ok_op
+from jepsen_tpu.models import cas_register
+
+
+def _invalid_history():
+    # read 3 can never be right: only 1 was ever written
+    h = [invoke_op(0, "write", 1), ok_op(0, "write", 1),
+         invoke_op(1, "read", None), ok_op(1, "read", 3),
+         invoke_op(0, "read", None), ok_op(0, "read", 1)]
+    return encode_ops(h, cas_register().f_codes)
+
+
+def test_oracle_returns_final_paths():
+    s = _invalid_history()
+    out = oracle.check_opseq(s, cas_register())
+    assert out["valid"] is False
+    assert out["final_paths"]
+    assert len(out["final_paths"]) <= 10
+    for p in out["final_paths"]:
+        assert len(p["linearized"]) == out["max_depth"]
+
+
+def test_render_linear_html_contains_svg_and_paths():
+    s = _invalid_history()
+    out = oracle.check_opseq(s, cas_register())
+    doc = linear_report.render_linear_html(s, out)
+    assert "<svg" in doc
+    assert "could not be linearized" in doc
+    assert "read" in doc
+
+
+def test_checker_writes_linear_html(tmp_path):
+    s = _invalid_history()
+    test = {"name": "report-test", "store_base": str(tmp_path)}
+    out = Linearizable(cas_register()).check(test, s)
+    assert out["valid"] is False
+    assert "report_file" in out
+    assert os.path.exists(out["report_file"])
+    assert out["report_file"].endswith("linear.html")
+    with open(out["report_file"]) as f:
+        assert "<svg" in f.read()
+
+
+def test_valid_history_writes_nothing(tmp_path):
+    h = [invoke_op(0, "write", 1), ok_op(0, "write", 1),
+         invoke_op(1, "read", None), ok_op(1, "read", 1)]
+    s = encode_ops(h, cas_register().f_codes)
+    test = {"name": "report-test-valid", "store_base": str(tmp_path)}
+    out = Linearizable(cas_register()).check(test, s)
+    assert out["valid"] is True
+    assert "report_file" not in out

@@ -25,7 +25,7 @@ from __future__ import annotations
 import html as html_mod
 
 from .. import store
-from ..history import OpSeq
+from ..history import INF_RET, OpSeq
 
 LANE_H = 22
 BAR_H = 14
@@ -55,7 +55,7 @@ def _svg(seq: OpSeq, result: dict) -> str:
     paths = result.get("final_paths") or []
     prefix = set(paths[0]["linearized"]) if paths else set()
     frontier = set(result.get("final_ops") or [])
-    max_rank = max([r for r in ret if r < 2**31 - 1] + inv + [1])
+    max_rank = max([r for r in ret if r < INF_RET] + inv + [1])
 
     width = LEFT + (max_rank + 2) * PX_PER_RANK + 40
     height = (len(procs) + 1) * LANE_H + 30
@@ -144,8 +144,13 @@ def write_linear_html(test: dict, seq: OpSeq, result: dict,
     writes linear.svg the same way).  Never raises — reporting must not
     change a verdict."""
     try:
+        # independent-key checks run concurrently with only
+        # {"history_key": k} in opts — suffix the filename so per-key
+        # reports don't clobber each other
+        key = (opts or {}).get("history_key")
+        fname = "linear.html" if key is None else f"linear-{key}.html"
         p = store.path_mkdirs(test, *(opts or {}).get("subdirectory", []),
-                              "linear.html")
+                              fname)
         with open(p, "w") as fh:
             fh.write(render_linear_html(seq, result))
         return str(p)

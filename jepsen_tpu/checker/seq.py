@@ -40,6 +40,21 @@ from ..history import INF_RET, OpSeq
 from ..models import ModelSpec
 
 
+def _walk_parents(parent_of: dict, key) -> list[int]:
+    """Rebuild a linearization (op rows, in order) by walking parents."""
+    lin: list[int] = []
+    k = key
+    while k is not None:
+        p = parent_of.get(k)
+        if p is None:
+            break
+        op, pk = p
+        lin.append(op)
+        k = pk
+    lin.reverse()
+    return lin
+
+
 def check_opseq(seq: OpSeq, model: ModelSpec, *,
                 max_configs: int = 5_000_000) -> dict:
     """Run the DFS over a columnar OpSeq.  Returns a knossos-style map:
@@ -92,17 +107,7 @@ def check_opseq(seq: OpSeq, model: ModelSpec, *,
                     "info": f"exceeded max_configs={max_configs}"}
 
         if (mask & ok_mask) == ok_mask:
-            # reconstruct linearization by following parents
-            lin = []
-            k: Optional[tuple[int, tuple]] = key
-            while k is not None:
-                p = parent_of[k]
-                if p is None:
-                    break
-                op, pk = p
-                lin.append(op)
-                k = pk
-            lin.reverse()
+            lin = _walk_parents(parent_of, key)
             return {"valid": True, "configs": configs,
                     "linearization": lin,
                     "max_depth": len(lin)}
@@ -162,18 +167,8 @@ def check_opseq(seq: OpSeq, model: ModelSpec, *,
     # reconstruct up to 10 deepest partial linearizations — the analog of
     # knossos's :final-paths, truncated exactly as checker.clj:136-139
     # ("writing these can take *hours*") truncates for the report
-    final_paths = []
-    for bkey in best_keys[:10]:
-        lin = []
-        k: Optional[tuple[int, tuple]] = bkey
-        while k is not None:
-            p = parent_of.get(k)
-            if p is None:
-                break
-            op, pk = p
-            lin.append(op)
-            k = pk
-        lin.reverse()
-        final_paths.append({"linearized": lin, "state": bkey[1]})
+    final_paths = [{"linearized": _walk_parents(parent_of, bkey),
+                    "state": bkey[1]}
+                   for bkey in best_keys[:10]]
     return {"valid": False, "configs": configs, "max_depth": max_depth,
             "final_ops": best_frontier, "final_paths": final_paths}

@@ -25,6 +25,7 @@ from __future__ import annotations
 
 import logging
 import threading
+import time
 import traceback
 from dataclasses import replace
 from typing import Optional
@@ -428,6 +429,10 @@ def run(test: dict) -> dict:
                     threads = list(range(test["concurrency"])) + ["nemesis"]
                     with gen.with_threads(threads):
                         with relative_time():
+                            # wall-clock anchor of op :time = 0, for
+                            # checkers that reason about absolute time
+                            # (e.g. the chronos schedule checker)
+                            test["start_wall_time"] = time.time()
                             test["history"] = run_case(test)
                     log.info("Run complete, writing")
                     if test.get("name"):

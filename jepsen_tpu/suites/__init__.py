@@ -18,7 +18,8 @@ automation, clients, workloads, and nemeses into the core library
 from importlib import import_module
 
 SUITES = ["atomdemo", "etcdemo", "zookeeper", "hazelcast", "registry",
-          "consul", "rabbitmq", "cockroach", "galera", "elasticsearch"]
+          "consul", "rabbitmq", "cockroach", "galera", "elasticsearch",
+          "mongodb", "disque", "chronos"]
 
 
 def suite(name: str):

@@ -1,0 +1,94 @@
+"""Chronos schedule checker (checker/schedule.py) — constraint
+satisfaction of repeating job targets by observed runs."""
+
+from jepsen_tpu.checker import schedule
+from jepsen_tpu.history import invoke_op, ok_op
+
+
+def test_job_targets_cutoff():
+    job = {"name": "1", "start": 100.0, "interval": 60, "count": 5,
+           "epsilon": 10, "duration": 5}
+    # read at t=250: targets at 100, 160, 220 must have begun
+    # (cutoff = 250 - 10 - 5 = 235; 280 > 235 excluded)
+    ts = schedule.job_targets(250.0, job)
+    assert [t0 for t0, _ in ts] == [100.0, 160.0, 220.0]
+    assert ts[0][1] == 100.0 + 10 + schedule.EPSILON_FORGIVENESS
+
+
+def test_job_targets_respects_count():
+    job = {"name": "1", "start": 0.0, "interval": 10, "count": 2,
+           "epsilon": 1, "duration": 0}
+    assert len(schedule.job_targets(1e9, job)) == 2
+
+
+def _run(name, start, end="auto"):
+    return {"name": name, "start": start,
+            "end": start + 1 if end == "auto" else end}
+
+
+def test_job_solution_satisfied():
+    job = {"name": "1", "start": 100.0, "interval": 60, "count": 3,
+           "epsilon": 10, "duration": 5}
+    runs = [_run("1", 101), _run("1", 165), _run("1", 228)]
+    s = schedule.job_solution(400.0, job, runs)
+    assert s["valid"] is True
+    assert all(r is not None for _, r in s["solution"])
+    assert s["extra"] == []
+
+
+def test_job_solution_missing_run():
+    job = {"name": "1", "start": 100.0, "interval": 60, "count": 3,
+           "epsilon": 10, "duration": 5}
+    runs = [_run("1", 101), _run("1", 228)]  # 160-target missed
+    s = schedule.job_solution(400.0, job, runs)
+    assert s["valid"] is False
+    missed = [t for t, r in s["solution"] if r is None]
+    assert missed == [(160.0, 175.0)]
+
+
+def test_job_solution_incomplete_runs_dont_count():
+    job = {"name": "1", "start": 100.0, "interval": 60, "count": 1,
+           "epsilon": 10, "duration": 5}
+    runs = [_run("1", 101, end=None)]  # began but never finished
+    s = schedule.job_solution(400.0, job, runs)
+    assert s["valid"] is False
+    assert s["incomplete"] and not s["complete"]
+
+
+def test_job_solution_duplicate_runs_are_extra():
+    job = {"name": "1", "start": 100.0, "interval": 60, "count": 1,
+           "epsilon": 10, "duration": 5}
+    runs = [_run("1", 101), _run("1", 103)]
+    s = schedule.job_solution(400.0, job, runs)
+    assert s["valid"] is True
+    assert len(s["extra"]) == 1
+
+
+def test_solution_multi_job():
+    jobs = [{"name": "1", "start": 100.0, "interval": 60, "count": 1,
+             "epsilon": 10, "duration": 5},
+            {"name": "2", "start": 100.0, "interval": 60, "count": 1,
+             "epsilon": 10, "duration": 5}]
+    runs = [_run("1", 101)]  # job 2 never ran
+    out = schedule.solution(400.0, jobs, runs)
+    assert out["valid"] is False
+    assert out["jobs"]["1"]["valid"] is True
+    assert out["jobs"]["2"]["valid"] is False
+
+
+def test_schedule_checker_over_history(tmp_path):
+    job = {"name": "1", "start": 100.0, "interval": 60, "count": 1,
+           "epsilon": 10, "duration": 5}
+    runs = [_run("1", 101)]
+    h = [invoke_op(0, "add-job", job), ok_op(0, "add-job", job),
+         invoke_op(0, "read", None, time=int(400e9)),
+         ok_op(0, "read", runs, time=int(400e9))]
+    test = {"name": "chronos-test", "start_wall_time": 0,
+            "store_base": str(tmp_path)}
+    out = schedule.schedule_checker().check(test, h)
+    assert out["valid"] is True
+
+
+def test_schedule_checker_no_read():
+    out = schedule.schedule_checker(plot=False).check({}, [])
+    assert out["valid"] == "unknown"

@@ -1,0 +1,237 @@
+"""Second suite tranche: mongodb (replica sets + write-concern matrix),
+disque (RESP client + cluster meet), chronos (mesos + schedule)."""
+
+import json
+import socket
+import threading
+
+from jepsen_tpu.util import AbortableBarrier
+
+from test_suites import dummy_test
+
+
+# --- mongodb --------------------------------------------------------------
+
+
+def _rs_status_ok(nodes):
+    return json.dumps({"members": [
+        {"name": f"{n}:27017", "stateStr":
+         "PRIMARY" if i == 0 else "SECONDARY"}
+        for i, n in enumerate(nodes)]})
+
+
+def test_mongo_replica_set_config():
+    from jepsen_tpu.suites import mongodb
+
+    cfg = mongodb.target_replica_set_config(
+        {"nodes": ["n1", "n2", "n3"]})
+    assert cfg["_id"] == "jepsen"
+    assert cfg["members"][2] == {"_id": 2, "host": "n3:27017"}
+
+
+def test_mongo_db_setup_commands():
+    from jepsen_tpu.suites import mongodb
+
+    nodes = ["n1", "n2", "n3"]
+    test, r = dummy_test(responses={
+        "rs.status()": (0, _rs_status_ok(nodes), ""),
+        "pkgin list": (0, "", "")})
+    test["barrier"] = "no-barrier"
+    mongodb.db("3.0.4").setup(test, "n1")
+    cmds = [e[2] for e in r.log if e[0] == "n1" and e[1] == "exec"]
+    assert any("pkgin -y install mongodb-3.0.4" in c for c in cmds)
+    assert any("replSetName: jepsen" in c for c in cmds)
+    assert any("svcadm enable -r mongodb" in c for c in cmds)
+    assert any("rs.initiate" in c for c in cmds)  # n1 is jepsen primary
+
+
+def test_mongo_await_join_parses_members():
+    from jepsen_tpu.suites import mongodb
+    from jepsen_tpu.control import DummyRemote, Session
+
+    r = DummyRemote({"rs.status()": (0, _rs_status_ok(["n1", "n2"]), "")})
+    sess = Session(node="n1", remote=r)
+    mongodb.await_join({"nodes": ["n1", "n2"]}, sess, timeout_s=2)
+    mongodb.await_primary(sess, timeout_s=2)
+
+
+def test_mongo_workloads_and_write_concern_matrix():
+    from jepsen_tpu.suites import mongodb
+
+    for wc in mongodb.WRITE_CONCERNS:
+        t = mongodb.doc_cas_test({"write_concern": wc,
+                                  "nodes": ["n1"], "time_limit": 1})
+        assert wc in t["name"]
+        assert isinstance(t["client"], mongodb.DocumentCASClient)
+    t = mongodb.doc_cas_test({"no_reads": True, "nodes": ["n1"]})
+    assert "no-read" in t["name"]
+    t = mongodb.transfer_test({"nodes": ["n1"]})
+    assert isinstance(t["client"], mongodb.TransferClient)
+
+
+# --- disque ---------------------------------------------------------------
+
+
+def test_disque_db_commands():
+    from jepsen_tpu.suites import disque
+
+    test, r = dummy_test(responses={
+        "stat /opt/disque": (1, "", "no"),
+        "getent ahosts n1": (0, "10.0.0.1 STREAM n1\n", ""),
+        "cluster meet": (0, "OK", "")})
+    test["barrier"] = "no-barrier"
+    disque.db("abc123").setup(test, "n2")
+    cmds = [e[2] for e in r.log if e[0] == "n2" and e[1] == "exec"]
+    assert any("git clone" in c for c in cmds)
+    assert any("git reset --hard abc123" in c for c in cmds)
+    assert any("start-stop-daemon --start" in c and "disque-server" in c
+               for c in cmds)
+    assert any("cluster meet 10.0.0.1 7711" in c for c in cmds)
+
+
+class FakeDisque(threading.Thread):
+    """Tiny RESP server: ADDJOB queues, GETJOB pops, ACKJOB acks."""
+
+    def __init__(self):
+        super().__init__(daemon=True)
+        self.server = socket.create_server(("127.0.0.1", 0))
+        self.port = self.server.getsockname()[1]
+        self.jobs: list = []
+        self.acked: list = []
+
+    def run(self):
+        while True:
+            try:
+                conn, _ = self.server.accept()
+            except OSError:
+                return
+            threading.Thread(target=self._serve, args=(conn,),
+                             daemon=True).start()
+
+    def _serve(self, conn):
+        buf = conn.makefile("rb")
+        while True:
+            line = buf.readline()
+            if not line:
+                return
+            n = int(line[1:])
+            args = []
+            for _ in range(n):
+                ln = int(buf.readline()[1:])
+                args.append(buf.read(ln + 2)[:-2].decode())
+            cmd = args[0].upper()
+            if cmd == "ADDJOB":
+                self.jobs.append(args[2])
+                conn.sendall(b"+D-jobid1\r\n")
+            elif cmd == "GETJOB":
+                if not self.jobs:
+                    conn.sendall(b"*-1\r\n")
+                else:
+                    body = self.jobs.pop(0)
+                    reply = (f"*1\r\n*3\r\n$6\r\njepsen\r\n$5\r\njob-1"
+                             f"\r\n${len(body)}\r\n{body}\r\n")
+                    conn.sendall(reply.encode())
+            elif cmd == "ACKJOB":
+                self.acked.append(args[1])
+                conn.sendall(b":1\r\n")
+            else:
+                conn.sendall(b"-ERR unknown\r\n")
+
+
+def test_disque_client_roundtrip():
+    from dataclasses import dataclass as dc
+
+    from jepsen_tpu.suites import disque
+
+    srv = FakeDisque()
+    srv.start()
+
+    @dc
+    class Op:
+        f: str
+        type: str = "invoke"
+        value: object = None
+        process: int = 0
+
+    c = disque.DisqueClient().open({"nodes": ["127.0.0.1"]}, "127.0.0.1")
+    import jepsen_tpu.suites.disque as dmod
+
+    orig = dmod.PORT
+    try:
+        dmod.PORT = srv.port
+        c.conn = dmod.RespConn("127.0.0.1", srv.port)
+        out = c.invoke({}, Op(f="enqueue", value=42))
+        assert out.type == "ok"
+        out = c.invoke({}, Op(f="dequeue"))
+        assert out.type == "ok" and out.value == 42
+        assert srv.acked == ["job-1"]
+        out = c.invoke({}, Op(f="dequeue"))
+        assert out.type == "fail"  # empty queue
+        c.invoke({}, Op(f="enqueue", value=7))
+        out = c.invoke({}, Op(f="drain"))
+        assert out.type == "ok" and out.value == 1
+    finally:
+        dmod.PORT = orig
+        c.close({})
+        srv.server.close()
+
+
+# --- chronos --------------------------------------------------------------
+
+
+def test_chronos_job_json_and_interval():
+    from jepsen_tpu.suites import chronos
+
+    job = {"name": "3", "start": 0.0, "count": 5, "duration": 2,
+           "epsilon": 11, "interval": 30}
+    assert chronos.interval_str(job) == "R5/1970-01-01T00:00:00Z/PT30S"
+    j = chronos.job_json(job)
+    assert j["epsilon"] == "PT11S"
+    assert "sleep 2" in j["command"]
+    assert chronos.JOB_DIR in j["command"]
+
+
+def test_chronos_parse_run_file():
+    from jepsen_tpu.suites import chronos
+
+    text = "7\n2026-07-29T10:00:00,500000+00:00\n" \
+           "2026-07-29T10:00:02.500000+00:00\n"
+    run = chronos.parse_run_file("n1", text)
+    assert run["name"] == "7"
+    assert run["end"] - run["start"] == 2.0
+
+
+def test_chronos_db_commands():
+    from jepsen_tpu.suites import chronos
+
+    test, r = dummy_test(responses={
+        "stat /etc/apt/sources.list.d/mesosphere.list": (1, "", "no"),
+        "service chronos status": (1, "", "not running"),
+        "dpkg-query": (1, "", "")})
+    chronos.db().setup(test, "n1")
+    cmds = [e[2] for e in r.log if e[0] == "n1" and e[1] == "exec"]
+    assert any("repos.mesosphere.io" in c for c in cmds)
+    assert any("mesos-master" in c and "--quorum=2" in c for c in cmds)
+    assert any("mesos-slave" in c and "zk://n1:2181,n2:2181,n3:2181/mesos"
+               in c for c in cmds)
+    assert any("schedule_horizon" in c for c in cmds)
+    assert any("service chronos start" in c for c in cmds)
+
+
+def test_chronos_masters_subset():
+    from jepsen_tpu.suites import chronos
+
+    test = {"nodes": ["n5", "n1", "n3", "n2", "n4"]}
+    assert chronos.masters(test) == ["n1", "n2", "n3"]
+
+
+def test_chronos_add_job_gen_non_overlapping():
+    from jepsen_tpu.suites import chronos
+    from jepsen_tpu.checker.schedule import EPSILON_FORGIVENESS
+
+    g = chronos.AddJobGen()
+    for _ in range(20):
+        op = g.op({}, 0)
+        v = op["value"]
+        assert v["interval"] > v["duration"] + v["epsilon"] + \
+            EPSILON_FORGIVENESS

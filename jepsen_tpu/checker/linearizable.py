@@ -53,6 +53,8 @@ gather.
 
 from __future__ import annotations
 
+import os
+import time
 from dataclasses import dataclass
 
 import jax
@@ -279,9 +281,8 @@ def _compact_indices(mask, k_out: int):
     return jnp.minimum(idx, n - 1).astype(jnp.int32), csum[-1]
 
 
-def build_search_fn(model: ModelSpec, dims: SearchDims, budget: int,
-                    bail_on_overflow: bool = False):
-    """Compile the frontier search for one (model, dims) pair.
+def build_search_step_fn(model: ModelSpec, dims: SearchDims):
+    """Compile one *slice* of the frontier search for a (model, dims) pair.
 
     Level-synchronous BFS with a double-buffered frontier: a configuration
     at depth d (d = ops linearized) can only ever be generated at level d,
@@ -290,10 +291,19 @@ def build_search_fn(model: ModelSpec, dims: SearchDims, budget: int,
     compare on the full config words (no fingerprint-collision soundness
     hole, and no random-index scatters, which TPUs serialize).
 
-    Returns fn(arrays...) -> (status, configs, max_depth, overflowed):
-    status 2=valid, 1=frontier died out (invalid; sound iff not
-    overflowed), 0=unknown (budget exceeded, or overflow made an
-    exhausted search inconclusive).
+    The search state (frontier, count, status, configs, max_depth, ovf) is
+    an explicit *carry* passed in and returned, and each call runs at most
+    ``lvl_cap`` BFS levels: long searches are driven as a sequence of
+    bounded device calls from the host.  This is load-bearing on the axon
+    TPU backend, whose worker kills any single execution running past its
+    watchdog (~60 s); it also makes the carry a natural checkpoint
+    (SURVEY.md §5.4's device-side frontier checkpoint) and turns
+    ``budget``/``bail`` into runtime scalars so every budget shares one
+    compiled program.
+
+    status: -1 running, 2 valid, 1 frontier died out (invalid; sound iff
+    not overflowed), 0 unknown.  The final -1 -> verdict mapping happens
+    host-side in the slice driver.
     """
     W = dims.window
     K = dims.k
@@ -301,32 +311,25 @@ def build_search_fn(model: ModelSpec, dims: SearchDims, budget: int,
     NC = dims.n_crash_pad
     WORDS = dims.words
     pieces = _make_kernel_pieces(model, dims)
-    pack, expand = pieces["pack"], pieces["expand"]
+    expand = pieces["expand"]
 
-    def search(det_f, det_v1, det_v2, det_inv, det_ret, sfx_min,
-               crash_f, crash_v1, crash_v2, crash_inv, n_det, n_crash,
-               init_state):
-        # initial config occupies frontier row 0
-        init_cfg = pack(jnp.int32(0), jnp.zeros(W, bool),
-                        jnp.zeros(NC, bool), init_state)
-        frontier = jnp.zeros((F, WORDS), dtype=jnp.int32).at[0].set(init_cfg)
-
-        # carried: frontier, count, status, configs, max_depth, overflow
-        # status: -1 running, 2 valid, 1 frontier died out, 0 budget
-        carry0 = (frontier, jnp.int32(1), jnp.int32(-1), jnp.int32(0),
-                  jnp.int32(0), jnp.bool_(False))
+    def step(det_f, det_v1, det_v2, det_inv, det_ret, sfx_min,
+             crash_f, crash_v1, crash_v2, crash_inv, n_det, n_crash,
+             budget, lvl_cap, bail,
+             frontier, count, status, configs, max_depth, ovf):
+        carry0 = (frontier, count, status, configs, max_depth, ovf,
+                  jnp.int32(0))
 
         def cond(c):
-            _, count, status, configs, _, ovf = c
-            go = (status == -1) & (count > 0) & (configs < budget)
-            if bail_on_overflow:
-                # a wider re-run is coming; don't waste time on a
-                # truncated (unsound-for-invalid) frontier
-                go = go & ~ovf
-            return go
+            _, count, status, configs, _, ovf, lvl = c
+            go = ((status == -1) & (count > 0) & (configs < budget)
+                  & (lvl < lvl_cap))
+            # when a wider re-run is coming (bail), don't waste time on a
+            # truncated (unsound-for-invalid) frontier
+            return go & ~(bail & ovf)
 
         def body(c):
-            frontier, count, status, configs, max_depth, ovf = c
+            frontier, count, status, configs, max_depth, ovf, lvl = c
             alive = jnp.arange(F) < count
 
             cfgs, valid, goal, p2s = expand(
@@ -374,20 +377,13 @@ def build_search_fn(model: ModelSpec, dims: SearchDims, budget: int,
             max_depth = jnp.maximum(max_depth, jnp.max(
                 jnp.where(alive, frontier[:, 0], 0)))
             status = jnp.where(found, 2, status)
-            return (new_frontier, new_count, status, configs, max_depth, ovf)
+            return (new_frontier, new_count, status, configs, max_depth,
+                    ovf, lvl + 1)
 
-        (frontier, count, status, configs, max_depth, ovf) = \
-            lax.while_loop(cond, body, carry0)
+        out = lax.while_loop(cond, body, carry0)
+        return out[:6]
 
-        # frontier died out with no goal: invalid if we never overflowed,
-        # otherwise unknown.  budget exceeded: unknown.
-        status = jnp.where(
-            status == -1,
-            jnp.where(count <= 0, jnp.where(ovf, 0, 1), 0),
-            status)
-        return status, configs, max_depth, ovf
-
-    return search
+    return step
 
 
 # ---------------------------------------------------------------------------
@@ -395,10 +391,9 @@ def build_search_fn(model: ModelSpec, dims: SearchDims, budget: int,
 # ---------------------------------------------------------------------------
 
 
-def build_sharded_search_fn(model: ModelSpec, dims: SearchDims, budget: int,
-                            mesh, axis: str = "shard",
-                            bail_on_overflow: bool = False):
-    """The frontier of ONE search sharded over a device mesh.
+def build_sharded_search_step_fn(model: ModelSpec, dims: SearchDims,
+                                 mesh, axis: str = "shard"):
+    """One *slice* of a search whose frontier is sharded over a mesh.
 
     Each device owns the hash partition ``h1 % D`` of the configuration
     space.  Per BFS level: devices expand their local frontier slice,
@@ -409,6 +404,14 @@ def build_sharded_search_fn(model: ModelSpec, dims: SearchDims, budget: int,
     scale-out path for histories whose levels outgrow one chip's
     frontier — the reference's analog is simply "buy a bigger JVM heap"
     (-Xmx32g, jepsen/project.clj:25).
+
+    Like `build_search_step_fn`, the search state is an explicit carry
+    and each call runs at most ``lvl_cap`` levels, so device executions
+    stay bounded.  The per-device frontier slice travels as a global
+    ``[D*F, WORDS]`` array sharded on its leading axis; loop-control
+    scalars (status, configs, total, any_ovf) are replicated (psum'd in
+    the body, never in the cond — collectives inside a while cond can
+    diverge between devices and deadlock/corrupt the all_to_alls).
 
     dims.frontier is the PER-DEVICE frontier width.
     """
@@ -429,33 +432,27 @@ def build_sharded_search_fn(model: ModelSpec, dims: SearchDims, budget: int,
     jstep = model.jstep
 
     inner = _make_kernel_pieces(model, dims)
-    pack, expand = inner["pack"], inner["expand"]
+    expand = inner["expand"]
 
-    def search_device(det_f, det_v1, det_v2, det_inv, det_ret, sfx_min,
-                      crash_f, crash_v1, crash_v2, crash_inv, n_det,
-                      n_crash, init_state):
-        me = lax.axis_index(axis)
-        init_cfg = pack(jnp.int32(0), jnp.zeros(W, bool),
-                        jnp.zeros(NC, bool), init_state)
-        frontier = jnp.zeros((F, WORDS), dtype=jnp.int32).at[0].set(init_cfg)
-        count = jnp.where(me == 0, jnp.int32(1), jnp.int32(0))
+    def step_device(det_f, det_v1, det_v2, det_inv, det_ret, sfx_min,
+                    crash_f, crash_v1, crash_v2, crash_inv, n_det,
+                    n_crash, budget, lvl_cap, bail,
+                    frontier, count, status, configs, max_depth,
+                    any_ovf, total):
+        count = count[0]  # [1] local slice of the [D] count array
 
-        # Loop control state (total, any_ovf, status) is psum'd in the
-        # BODY so it is replicated across devices; the cond is then a
-        # pure local test — collectives inside a while cond can diverge
-        # between devices and deadlock/corrupt the all_to_alls.
-        carry0 = (frontier, count, jnp.int32(-1), jnp.int32(0),
-                  jnp.int32(0), jnp.bool_(False), jnp.int32(1))
+        carry0 = (frontier, count, status, configs, max_depth, any_ovf,
+                  total, jnp.int32(0))
 
         def cond(c):
-            _, _, status, configs, _, any_ovf, total = c
-            go = (status == -1) & (total > 0) & (configs < budget)
-            if bail_on_overflow:
-                go = go & ~any_ovf
-            return go
+            _, _, status, configs, _, any_ovf, total, lvl = c
+            go = ((status == -1) & (total > 0) & (configs < budget)
+                  & (lvl < lvl_cap))
+            return go & ~(bail & any_ovf)
 
         def body(c):
-            frontier, count, status, configs, max_depth, ovf, _total = c
+            frontier, count, status, configs, max_depth, ovf, _total, \
+                lvl = c
             alive = jnp.arange(F) < count
             cfgs, valid, goal, p2s = expand(
                 frontier, alive, det_f, det_v1, det_v2, det_inv, det_ret,
@@ -505,27 +502,24 @@ def build_sharded_search_fn(model: ModelSpec, dims: SearchDims, budget: int,
             new_count = jnp.minimum(new_count, F)
 
             configs = configs + lax.psum(count, axis)
-            max_depth = jnp.maximum(max_depth, jnp.max(
-                jnp.where(alive, frontier[:, 0], 0)))
+            max_depth = jnp.maximum(max_depth, lax.pmax(jnp.max(
+                jnp.where(alive, frontier[:, 0], 0)), axis))
             status = jnp.where(found, 2, status)
             total = lax.psum(new_count, axis)
             any_ovf = lax.psum(ovf.astype(jnp.int32), axis) > 0
             return (new_frontier, new_count, status, configs, max_depth,
-                    any_ovf, total)
+                    any_ovf, total, lvl + 1)
 
-        (frontier, count, status, configs, max_depth, any_ovf, total) = \
-            lax.while_loop(cond, body, carry0)
+        (frontier, count, status, configs, max_depth, any_ovf, total,
+         _lvl) = lax.while_loop(cond, body, carry0)
 
-        status = jnp.where(
-            status == -1,
-            jnp.where(total <= 0, jnp.where(any_ovf, 0, 1), 0),
-            status)
-        max_depth = lax.pmax(max_depth, axis)
-        return status, configs, max_depth, any_ovf
+        return (frontier, count[None], status, configs, max_depth,
+                any_ovf, total)
 
-    specs = (P(),) * 13
-    return shard_map(search_device, mesh=mesh, in_specs=specs,
-                     out_specs=(P(), P(), P(), P()), check_vma=False)
+    specs = (P(),) * 15
+    carry_in = (P(axis), P(axis), P(), P(), P(), P(), P())
+    return shard_map(step_device, mesh=mesh, in_specs=specs + carry_in,
+                     out_specs=carry_in, check_vma=False)
 
 
 def _make_kernel_pieces(model: ModelSpec, dims: SearchDims):
@@ -645,36 +639,62 @@ def search_opseq_sharded(seq: OpSeq, model: ModelSpec, mesh, *,
 
     dims = choose_dims(es, model, frontier=frontier_per_device)
     esp = pad_search(es, dims.n_det_pad, dims.n_crash_pad)
+    D = mesh.shape[axis]
     while True:
+        bail = dims.frontier < MAX_FRONTIER
         mesh_key = (tuple(mesh.shape.items()),
                     tuple(d.id for d in mesh.devices.flat))
-        key = (model.name, dims, budget, axis, mesh_key,
-               dims.frontier < MAX_FRONTIER)
+        key = (model.name, dims, axis, mesh_key)
         fn = _SHARDED_CACHE.get(key)
         if fn is None:
-            fn = jax.jit(build_sharded_search_fn(
-                model, dims, budget, mesh, axis,
-                bail_on_overflow=dims.frontier < MAX_FRONTIER))
+            fn = jax.jit(build_sharded_search_step_fn(
+                model, dims, mesh, axis))
             _SHARDED_CACHE[key] = fn
-        status, configs, max_depth, ovf = fn(
+        args = (
             jnp.asarray(esp.det_f), jnp.asarray(esp.det_v1),
             jnp.asarray(esp.det_v2), jnp.asarray(esp.det_inv),
             jnp.asarray(esp.det_ret), jnp.asarray(esp.suffix_min_ret),
             jnp.asarray(esp.crash_f), jnp.asarray(esp.crash_v1),
             jnp.asarray(esp.crash_v2), jnp.asarray(esp.crash_inv),
-            jnp.int32(es.n_det), jnp.int32(es.n_crash),
-            jnp.asarray(np.asarray(model.init, dtype=np.int32)))
-        status = int(np.asarray(status).reshape(-1)[0])
-        if status == UNKNOWN and bool(np.asarray(ovf).reshape(-1)[0]) \
-                and dims.frontier < MAX_FRONTIER:
+            jnp.int32(es.n_det), jnp.int32(es.n_crash))
+        # global carry: device 0's frontier row 0 holds the root config
+        frontier0 = np.zeros((D * dims.frontier, dims.words), np.int32)
+        frontier0[0] = _init_config(dims, model)
+        count0 = np.zeros(D, np.int32)
+        count0[0] = 1
+        carry0 = (jnp.asarray(frontier0), jnp.asarray(count0),
+                  jnp.int32(-1), jnp.int32(0), jnp.int32(0),
+                  jnp.bool_(False), jnp.int32(1))
+
+        def sc(carry, i):
+            return int(np.asarray(carry[i]).reshape(-1)[0])
+
+        def call(carry, lvl_cap):
+            return fn(*args, jnp.int32(budget), jnp.int32(lvl_cap),
+                      jnp.bool_(bail), *carry)
+
+        def is_active(carry):
+            return (sc(carry, 2) == -1 and sc(carry, 6) > 0
+                    and sc(carry, 3) < budget
+                    and not (bail and sc(carry, 5)))
+
+        carry = _drive_slices(call, carry0, is_active)
+        status = sc(carry, 2)
+        configs = sc(carry, 3)
+        ovf = bool(sc(carry, 5))
+        total = sc(carry, 6)
+        if status == -1:
+            status = (UNKNOWN if ovf else INVALID) if total <= 0 \
+                else UNKNOWN
+        if status == UNKNOWN and ovf and dims.frontier < MAX_FRONTIER:
             dims = SearchDims(**{**dims.__dict__,
                                  "frontier": min(dims.frontier * 8,
                                                  MAX_FRONTIER)})
             continue
         break
     return {"valid": _STATUS[status],
-            "configs": int(np.asarray(configs).reshape(-1)[0]),
-            "max_depth": int(np.asarray(max_depth).reshape(-1)[0]),
+            "configs": configs,
+            "max_depth": int(np.asarray(carry[4]).reshape(-1)[0]),
             "engine": f"tpu-sharded-x{mesh.shape[axis]}",
             "frontier_per_device": dims.frontier}
 
@@ -685,17 +705,75 @@ def search_opseq_sharded(seq: OpSeq, model: ModelSpec, mesh, *,
 
 _KERNEL_CACHE: dict = {}
 
+#: initial BFS levels per device call; the driver adapts from here so
+#: each call lands near _SLICE_TARGET_S seconds of device time (axon
+#: kills executions past its ~60 s watchdog; slices also amortize to
+#: near-zero overhead on fast backends)
+_SLICE_LEVELS0 = int(os.environ.get("JEPSEN_TPU_SLICE_LEVELS", "32"))
+_SLICE_TARGET_S = float(os.environ.get("JEPSEN_TPU_SLICE_TARGET_S", "2.0"))
+_SLICE_MAX = 16384
+
+
+def _adapt_lvl_cap(lvl_cap: int, dt: float) -> int:
+    """Grow/shrink the per-call level cap toward the target slice time."""
+    if dt < _SLICE_TARGET_S / 4:
+        return min(lvl_cap * 4, _SLICE_MAX)
+    if dt < _SLICE_TARGET_S / 2:
+        return min(lvl_cap * 2, _SLICE_MAX)
+    if dt > _SLICE_TARGET_S * 2:
+        return max(lvl_cap // 2, 8)
+    return lvl_cap
+
+
+def _drive_slices(call, carry, is_active, *, on_slice=None):
+    """Shared host loop for all three sliced kernels.
+
+    ``call(carry, lvl_cap)`` runs one bounded device slice;
+    ``is_active(carry)`` says whether another slice is needed;
+    ``on_slice(carry)`` is the checkpoint hook.  The first slice's wall
+    time includes trace+compile, so it never feeds cap adaptation."""
+    lvl_cap = _SLICE_LEVELS0
+    first = True
+    while True:
+        t0 = time.perf_counter()
+        carry = call(carry, lvl_cap)
+        jax.block_until_ready(carry)
+        dt = time.perf_counter() - t0
+        if on_slice is not None:
+            on_slice(carry)
+        if not is_active(carry):
+            return carry
+        if not first:
+            lvl_cap = _adapt_lvl_cap(lvl_cap, dt)
+        first = False
+
 
 def _round_up(x: int, m: int) -> int:
     return ((max(1, x) + m - 1) // m) * m
 
 
-def get_kernel(model: ModelSpec, dims: SearchDims, budget: int,
-               bail_on_overflow: bool = False):
-    key = (model.name, dims, budget, bail_on_overflow)
+def _init_config(dims: SearchDims, model: ModelSpec) -> np.ndarray:
+    """Root configuration words: p=0, empty window/crash masks, init
+    state."""
+    cfg = np.zeros(dims.words, np.int32)
+    cfg[1 + dims.win_words + dims.crash_words:] = np.asarray(
+        model.init, np.int32)
+    return cfg
+
+
+def _init_carry(dims: SearchDims, model: ModelSpec):
+    """Fresh single-device search carry (also the checkpoint format)."""
+    frontier = np.zeros((dims.frontier, dims.words), np.int32)
+    frontier[0] = _init_config(dims, model)
+    return (frontier, np.int32(1), np.int32(-1), np.int32(0),
+            np.int32(0), np.bool_(False))
+
+
+def get_kernel(model: ModelSpec, dims: SearchDims):
+    key = (model.name, dims)
     fn = _KERNEL_CACHE.get(key)
     if fn is None:
-        fn = jax.jit(build_search_fn(model, dims, budget, bail_on_overflow))
+        fn = jax.jit(build_search_step_fn(model, dims))
         _KERNEL_CACHE[key] = fn
     return fn
 
@@ -742,16 +820,47 @@ MAX_FRONTIER = 1 << 17
 
 def _run_kernel(esp: EncodedSearch, es: EncodedSearch, model: ModelSpec,
                 dims: SearchDims, budget: int,
-                bail_on_overflow: bool = False):
-    fn = get_kernel(model, dims, budget, bail_on_overflow)
-    return fn(
+                bail_on_overflow: bool = False, *,
+                on_slice=None, resume=None):
+    """Drive the sliced kernel to completion from the host.
+
+    Returns (status, configs, max_depth, ovf) with status already
+    finalized (-1 never escapes).  ``on_slice(carry)`` is invoked after
+    every device call with the live carry (host-transferable: the
+    checkpoint hook).  ``resume`` accepts a previously captured carry.
+    """
+    fn = get_kernel(model, dims)
+    args = (
         jnp.asarray(esp.det_f), jnp.asarray(esp.det_v1),
         jnp.asarray(esp.det_v2), jnp.asarray(esp.det_inv),
         jnp.asarray(esp.det_ret), jnp.asarray(esp.suffix_min_ret),
         jnp.asarray(esp.crash_f), jnp.asarray(esp.crash_v1),
         jnp.asarray(esp.crash_v2), jnp.asarray(esp.crash_inv),
-        jnp.int32(es.n_det), jnp.int32(es.n_crash),
-        jnp.asarray(np.asarray(model.init, dtype=np.int32)))
+        jnp.int32(es.n_det), jnp.int32(es.n_crash))
+    carry0 = tuple(jnp.asarray(c) for c in
+                   (resume if resume is not None
+                    else _init_carry(dims, model)))
+
+    def call(carry, lvl_cap):
+        return fn(*args, jnp.int32(budget), jnp.int32(lvl_cap),
+                  jnp.bool_(bail_on_overflow), *carry)
+
+    def is_active(carry):
+        return (int(carry[2]) == -1 and int(carry[1]) > 0
+                and int(carry[3]) < budget
+                and not (bail_on_overflow and bool(carry[5])))
+
+    hook = None if on_slice is None else (lambda c: on_slice(c, dims))
+    carry = _drive_slices(call, carry0, is_active, on_slice=hook)
+    status = int(carry[2])
+    count = int(carry[1])
+    configs = int(carry[3])
+    ovf = bool(carry[5])
+    if status == -1:
+        # frontier died out with no goal: invalid if we never overflowed,
+        # otherwise unknown.  budget exceeded: unknown.
+        status = (UNKNOWN if ovf else INVALID) if count <= 0 else UNKNOWN
+    return status, configs, int(carry[4]), ovf
 
 
 def greedy_witness(seq: OpSeq, model: ModelSpec) -> bool:
@@ -775,9 +884,14 @@ def greedy_witness(seq: OpSeq, model: ModelSpec) -> bool:
 
 def search_opseq(seq: OpSeq, model: ModelSpec, *,
                  budget: int = 20_000_000,
-                 dims: SearchDims | None = None) -> dict:
+                 dims: SearchDims | None = None,
+                 on_slice=None) -> dict:
     """Check one columnar history on device.  Returns a knossos-style map
-    {"valid": True|False|"unknown", "configs": n, "max_depth": d}."""
+    {"valid": True|False|"unknown", "configs": n, "max_depth": d}.
+
+    ``on_slice(carry, dims)`` fires after every bounded device call — the
+    checkpoint hook (see ``save_checkpoint``/``resume_opseq``); ``dims``
+    reflects any frontier escalation, so checkpoints stay loadable."""
     es = encode_search(seq)
     if es.n_det == 0 and es.n_crash == 0:
         return {"valid": True, "configs": 0, "max_depth": 0,
@@ -796,18 +910,95 @@ def search_opseq(seq: OpSeq, model: ModelSpec, *,
     while True:
         status, configs, max_depth, ovf = _run_kernel(
             esp, es, model, dims, budget,
-            bail_on_overflow=dims.frontier < MAX_FRONTIER)
-        status = int(status)
+            bail_on_overflow=dims.frontier < MAX_FRONTIER,
+            on_slice=on_slice)
         # a level overflowed the frontier and the search didn't prove
         # validity: escalate to a wider frontier and re-run
-        if status == UNKNOWN and bool(ovf) and dims.frontier < MAX_FRONTIER:
+        if status == UNKNOWN and ovf and dims.frontier < MAX_FRONTIER:
             dims = SearchDims(**{**dims.__dict__,
                                  "frontier": min(dims.frontier * 8,
                                                  MAX_FRONTIER)})
             continue
         break
-    return {"valid": _STATUS[status], "configs": int(configs),
-            "max_depth": int(max_depth), "engine": "tpu",
+    return {"valid": _STATUS[status], "configs": configs,
+            "max_depth": max_depth, "engine": "tpu",
+            "frontier": dims.frontier,
+            "window": es.window, "concurrency": es.concurrency}
+
+
+# ---------------------------------------------------------------------------
+# Search checkpointing (SURVEY §5.4 — device-side frontier checkpoint)
+# ---------------------------------------------------------------------------
+
+
+def history_digest(seq: OpSeq, model: ModelSpec) -> str:
+    """Identity of (history, model) — resuming against the wrong history
+    would silently produce a garbage verdict."""
+    import hashlib
+
+    h = hashlib.sha256()
+    for a in (seq.f, seq.v1, seq.v2, seq.inv, seq.ret, seq.ok):
+        h.update(np.ascontiguousarray(np.asarray(a)).tobytes())
+    h.update(model.name.encode())
+    return h.hexdigest()
+
+
+def save_checkpoint(path: str, carry, dims: SearchDims, model: ModelSpec,
+                    budget: int, seq: OpSeq | None = None) -> None:
+    """Persist a live search carry (as delivered to ``on_slice``).
+
+    The BFS carry is the *entire* search state — frontier configs plus
+    progress counters — so a checkpoint is one npz.  The reference's
+    knossos search has no analog: a killed -Xmx32g JVM search restarts
+    from scratch (jepsen/project.clj:25).  Pass ``seq`` to bind the
+    checkpoint to its history so `resume_opseq` can refuse a mismatch."""
+    c = [np.asarray(x) for x in carry]
+    digest = history_digest(seq, model) if seq is not None else ""
+    np.savez_compressed(
+        path, frontier=c[0], count=c[1], status=c[2], configs=c[3],
+        max_depth=c[4], ovf=c[5], budget=np.int64(budget),
+        model=np.bytes_(model.name.encode()),
+        digest=np.bytes_(digest.encode()),
+        dims=np.asarray([dims.n_det_pad, dims.n_crash_pad, dims.window,
+                         dims.k, dims.state_width, dims.frontier],
+                        np.int64))
+
+
+def load_checkpoint(path: str):
+    """Returns (carry, dims, model_name, budget, digest)."""
+    z = np.load(path)
+    d = z["dims"]
+    dims = SearchDims(n_det_pad=int(d[0]), n_crash_pad=int(d[1]),
+                      window=int(d[2]), k=int(d[3]), state_width=int(d[4]),
+                      frontier=int(d[5]))
+    carry = (z["frontier"], z["count"][()], z["status"][()],
+             z["configs"][()], z["max_depth"][()], z["ovf"][()])
+    digest = bytes(z["digest"][()]).decode() if "digest" in z else ""
+    return (carry, dims, bytes(z["model"][()]).decode(), int(z["budget"]),
+            digest)
+
+
+def resume_opseq(seq: OpSeq, model: ModelSpec, path: str, *,
+                 on_slice=None) -> dict:
+    """Continue a checkpointed `search_opseq` from `save_checkpoint`."""
+    carry, dims, model_name, budget, digest = load_checkpoint(path)
+    if model_name != model.name:
+        raise ValueError(
+            f"checkpoint is for model {model_name!r}, got {model.name!r}")
+    if digest and digest != history_digest(seq, model):
+        raise ValueError(
+            "checkpoint was taken on a different history (digest mismatch)")
+    es = encode_search(seq)
+    esp = pad_search(es, dims.n_det_pad, dims.n_crash_pad)
+    status, configs, max_depth, ovf = _run_kernel(
+        esp, es, model, dims, budget,
+        bail_on_overflow=dims.frontier < MAX_FRONTIER,
+        on_slice=on_slice, resume=carry)
+    if status == UNKNOWN and ovf and dims.frontier < MAX_FRONTIER:
+        # overflow after resume: restart fresh with a wider frontier
+        return search_opseq(seq, model, budget=budget, on_slice=on_slice)
+    return {"valid": _STATUS[status], "configs": configs,
+            "max_depth": max_depth, "engine": "tpu(resumed)",
             "frontier": dims.frontier,
             "window": es.window, "concurrency": es.concurrency}
 
@@ -836,11 +1027,13 @@ def batch_dims(ess: list[EncodedSearch], model: ModelSpec, *,
         state_width=model.state_width, frontier=frontier)
 
 
-def get_batch_kernel(model: ModelSpec, dims: SearchDims, budget: int):
-    key = ("batch", model.name, dims, budget)
+def get_batch_kernel(model: ModelSpec, dims: SearchDims):
+    key = ("batch", model.name, dims)
     fn = _KERNEL_CACHE.get(key)
     if fn is None:
-        fn = jax.jit(jax.vmap(build_search_fn(model, dims, budget)))
+        fn = jax.jit(jax.vmap(
+            build_search_step_fn(model, dims),
+            in_axes=(0,) * 12 + (None, None, None) + (0,) * 6))
         _KERNEL_CACHE[key] = fn
     return fn
 
@@ -853,15 +1046,21 @@ def stack_batch(seqs: list[OpSeq], model: ModelSpec, dims: SearchDims):
     def st(attr):
         return jnp.asarray(np.stack([getattr(e, attr) for e in ess]))
 
-    init = np.broadcast_to(
-        np.asarray(model.init, dtype=np.int32),
-        (len(ess), model.state_width))
     return (st("det_f"), st("det_v1"), st("det_v2"), st("det_inv"),
             st("det_ret"), st("suffix_min_ret"), st("crash_f"),
             st("crash_v1"), st("crash_v2"), st("crash_inv"),
             jnp.asarray(np.array([e.n_det for e in ess], np.int32)),
-            jnp.asarray(np.array([e.n_crash for e in ess], np.int32)),
-            jnp.asarray(init))
+            jnp.asarray(np.array([e.n_crash for e in ess], np.int32)))
+
+
+def _init_batch_carry(n: int, dims: SearchDims, model: ModelSpec):
+    """Stacked fresh carries for an n-key batch."""
+    one = _init_config(dims, model)
+    frontier = np.zeros((n, dims.frontier, dims.words), np.int32)
+    frontier[:, 0] = one
+    return (frontier, np.ones(n, np.int32),
+            np.full(n, -1, np.int32), np.zeros(n, np.int32),
+            np.zeros(n, np.int32), np.zeros(n, bool))
 
 
 def search_batch(seqs: list[OpSeq], model: ModelSpec, *,
@@ -917,12 +1116,34 @@ def search_batch(seqs: list[OpSeq], model: ModelSpec, *,
 
     dims = dims or batch_dims(ess, model)
     args = stack_batch(seqs, model, dims)
+    carry = tuple(jnp.asarray(c) for c in
+                  _init_batch_carry(len(seqs), dims, model))
     if sharding is not None:
         args = tuple(jax.device_put(a, sharding) for a in args)
-    fn = get_batch_kernel(model, dims, budget)
-    status, configs, depth, ovf = fn(*args)
-    status = np.asarray(status)
-    ovf = np.asarray(ovf)
+        carry = tuple(jax.device_put(c, sharding) for c in carry)
+    fn = get_batch_kernel(model, dims)
+
+    def call(c, lvl_cap):
+        return fn(*args, jnp.int32(budget), jnp.int32(lvl_cap),
+                  jnp.bool_(False), *c)
+
+    def is_active(c):
+        active = ((np.asarray(c[2]) == -1) & (np.asarray(c[1]) > 0)
+                  & (np.asarray(c[3]) < budget))
+        return bool(active.any())
+
+    carry = _drive_slices(call, carry, is_active)
+    status = np.asarray(carry[2])
+    count = np.asarray(carry[1])
+    configs = np.asarray(carry[3])
+    depth = np.asarray(carry[4])
+    ovf = np.asarray(carry[5])
+    # host-side finalization of still -1 statuses (dead frontier or
+    # exhausted budget), mirroring _run_kernel
+    status = np.where(
+        status == -1,
+        np.where(count <= 0, np.where(ovf, UNKNOWN, INVALID), UNKNOWN),
+        status)
     out = []
     for i in range(len(seqs)):
         if int(status[i]) == UNKNOWN and bool(ovf[i]):

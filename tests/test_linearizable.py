@@ -229,3 +229,65 @@ def test_wrapper_witness_prefix():
     assert out["valid"] == ref["valid"]
     if out["valid"] is False and "witness_prefix_ops" in out:
         assert out["witness_prefix_ops"] < 300
+
+
+def test_slicing_equivalence(monkeypatch):
+    """Tiny slices (1 level per device call) must give the same verdict
+    as big ones — the slice boundary is invisible to the search."""
+    monkeypatch.setattr(lin, "_SLICE_LEVELS0", 1)
+    monkeypatch.setattr(lin, "_adapt_lvl_cap", lambda cap, dt: cap)
+    rng = random.Random(77)
+    h = corrupt(rng, random_register_history(rng, n_procs=4, n_ops=40))
+    model = cas_register()
+    s = encode_ops(h, model.f_codes)
+    a = oracle.check_opseq(s, model)
+    slices = []
+    b = lin.search_opseq(s, model, dims=DIMS,
+                         on_slice=lambda c, d: slices.append(True))
+    assert b["valid"] == a["valid"], f"oracle={a} device={b}"
+    assert len(slices) > 1, "expected multiple 1-level slices"
+
+
+def test_checkpoint_resume(tmp_path, monkeypatch):
+    """Stop a search mid-flight, persist the carry, resume in a 'new'
+    driver, and get the same verdict as an uninterrupted run."""
+    monkeypatch.setattr(lin, "_SLICE_LEVELS0", 2)
+    monkeypatch.setattr(lin, "_adapt_lvl_cap", lambda cap, dt: cap)
+    rng = random.Random(78)
+    h = corrupt(rng, random_register_history(rng, n_procs=4, n_ops=40))
+    model = cas_register()
+    s = encode_ops(h, model.f_codes)
+    want = lin.search_opseq(s, model, dims=DIMS)["valid"]
+
+    ckpt = str(tmp_path / "search.npz")
+
+    class Stop(Exception):
+        pass
+
+    n = [0]
+
+    def save_then_stop(carry, dims):
+        n[0] += 1
+        lin.save_checkpoint(ckpt, carry, dims, model, budget=20_000_000,
+                            seq=s)
+        if n[0] >= 2:
+            raise Stop
+
+    try:
+        lin.search_opseq(s, model, dims=DIMS, on_slice=save_then_stop)
+    except Stop:
+        pass
+    carry, dims2, name, budget, digest = lin.load_checkpoint(ckpt)
+    assert dims2 == DIMS and name == model.name
+    assert digest == lin.history_digest(s, model)
+    out = lin.resume_opseq(s, model, ckpt)
+    assert out["valid"] == want
+    assert out["engine"].startswith("tpu")
+
+    # resuming against a different history must be refused
+    h2 = corrupt(random.Random(99),
+                 random_register_history(random.Random(99), n_procs=4,
+                                         n_ops=40))
+    s2 = encode_ops(h2, model.f_codes)
+    with pytest.raises(ValueError, match="digest"):
+        lin.resume_opseq(s2, model, ckpt)

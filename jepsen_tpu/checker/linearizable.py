@@ -305,10 +305,8 @@ def build_search_step_fn(model: ModelSpec, dims: SearchDims):
     not overflowed), 0 unknown.  The final -1 -> verdict mapping happens
     host-side in the slice driver.
     """
-    W = dims.window
     K = dims.k
     F = dims.frontier
-    NC = dims.n_crash_pad
     WORDS = dims.words
     pieces = _make_kernel_pieces(model, dims)
     expand = pieces["expand"]
@@ -418,18 +416,13 @@ def build_sharded_search_step_fn(model: ModelSpec, dims: SearchDims,
     from jax import shard_map
     from jax.sharding import PartitionSpec as P
 
-    W = dims.window
     K = dims.k
     F = dims.frontier
     S = 4 * F
-    NC = dims.n_crash_pad
-    WW = dims.win_words
-    CW = dims.crash_words
     WORDS = dims.words
     D = mesh.shape[axis]
     # per-destination-device routing capacity per level
     C_CAP = max(64, _round_up(S // D, 32))
-    jstep = model.jstep
 
     inner = _make_kernel_pieces(model, dims)
     expand = inner["expand"]

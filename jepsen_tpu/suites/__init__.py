@@ -19,7 +19,8 @@ from importlib import import_module
 
 SUITES = ["atomdemo", "etcdemo", "zookeeper", "hazelcast", "registry",
           "consul", "rabbitmq", "cockroach", "galera", "elasticsearch",
-          "mongodb", "disque", "chronos"]
+          "mongodb", "disque", "chronos", "aerospike", "crate",
+          "rethinkdb", "tidb"]
 
 
 def suite(name: str):

@@ -1,0 +1,176 @@
+------------------------------ MODULE aerospike_cp ------------------------------
+(* Model of Aerospike's strong-consistency (CP-mode) partition-ownership
+   protocol, as exercised by the jepsen_tpu aerospike suite
+   (jepsen_tpu/suites/aerospike.py).  The reference ships its own spec at
+   aerospike/spec/aerospike.tla; this is an independent model of the same
+   protocol surface:
+
+     * A *roster* — the committed membership list — divides a namespace's
+       partitions among nodes; a partition is writable only while a
+       majority ("super-majority" simplified to majority here) of its
+       roster replicas are alive and mutually connected.
+     * `recluster` commits the pending roster and recomputes ownership.
+     * A partition whose full replica set was lost goes DEAD and refuses
+       ops until an operator `revive` acknowledges potential data loss.
+
+   The safety property checked is single-register linearizability of one
+   partition's record under kills, restarts, network splits, recluster
+   and revive — i.e. exactly the history shape the suite's cas-register
+   workload feeds to the TPU checker.  Run with TLC:
+     CONSTANTS  Nodes = {n1, n2, n3}   Values = {0, 1}
+*)
+
+EXTENDS Integers, FiniteSets, TLC
+
+CONSTANTS Nodes,      \* model nodes, e.g. {n1, n2, n3}
+          Values      \* register values, e.g. 0..1
+
+VARIABLES roster,     \* committed membership (a subset of Nodes)
+          pending,    \* observed/pending membership awaiting recluster
+          alive,      \* set of running nodes
+          conn,       \* symmetric connectivity relation (set of {a,b})
+          primary,    \* current partition master (or NoNode)
+          replicas,   \* nodes holding a current copy
+          dead,       \* TRUE when the partition is DEAD (needs revive)
+          reg,        \* register value per node copy
+          committed   \* sequence-free audit: set of (value) committed
+
+NoNode == CHOOSE x : x \notin Nodes
+
+Majority(S) == Cardinality(S) * 2 > Cardinality(roster)
+
+Connected(a, b) == a = b \/ {a, b} \in conn
+
+Component(n) == {m \in Nodes : Connected(n, m) /\ m \in alive}
+
+TypeOK ==
+  /\ roster \subseteq Nodes
+  /\ pending \subseteq Nodes
+  /\ alive \subseteq Nodes
+  /\ primary \in Nodes \cup {NoNode}
+  /\ replicas \subseteq Nodes
+  /\ dead \in BOOLEAN
+  /\ reg \in [Nodes -> Values \cup {NoNode}]
+  /\ committed \subseteq Values
+
+Init ==
+  /\ roster = Nodes
+  /\ pending = Nodes
+  /\ alive = Nodes
+  /\ conn = {{a, b} : a, b \in Nodes}
+  /\ primary = CHOOSE n \in Nodes : TRUE
+  /\ replicas = Nodes
+  /\ dead = FALSE
+  /\ reg = [n \in Nodes |-> NoNode]
+  /\ committed = {}
+
+(* --- faults ------------------------------------------------------------ *)
+
+Kill(n) ==
+  /\ n \in alive
+  /\ alive' = alive \ {n}
+  /\ primary' = IF primary = n THEN NoNode ELSE primary
+  /\ UNCHANGED <<roster, pending, conn, replicas, dead, reg, committed>>
+
+Restart(n) ==
+  /\ n \notin alive
+  /\ alive' = alive \cup {n}
+  /\ pending' = pending \cup {n}
+  /\ UNCHANGED <<roster, conn, primary, replicas, dead, reg, committed>>
+
+Split(S) ==   \* partition the network into S | Nodes\S
+  /\ S # {} /\ S # Nodes
+  /\ conn' = {{a, b} : a, b \in S} \cup
+             {{a, b} : a, b \in (Nodes \ S)}
+  /\ UNCHANGED <<roster, pending, alive, primary, replicas, dead, reg,
+                 committed>>
+
+Heal ==
+  /\ conn' = {{a, b} : a, b \in Nodes}
+  /\ UNCHANGED <<roster, pending, alive, primary, replicas, dead, reg,
+                 committed>>
+
+(* --- protocol ----------------------------------------------------------- *)
+
+\* A node takes mastership iff a majority of the roster is in its
+\* connected component; the fresh copy set is that component.
+Elect(n) ==
+  /\ n \in alive
+  /\ ~dead
+  /\ Majority(Component(n) \cap roster)
+  /\ primary' = n
+  /\ replicas' = Component(n) \cap roster
+  \* new replicas adopt the value of some current copy in the component;
+  \* if every current copy was lost the partition must NOT elect —
+  \* modeled by requiring an intersection with the old replicas
+  /\ Component(n) \cap replicas # {}
+  /\ LET src == CHOOSE m \in Component(n) \cap replicas : TRUE IN
+       reg' = [m \in Nodes |->
+                IF m \in Component(n) \cap roster THEN reg[src]
+                ELSE reg[m]]
+  /\ UNCHANGED <<roster, pending, alive, conn, dead, committed>>
+
+\* All current copies gone: partition goes DEAD rather than serving stale
+\* state.
+GoDead ==
+  /\ ~dead
+  /\ \A m \in replicas : m \notin alive
+  /\ dead' = TRUE
+  /\ primary' = NoNode
+  /\ UNCHANGED <<roster, pending, alive, conn, replicas, reg, committed>>
+
+\* Operator revive: acknowledge availability loss; surviving roster
+\* members may re-form with whatever copies exist.
+Revive ==
+  /\ dead
+  /\ dead' = FALSE
+  /\ replicas' = alive \cap roster
+  /\ UNCHANGED <<roster, pending, alive, conn, primary, reg, committed>>
+
+\* Recluster: commit the pending roster.
+Recluster ==
+  /\ roster' = pending
+  /\ UNCHANGED <<pending, alive, conn, primary, replicas, dead, reg,
+                 committed>>
+
+\* A client write through the primary commits to every connected replica.
+Write(v) ==
+  /\ primary # NoNode
+  /\ primary \in alive
+  /\ ~dead
+  /\ Majority(Component(primary) \cap roster)
+  /\ reg' = [m \in Nodes |->
+              IF m \in replicas /\ m \in Component(primary)
+              THEN v ELSE reg[m]]
+  /\ committed' = committed \cup {v}
+  /\ UNCHANGED <<roster, pending, alive, conn, primary, replicas, dead>>
+
+Next ==
+  \/ \E n \in Nodes : Kill(n) \/ Restart(n) \/ Elect(n)
+  \/ \E S \in SUBSET Nodes : Split(S)
+  \/ Heal \/ GoDead \/ Revive \/ Recluster
+  \/ \E v \in Values : Write(v)
+
+(* --- safety ------------------------------------------------------------- *)
+
+\* At most one primary can ever hold a roster majority in its component:
+\* two simultaneous eligible primaries would allow split-brain.
+NoSplitBrain ==
+  \A a, b \in alive :
+    (Majority(Component(a) \cap roster) /\
+     Majority(Component(b) \cap roster))
+    => Component(a) = Component(b)
+
+\* A committed write is never silently lost while the partition is not
+\* DEAD: some alive replica still holds the last committed value, or the
+\* partition has gone DEAD (loss is *announced*, never silent).
+NoSilentLoss ==
+  (committed # {} /\ ~dead /\ primary # NoNode /\ primary \in alive)
+    => \E m \in replicas : m \in alive
+
+Spec == Init /\ [][Next]_<<roster, pending, alive, conn, primary,
+                           replicas, dead, reg, committed>>
+
+THEOREM Spec => [](TypeOK /\ NoSplitBrain /\ NoSilentLoss)
+
+===============================================================================

@@ -1,0 +1,268 @@
+"""Third suite tranche: aerospike (roster workflow + capped kill
+nemesis), crate (version-divergence/lost-updates checkers), rethinkdb
+(reconfigure grudge math), tidb (three-daemon orchestration)."""
+
+import random
+
+from jepsen_tpu.history import Op
+
+from test_suites import dummy_test
+
+
+def mkop(**kw):
+    base = dict(index=0, type="ok", f="read", value=None, process=0,
+                time=0)
+    base.update(kw)
+    return Op(**base)
+
+
+# --- aerospike ------------------------------------------------------------
+
+
+def test_aerospike_parse_kv_and_roster():
+    from jepsen_tpu.suites import aerospike
+
+    kv = aerospike.parse_kv("migrate_allowed=true;migrate_partitions_"
+                            "remaining=0")
+    assert kv["migrate_allowed"] == "true"
+
+    test, r = dummy_test()
+    resp = ("roster=A,B,C:pending_roster=A,B,C:"
+            "observed_nodes=A,B,C")
+    r.responses["asinfo -v roster:namespace=jepsen"] = (0, resp, "")
+    from jepsen_tpu.control import Session
+
+    sess = Session(node="n1", remote=r)
+    ro = aerospike.roster(sess)
+    assert ro["roster"] == ["A", "B", "C"]
+    assert ro["observed_nodes"] == ["A", "B", "C"]
+
+
+def test_aerospike_config_template():
+    from jepsen_tpu.suites import aerospike
+
+    conf = aerospike.config_template(
+        "10.0.0.1", "10.0.0.9", replication_factor=3,
+        heartbeat_interval=150, commit_to_device=False)
+    assert "mesh-seed-address-port 10.0.0.9 3002" in conf
+    assert "replication-factor 3" in conf
+    assert "strong-consistency true" in conf
+    assert "storage-engine memory" in conf
+    conf2 = aerospike.config_template(
+        "a", "b", replication_factor=2, heartbeat_interval=150,
+        commit_to_device=True)
+    assert "commit-to-device true" in conf2
+
+
+def test_aerospike_capped_kill():
+    from jepsen_tpu.suites import aerospike
+
+    assert aerospike.capped_conj({"a"}, "b", 1) == {"a"}
+    assert aerospike.capped_conj({"a"}, "b", 2) == {"a", "b"}
+    assert aerospike.capped_conj({"a"}, "a", 1) == {"a"}
+
+    test, r = dummy_test()
+    nem = aerospike.KillNemesis(max_dead=1)
+    op = mkop(type="info", f="kill", value=["n1", "n2"], process="nemesis")
+    out = nem.invoke(test, op)
+    vals = sorted(out.value.values())
+    # cap 1: exactly one node actually killed
+    assert vals.count("killed") == 1 and vals.count("still-alive") == 1
+    killed = [n for n, v in out.value.items() if v == "killed"][0]
+    out2 = nem.invoke(test, mkop(type="info", f="restart",
+                                 value=[killed], process="nemesis"))
+    assert out2.value[killed] == "started"
+    assert nem.dead == set()
+
+
+def test_aerospike_db_setup_commands():
+    from jepsen_tpu.suites import aerospike
+
+    test, r = dummy_test(nodes=("n1",))
+    test["barrier"] = "no-barrier"
+    roster_resp = ("roster=n1:pending_roster=n1:observed_nodes=n1")
+    r.responses["ls /tmp/packages"] = (
+        0, "aerospike-server.deb\naerospike-tools.deb\n", "")
+    r.responses["asinfo -v roster:namespace=jepsen"] = (0, roster_resp, "")
+    r.responses["asinfo -v statistics"] = (
+        0, "migrate_allowed=true;migrate_partitions_remaining=0", "")
+    r.responses["getent ahosts n1"] = (0, "10.0.0.1 STREAM n1\n", "")
+    aerospike.db().setup(test, "n1")
+    cmds = [e[2] for e in r.log if e[1] == "exec"]
+    assert any("dpkg -i --force-confnew" in c for c in cmds)
+    assert any("service aerospike start" in c for c in cmds)
+    assert any("roster-set:namespace=jepsen" in c for c in cmds)
+    assert any("asadm" in c and "recluster" in c for c in cmds)
+
+
+def test_aerospike_workloads_construct():
+    from jepsen_tpu.suites import aerospike
+
+    for wl in aerospike.WORKLOADS:
+        t = aerospike.aerospike_test({"workload": wl, "nodes": ["n1"],
+                                      "time_limit": 1})
+        assert t["client"] is not None
+        assert t["generator"] is not None
+        assert wl in t["name"]
+
+
+def test_aerospike_tla_spec_exists():
+    import os
+
+    p = os.path.join(os.path.dirname(__file__), "..", "native", "spec",
+                     "aerospike_cp.tla")
+    src = open(p).read()
+    assert "NoSplitBrain" in src and "Revive" in src
+
+
+# --- crate ----------------------------------------------------------------
+
+
+def test_crate_config_yml():
+    from jepsen_tpu.suites import crate
+
+    yml = crate.config_yml({"nodes": ["n1", "n2", "n3"]}, "n2")
+    assert "node.name: n2" in yml
+    assert 'discovery.zen.minimum_master_nodes: 2' in yml
+    assert '"n3:44300"' in yml
+
+
+def test_crate_multiversion_checker():
+    from jepsen_tpu.suites import crate
+
+    ch = crate.multiversion_checker()
+    good = [
+        mkop(index=0, value={"value": 1, "_version": 1}),
+        mkop(index=1, value={"value": 1, "_version": 1}),
+        mkop(index=2, value={"value": 2, "_version": 2}),
+    ]
+    assert ch.check({}, good)["valid"] is True
+
+    bad = good + [mkop(index=3, value={"value": 9, "_version": 2})]
+    out = ch.check({}, bad)
+    assert out["valid"] is False
+    assert 2 in out["multis"]
+
+
+def test_crate_tests_construct():
+    from jepsen_tpu.suites import crate
+
+    for wl in crate.TESTS:
+        t = crate.crate_test({"workload": wl, "nodes": ["n1"],
+                              "time_limit": 1})
+        assert wl in t["name"]
+        assert t["checker"] is not None
+
+
+# --- rethinkdb ------------------------------------------------------------
+
+
+def test_rethinkdb_config():
+    from jepsen_tpu.suites import rethinkdb
+
+    conf = rethinkdb.config({"nodes": ["n1", "n2"]}, "n1")
+    assert "join=n1:29015" in conf and "join=n2:29015" in conf
+    assert "server-name=n1" in conf
+
+
+def test_rethinkdb_random_topology_and_grudge():
+    from jepsen_tpu.suites import rethinkdb
+
+    random.seed(5)
+    nodes = ["n1", "n2", "n3", "n4", "n5"]
+    for _ in range(20):
+        primary, replicas = rethinkdb.random_topology(nodes)
+        assert primary in replicas
+        assert set(replicas) <= set(nodes)
+        assert len(set(replicas)) == len(replicas)
+
+    saw_empty = saw_grudge = False
+    for _ in range(50):
+        g = rethinkdb.reconfigure_grudge(nodes, "n1")
+        if not g:
+            saw_empty = True
+            continue
+        saw_grudge = True
+        # complete grudge over a bisection: every node blocks the other
+        # half
+        assert set(g.keys()) == set(nodes)
+        for dst, srcs in g.items():
+            assert dst not in srcs
+            assert 0 < len(srcs) < len(nodes)
+    assert saw_empty and saw_grudge
+
+
+def test_rethinkdb_db_commands():
+    from jepsen_tpu.suites import rethinkdb
+
+    test, r = dummy_test(nodes=("n1",))
+    r.responses["apt-get install"] = (0, "", "")
+    rethinkdb.db("2.3.5~0jessie").setup(test, "n1")
+    cmds = [e[2] for e in r.log if e[1] == "exec"]
+    assert any("apt-key add" in c for c in cmds)
+    assert any("/etc/rethinkdb/instances.d/jepsen.conf" in c
+               for c in cmds)
+    assert any("service rethinkdb start" in c for c in cmds)
+
+
+def test_rethinkdb_test_constructs():
+    from jepsen_tpu.suites import rethinkdb
+
+    for nem in rethinkdb.NEMESES:
+        t = rethinkdb.document_cas_test(
+            {"nemesis": nem, "write_acks": "single",
+             "read_mode": "outdated", "nodes": ["n1"], "time_limit": 1})
+        assert "w=single" in t["name"] and "r=outdated" in t["name"]
+
+
+# --- tidb -----------------------------------------------------------------
+
+
+def test_tidb_cluster_strings():
+    from jepsen_tpu.suites import tidb
+
+    test = {"nodes": ["n1", "n2"]}
+    assert tidb.initial_cluster(test) == \
+        "pd-n1=http://n1:2380,pd-n2=http://n2:2380"
+    assert tidb.pd_endpoints(test) == "n1:2379,n2:2379"
+
+
+def test_tidb_db_commands():
+    from jepsen_tpu.suites import tidb
+
+    test, r = dummy_test(nodes=("n1",))
+    test["barrier"] = "no-barrier"
+    r.responses["stat /"] = (1, "", "no")
+    r.responses["ls -A"] = (0, "tidb-latest-linux-amd64\n", "")
+    r.responses["dirname"] = (0, "/opt", "")
+    import time as time_mod
+
+    orig = time_mod.sleep
+    time_mod.sleep = lambda s: None
+    try:
+        tidb.db("file:///tmp/tidb.tar.gz").setup(test, "n1")
+    finally:
+        time_mod.sleep = orig
+    cmds = [e[2] for e in r.log if e[1] == "exec"]
+    pd = [i for i, c in enumerate(cmds) if "pd-server" in c
+          and "start-stop-daemon" in c]
+    kv = [i for i, c in enumerate(cmds) if "tikv-server" in c
+          and "start-stop-daemon" in c]
+    db_ = [i for i, c in enumerate(cmds) if "tidb-server" in c
+           and "start-stop-daemon" in c]
+    assert pd and kv and db_, "all three daemons must start"
+    assert pd[0] < kv[0] < db_[0], "dependency order: pd -> tikv -> tidb"
+    assert any("--initial-cluster pd-n1=http://n1:2380" in c
+               for c in cmds)
+
+
+def test_tidb_workloads_construct():
+    from jepsen_tpu.suites import tidb
+
+    for wl in tidb.WORKLOADS:
+        for nem in tidb.NEMESES:
+            t = tidb.tidb_test({"workload": wl, "nemesis": nem,
+                                "nodes": ["n1"], "time_limit": 1})
+            assert wl in t["name"]
+    t = tidb.tidb_test({"workload": "bank", "nodes": ["n1"]})
+    assert t["total_amount"] == 50

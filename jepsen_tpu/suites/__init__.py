@@ -20,7 +20,9 @@ from importlib import import_module
 SUITES = ["atomdemo", "etcdemo", "zookeeper", "hazelcast", "registry",
           "consul", "rabbitmq", "cockroach", "galera", "elasticsearch",
           "mongodb", "disque", "chronos", "aerospike", "crate",
-          "rethinkdb", "tidb"]
+          "rethinkdb", "tidb", "etcd", "logcabin", "raftis",
+          "robustirc", "percona", "mysql_cluster", "postgres_rds",
+          "dgraph"]
 
 
 def suite(name: str):

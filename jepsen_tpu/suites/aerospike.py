@@ -522,7 +522,9 @@ class CasRegisterClient(AerospikeClient):
     def invoke(self, test, op):
         self._out = None
         k, v = op.value
-        with self._errors(op, fail_fs=("read", "cas")):
+        # only reads are determinate on generic errors; a timed-out CAS
+        # put may still have committed, so it must be :info, not :fail
+        with self._errors(op, fail_fs=("read",)):
             if op.f == "read":
                 try:
                     _key, meta, bins = self.conn.get(self._key(k))
@@ -544,11 +546,18 @@ class CasRegisterClient(AerospikeClient):
                 if (bins or {}).get("value") != frm:
                     return replace(op, type="fail", error="value-mismatch")
                 # generation check makes the read-modify-write atomic
-                # (support.clj:376-383 EXPECT_GEN_EQUAL)
-                self.conn.put(
-                    self._key(k), {"value": to},
-                    meta={"gen": meta["gen"]},
-                    policy={"gen": aero.POLICY_GEN_EQ})
+                # (support.clj:376-383 EXPECT_GEN_EQUAL); a lost gen race
+                # is determinate — the put did NOT apply
+                try:
+                    self.conn.put(
+                        self._key(k), {"value": to},
+                        meta={"gen": meta["gen"]},
+                        policy={"gen": aero.POLICY_GEN_EQ})
+                except Exception as e:
+                    if "Generation" in type(e).__name__:
+                        return replace(op, type="fail",
+                                       error="gen-conflict")
+                    raise
                 return replace(op, type="ok")
             raise ValueError(f"unknown f {op.f!r}")
         return self._out

@@ -316,7 +316,7 @@ def disque_test(opts: dict) -> dict:
             "perf": perf_mod.perf(),
         }),
         "generator": std_gen(opts, gen.delay(1, gen.queue())),
-    } | dict(opts)
+    } | {k: v for k, v in opts.items() if k != "nemesis"}
 
 
 def add_opts(p):

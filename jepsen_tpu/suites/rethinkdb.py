@@ -412,7 +412,7 @@ def document_cas_test(opts: dict) -> dict:
                 lambda k: gen.limit(
                     opts.get("ops_per_key", 100),
                     gen.stagger(0.1, gen.mix([w, cas, r_read])))))),
-    } | dict(opts)
+    } | {k: v for k, v in opts.items() if k != "nemesis"}
 
 
 def add_opts(p):

@@ -323,36 +323,10 @@ class BankClient(TiDBClient):
                f" {self.starting_balance})" for i in range(self.n)])
 
     def invoke(self, test, op):
-        def body(cur):
-            if op.f == "read":
-                cur.execute("select id, balance from accounts")
-                rows = dict(cur.fetchall())
-                return replace(op, type="ok",
-                               value={i: rows.get(i)
-                                      for i in range(self.n)})
-            if op.f == "transfer":
-                frm = op.value["from"]
-                to = op.value["to"]
-                amount = op.value["amount"]
-                cur.execute(
-                    "select balance from accounts where id = %s"
-                    " for update", (frm,))
-                b1 = cur.fetchone()[0] - amount
-                cur.execute(
-                    "select balance from accounts where id = %s"
-                    " for update", (to,))
-                b2 = cur.fetchone()[0] + amount
-                if b1 < 0:
-                    return replace(op, type="fail",
-                                   error=f"negative {frm} {b1}")
-                cur.execute("update accounts set balance = %s"
-                            " where id = %s", (b1, frm))
-                cur.execute("update accounts set balance = %s"
-                            " where id = %s", (b2, to))
-                return replace(op, type="ok")
-            raise ValueError(f"unknown f {op.f!r}")
+        from ..bank import sql_bank_body
 
-        return self.txn(op, body)
+        return self.txn(op, lambda cur: sql_bank_body(
+            cur, op, self.n, lock_type=" for update"))
 
 
 class SetsClient(TiDBClient):
@@ -457,15 +431,9 @@ def register_workload(opts) -> dict:
 def bank_workload(opts) -> dict:
     n = opts.get("accounts", 5)
 
-    def read(t, p):
-        return {"type": "invoke", "f": "read", "value": None}
+    from ..bank import bank_read, bank_transfer
 
-    def transfer(t, p):
-        frm, to = random.sample(range(n), 2)
-        return {"type": "invoke", "f": "transfer",
-                "value": {"from": frm, "to": to,
-                          "amount": 1 + random.randrange(5)}}
-
+    read, transfer = bank_read, bank_transfer(n)
     return {
         "client": BankClient(n=n),
         "total_amount": n * 10,
@@ -523,7 +491,9 @@ def tidb_test(opts: dict) -> dict:
     }
     if "total_amount" in workload:
         t["total_amount"] = workload["total_amount"]
-    return t | dict(opts)
+    # CLI strings must not clobber the constructed objects they selected
+    return t | {k: v for k, v in opts.items()
+                if k not in ("nemesis", "workload")}
 
 
 def add_opts(p):

@@ -1,0 +1,320 @@
+"""Fourth suite tranche: etcd(v2), logcabin (SSH TreeOps client),
+raftis, robustirc, percona, mysql-cluster, postgres-rds, dgraph."""
+
+import json
+import random
+
+from jepsen_tpu.history import Op
+
+from test_suites import dummy_test
+
+
+def mkop(**kw):
+    base = dict(index=0, type="ok", f="read", value=None, process=0,
+                time=0)
+    base.update(kw)
+    return Op(**base)
+
+
+# --- etcd (v2) ------------------------------------------------------------
+
+
+def test_etcd_v2_urls():
+    from jepsen_tpu.suites import etcd
+
+    assert etcd.peer_url("n1") == "http://n1:2380"
+    assert etcd.initial_cluster({"nodes": ["n1", "n2"]}) == \
+        "n1=http://n1:2380,n2=http://n2:2380"
+
+
+def test_etcd_v2_db_commands():
+    from jepsen_tpu.suites import etcd
+
+    test, r = dummy_test(nodes=("n1",))
+    r.responses["stat /"] = (1, "", "no")
+    r.responses["ls -A"] = (0, "etcd-v2.1.1-linux-amd64\n", "")
+    r.responses["dirname"] = (0, "/opt", "")
+    import time as time_mod
+
+    orig = time_mod.sleep
+    time_mod.sleep = lambda s: None
+    try:
+        etcd.db("v2.1.1").setup(test, "n1")
+    finally:
+        time_mod.sleep = orig
+    cmds = [e[2] for e in r.log if e[1] == "exec"]
+    assert any("--initial-cluster n1=http://n1:2380" in c for c in cmds)
+    assert any("start-stop-daemon" in c for c in cmds)
+
+
+# --- logcabin -------------------------------------------------------------
+
+
+def test_logcabin_addrs():
+    from jepsen_tpu.suites import logcabin
+
+    assert logcabin.server_id("n3") == "3"
+    assert logcabin.server_addr("n1") == "n1:5254"
+    assert logcabin.server_addrs({"nodes": ["n1", "n2"]}) == \
+        "n1:5254,n2:5254"
+
+
+def test_logcabin_db_commands():
+    from jepsen_tpu.suites import logcabin
+
+    test, r = dummy_test(nodes=("n1",))
+    test["barrier"] = "no-barrier"
+    r.responses["stat /logcabin"] = (1, "", "no")
+    import time as time_mod
+
+    orig = time_mod.sleep
+    time_mod.sleep = lambda s: None
+    try:
+        logcabin.db().setup(test, "n1")
+    finally:
+        time_mod.sleep = orig
+    cmds = [e[2] for e in r.log if e[1] == "exec"]
+    assert any("git clone" in c for c in cmds)
+    assert any("scons" in c for c in cmds)
+    assert any("--bootstrap" in c for c in cmds), "primary bootstraps"
+    assert any("Reconfigure" in c for c in cmds)
+
+
+def test_logcabin_cas_client_over_ssh():
+    from jepsen_tpu.suites import logcabin
+
+    test, r = dummy_test(nodes=("n1",))
+    c = logcabin.CASClient().open(test, "n1")
+    # reads shell to TreeOps and parse JSON from stdout
+    r.responses["read /jepsen"] = (0, json.dumps(4), "")
+    out = c.invoke(test, mkop(type="invoke", f="read"))
+    assert out.type == "ok" and out.value == 4
+    # cas failure pattern -> :fail
+    r.responses["write /jepsen"] = (
+        1, "", "Exiting due to LogCabin::Client::Exception: Path "
+        "'/jepsen' has value '3', not '4' as required")
+    out = c.invoke(test, mkop(type="invoke", f="cas", value=(4, 5)))
+    assert out.type == "fail"
+
+
+# --- raftis ---------------------------------------------------------------
+
+
+def test_raftis_cluster_and_db():
+    from jepsen_tpu.suites import raftis
+
+    assert raftis.initial_cluster({"nodes": ["n1", "n2"]}) == \
+        "n1:8901,n2:8901"
+    test, r = dummy_test(nodes=("n1",))
+    r.responses["stat /"] = (1, "", "no")
+    r.responses["ls -A"] = (0, "raftis\n", "")
+    r.responses["dirname"] = (0, "/opt", "")
+    import time as time_mod
+
+    orig = time_mod.sleep
+    time_mod.sleep = lambda s: None
+    try:
+        raftis.db("v2.0.4").setup(test, "n1")
+    finally:
+        time_mod.sleep = orig
+    cmds = [e[2] for e in r.log if e[1] == "exec"]
+    assert any("n1:8901" in c and "start-stop-daemon" in c
+               for c in cmds)
+
+
+def test_raftis_test_constructs():
+    from jepsen_tpu.suites import raftis
+
+    t = raftis.raftis_test({"nodes": ["n1"], "time_limit": 1})
+    assert t["model"].name == "register"
+
+
+# --- robustirc ------------------------------------------------------------
+
+
+def test_robustirc_topic_parsing():
+    from jepsen_tpu.suites import robustirc
+
+    assert robustirc.parse_topic(
+        {"Data": ":n1!j@x TOPIC #jepsen :42"}) == 42
+    assert robustirc.parse_topic({"Data": "PRIVMSG #jepsen :42"}) is None
+    assert robustirc.parse_topic({"Data": "PING"}) is None
+
+
+def test_robustirc_daemon_cmd():
+    from jepsen_tpu.suites import robustirc
+
+    cmd = robustirc.daemon_cmd("n1", singlenode=True)
+    assert "-singlenode" in cmd and "-listen=n1:13001" in cmd
+    cmd2 = robustirc.daemon_cmd("n2", join="n1")
+    assert "-join=n1:13001" in cmd2
+
+
+def test_robustirc_message_id_deterministic_tail():
+    from jepsen_tpu.suites import robustirc
+
+    a = robustirc.message_id("TOPIC #jepsen :1")
+    b = robustirc.message_id("TOPIC #jepsen :1")
+    import hashlib
+
+    tail = int(hashlib.md5(b"TOPIC #jepsen :1").hexdigest()[17:], 16)
+    assert a & tail == tail and b & tail == tail
+
+
+# --- percona --------------------------------------------------------------
+
+
+def test_percona_cluster_address():
+    from jepsen_tpu.suites import percona
+
+    test = {"nodes": ["n1", "n2", "n3"]}
+    assert percona.cluster_address(test, "n1") == "gcomm://"
+    assert percona.cluster_address(test, "n2") == "gcomm://n1,n2,n3"
+
+
+def test_percona_db_commands():
+    from jepsen_tpu.suites import percona
+
+    test, r = dummy_test(nodes=("n1", "n2"))
+    test["barrier"] = "no-barrier"
+    r.responses["dpkg-query"] = (1, "", "not installed")
+    r.responses["apt-get install"] = (0, "", "")
+    percona.db("5.6.25-25.12-1.jessie").setup(test, "n1")
+    cmds = [e[2] for e in r.log if e[0] == "n1" and e[1] == "exec"]
+    assert any("debconf-set-selections" in c for c in cmds)
+    assert any("service mysql start bootstrap-pxc" in c for c in cmds)
+    assert any("create database if not exists jepsen" in c
+               for c in cmds)
+    # joiner does a plain start
+    test2, r2 = dummy_test(nodes=("n1", "n2"))
+    test2["barrier"] = "no-barrier"
+    r2.responses["dpkg-query"] = (1, "", "not installed")
+    percona.db("5.6.25-25.12-1.jessie").setup(test2, "n2")
+    cmds2 = [e[2] for e in r2.log if e[1] == "exec"]
+    assert any("service mysql start" in c and "bootstrap" not in c
+               for c in cmds2)
+
+
+def test_percona_bank_test_lock_types():
+    from jepsen_tpu.suites import percona
+
+    t = percona.bank_test({"lock_type": "share", "nodes": ["n1"]})
+    assert "share-lock" in t["name"]
+    assert t["client"].lock_type == " LOCK IN SHARE MODE"
+    assert t["total_amount"] == 50
+
+
+# --- mysql-cluster --------------------------------------------------------
+
+
+def test_mysql_cluster_node_ids_and_conf():
+    from jepsen_tpu.suites import mysql_cluster as mc
+
+    test = {"nodes": ["n1", "n2", "n3", "n4", "n5"]}
+    assert mc.mgmd_node_id(test, "n1") == 1
+    assert mc.ndbd_node_id(test, "n2") == 12
+    assert mc.mysqld_node_id(test, "n5") == 25
+    assert mc.ndbd_nodes(test) == ["n1", "n2", "n3", "n4"]
+    conf = mc.nodes_conf(test)
+    assert conf.count("[ndb_mgmd]") == 5
+    assert conf.count("[ndbd]") == 4  # storage on first four only
+    assert conf.count("[mysqld]") == 5
+    cnf = mc.my_cnf(test, "n2")
+    assert "ndb-nodeid=22" in cnf
+    assert "ndb-connectstring=n1,n2,n3,n4,n5" in cnf
+
+
+def test_mysql_cluster_start_order():
+    from jepsen_tpu.suites import mysql_cluster as mc
+
+    test, r = dummy_test(nodes=("n1",))
+    test["barrier"] = "no-barrier"
+    import time as time_mod
+
+    orig = time_mod.sleep
+    time_mod.sleep = lambda s: None
+    try:
+        mc.db("7.4.6").setup(test, "n1")
+    finally:
+        time_mod.sleep = orig
+    cmds = [e[2] for e in r.log if e[1] == "exec"]
+    mgmd = [i for i, c in enumerate(cmds) if "ndb_mgmd" in c]
+    ndbd = [i for i, c in enumerate(cmds)
+            if "/bin/ndbd" in c]
+    mysqld = [i for i, c in enumerate(cmds) if "mysqld_safe" in c]
+    assert mgmd and ndbd and mysqld
+    assert mgmd[0] < ndbd[0] < mysqld[0]
+
+
+# --- postgres-rds ---------------------------------------------------------
+
+
+def test_postgres_rds_test_shape():
+    from jepsen_tpu import nemesis as nemesis_mod
+    from jepsen_tpu.suites import postgres_rds
+
+    t = postgres_rds.bank_test({"nodes": ["rds.example.com"],
+                                "time_limit": 1})
+    # managed service: no db automation, no-op nemesis
+    assert t["nemesis"] is nemesis_mod.noop
+    assert t["total_amount"] == 50
+    assert t["client"].n == 5
+
+
+# --- dgraph ---------------------------------------------------------------
+
+
+def test_dgraph_db_commands():
+    from jepsen_tpu.suites import dgraph
+
+    test, r = dummy_test(nodes=("n1", "n2"))
+    test["barrier"] = "no-barrier"
+    r.responses["stat /"] = (1, "", "no")
+    r.responses["ls -A"] = (0, "dgraph\n", "")
+    r.responses["dirname"] = (0, "/opt", "")
+    import time as time_mod
+
+    orig = time_mod.sleep
+    time_mod.sleep = lambda s: None
+    try:
+        dgraph.db().setup(test, "n2")
+    finally:
+        time_mod.sleep = orig
+    cmds = [e[2] for e in r.log if e[1] == "exec"]
+    zero = [c for c in cmds if " zero " in c or c.endswith(" zero")]
+    assert any("--peer n1:5080" in c for c in cmds), "n2 joins primary"
+    assert any("server" in c and "--zero n2:5080" in c for c in cmds)
+
+
+def test_dgraph_upsert_checker():
+    from jepsen_tpu.suites import dgraph
+
+    ch = dgraph.upsert_checker()
+    good = [mkop(index=0, f="upsert", value="0x1"),
+            mkop(index=1, f="read", value=["0x1"])]
+    assert ch.check({}, good)["valid"] is True
+    two_ok = good + [mkop(index=2, f="upsert", value="0x2")]
+    assert ch.check({}, two_ok)["valid"] is False
+    multi_read = good + [mkop(index=3, f="read",
+                              value=["0x1", "0x2"])]
+    assert ch.check({}, multi_read)["valid"] is False
+
+
+def test_dgraph_delete_checker():
+    from jepsen_tpu.suites import dgraph
+
+    ch = dgraph.delete_checker()
+    ok = [mkop(index=0, value=[5]), mkop(index=1, value=[])]
+    assert ch.check({}, ok)["valid"] is True
+    bad = ok + [mkop(index=2, value=[5, 5])]
+    assert ch.check({}, bad)["valid"] is False
+
+
+def test_dgraph_workloads_construct():
+    from jepsen_tpu.suites import dgraph
+
+    for wl in dgraph.WORKLOADS:
+        t = dgraph.dgraph_test({"workload": wl, "nodes": ["n1"],
+                                "time_limit": 1})
+        assert wl in t["name"]
+        assert t["checker"] is not None

@@ -1,0 +1,74 @@
+#!/bin/bash
+# Tier-3 smoke: bring up the 5-node cluster and run real suites against
+# it over SSH — the analog of the reference's ssh-test tier
+# (jepsen/test/jepsen/core_test.clj:32-86, which drives cd+echo over
+# real SSH to n1..n5).
+#
+# Usage:  docker/smoke.sh [--keep]
+#
+# Steps:
+#   1. build + start jepsen-control and n1..n5 (up.sh)
+#   2. wait until every node answers SSH from the control container
+#   3. run the atomdemo suite (in-process db; exercises the full
+#      runner/checker/store pipeline inside the container)
+#   4. run the etcdemo register workload against n1..n5 (real db
+#      install over SSH, partition nemesis, TPU/CPU checker)
+#   5. assert both runs produced results.json with "valid": true
+#   6. docker compose down (unless --keep)
+#
+# Requires a docker daemon; this is the one tier that cannot run in the
+# sandboxed build image (no docker, no sshd) — run it on any docker host.
+set -euo pipefail
+cd "$(dirname "$0")"
+
+KEEP=${1:-}
+
+./up.sh
+
+cleanup() {
+  if [ "$KEEP" != "--keep" ]; then
+    docker compose down -v
+  fi
+}
+trap cleanup EXIT
+
+echo "== waiting for SSH on n1..n5"
+for n in n1 n2 n3 n4 n5; do
+  for i in $(seq 1 60); do
+    if docker exec jepsen-control \
+         ssh -o StrictHostKeyChecking=no -o ConnectTimeout=2 \
+         root@"$n" true 2>/dev/null; then
+      echo "  $n up"
+      break
+    fi
+    [ "$i" = 60 ] && { echo "  $n NEVER came up"; exit 1; }
+    sleep 2
+  done
+done
+
+check_valid() {
+  # $1: store glob inside the control container
+  docker exec jepsen-control python - "$1" <<'PY'
+import glob, json, sys
+paths = sorted(glob.glob(sys.argv[1]))
+assert paths, f"no results at {sys.argv[1]}"
+r = json.load(open(paths[-1]))
+assert r.get("valid") is True, f"run INVALID: {r}"
+print("valid:", paths[-1])
+PY
+}
+
+echo "== tier 2: atomdemo (in-process db, full pipeline)"
+docker exec jepsen-control \
+  python -m jepsen_tpu.suites.atomdemo test --time-limit 10 \
+  --concurrency 5
+check_valid "store/atom*/latest/results.json"
+
+echo "== tier 3: etcdemo register over SSH against n1..n5"
+docker exec jepsen-control \
+  python -m jepsen_tpu.suites.etcdemo test -w register \
+  --node n1 --node n2 --node n3 --node n4 --node n5 \
+  --time-limit 60 --concurrency 5
+check_valid "store/etcd*/latest/results.json"
+
+echo "== smoke OK"

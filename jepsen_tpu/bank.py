@@ -19,21 +19,23 @@ def bank_read(test, process):
     return {"type": "invoke", "f": "read", "value": None}
 
 
-def bank_transfer(n: int):
+def bank_transfer(n: int, min_amount: int = 0, max_amount: int = 4):
     """Transfer between two *different* accounts (bank.clj:41-55's
-    diff-transfer)."""
+    diff-transfer).  Default amount range matches bank.clj's
+    (rand-int 5)."""
 
     def op(test, process):
         frm, to = random.sample(range(n), 2)
         return {"type": "invoke", "f": "transfer",
                 "value": {"from": frm, "to": to,
-                          "amount": random.randrange(5)}}
+                          "amount": random.randint(min_amount,
+                                                   max_amount)}}
 
     return op
 
 
 def sql_bank_body(cur, op, n: int, *, lock_type: str = "",
-                  in_place: bool = False):
+                  in_place: bool = False, lock_reads: bool = True):
     """One bank op against a DB-API cursor inside an open transaction
     (percona.clj:247-287 / postgres_rds.clj:163-204 / tidb bank.clj:33-90).
 
@@ -42,7 +44,12 @@ def sql_bank_body(cur, op, n: int, *, lock_type: str = "",
     (:fail — determinate), then write back either in place or by
     absolute value."""
     if op.f == "read":
-        cur.execute("select id, balance from accounts" + lock_type)
+        # percona locks its bank reads (percona.clj:247-250) but tidb
+        # deliberately snapshot-reads (tidb bank.clj:36-38) — a locked
+        # read would serialize against transfers and mask exactly the
+        # fractured-total anomalies the checker hunts
+        cur.execute("select id, balance from accounts"
+                    + (lock_type if lock_reads else ""))
         rows = dict(cur.fetchall())
         return replace(op, type="ok",
                        value={i: rows.get(i) for i in range(n)})

@@ -25,6 +25,7 @@ with one thread per node (util.real_pmap, mirroring control.clj:357).
 
 from __future__ import annotations
 
+import logging
 import os
 import shlex
 import subprocess
@@ -33,6 +34,33 @@ from dataclasses import dataclass, field, replace
 from typing import Callable, Iterable, Optional
 
 from .util import real_pmap
+
+
+log = logging.getLogger("jepsen")
+
+
+class _TraceState(threading.local):
+    """Thread-scoped command tracing (the *trace* dynamic var +
+    c/trace macro, control.clj:116-120,262-266)."""
+
+    on = False
+
+
+_TRACE = _TraceState()
+
+
+class trace:
+    """``with control.trace(): ...`` logs every command + reply run by
+    the current thread (control.clj:262-266)."""
+
+    def __enter__(self):
+        self._prev = _TRACE.on
+        _TRACE.on = True
+        return self
+
+    def __exit__(self, *exc):
+        _TRACE.on = self._prev
+        return False
 
 
 class RemoteError(Exception):
@@ -237,12 +265,17 @@ class Session:
         exit, return trimmed stdout (control.clj:176-197)."""
         cmd = " ".join(a.raw if isinstance(a, Lit) else escape(a)
                        for a in args)
+        if _TRACE.on:
+            log.info("trace %s> %s", self.node, cmd)
         last: Exception | None = None
         for _ in range(max(1, self.retries)):
             try:
                 res = self.exec_raw(cmd, timeout=timeout)
                 if res.exit != 0:
                     raise RemoteError(cmd, res.exit, res.out, res.err)
+                if _TRACE.on:
+                    log.info("trace %s< %s", self.node,
+                             res.out.strip()[:200])
                 return res.out.strip()
             except (subprocess.TimeoutExpired, OSError) as e:
                 last = e  # transport flake: retry (control.clj:141-161)

@@ -207,3 +207,41 @@ def daemon_running(sess: Session, pidfile: str) -> bool:
         return True
     except RemoteError:
         return False
+
+
+# ---------------------------------------------------------------------------
+# packet capture (cockroachdb/src/jepsen/cockroach/auto.clj:67-76)
+# ---------------------------------------------------------------------------
+
+TCPDUMP_PID = "/var/run/jepsen-tcpdump.pid"
+
+
+def start_tcpdump(sess: Session, pcap_file: str, *,
+                  port: int | None = None,
+                  filter_expr: str | None = None,
+                  iface: str = "any") -> None:
+    """Capture packets to pcap_file in the background — the wire-level
+    debugging companion to command tracing (auto.clj:67-76 captures the
+    cockroach client port during every run)."""
+    expr = filter_expr if filter_expr is not None else \
+        (f"port {port}" if port is not None else "")
+    argv = ["start-stop-daemon", "--start", "--background",
+            "--make-pidfile", "--pidfile", TCPDUMP_PID,
+            "--exec", "/usr/sbin/tcpdump", "--",
+            "-w", pcap_file, "-i", iface]
+    if expr:
+        argv += expr.split()
+    sess.su().exec(*argv)
+
+
+def stop_tcpdump(sess: Session) -> None:
+    """auto.clj's teardown kill of the capture daemon."""
+    su = sess.su()
+    try:
+        grepkill(su, "tcpdump")
+    except RemoteError:
+        pass
+    try:
+        su.exec("rm", "-rf", TCPDUMP_PID)
+    except RemoteError:
+        pass

@@ -84,15 +84,36 @@ def serializable_test(test: dict) -> dict:
             if k not in NONSERIALIZABLE_KEYS}
 
 
+#: chunk size for buffered history writes (util.clj:161-166's 16,384-op
+#: parallel-writer threshold)
+HISTORY_CHUNK = 16384
+
+
+def _encode_chunk(ops: list) -> str:
+    out = []
+    for op in ops:
+        d = op.to_dict() if isinstance(op, Op) else op
+        out.append(json.dumps(_jsonable(d)))
+    return "\n".join(out) + "\n"
+
+
 def write_history(test: dict, history: Iterable[Op],
                   fname: str = "history.jsonl") -> str:
     """One op per line (the analog of history.txt + history.edn,
-    store.clj:267-279)."""
+    store.clj:267-279).
+
+    Long histories are encoded and flushed in 16k-op chunks — the shape
+    of util.clj:156-178's chunked history writer.  The reference
+    parallelizes the per-chunk encode across JVM threads; CPython's
+    json.dumps holds the GIL, so threads buy nothing here and the win is
+    the chunked buffering (one write syscall per 16k ops) — histories
+    big enough for encode throughput to matter ride the columnar OpSeq
+    path instead."""
     p = path_mkdirs(test, fname)
+    ops = history if isinstance(history, list) else list(history)
     with open(p, "w") as f:
-        for op in history:
-            d = op.to_dict() if isinstance(op, Op) else op
-            f.write(json.dumps(_jsonable(d)) + "\n")
+        for i in range(0, len(ops), HISTORY_CHUNK):
+            f.write(_encode_chunk(ops[i:i + HISTORY_CHUNK]))
     return p
 
 

@@ -162,16 +162,32 @@ class IRCSession:
         (robustirc.clj:123-135)."""
         import time
 
+        import socket
+
         out = []
         deadline = time.time() + timeout_s
         r = self._req("GET",
                       f"/robustirc/v1/{self.session_id}/messages"
                       "?lastseen=0.0", auth=True, stream=True)
         try:
+            # the stream stays open once history is replayed: bound each
+            # read by the remaining deadline and keep what we have on
+            # timeout (the reference's jepsen.util/timeout wrapper
+            # returns the accumulated atom, robustirc.clj:123-135)
+            sock = getattr(r, "fp", None)
             dec = json.JSONDecoder()
             buf = ""
             while time.time() < deadline:
-                chunk = r.read(4096)
+                remaining = deadline - time.time()
+                try:
+                    if sock is not None and hasattr(r, "fp") and                             r.fp is not None:
+                        r.fp.raw._sock.settimeout(max(0.05, remaining))
+                except Exception:
+                    pass
+                try:
+                    chunk = r.read(4096)
+                except (TimeoutError, socket.timeout, OSError):
+                    break
                 if not chunk:
                     break
                 buf += chunk.decode()
@@ -196,13 +212,14 @@ class SetClient(client_mod.Client):
         self.session = None
 
     def open(self, test, node):
-        return type(self)(node)
-
-    def setup(self, test):
-        self.session = IRCSession(self.node)
-        self.session.post(f"NICK {self.node}")
-        self.session.post("USER j j j j")
-        self.session.post("JOIN #jepsen")
+        # the session must be (re)established here: the worker reopens
+        # crashed clients via open() alone, never setup()
+        c = type(self)(node)
+        c.session = IRCSession(node)
+        c.session.post(f"NICK {node}")
+        c.session.post("USER j j j j")
+        c.session.post("JOIN #jepsen")
+        return c
 
     def invoke(self, test, op):
         try:

@@ -326,7 +326,8 @@ class BankClient(TiDBClient):
         from ..bank import sql_bank_body
 
         return self.txn(op, lambda cur: sql_bank_body(
-            cur, op, self.n, lock_type=" for update"))
+            cur, op, self.n, lock_type=" for update",
+            lock_reads=False))
 
 
 class SetsClient(TiDBClient):
@@ -433,7 +434,8 @@ def bank_workload(opts) -> dict:
 
     from ..bank import bank_read, bank_transfer
 
-    read, transfer = bank_read, bank_transfer(n)
+    read, transfer = bank_read, bank_transfer(n, min_amount=1,
+                                              max_amount=5)
     return {
         "client": BankClient(n=n),
         "total_amount": n * 10,

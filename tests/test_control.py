@@ -175,3 +175,50 @@ def test_ssh_remote_command_construction():
     assert "-p" in args and "2222" in args[args.index("-p") + 1]
     assert "-i" in args and "/k" in args
     assert any("ControlMaster" in a for a in args)
+
+
+def test_command_trace_logs(caplog):
+    import logging
+
+    r = DummyRemote()
+    sess = Session(node="n1", remote=r)
+    with caplog.at_level(logging.INFO, logger="jepsen"):
+        sess.exec("echo", "untraced")
+        with control.trace():
+            sess.exec("echo", "traced-cmd")
+        sess.exec("echo", "after")
+    traced = [rec.message for rec in caplog.records
+              if "trace" in rec.message]
+    assert any("traced-cmd" in m and "n1>" in m for m in traced)
+    assert not any("untraced" in m for m in traced)
+    assert not any("after" in m for m in traced)
+
+
+def test_trace_is_thread_scoped():
+    import threading
+
+    r = DummyRemote()
+    seen = []
+
+    def other():
+        seen.append(control._TRACE.on)
+
+    with control.trace():
+        t = threading.Thread(target=other)
+        t.start()
+        t.join()
+        assert control._TRACE.on is True
+    assert seen == [False]
+    assert control._TRACE.on is False
+
+
+def test_tcpdump_capture_commands():
+    r = DummyRemote()
+    sess = Session(node="n1", remote=r)
+    cu.start_tcpdump(sess, "/tmp/jepsen.pcap", port=26257)
+    cmds = [e[2] for e in r.log if e[1] == "exec"]
+    assert any("tcpdump" in c and "-w /tmp/jepsen.pcap" in c
+               and "port 26257" in c for c in cmds)
+    cu.stop_tcpdump(sess)
+    cmds = [e[2] for e in r.log if e[1] == "exec"]
+    assert any("kill" in c or "pkill" in c or "grep" in c for c in cmds)

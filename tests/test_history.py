@@ -112,3 +112,30 @@ def test_max_concurrency():
     )
     seq = encode_ops(hist, FC)
     assert max_concurrency(seq) == 2
+
+
+def test_chunked_history_writer_roundtrip(tmp_path, monkeypatch):
+    """>16k ops take the chunked path; bytes must be identical to a
+    1-op-per-chunk write and order exact (util.clj:156-178 parity)."""
+    from jepsen_tpu import store
+    from jepsen_tpu.history import invoke_op, ok_op
+
+    ops = []
+    for i in range(20_000):
+        ops.append(invoke_op(i % 7, "write", i))
+        ops.append(ok_op(i % 7, "write", i))
+    test = {"name": "pwriter", "start_time": "t1",
+            "store_root": str(tmp_path)}
+    p = store.write_history(test, ops)
+    chunked_bytes = open(p, "rb").read()
+    assert chunked_bytes.count(b"\n") == len(ops)
+
+    monkeypatch.setattr(store, "HISTORY_CHUNK", 1)
+    test2 = {"name": "pwriter", "start_time": "t2",
+             "store_root": str(tmp_path)}
+    p2 = store.write_history(test2, ops)
+    assert open(p2, "rb").read() == chunked_bytes
+
+    back = store.read_history(p)
+    assert len(back) == len(ops)
+    assert back[0].f == "write" and back[-1].value == 19_999

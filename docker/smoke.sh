@@ -48,7 +48,7 @@ done
 
 check_valid() {
   # $1: store glob inside the control container
-  docker exec jepsen-control python - "$1" <<'PY'
+  docker exec -i jepsen-control python - "$1" <<'PY'
 import glob, json, sys
 paths = sorted(glob.glob(sys.argv[1]))
 assert paths, f"no results at {sys.argv[1]}"

@@ -89,31 +89,29 @@ def serializable_test(test: dict) -> dict:
 HISTORY_CHUNK = 16384
 
 
-def _encode_chunk(ops: list) -> str:
-    out = []
-    for op in ops:
-        d = op.to_dict() if isinstance(op, Op) else op
-        out.append(json.dumps(_jsonable(d)))
-    return "\n".join(out) + "\n"
-
-
 def write_history(test: dict, history: Iterable[Op],
                   fname: str = "history.jsonl") -> str:
     """One op per line (the analog of history.txt + history.edn,
     store.clj:267-279).
 
-    Long histories are encoded and flushed in 16k-op chunks — the shape
-    of util.clj:156-178's chunked history writer.  The reference
+    Streams: ops are encoded one at a time (generators never
+    materialize) and flushed in 16k-op chunks — the shape of
+    util.clj:156-178's chunked history writer.  The reference
     parallelizes the per-chunk encode across JVM threads; CPython's
-    json.dumps holds the GIL, so threads buy nothing here and the win is
-    the chunked buffering (one write syscall per 16k ops) — histories
+    json.dumps holds the GIL, so threads buy nothing here — histories
     big enough for encode throughput to matter ride the columnar OpSeq
     path instead."""
     p = path_mkdirs(test, fname)
-    ops = history if isinstance(history, list) else list(history)
     with open(p, "w") as f:
-        for i in range(0, len(ops), HISTORY_CHUNK):
-            f.write(_encode_chunk(ops[i:i + HISTORY_CHUNK]))
+        buf: list[str] = []
+        for op in history:
+            d = op.to_dict() if isinstance(op, Op) else op
+            buf.append(json.dumps(_jsonable(d)))
+            if len(buf) >= HISTORY_CHUNK:
+                f.write("\n".join(buf) + "\n")
+                buf.clear()
+        if buf:
+            f.write("\n".join(buf) + "\n")
     return p
 
 

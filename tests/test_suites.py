@@ -133,12 +133,13 @@ def test_hazelcast_lock_end_to_end_valid_and_broken():
         svc = hazelcast.InProcessLockService()
         svc.broken = broken
         opts = {"nodes": ["n1", "n2"], "concurrency": 3, "time_limit": 2,
-                "rate": 200, "workload": "lock", "name": None}
+                "rate": 200, "workload": "lock-fixture", "name": None}
         test = hazelcast.hazelcast_test(opts)
         test["client"] = hazelcast.LockClient(svc)
         test["name"] = None  # no store writes
         # drop perf graphs for unit-test speed
-        test["checker"] = hazelcast.lock_workload(opts, svc)["checker"]
+        test["checker"] = hazelcast.lock_fixture_workload(
+            opts, svc)["checker"]
         return test
 
     good = core.run(make(False))
@@ -149,7 +150,7 @@ def test_hazelcast_lock_end_to_end_valid_and_broken():
 
 
 def test_unique_ids_workload():
-    wl = hazelcast.unique_ids_workload({})
+    wl = hazelcast.unique_ids_fixture_workload({})
     c = wl["client"].open({}, "n1")
     vals = {c.invoke({}, invoke_op(0, "generate", None)).value
             for _ in range(10)}
@@ -416,3 +417,127 @@ def test_cockroach_bump_time_targeting():
     cmds = [e[2] for e in r.log if e[1] == "exec"]
     assert any("ntpdate" in c for c in cmds)
     assert any("start-stop-daemon --start" in c for c in cmds)
+
+
+def test_hazelcast_db_commands():
+    import os
+    import tempfile
+
+    from test_suites import dummy_test
+
+    test, r = dummy_test(nodes=("n1", "n2"))
+    r.responses["getent ahosts n2"] = (0, "10.0.0.2 STREAM n2\n", "")
+    import time as time_mod
+
+    orig = time_mod.sleep
+    time_mod.sleep = lambda s: None
+    try:
+        with tempfile.NamedTemporaryFile(suffix=".jar") as jar:
+            hazelcast.db(jar.name).setup(test, "n1")
+    finally:
+        time_mod.sleep = orig
+    cmds = [e[2] for e in r.log if e[1] == "exec"]
+    assert any("start-stop-daemon" in c
+               and "-jar /opt/hazelcast/server.jar" in c
+               and "--members 10.0.0.2" in c for c in cmds)
+    ups = [e for e in r.log if e[1] == "upload"]
+    assert any("/opt/hazelcast/server.jar" in str(e) for e in ups)
+
+
+def test_hazelcast_rest_queue_client():
+    import http.server
+    import threading as th
+
+    q = []
+
+    class H(http.server.BaseHTTPRequestHandler):
+        def do_POST(self):
+            n = int(self.headers.get("Content-Length", 0))
+            q.append(int(self.rfile.read(n)))
+            self.send_response(200)
+            self.end_headers()
+
+        def do_DELETE(self):
+            if q:
+                body = str(q.pop(0)).encode()
+                self.send_response(200)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+            else:
+                self.send_response(204)
+                self.end_headers()
+
+        def log_message(self, *a):
+            pass
+
+    srv = http.server.HTTPServer(("127.0.0.1", 0), H)
+    th.Thread(target=srv.serve_forever, daemon=True).start()
+    try:
+        c = hazelcast.RestQueueClient()
+        c.node = "127.0.0.1"
+        old_port = hazelcast.PORT
+        hazelcast.PORT = srv.server_address[1]
+        try:
+            out = c.invoke({}, invoke_op(0, "enqueue", 7))
+            assert out.type == "ok"
+            out = c.invoke({}, invoke_op(0, "dequeue", None))
+            assert out.type == "ok" and out.value == 7
+            out = c.invoke({}, invoke_op(0, "dequeue", None))
+            assert out.type == "fail" and out.error == "empty"
+            c.invoke({}, invoke_op(0, "enqueue", 8))
+            c.invoke({}, invoke_op(0, "enqueue", 9))
+            out = c.invoke({}, invoke_op(0, "drain", None))
+            assert out.type == "ok" and out.value == [8, 9]
+        finally:
+            hazelcast.PORT = old_port
+    finally:
+        srv.shutdown()
+
+
+def test_hazelcast_memcache_id_client():
+    import socket as sock_mod
+    import threading as th
+
+    state = {"n": 0}
+
+    def server(srv):
+        while True:
+            try:
+                conn, _ = srv.accept()
+            except OSError:
+                return
+            f = conn.makefile("rb")
+
+            def serve(conn=conn, f=f):
+                while True:
+                    line = f.readline()
+                    if not line:
+                        return
+                    parts = line.decode().split()
+                    if parts and parts[0] == "add":
+                        f.readline()  # payload
+                        conn.sendall(b"STORED\r\n")
+                    elif parts and parts[0] == "incr":
+                        state["n"] += int(parts[2])
+                        conn.sendall(f"{state['n']}\r\n".encode())
+
+            th.Thread(target=serve, daemon=True).start()
+
+    srv = sock_mod.socket()
+    srv.bind(("127.0.0.1", 0))
+    srv.listen(4)
+    th.Thread(target=server, args=(srv,), daemon=True).start()
+    try:
+        old_port = hazelcast.PORT
+        hazelcast.PORT = srv.getsockname()[1]
+        try:
+            c = hazelcast.MemcacheIdClient()
+            c.node = "127.0.0.1"
+            vals = [c.invoke({}, invoke_op(0, "generate", None)).value
+                    for _ in range(5)]
+            assert vals == [1, 2, 3, 4, 5]
+        finally:
+            hazelcast.PORT = old_port
+    finally:
+        srv.close()

@@ -23,11 +23,18 @@ against knossos — the absolute configs/sec figures are printed so an
 offline knossos comparison can be made.
 
 Robustness contract (VERDICT r1 item 1): this script ALWAYS emits its
-JSON line.  The TPU (axon PJRT plugin) can take many minutes of wall
-clock on first backend touch, or hang forever when the tunnel is down, so
-the backend is probed in a subprocess while the host-oracle baseline runs
-in parallel; benchmark tiers run smallest-first under a wall-clock budget;
-and SIGTERM/SIGALRM print the best completed tier before exiting.
+JSON line.  The TPU (axon PJRT plugin) can take minutes of wall clock on
+first backend touch, hang forever when the tunnel is down, or KILL its
+worker if any single execution outlives its ~60s watchdog — and a
+crashed worker poisons the whole process's jax backend.  So:
+
+  * the backend is probed in a subprocess while the host-oracle baseline
+    runs in the parent;
+  * every device tier runs in its OWN subprocess (``--run-tier``) with a
+    parent-side timeout: a worker crash costs one tier, not the bench,
+    and the parent retries the tier on a pinned-CPU child;
+  * tiers run smallest-first under a wall-clock budget, and
+    SIGTERM/SIGALRM print the best completed tier before exiting.
 """
 
 import json
@@ -47,11 +54,33 @@ T0 = time.time()
 # is unknown; stay comfortably inside a 30-minute envelope by default.
 BUDGET_S = float(os.environ.get("BENCH_BUDGET_S", "300" if QUICK else "1500"))
 # Backend probe budget: axon first touch has been observed to take ~9min.
-PROBE_S = float(os.environ.get("BENCH_PROBE_S", "60" if QUICK else "680"))
+PROBE_S = float(os.environ.get("BENCH_PROBE_S", "60" if QUICK else "420"))
+
+#: (name, n_ops, n_procs, device budget, oracle cap)
+TIERS = [("1k", 1_000, 32, 2_000_000, 200_000),
+         ("10k", 10_000, 32, 50_000_000, 1_000_000)]
 
 _BEST: dict | None = None
 _EMITTED = False
 _PROBE: "subprocess.Popen | None" = None
+_CHILD: "subprocess.Popen | None" = None
+
+
+def make_seq(name: str):
+    """Deterministic per-tier history (seeded by the tier name, so child
+    processes rebuild the identical history)."""
+    from jepsen_tpu.history import encode_ops
+    from jepsen_tpu.models import cas_register
+    from jepsen_tpu.synth import corrupt_read, register_history
+
+    spec = {t[0]: t for t in TIERS}[name]
+    _, n_ops, n_procs, _, _ = spec
+    rng = random.Random(f"bench-{name}")
+    model = cas_register()
+    h = register_history(rng, n_ops=n_ops, n_procs=n_procs, overlap=8,
+                         crash_p=0.002, max_crashes=8, n_values=4)
+    h = corrupt_read(rng, h, at=0.98)
+    return encode_ops(h, model.f_codes), model
 
 
 def _remaining() -> float:
@@ -72,12 +101,13 @@ def _emit():
 
 
 def _reap_probe():
-    if _PROBE is not None and _PROBE.poll() is None:
-        try:
-            _PROBE.kill()
-            _PROBE.wait(timeout=5)
-        except Exception:
-            pass
+    for proc in (_PROBE, _CHILD):
+        if proc is not None and proc.poll() is None:
+            try:
+                proc.kill()
+                proc.wait(timeout=5)
+            except Exception:
+                pass
 
 
 def _bail(why: str):
@@ -92,27 +122,26 @@ def _on_signal(signum, frame):
     _bail(f"signal {signum}")
 
 
-for _sig in (signal.SIGTERM, signal.SIGINT, signal.SIGALRM, signal.SIGHUP):
-    try:
-        signal.signal(_sig, _on_signal)
-    except (OSError, ValueError):
-        pass
+def _install_guards():
+    for _sig in (signal.SIGTERM, signal.SIGINT, signal.SIGALRM,
+                 signal.SIGHUP):
+        try:
+            signal.signal(_sig, _on_signal)
+        except (OSError, ValueError):
+            pass
 
-# Two layers of deadline enforcement: an alarm (covers pure-Python
-# blocking) and a watchdog thread (covers the main thread being stuck in
-# non-interruptible C code — e.g. this process's own first PJRT backend
-# touch, where Python signal handlers never get to run).
-signal.alarm(max(10, int(BUDGET_S - 5)))
+    # Two layers of deadline enforcement: an alarm (covers pure-Python
+    # blocking) and a watchdog thread (covers the main thread stuck in
+    # non-interruptible C code).
+    signal.alarm(max(10, int(BUDGET_S - 5)))
 
+    import threading
 
-def _watchdog():
-    time.sleep(max(10, BUDGET_S - 2))
-    _bail("watchdog deadline")
+    def _watchdog():
+        time.sleep(max(10, BUDGET_S - 2))
+        _bail("watchdog deadline")
 
-
-import threading  # noqa: E402
-
-threading.Thread(target=_watchdog, daemon=True).start()
+    threading.Thread(target=_watchdog, daemon=True).start()
 
 
 def start_probe() -> subprocess.Popen:
@@ -146,39 +175,97 @@ def finish_probe(proc: subprocess.Popen, timeout: float) -> str | None:
     return platform
 
 
+# ---------------------------------------------------------------------------
+# child: run one tier in this process, print one JSON line
+# ---------------------------------------------------------------------------
+
+
+def run_tier_child(name: str, budget: int) -> None:
+    import jax
+
+    if os.environ.get("JAX_PLATFORMS") == "cpu":
+        # the sitecustomize-registered TPU plugin ignores the env var
+        # alone; the config pin must land before first backend touch
+        # (tests/conftest.py:10-23)
+        jax.config.update("jax_platforms", "cpu")
+
+    from jepsen_tpu.checker import linearizable as lin
+
+    seq, model = make_seq(name)
+
+    deadline = T0 + float(os.environ.get("BENCH_CHILD_S", "1e9"))
+    t0 = time.perf_counter()
+    out = lin.search_opseq(seq, model, budget=budget)
+    t_first = time.perf_counter() - t0
+    t_dev = t_first  # compile-inclusive, as a floor
+    # re-run compile-free only when it fits the parent's window
+    if time.time() + t_first * 1.3 + 20 < deadline:
+        t0 = time.perf_counter()
+        out = lin.search_opseq(seq, model, budget=budget)
+        t_dev = time.perf_counter() - t0
+    print(json.dumps({
+        "configs": out["configs"],
+        "t_dev": t_dev,
+        "t_first": t_first,
+        "valid": out["valid"],
+        "window": out.get("window"),
+        "concurrency": out.get("concurrency"),
+        "engine": out.get("engine"),
+        "n_ops": len(seq),
+        "backend": jax.default_backend(),
+    }), flush=True)
+
+
+def run_tier(name: str, budget: int, *, force_cpu: bool,
+             timeout: float) -> dict | None:
+    """Spawn a tier child; returns its parsed JSON or None."""
+    global _CHILD
+    env = dict(os.environ)
+    env["BENCH_CHILD_S"] = str(max(5.0, timeout))
+    if force_cpu:
+        env["JAX_PLATFORMS"] = "cpu"
+    proc = _CHILD = subprocess.Popen(
+        [sys.executable, os.path.abspath(__file__),
+         "--run-tier", name, "--budget", str(budget)],
+        stdout=subprocess.PIPE, stderr=sys.stderr, text=True, env=env)
+    try:
+        out, _ = proc.communicate(timeout=max(5.0, timeout))
+    except subprocess.TimeoutExpired:
+        proc.kill()
+        try:
+            proc.wait(timeout=5)
+        except subprocess.TimeoutExpired:
+            pass
+        print(f"bench: tier {name} child timed out ({timeout:.0f}s)",
+              file=sys.stderr)
+        return None
+    if proc.returncode != 0 or not out.strip():
+        print(f"bench: tier {name} child failed rc={proc.returncode}",
+              file=sys.stderr)
+        return None
+    try:
+        return json.loads(out.strip().splitlines()[-1])
+    except json.JSONDecodeError:
+        return None
+
+
 def main():
     global _BEST, _PROBE
 
+    _install_guards()
     probe = _PROBE = start_probe()
 
-    # --- host-side work that needs no jax: histories + oracle baseline ---
     from jepsen_tpu.checker import seq as oracle
-    from jepsen_tpu.history import encode_ops
-    from jepsen_tpu.models import cas_register
-    from jepsen_tpu.synth import corrupt_read, register_history
 
-    rng = random.Random(42)
-    model = cas_register()
-
-    tiers = [  # (name, n_ops, n_procs, device budget, oracle cap)
-        ("1k", 1_000, 32, 2_000_000, 200_000),
-    ]
-    if not QUICK:
-        tiers.append(("10k", 10_000, 32, 50_000_000, 1_000_000))
-
-    seqs = {}
-    for name, n_ops, n_procs, _, _ in tiers:
-        h = register_history(rng, n_ops=n_ops, n_procs=n_procs, overlap=8,
-                             crash_p=0.002, max_crashes=8, n_values=4)
-        h = corrupt_read(rng, h, at=0.98)
-        seqs[name] = encode_ops(h, model.f_codes)
+    tiers = TIERS[:1] if QUICK else TIERS
 
     # Oracle baseline on the largest tier's history (runs while the
     # backend probe warms the tunnel in the subprocess).
     big = tiers[-1][0]
     cap = tiers[-1][4]
+    seq_big, model = make_seq(big)
     t0 = time.perf_counter()
-    ref = oracle.check_opseq(seqs[big], model, max_configs=cap)
+    ref = oracle.check_opseq(seq_big, model, max_configs=cap)
     t_ref = time.perf_counter() - t0
     ref_rate = ref["configs"] / t_ref if t_ref > 0 else float("inf")
     print(f"bench: oracle {ref['configs']} configs in {t_ref:.1f}s "
@@ -186,27 +273,19 @@ def main():
 
     # --- bring up the backend ------------------------------------------
     platform = finish_probe(probe, min(PROBE_S, _remaining() - 60))
-    if platform is None:
+    force_cpu = platform is None
+    if force_cpu:
         print("bench: accelerator unreachable within probe budget; "
               "forcing CPU backend", file=sys.stderr)
-        os.environ["JAX_PLATFORMS"] = "cpu"
-        import jax
-        jax.config.update("jax_platforms", "cpu")
         platform = "cpu"
     else:
         print(f"bench: backend '{platform}' is up "
               f"({time.time()-T0:.0f}s in)", file=sys.stderr)
-    import jax
-
-    from jepsen_tpu.checker import linearizable as lin
 
     # --- tiered device ladder: smallest first, best completed wins ------
     measured_rate = None
     for name, n_ops, n_procs, budget, _ in tiers:
-        seq = seqs[name]
-        # compile + measure in one run first (counts against budget),
-        # then re-run timed if time allows.
-        if _remaining() < 30:
+        if _remaining() < 45:
             print(f"bench: skipping tier {name} (out of budget)",
                   file=sys.stderr)
             break
@@ -217,20 +296,24 @@ def main():
                       f"{_remaining():.0f}s left at "
                       f"{measured_rate:,.0f} configs/s)", file=sys.stderr)
                 break
-        t0 = time.perf_counter()
-        out = lin.search_opseq(seq, model, budget=budget)
-        t_first = time.perf_counter() - t0
-        t_dev = t_first  # compile-inclusive, as a floor
-        if _remaining() > t_first * 1.3 + 20:
-            t0 = time.perf_counter()
-            out = lin.search_opseq(seq, model, budget=budget)
-            t_dev = time.perf_counter() - t0
-        dev_rate = out["configs"] / t_dev if t_dev > 0 else float("inf")
+        timeout = _remaining() - 20
+        res = run_tier(name, budget, force_cpu=force_cpu, timeout=timeout)
+        if res is None and not force_cpu:
+            # accelerator child crashed (worker watchdog / tunnel): the
+            # tier retries on a pinned-CPU child, isolated from the wreck
+            print(f"bench: tier {name} retrying on CPU", file=sys.stderr)
+            if _remaining() > 45:
+                res = run_tier(name, budget, force_cpu=True,
+                               timeout=_remaining() - 15)
+        if res is None:
+            break
+        t_dev = res["t_dev"]
+        dev_rate = res["configs"] / t_dev if t_dev > 0 else float("inf")
         measured_rate = dev_rate
-        ops_per_sec = len(seq) / t_dev if t_dev > 0 else float("inf")
-        print(f"bench: tier {name}: {out['configs']} configs in "
-              f"{t_dev:.2f}s ({dev_rate:,.0f}/s), verdict={out['valid']}",
-              file=sys.stderr)
+        ops_per_sec = res["n_ops"] / t_dev if t_dev > 0 else float("inf")
+        print(f"bench: tier {name}: {res['configs']} configs in "
+              f"{t_dev:.2f}s ({dev_rate:,.0f}/s), verdict={res['valid']} "
+              f"backend={res['backend']}", file=sys.stderr)
         _BEST = {
             "metric": f"ops-verified/sec, {name}-op {n_procs}-proc "
                       "CAS-register history (invalid tail; full "
@@ -240,21 +323,21 @@ def main():
             "vs_baseline": round(dev_rate / ref_rate, 2) if ref_rate
             else None,
             "detail": {
-                "n_ops": len(seq),
-                "backend": platform,
+                "n_ops": res["n_ops"],
+                "backend": res["backend"],
                 "device_seconds": round(t_dev, 3),
-                "device_seconds_incl_compile": round(t_first, 3),
-                "device_configs": out["configs"],
-                "device_verdict": out["valid"],
+                "device_seconds_incl_compile": round(res["t_first"], 3),
+                "device_configs": res["configs"],
+                "device_verdict": res["valid"],
                 "device_configs_per_sec": round(dev_rate, 1),
                 "oracle_history": big,
                 "oracle_seconds": round(t_ref, 3),
                 "oracle_configs": ref["configs"],
                 "oracle_verdict": ref["valid"],
                 "oracle_configs_per_sec": round(ref_rate, 1),
-                "window": out.get("window"),
-                "concurrency": out.get("concurrency"),
-                "engine": out.get("engine"),
+                "window": res.get("window"),
+                "concurrency": res.get("concurrency"),
+                "engine": res.get("engine"),
                 "baseline_note": "oracle is this repo's single-threaded "
                                  "exact WGL host checker, not knossos on "
                                  "16 cores; vs_baseline overstates the "
@@ -267,9 +350,15 @@ def main():
 
 
 if __name__ == "__main__":
-    try:
-        main()
-    except Exception as e:  # noqa: BLE001 — always emit the JSON line
-        print(f"bench: fatal {e!r}", file=sys.stderr)
-        _emit()
-        raise
+    if "--run-tier" in sys.argv:
+        i = sys.argv.index("--run-tier")
+        tier_name = sys.argv[i + 1]
+        budget_arg = int(sys.argv[sys.argv.index("--budget") + 1])
+        run_tier_child(tier_name, budget_arg)
+    else:
+        try:
+            main()
+        except Exception as e:  # noqa: BLE001 — always emit the JSON line
+            print(f"bench: fatal {e!r}", file=sys.stderr)
+            _emit()
+            raise

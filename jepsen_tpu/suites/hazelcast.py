@@ -23,6 +23,7 @@ Transports, real first:
 
 from __future__ import annotations
 
+import http.client
 import logging
 import random
 import socket
@@ -106,8 +107,9 @@ def db(server_jar: str = "server/target/hazelcast-server.jar"
 
 
 class RestQueueClient(client_mod.Client):
-    """POST offers, DELETE polls.  Network errors on enqueue are
-    indeterminate :info; empty polls are :fail."""
+    """POST offers, DELETE polls.  Network errors on enqueue AND dequeue
+    are indeterminate :info (a timed-out DELETE may have popped the
+    element server-side); empty polls are :fail."""
 
     queue = "jepsen.queue"
 
@@ -163,7 +165,8 @@ class RestQueueClient(client_mod.Client):
                 while time.time() < deadline:
                     try:
                         v = self._poll(timeout_s=1)
-                    except (urllib.error.URLError, OSError):
+                    except (urllib.error.URLError, OSError,
+                            http.client.HTTPException, ValueError):
                         empties = 0
                         time.sleep(0.5)
                         continue
@@ -179,10 +182,9 @@ class RestQueueClient(client_mod.Client):
                                    error="drain-window-exhausted")
                 return replace(op, type="fail", error="drain timeout")
             raise ValueError(f"unknown f {op.f!r}")
-        except (urllib.error.URLError, OSError) as e:
-            return replace(op,
-                           type="fail" if op.f == "dequeue" else "info",
-                           error=str(e))
+        except (urllib.error.URLError, OSError,
+                http.client.HTTPException) as e:
+            return replace(op, type="info", error=str(e))
 
 
 def queue_workload(opts: dict) -> dict:
@@ -197,7 +199,7 @@ def queue_workload(opts: dict) -> dict:
     return {
         "client": RestQueueClient(),
         "checker": basic.total_queue(),
-        "generator": gen.stagger(1, gen.mix([enq, deq])),
+        "generator": gen.mix([enq, deq]),  # test-level --rate governs
         "final_generator": gen.each(lambda: gen.once(
             {"type": "invoke", "f": "drain", "value": None})),
         "model": None,

@@ -633,6 +633,7 @@ def search_opseq_sharded(seq: OpSeq, model: ModelSpec, mesh, *,
     dims = choose_dims(es, model, frontier=frontier_per_device)
     esp = pad_search(es, dims.n_det_pad, dims.n_crash_pad)
     D = mesh.shape[axis]
+    resume = None
     while True:
         bail = dims.frontier < MAX_FRONTIER
         mesh_key = (tuple(mesh.shape.items()),
@@ -650,14 +651,18 @@ def search_opseq_sharded(seq: OpSeq, model: ModelSpec, mesh, *,
             jnp.asarray(esp.crash_f), jnp.asarray(esp.crash_v1),
             jnp.asarray(esp.crash_v2), jnp.asarray(esp.crash_inv),
             jnp.int32(es.n_det), jnp.int32(es.n_crash))
-        # global carry: device 0's frontier row 0 holds the root config
-        frontier0 = np.zeros((D * dims.frontier, dims.words), np.int32)
-        frontier0[0] = _init_config(dims, model)
-        count0 = np.zeros(D, np.int32)
-        count0[0] = 1
-        carry0 = (jnp.asarray(frontier0), jnp.asarray(count0),
-                  jnp.int32(-1), jnp.int32(0), jnp.int32(0),
-                  jnp.bool_(False), jnp.int32(1))
+        if resume is not None:
+            carry0 = tuple(jnp.asarray(c) for c in resume)
+        else:
+            # global carry: device 0's frontier row 0 holds the root
+            frontier0 = np.zeros((D * dims.frontier, dims.words),
+                                 np.int32)
+            frontier0[0] = _init_config(dims, model)
+            count0 = np.zeros(D, np.int32)
+            count0[0] = 1
+            carry0 = (jnp.asarray(frontier0), jnp.asarray(count0),
+                      jnp.int32(-1), jnp.int32(0), jnp.int32(0),
+                      jnp.bool_(False), jnp.int32(1))
 
         def sc(carry, i):
             return int(np.asarray(carry[i]).reshape(-1)[0])
@@ -671,7 +676,13 @@ def search_opseq_sharded(seq: OpSeq, model: ModelSpec, mesh, *,
                     and sc(carry, 3) < budget
                     and not (bail and sc(carry, 5)))
 
-        carry = _drive_slices(call, carry0, is_active)
+        prev = [carry0]
+
+        def track(carry):
+            if not sc(carry, 5):  # clean (pre-overflow) carry
+                prev[0] = carry
+
+        carry = _drive_slices(call, carry0, is_active, on_slice=track)
         status = sc(carry, 2)
         configs = sc(carry, 3)
         ovf = bool(sc(carry, 5))
@@ -680,9 +691,12 @@ def search_opseq_sharded(seq: OpSeq, model: ModelSpec, mesh, *,
             status = (UNKNOWN if ovf else INVALID) if total <= 0 \
                 else UNKNOWN
         if status == UNKNOWN and ovf and dims.frontier < MAX_FRONTIER:
-            dims = SearchDims(**{**dims.__dict__,
-                                 "frontier": min(dims.frontier * 8,
-                                                 MAX_FRONTIER)})
+            # escalate, resuming from the last clean carry: each
+            # device's frontier block zero-pads from F to F' rows
+            new_f = min(dims.frontier * 8, MAX_FRONTIER)
+            resume = _widen_sharded_carry(prev[0], D, dims.frontier,
+                                          new_f)
+            dims = SearchDims(**{**dims.__dict__, "frontier": new_f})
             continue
         break
     return {"valid": _STATUS[status],
@@ -762,6 +776,24 @@ def _init_carry(dims: SearchDims, model: ModelSpec):
             np.int32(0), np.bool_(False))
 
 
+def _widen_carry(carry, old_f: int, new_f: int):
+    """Zero-pad a carry's frontier from old_f to new_f rows (frontier
+    escalation without restarting the search)."""
+    frontier = np.zeros((new_f, np.asarray(carry[0]).shape[1]), np.int32)
+    frontier[:old_f] = np.asarray(carry[0])
+    return (frontier,) + tuple(np.asarray(c) for c in carry[1:])
+
+
+def _widen_sharded_carry(carry, d: int, old_f: int, new_f: int):
+    """Widen a sharded carry's global [D*F, WORDS] frontier to
+    [D*F', WORDS], keeping each device's rows in its own block."""
+    fr = np.asarray(carry[0]).reshape(d, old_f, -1)
+    fr2 = np.zeros((d, new_f, fr.shape[2]), np.int32)
+    fr2[:, :old_f] = fr
+    return (fr2.reshape(d * new_f, -1),) + tuple(
+        np.asarray(c) for c in carry[1:])
+
+
 def get_kernel(model: ModelSpec, dims: SearchDims):
     key = (model.name, dims)
     fn = _KERNEL_CACHE.get(key)
@@ -817,10 +849,13 @@ def _run_kernel(esp: EncodedSearch, es: EncodedSearch, model: ModelSpec,
                 on_slice=None, resume=None):
     """Drive the sliced kernel to completion from the host.
 
-    Returns (status, configs, max_depth, ovf) with status already
-    finalized (-1 never escapes).  ``on_slice(carry)`` is invoked after
-    every device call with the live carry (host-transferable: the
-    checkpoint hook).  ``resume`` accepts a previously captured carry.
+    Returns (status, configs, max_depth, ovf, pre_ovf_carry): status is
+    already finalized (-1 never escapes), and when the search bailed on
+    overflow, ``pre_ovf_carry`` is the last clean carry *before* the
+    overflowing slice — the escalation ladder resumes from it at a wider
+    frontier instead of re-searching from the root.  ``on_slice(carry,
+    dims)`` fires after every device call (the checkpoint hook);
+    ``resume`` accepts a previously captured carry.
     """
     fn = get_kernel(model, dims)
     args = (
@@ -844,7 +879,15 @@ def _run_kernel(esp: EncodedSearch, es: EncodedSearch, model: ModelSpec,
                 and not (bail_on_overflow and bool(carry[5])))
 
     hook = None if on_slice is None else (lambda c: on_slice(c, dims))
-    carry = _drive_slices(call, carry0, is_active, on_slice=hook)
+    prev = [carry0]
+
+    def track(carry):
+        if hook is not None:
+            hook(carry)
+        if not bool(carry[5]):  # clean (pre-overflow) carry
+            prev[0] = carry
+
+    carry = _drive_slices(call, carry0, is_active, on_slice=track)
     status = int(carry[2])
     count = int(carry[1])
     configs = int(carry[3])
@@ -853,7 +896,7 @@ def _run_kernel(esp: EncodedSearch, es: EncodedSearch, model: ModelSpec,
         # frontier died out with no goal: invalid if we never overflowed,
         # otherwise unknown.  budget exceeded: unknown.
         status = (UNKNOWN if ovf else INVALID) if count <= 0 else UNKNOWN
-    return status, configs, int(carry[4]), ovf
+    return status, configs, int(carry[4]), ovf, prev[0]
 
 
 def greedy_witness(seq: OpSeq, model: ModelSpec) -> bool:
@@ -900,17 +943,20 @@ def search_opseq(seq: OpSeq, model: ModelSpec, *,
 
     dims = dims or choose_dims(es, model)
     esp = pad_search(es, dims.n_det_pad, dims.n_crash_pad)
+    resume = None
     while True:
-        status, configs, max_depth, ovf = _run_kernel(
+        status, configs, max_depth, ovf, pre_ovf = _run_kernel(
             esp, es, model, dims, budget,
             bail_on_overflow=dims.frontier < MAX_FRONTIER,
-            on_slice=on_slice)
+            on_slice=on_slice, resume=resume)
         # a level overflowed the frontier and the search didn't prove
-        # validity: escalate to a wider frontier and re-run
+        # validity: escalate to a wider frontier — resuming from the last
+        # clean pre-overflow carry (BFS state is level-local, so only the
+        # overflowing slice's levels re-run, not the whole search)
         if status == UNKNOWN and ovf and dims.frontier < MAX_FRONTIER:
-            dims = SearchDims(**{**dims.__dict__,
-                                 "frontier": min(dims.frontier * 8,
-                                                 MAX_FRONTIER)})
+            new_f = min(dims.frontier * 8, MAX_FRONTIER)
+            resume = _widen_carry(pre_ovf, dims.frontier, new_f)
+            dims = SearchDims(**{**dims.__dict__, "frontier": new_f})
             continue
         break
     return {"valid": _STATUS[status], "configs": configs,
@@ -983,13 +1029,19 @@ def resume_opseq(seq: OpSeq, model: ModelSpec, path: str, *,
             "checkpoint was taken on a different history (digest mismatch)")
     es = encode_search(seq)
     esp = pad_search(es, dims.n_det_pad, dims.n_crash_pad)
-    status, configs, max_depth, ovf = _run_kernel(
-        esp, es, model, dims, budget,
-        bail_on_overflow=dims.frontier < MAX_FRONTIER,
-        on_slice=on_slice, resume=carry)
-    if status == UNKNOWN and ovf and dims.frontier < MAX_FRONTIER:
-        # overflow after resume: restart fresh with a wider frontier
-        return search_opseq(seq, model, budget=budget, on_slice=on_slice)
+    while True:
+        status, configs, max_depth, ovf, pre_ovf = _run_kernel(
+            esp, es, model, dims, budget,
+            bail_on_overflow=dims.frontier < MAX_FRONTIER,
+            on_slice=on_slice, resume=carry)
+        if status == UNKNOWN and ovf and dims.frontier < MAX_FRONTIER:
+            # overflow after resume: widen and continue from the last
+            # clean carry, same as search_opseq's ladder
+            new_f = min(dims.frontier * 8, MAX_FRONTIER)
+            carry = _widen_carry(pre_ovf, dims.frontier, new_f)
+            dims = SearchDims(**{**dims.__dict__, "frontier": new_f})
+            continue
+        break
     return {"valid": _STATUS[status], "configs": configs,
             "max_depth": max_depth, "engine": "tpu(resumed)",
             "frontier": dims.frontier,

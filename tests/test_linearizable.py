@@ -291,3 +291,24 @@ def test_checkpoint_resume(tmp_path, monkeypatch):
     s2 = encode_ops(h2, model.f_codes)
     with pytest.raises(ValueError, match="digest"):
         lin.resume_opseq(s2, model, ckpt)
+
+
+@pytest.mark.parametrize("seed", range(6))
+def test_escalation_resumes_not_restarts(seed, monkeypatch):
+    """Force frontier overflow with a tiny initial frontier: the ladder
+    must widen and RESUME from the pre-overflow carry, producing the
+    oracle's verdict."""
+    monkeypatch.setattr(lin, "_SLICE_LEVELS0", 4)
+    monkeypatch.setattr(lin, "_adapt_lvl_cap", lambda cap, dt: cap)
+    rng = random.Random(4000 + seed)
+    h = corrupt(rng, random_register_history(rng, n_procs=4, n_ops=40))
+    model = cas_register()
+    s = encode_ops(h, model.f_codes)
+    a = oracle.check_opseq(s, model)
+    tiny = lin.SearchDims(n_det_pad=128, n_crash_pad=32, window=96,
+                          k=16, state_width=1, frontier=8)
+    b = lin.search_opseq(s, model, dims=tiny)
+    assert b["valid"] == a["valid"], f"oracle={a} device={b}"
+    # ladder must actually have escalated for a nontrivial search
+    if a["configs"] > 64:
+        assert b["frontier"] > 8, f"no escalation happened: {b}"

@@ -67,3 +67,28 @@ def test_sharded_escalates_on_overflow(mesh):
     # start absurdly narrow; the ladder must still converge to the truth
     out = lin.search_opseq_sharded(s, model, mesh, frontier_per_device=64)
     assert out["valid"] == ref["valid"]
+
+
+def test_sharded_escalation_resumes(mesh, monkeypatch):
+    """Tiny per-device frontier forces the sharded ladder to widen; the
+    verdict must still match the oracle (resume-from-carry soundness on
+    the mesh path)."""
+    import random
+
+    from jepsen_tpu.checker import linearizable as lin, seq as oracle
+    from jepsen_tpu.history import encode_ops
+    from jepsen_tpu.models import cas_register
+
+    monkeypatch.setattr(lin, "_SLICE_LEVELS0", 4)
+    monkeypatch.setattr(lin, "_adapt_lvl_cap", lambda cap, dt: cap)
+    from test_linearizable import corrupt, random_register_history
+
+    rng = random.Random(911)
+    h = corrupt(rng, random_register_history(rng, n_procs=4, n_ops=40))
+    model = cas_register()
+    s = encode_ops(h, model.f_codes)
+    want = oracle.check_opseq(s, model)["valid"]
+    out = lin.search_opseq_sharded(s, model, mesh,
+                                   frontier_per_device=8,
+                                   budget=500_000)
+    assert out["valid"] == want, f"oracle={want} sharded={out}"

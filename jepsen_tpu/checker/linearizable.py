@@ -8,9 +8,12 @@ linearized ops, model state) — by depth-first search with a visited memo,
 sized at -Xmx32g (jepsen/project.clj:25).  Here the same configuration
 space is explored breadth-first on device: a frontier of configurations is
 expanded in lockstep under ``vmap`` (one lane per configuration ×
-candidate), deduplicated against a packed fingerprint table in HBM, and
-queued in a device ring buffer — all inside one ``lax.while_loop`` so XLA
-compiles the entire search into a single program with no host round-trips.
+candidate), deduplicated exactly per level, and compacted into the next
+frontier.  The BFS runs as a sequence of bounded device calls — a
+``lax.while_loop`` capped at ``lvl_cap`` levels per call, with the search
+state as an explicit carry — because the axon TPU worker kills any single
+execution outliving its ~60s watchdog; the carry doubles as a checkpoint
+and as the resume point for in-place frontier escalation.
 
 Configuration encoding (the "hashing model states on TPU" problem,
 SURVEY.md §7): a naive linearized-set needs n bits per config.  Instead we

@@ -1,0 +1,269 @@
+"""Differential fuzzer for the linearizability engines, with shrinking.
+
+The reference's trust story for its checker is knossos `competition` —
+racing two independent algorithms and taking the first answer
+(jepsen/src/jepsen/checker.clj:122-126).  This goes further: generate
+random histories (valid-by-construction, corrupted, and crash-heavy),
+require the device BFS engine and the exact host DFS oracle
+(checker/seq.py) to agree, and on ANY disagreement shrink the history to
+a minimal counterexample before reporting — the artifact a human needs
+to debug a checker divergence is the 6-op core, not the 400-op haystack.
+
+Usage:
+    python tools/fuzz.py --rounds 200 [--seed 0] [--n-ops 60]
+                         [--model cas-register|register|mutex]
+Exit code 0 = no divergence; 1 = divergence found (minimal repro printed
+as JSON ops, replayable via --replay FILE).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import random
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+if os.environ.get("JAX_PLATFORMS") == "cpu":
+    # env alone does not stop the sitecustomize-registered TPU plugin;
+    # pin via config before first backend touch (tests/conftest.py:10-23)
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+
+from jepsen_tpu.checker import linearizable as lin, seq as oracle  # noqa: E402
+from jepsen_tpu.history import Op, encode_ops, info_op, invoke_op, ok_op  # noqa: E402
+from jepsen_tpu.models import cas_register, mutex, register  # noqa: E402
+
+MODELS = {
+    "cas-register": cas_register,
+    "register": lambda: register(0),
+    "mutex": mutex,
+}
+
+
+def gen_history(rng: random.Random, model_name: str, n_ops: int,
+                n_procs: int, crash_p: float) -> list[Op]:
+    """Simulate concurrent processes against a real in-memory model (ops
+    linearize at completion, so the emitted history is valid); crashed
+    completions become :info with a coin-flip effect."""
+    if model_name == "mutex":
+        return gen_mutex_history(rng, n_ops, n_procs, crash_p)
+    state = None if model_name == "cas-register" else 0
+    h: list[Op] = []
+    pending: dict = {}
+    done = 0
+    while done < n_ops or pending:
+        p = rng.randrange(n_procs)
+        if p in pending:
+            f, v = pending.pop(p)
+            if crash_p and rng.random() < crash_p:
+                if rng.random() < 0.5:  # took effect
+                    if f == "write":
+                        state = v
+                    elif f == "cas" and state == v[0]:
+                        state = v[1]
+                h.append(info_op(p, f, v if f != "read" else None))
+                continue
+            if f == "read":
+                h.append(ok_op(p, f, state))
+            elif f == "write":
+                state = v
+                h.append(ok_op(p, f, v))
+            else:
+                if state == v[0]:
+                    state = v[1]
+                    h.append(ok_op(p, f, v))
+                else:
+                    from jepsen_tpu.history import fail_op
+
+                    h.append(fail_op(p, f, v))
+        elif done < n_ops:
+            fs = ["read", "write"] + (
+                ["cas"] if model_name == "cas-register" else [])
+            f = rng.choice(fs)
+            v = (None if f == "read"
+                 else rng.randrange(5) if f == "write"
+                 else (rng.randrange(5), rng.randrange(5)))
+            h.append(invoke_op(p, f, v))
+            pending[p] = (f, v)
+            done += 1
+    return h
+
+
+def gen_mutex_history(rng, n_ops, n_procs, crash_p) -> list[Op]:
+    holder = None
+    h: list[Op] = []
+    pending: dict = {}
+    wants: dict = {}
+    done = 0
+    while done < n_ops or pending:
+        p = rng.randrange(n_procs)
+        if p in pending:
+            f = pending[p]
+            if f == "acquire" and holder is None:
+                holder = p
+                del pending[p]
+                h.append(ok_op(p, f, None))
+            elif f == "release":
+                del pending[p]
+                if holder == p:
+                    holder = None
+                    h.append(ok_op(p, f, None))
+                else:
+                    from jepsen_tpu.history import fail_op
+
+                    h.append(fail_op(p, f, None))
+            continue
+        if done < n_ops:
+            f = "release" if wants.get(p) else "acquire"
+            wants[p] = not wants.get(p)
+            h.append(invoke_op(p, f, None))
+            pending[p] = f
+            done += 1
+    return h
+
+
+def corrupt(rng: random.Random, h: list[Op]) -> list[Op]:
+    """One random mutation: flip a read value, swap two completions, or
+    duplicate an acquire."""
+    from dataclasses import replace
+
+    h = list(h)
+    kind = rng.randrange(3)
+    if kind == 0:
+        idx = [i for i, op in enumerate(h)
+               if op.type == "ok" and op.f == "read"]
+        if idx:
+            i = rng.choice(idx)
+            h[i] = replace(h[i], value=(h[i].value or 0) + 7)
+    elif kind == 1:
+        idx = [i for i, op in enumerate(h) if op.type == "ok"]
+        if len(idx) >= 2:
+            i, j = rng.sample(idx, 2)
+            h[i], h[j] = h[j], h[i]
+    else:
+        idx = [i for i, op in enumerate(h) if op.type == "ok"]
+        if idx:
+            h.insert(rng.choice(idx), h[rng.choice(idx)])
+    return h
+
+
+#: per-engine work caps — mutated histories can explode combinatorially;
+#: rounds where either engine gives up are skipped, not flagged
+ORACLE_CAP = 40_000
+DEVICE_BUDGET = 120_000
+
+
+def verdicts(h: list[Op], model) -> tuple:
+    try:
+        s = encode_ops(h, model.f_codes)
+    except Exception as e:
+        return ("encode-error", str(e)), ("encode-error", str(e))
+    a = oracle.check_opseq(s, model, max_configs=ORACLE_CAP)
+    b = lin.search_opseq(s, model, budget=DEVICE_BUDGET)
+    return a["valid"], b["valid"]
+
+
+def diverges(h: list[Op], model) -> bool:
+    a, b = verdicts(h, model)
+    if a == "unknown" or b == "unknown":
+        return False  # a capped-out engine is not a divergence
+    return a != b
+
+
+def shrink(h: list[Op], model, *, max_passes: int = 8) -> list[Op]:
+    """Greedy delta-debugging: repeatedly drop op *pairs* (invoke + its
+    completion) and lone ops while the divergence persists."""
+    from dataclasses import replace as _r  # noqa: F401
+
+    cur = list(h)
+    for _ in range(max_passes):
+        changed = False
+        # try dropping each process's whole op stream first (coarse)
+        procs = sorted({op.process for op in cur})
+        for p in procs:
+            cand = [op for op in cur if op.process != p]
+            if len(cand) < len(cur) and cand and diverges(cand, model):
+                cur = cand
+                changed = True
+        # then drop invoke+completion pairs (fine)
+        i = 0
+        while i < len(cur):
+            op = cur[i]
+            if op.type == "invoke":
+                js = [j for j in range(i + 1, len(cur))
+                      if cur[j].process == op.process]
+                drop = {i} | ({js[0]} if js else set())
+            else:
+                drop = {i}
+            cand = [op for j, op in enumerate(cur) if j not in drop]
+            if cand and diverges(cand, model):
+                cur = cand
+                changed = True
+            else:
+                i += 1
+        if not changed:
+            break
+    return cur
+
+
+def replay(path: str, model_name: str) -> int:
+    model = MODELS[model_name]()
+    ops = [Op.from_dict(d) for d in json.load(open(path))]
+    a, b = verdicts(ops, model)
+    print(f"oracle={a} device={b} ({'DIVERGES' if a != b else 'agree'})")
+    return 1 if a != b else 0
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--rounds", type=int, default=100)
+    ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument("--n-ops", type=int, default=60)
+    ap.add_argument("--n-procs", type=int, default=4)
+    ap.add_argument("--model", default="cas-register",
+                    choices=sorted(MODELS))
+    ap.add_argument("--replay", metavar="FILE")
+    ap.add_argument("--out", default="fuzz-repro.json")
+    args = ap.parse_args()
+
+    if args.replay:
+        return replay(args.replay, args.model)
+
+    model = MODELS[args.model]()
+    t0 = time.time()
+    for i in range(args.rounds):
+        rng = random.Random(args.seed + i)
+        crash_p = rng.choice([0.0, 0.0, 0.1, 0.25])
+        h = gen_history(rng, args.model, args.n_ops, args.n_procs,
+                        crash_p)
+        if rng.random() < 0.7:
+            h = corrupt(rng, h)
+        if diverges(h, model):
+            a, b = verdicts(h, model)
+            print(f"DIVERGENCE at round {i} (seed {args.seed + i}): "
+                  f"oracle={a} device={b}; shrinking...",
+                  file=sys.stderr)
+            small = shrink(h, model)
+            a2, b2 = verdicts(small, model)
+            json.dump([op.to_dict() for op in small], open(args.out, "w"),
+                      indent=1)
+            print(f"minimal repro: {len(small)} ops (from {len(h)}) -> "
+                  f"{args.out}; oracle={a2} device={b2}")
+            for op in small:
+                print(" ", op.to_dict())
+            return 1
+        if (i + 1) % 25 == 0:
+            print(f"fuzz: {i + 1}/{args.rounds} rounds clean "
+                  f"({time.time() - t0:.0f}s)", file=sys.stderr)
+    print(f"fuzz: {args.rounds} rounds, no divergence "
+          f"({time.time() - t0:.0f}s)")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

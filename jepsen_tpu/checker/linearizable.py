@@ -518,6 +518,15 @@ def build_sharded_search_step_fn(model: ModelSpec, dims: SearchDims,
                      out_specs=carry_in, check_vma=False)
 
 
+def _trailing_ones(w):
+    """uint32 [..., n] -> per-word count of consecutive 1-bits from bit
+    0 (32 when the word is all-ones): popcount((~w & -~w) - 1)."""
+    inv = ~w
+    lsb = inv & (~inv + np.uint32(1))
+    t = lax.population_count(lsb - np.uint32(1))
+    return jnp.where(inv == 0, np.uint32(32), t.astype(jnp.uint32))
+
+
 def _make_kernel_pieces(model: ModelSpec, dims: SearchDims):
     """Expose build_search_fn's internal pack/expand for the sharded
     kernel (same closure construction, no search loop)."""
@@ -586,21 +595,60 @@ def _make_kernel_pieces(model: ModelSpec, dims: SearchDims):
         new_state, legal = jax.vmap(jstep)(st, cf, cv1, cv2)
         valid = alive & cand_on & legal
 
+        # --- successor construction, directly on the packed words ------
+        # The window/crash masks already live as uint32 words inside the
+        # config; building successors in word space (set-bit, trailing-
+        # ones popcount, funnel shift) avoids the per-candidate W-lane
+        # unpack / cumprod / cross-lane roll / repack of the naive form —
+        # expand dominates per-level cost, and this is its hot core.
+        win_words = cfg[1:1 + WW].astype(jnp.uint32)
+        crash_words = cfg[1 + WW:1 + WW + CW].astype(jnp.uint32)
+
         def succ(ci, ns):
             lane = cand[ci]
-            d_lane = jnp.clip(lane, 0, W - 1)
-            new_win = win.at[d_lane].set(True)
-            run = jnp.cumprod(new_win.astype(jnp.int32))
-            shift = jnp.sum(run).astype(jnp.int32)
-            rolled = jnp.roll(new_win, -shift)
-            tail_clear = jnp.arange(W) < (W - shift)
-            norm_win = rolled & tail_clear
             is_d = lane < W
-            p2 = jnp.where(is_d, p + shift, p)
-            win2 = jnp.where(is_d, norm_win, win)
+            d_lane = jnp.clip(lane, 0, W - 1)
+            wi = d_lane >> 5
+            bit = (d_lane & 31).astype(jnp.uint32)
+            setmask = jnp.where(jnp.arange(WW) == wi,
+                                np.uint32(1) << bit, np.uint32(0))
+            nw = win_words | setmask  # window with the new bit set
+
+            # shift = run of 1-bits from position 0, chained across words
+            t = _trailing_ones(nw)  # [WW]
+            shift = jnp.uint32(0)
+            open_run = jnp.bool_(True)
+            for i in range(WW):
+                shift = shift + jnp.where(open_run, t[i], np.uint32(0))
+                open_run = open_run & (t[i] == 32)
+
+            # funnel shift right by `shift` across the word array
+            s_words = (shift >> 5).astype(jnp.int32)
+            s_bits = shift & np.uint32(31)
+            idx = jnp.arange(WW) + s_words
+            lo = jnp.take(nw, idx, mode="fill", fill_value=np.uint32(0))
+            hi = jnp.take(nw, idx + 1, mode="fill",
+                          fill_value=np.uint32(0))
+            shifted = jnp.where(
+                s_bits == 0, lo,
+                (lo >> s_bits) | (hi << (np.uint32(32) - s_bits)))
+
+            p2 = jnp.where(is_d, p + shift.astype(jnp.int32), p)
+            win2 = jnp.where(is_d, shifted, win_words)
+
             cl = jnp.clip(lane - W, 0, NC - 1)
-            crash2 = jnp.where(is_d, crash, crash.at[cl].set(True))
-            return pack(p2, win2, crash2, ns), p2
+            csetmask = jnp.where(
+                jnp.arange(CW) == (cl >> 5),
+                np.uint32(1) << (cl & 31).astype(jnp.uint32),
+                np.uint32(0))
+            crash2 = jnp.where(is_d, crash_words,
+                               crash_words | csetmask)
+            cfg2 = jnp.concatenate([
+                p2[None].astype(jnp.int32),
+                win2.astype(jnp.int32),
+                crash2.astype(jnp.int32),
+                ns.astype(jnp.int32)])
+            return cfg2, p2
 
         cfgs, p2s = jax.vmap(succ)(jnp.arange(K), new_state)
         goal = valid & (p2s >= n_det)

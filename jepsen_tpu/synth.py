@@ -93,3 +93,164 @@ def corrupt_read(rng: random.Random, h: list[Op], *,
     i = min(idx, key=lambda j: abs(j - target))
     h[i] = replace(h[i], value=(h[i].value or 0) + 1_000_003)
     return h
+
+
+# ---------------------------------------------------------------------------
+# Differential-test simulators (shared by tests/test_linearizable.py and
+# tools/fuzz.py — one canonical copy, so a simulator fix lands once)
+# ---------------------------------------------------------------------------
+
+
+def sim_register_history(rng: random.Random, n_procs: int = 4,
+                         n_ops: int = 40, *, crash_p: float = 0.0,
+                         cas: bool = True,
+                         max_crashes: int = 8) -> list[Op]:
+    """Simulate processes against a real register; ops linearize at
+    completion, so the emitted history is valid."""
+    state = None  # register starts unset
+    h: list[Op] = []
+    pending: dict = {}  # process -> (f, value)
+    n_crashed = 0
+    done = 0
+    while done < n_ops or pending:
+        p = rng.randrange(n_procs)
+        if p in pending:
+            f, v = pending.pop(p)
+            if crash_p and rng.random() < crash_p and \
+                    n_crashed < max_crashes:
+                n_crashed += 1
+                # crashed: op takes effect iff coin flip says so
+                if rng.random() < 0.5:
+                    if f == "write":
+                        state = v
+                    elif f == "cas" and state == v[0]:
+                        state = v[1]
+                h.append(info_op(p, f, v if f != "read" else None))
+                continue
+            if f == "read":
+                h.append(ok_op(p, f, state))
+            elif f == "write":
+                state = v
+                h.append(ok_op(p, f, v))
+            else:  # cas
+                if state == v[0]:
+                    state = v[1]
+                    h.append(ok_op(p, f, v))
+                else:
+                    h.append(fail_op(p, f, v))
+        elif done < n_ops:
+            fs = ["read", "write"] + (["cas"] if cas else [])
+            f = rng.choice(fs)
+            if f == "read":
+                v = None
+            elif f == "write":
+                v = rng.randrange(5)
+            else:
+                v = (rng.randrange(5), rng.randrange(5))
+            h.append(invoke_op(p, f, v))
+            pending[p] = (f, v)
+            done += 1
+    return h
+
+
+def sim_mutex_history(rng: random.Random, n_ops: int = 40,
+                      n_procs: int = 4, *,
+                      crash_p: float = 0.0) -> list[Op]:
+    """Alternating acquire/release per process against a real lock.
+
+    Always terminates: after the op budget is spent, completable pending
+    ops are drained (the holder releases out-of-budget if needed) and
+    anything still stuck — e.g. acquires blocked behind a crashed holder
+    — becomes a crashed :info op, exactly what the harness records for
+    ops whose fate is unknown (core.clj:387-397)."""
+    holder = None
+    h: list[Op] = []
+    pending: dict = {}  # process -> f
+    wants: dict = {}
+    crashed: set = set()
+    done = 0
+    while done < n_ops:
+        if len(crashed) >= n_procs:
+            break  # everyone crashed; the history just ends short
+        p = rng.randrange(n_procs)
+        if p in crashed:
+            continue
+        if p in pending:
+            f = pending[p]
+            if crash_p and rng.random() < crash_p:
+                # coin flip: did the op take effect before the crash?
+                if rng.random() < 0.5:
+                    if f == "acquire" and holder is None:
+                        holder = p
+                    elif f == "release" and holder == p:
+                        holder = None
+                del pending[p]
+                crashed.add(p)
+                h.append(info_op(p, f, None))
+                continue
+            if f == "acquire" and holder is None:
+                holder = p
+                del pending[p]
+                h.append(ok_op(p, f, None))
+            elif f == "release":
+                del pending[p]
+                if holder == p:
+                    holder = None
+                    h.append(ok_op(p, f, None))
+                else:
+                    h.append(fail_op(p, f, None))
+            continue
+        f = "release" if wants.get(p) else "acquire"
+        wants[p] = not wants.get(p)
+        h.append(invoke_op(p, f, None))
+        pending[p] = f
+        done += 1
+
+    # drain: free the lock if its holder is still schedulable, complete
+    # what completes, and crash the rest
+    if holder is not None and holder not in crashed \
+            and holder not in pending:
+        h.append(invoke_op(holder, "release", None))
+        h.append(ok_op(holder, "release", None))
+        holder = None
+    for p, f in sorted(pending.items()):
+        if f == "acquire" and holder is None:
+            holder = p
+            h.append(ok_op(p, f, None))
+        elif f == "release":
+            if holder == p:
+                holder = None
+                h.append(ok_op(p, f, None))
+            else:
+                h.append(fail_op(p, f, None))
+        else:
+            h.append(info_op(p, f, None))
+    return h
+
+
+def flip_read(rng: random.Random, h: list[Op]) -> list[Op]:
+    """Flip one ok read's value; usually makes the history invalid."""
+    h = list(h)
+    idx = [i for i, op in enumerate(h)
+           if op.type == "ok" and op.f == "read" and op.value is not None]
+    if not idx:
+        return h
+    i = rng.choice(idx)
+    h[i] = replace(h[i], value=(h[i].value or 0) + 7)
+    return h
+
+
+def mutate(rng: random.Random, h: list[Op]) -> list[Op]:
+    """One random mutation: flip a read value, swap two completions, or
+    duplicate a completion."""
+    h = list(h)
+    kind = rng.randrange(3)
+    if kind == 0:
+        return flip_read(rng, h)
+    idx = [i for i, op in enumerate(h) if op.type == "ok"]
+    if kind == 1 and len(idx) >= 2:
+        i, j = rng.sample(idx, 2)
+        h[i], h[j] = h[j], h[i]
+    elif idx:
+        h.insert(rng.choice(idx), h[rng.choice(idx)])
+    return h

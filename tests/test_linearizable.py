@@ -27,65 +27,19 @@ DIMS = lin.SearchDims(n_det_pad=128, n_crash_pad=32, window=96, k=16,
 
 def random_register_history(rng: random.Random, n_procs=4, n_ops=40, *,
                             crash_p=0.0, cas=True):
-    """Simulate processes against a real register; ops linearize at
-    completion, so the emitted history is valid."""
-    state = None  # register starts unset (NIL reads only legal as unknown)
-    h = []
-    pending = {}  # process -> (f, value)
-    n_crashed = 0
-    done = 0
-    while done < n_ops or pending:
-        p = rng.randrange(n_procs)
-        if p in pending:
-            f, v = pending.pop(p)
-            if crash_p and rng.random() < crash_p and n_crashed < 8:
-                n_crashed += 1
-                # crashed: op takes effect iff coin flip says so
-                if rng.random() < 0.5:
-                    if f == "write":
-                        state = v
-                    elif f == "cas" and state == v[0]:
-                        state = v[1]
-                h.append(info_op(p, f, v if f != "read" else None))
-                continue
-            if f == "read":
-                h.append(ok_op(p, f, state))
-            elif f == "write":
-                state = v
-                h.append(ok_op(p, f, v))
-            else:  # cas
-                if state == v[0]:
-                    state = v[1]
-                    h.append(ok_op(p, f, v))
-                else:
-                    h.append(fail_op(p, f, v))
-        elif done < n_ops:
-            fs = ["read", "write"] + (["cas"] if cas else [])
-            f = rng.choice(fs)
-            if f == "read":
-                v = None
-            elif f == "write":
-                v = rng.randrange(5)
-            else:
-                v = (rng.randrange(5), rng.randrange(5))
-            h.append(invoke_op(p, f, v))
-            pending[p] = (f, v)
-            done += 1
-    return h
+    """Simulate processes against a real register (canonical simulator:
+    jepsen_tpu/synth.py; shared with tools/fuzz.py)."""
+    from jepsen_tpu.synth import sim_register_history
+
+    return sim_register_history(rng, n_procs, n_ops, crash_p=crash_p,
+                                cas=cas, max_crashes=8)
 
 
 def corrupt(rng: random.Random, h):
-    """Flip one ok read's value; usually makes the history invalid."""
-    h = list(h)
-    idx = [i for i, op in enumerate(h)
-           if op.type == "ok" and op.f == "read" and op.value is not None]
-    if not idx:
-        return h
-    i = rng.choice(idx)
-    op = h[i]
-    from dataclasses import replace
-    h[i] = replace(op, value=(op.value or 0) + 7)
-    return h
+    """Flip one ok read's value (canonical: synth.flip_read)."""
+    from jepsen_tpu.synth import flip_read
+
+    return flip_read(rng, h)
 
 
 def both_verdicts(h, model):
@@ -331,13 +285,11 @@ def test_fuzzer_smoke(monkeypatch):
                              0.0)
         assert fuzz.diverges(h, model) is False
 
-    # shrink: seed 1 deterministically yields an invalid corrupted
-    # history; shrink with a stand-in divergence predicate ("oracle says
+    # shrink with a stand-in divergence predicate ("oracle says
     # invalid") — exercises the pair-dropping logic without needing a
-    # real engine bug
-    rng = random.Random(1)
-    h = fuzz.corrupt(rng, fuzz.gen_history(rng, "cas-register", 30, 3,
-                                           0.0))
+    # real engine bug.  Search a few seeds for an invalid corruption
+    # rather than pinning one (randrange/choice sequences are not
+    # guaranteed stable across CPython versions).
     from jepsen_tpu.history import encode_ops as enc
 
     def invalid(hh, m):
@@ -348,7 +300,15 @@ def test_fuzzer_smoke(monkeypatch):
         return oracle.check_opseq(
             s, m, max_configs=fuzz.ORACLE_CAP)["valid"] is False
 
-    assert invalid(h, model), "seed 1 must produce an invalid history"
+    h = None
+    for seed in range(30):
+        rng = random.Random(seed)
+        cand = fuzz.corrupt(rng, fuzz.gen_history(rng, "cas-register",
+                                                  30, 3, 0.0))
+        if invalid(cand, model):
+            h = cand
+            break
+    assert h is not None, "no invalid corruption in 30 seeds?!"
     monkeypatch.setattr(fuzz, "diverges", lambda hh, m: invalid(hh, m))
     small = fuzz.shrink(h, model)
     assert invalid(small, model)

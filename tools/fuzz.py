@@ -47,109 +47,21 @@ MODELS = {
 
 def gen_history(rng: random.Random, model_name: str, n_ops: int,
                 n_procs: int, crash_p: float) -> list[Op]:
-    """Simulate concurrent processes against a real in-memory model (ops
-    linearize at completion, so the emitted history is valid); crashed
-    completions become :info with a coin-flip effect."""
+    """Canonical simulators live in jepsen_tpu/synth.py (shared with the
+    differential tests)."""
+    from jepsen_tpu.synth import sim_mutex_history, sim_register_history
+
     if model_name == "mutex":
-        return gen_mutex_history(rng, n_ops, n_procs, crash_p)
-    state = None if model_name == "cas-register" else 0
-    h: list[Op] = []
-    pending: dict = {}
-    done = 0
-    while done < n_ops or pending:
-        p = rng.randrange(n_procs)
-        if p in pending:
-            f, v = pending.pop(p)
-            if crash_p and rng.random() < crash_p:
-                if rng.random() < 0.5:  # took effect
-                    if f == "write":
-                        state = v
-                    elif f == "cas" and state == v[0]:
-                        state = v[1]
-                h.append(info_op(p, f, v if f != "read" else None))
-                continue
-            if f == "read":
-                h.append(ok_op(p, f, state))
-            elif f == "write":
-                state = v
-                h.append(ok_op(p, f, v))
-            else:
-                if state == v[0]:
-                    state = v[1]
-                    h.append(ok_op(p, f, v))
-                else:
-                    from jepsen_tpu.history import fail_op
-
-                    h.append(fail_op(p, f, v))
-        elif done < n_ops:
-            fs = ["read", "write"] + (
-                ["cas"] if model_name == "cas-register" else [])
-            f = rng.choice(fs)
-            v = (None if f == "read"
-                 else rng.randrange(5) if f == "write"
-                 else (rng.randrange(5), rng.randrange(5)))
-            h.append(invoke_op(p, f, v))
-            pending[p] = (f, v)
-            done += 1
-    return h
-
-
-def gen_mutex_history(rng, n_ops, n_procs, crash_p) -> list[Op]:
-    holder = None
-    h: list[Op] = []
-    pending: dict = {}
-    wants: dict = {}
-    done = 0
-    while done < n_ops or pending:
-        p = rng.randrange(n_procs)
-        if p in pending:
-            f = pending[p]
-            if f == "acquire" and holder is None:
-                holder = p
-                del pending[p]
-                h.append(ok_op(p, f, None))
-            elif f == "release":
-                del pending[p]
-                if holder == p:
-                    holder = None
-                    h.append(ok_op(p, f, None))
-                else:
-                    from jepsen_tpu.history import fail_op
-
-                    h.append(fail_op(p, f, None))
-            continue
-        if done < n_ops:
-            f = "release" if wants.get(p) else "acquire"
-            wants[p] = not wants.get(p)
-            h.append(invoke_op(p, f, None))
-            pending[p] = f
-            done += 1
-    return h
+        return sim_mutex_history(rng, n_ops, n_procs, crash_p=crash_p)
+    return sim_register_history(rng, n_procs, n_ops, crash_p=crash_p,
+                                cas=(model_name == "cas-register"),
+                                max_crashes=16)
 
 
 def corrupt(rng: random.Random, h: list[Op]) -> list[Op]:
-    """One random mutation: flip a read value, swap two completions, or
-    duplicate an acquire."""
-    from dataclasses import replace
+    from jepsen_tpu.synth import mutate
 
-    h = list(h)
-    kind = rng.randrange(3)
-    if kind == 0:
-        idx = [i for i, op in enumerate(h)
-               if op.type == "ok" and op.f == "read"]
-        if idx:
-            i = rng.choice(idx)
-            h[i] = replace(h[i], value=(h[i].value or 0) + 7)
-    elif kind == 1:
-        idx = [i for i, op in enumerate(h) if op.type == "ok"]
-        if len(idx) >= 2:
-            i, j = rng.sample(idx, 2)
-            h[i], h[j] = h[j], h[i]
-    else:
-        idx = [i for i, op in enumerate(h) if op.type == "ok"]
-        if idx:
-            h.insert(rng.choice(idx), h[rng.choice(idx)])
-    return h
+    return mutate(rng, h)
 
 
 #: per-engine work caps — mutated histories can explode combinatorially;

@@ -744,7 +744,7 @@ def search_opseq_sharded(seq: OpSeq, model: ModelSpec, mesh, *,
         if status == UNKNOWN and ovf and dims.frontier < MAX_FRONTIER:
             # escalate, resuming from the last clean carry: each
             # device's frontier block zero-pads from F to F' rows
-            new_f = min(dims.frontier * 8, MAX_FRONTIER)
+            new_f = _grid_width(dims.frontier * 4)
             resume = _widen_sharded_carry(prev[0], D, dims.frontier,
                                           new_f)
             dims = SearchDims(**{**dims.__dict__, "frontier": new_f})
@@ -867,9 +867,9 @@ def choose_dims(es: EncodedSearch, model: ModelSpec, *,
     K = _next_pow2(min(es.concurrency, W + es.n_crash))
     if frontier is None:
         # start narrow: most BFS levels are far smaller than the history;
-        # the escalation ladder widens on overflow
-        frontier = max(64, min(4096,
-                               _next_pow2((es.n_det + es.n_crash) // 8)))
+        # the adaptive driver widens on overflow and narrows again when
+        # the live frontier shrinks (on the power-of-four width grid)
+        frontier = _grid_width(min(4096, (es.n_det + es.n_crash) // 8))
     return SearchDims(
         n_det_pad=max(64, _next_pow2(es.n_det)),
         n_crash_pad=NC,
@@ -889,26 +889,48 @@ MAX_WINDOW = 512
 MAX_CRASH = 64
 
 
-#: frontier escalation ladder: retry with a wider frontier when a level
-#: overflowed and the verdict came back inconclusive
-MAX_FRONTIER = 1 << 17
+#: frontier-width grid: {64, 256, 1k, 4k, 16k, 64k, 256k}.  Widths are
+#: quantized to powers of four so the adaptive driver compiles at most 7
+#: kernels per model family; per-level cost is proportional to width, so
+#: one grid step is a meaningful (4x) cost change in either direction
+MAX_FRONTIER = 1 << 18
+
+
+def _grid_width(f: int) -> int:
+    """Snap up to the power-of-four width grid, clamped to MAX_FRONTIER."""
+    w = 64
+    while w < f and w < MAX_FRONTIER:
+        w *= 4
+    return w
 
 
 def _run_kernel(esp: EncodedSearch, es: EncodedSearch, model: ModelSpec,
-                dims: SearchDims, budget: int,
-                bail_on_overflow: bool = False, *,
-                on_slice=None, resume=None):
-    """Drive the sliced kernel to completion from the host.
+                dims: SearchDims, budget: int, *,
+                escalate: bool = True, on_slice=None, resume=None,
+                deadline: float | None = None):
+    """Drive the sliced kernel to completion with an adaptive width.
 
-    Returns (status, configs, max_depth, ovf, pre_ovf_carry): status is
-    already finalized (-1 never escapes), and when the search bailed on
-    overflow, ``pre_ovf_carry`` is the last clean carry *before* the
-    overflowing slice — the escalation ladder resumes from it at a wider
-    frontier instead of re-searching from the root.  ``on_slice(carry,
+    The frontier width moves both ways on the power-of-four grid:
+
+    * a slice that overflows the current width bails immediately (the
+      kernel's ``bail`` flag) and the search resumes from the last clean
+      pre-overflow carry at the next wider kernel — BFS state is
+      level-local, so only the bailed slice's levels re-run, never the
+      whole search;
+    * when the live frontier shrinks well below the current width, the
+      carry (live rows are prefix-compacted by the kernel) is truncated
+      a grid step down, so per-level cost tracks the frontier actually
+      alive rather than its high-water mark.  Deep histories alternate
+      narrow valleys with rare wide bursts; without the downshift one
+      burst taxes every later level at the burst's width.
+
+    Returns (status, configs, max_depth, dims): status is finalized
+    (-1 never escapes), dims reflects the final width.  ``on_slice(carry,
     dims)`` fires after every device call (the checkpoint hook);
-    ``resume`` accepts a previously captured carry.
+    ``resume`` accepts a previously captured carry at ``dims.frontier``
+    width.  ``deadline`` (``time.perf_counter()`` clock) stops cleanly
+    with status UNKNOWN when exceeded — for time-bounded throughput runs.
     """
-    fn = get_kernel(model, dims)
     args = (
         jnp.asarray(esp.det_f), jnp.asarray(esp.det_v1),
         jnp.asarray(esp.det_v2), jnp.asarray(esp.det_inv),
@@ -916,38 +938,69 @@ def _run_kernel(esp: EncodedSearch, es: EncodedSearch, model: ModelSpec,
         jnp.asarray(esp.crash_f), jnp.asarray(esp.crash_v1),
         jnp.asarray(esp.crash_v2), jnp.asarray(esp.crash_inv),
         jnp.int32(es.n_det), jnp.int32(es.n_crash))
-    carry0 = tuple(jnp.asarray(c) for c in
-                   (resume if resume is not None
-                    else _init_carry(dims, model)))
-
-    def call(carry, lvl_cap):
-        return fn(*args, jnp.int32(budget), jnp.int32(lvl_cap),
-                  jnp.bool_(bail_on_overflow), *carry)
-
-    def is_active(carry):
-        return (int(carry[2]) == -1 and int(carry[1]) > 0
-                and int(carry[3]) < budget
-                and not (bail_on_overflow and bool(carry[5])))
-
-    hook = None if on_slice is None else (lambda c: on_slice(c, dims))
-    prev = [carry0]
-
-    def track(carry):
-        if hook is not None:
-            hook(carry)
-        if not bool(carry[5]):  # clean (pre-overflow) carry
-            prev[0] = carry
-
-    carry = _drive_slices(call, carry0, is_active, on_slice=track)
+    carry = tuple(jnp.asarray(c) for c in
+                  (resume if resume is not None
+                   else _init_carry(dims, model)))
+    F = dims.frontier
+    clean = (carry, F)  # last pre-overflow (carry, width)
+    lvl_cap = _SLICE_LEVELS0
+    first = True
+    timed_out = False
+    while True:
+        bail = escalate and F < MAX_FRONTIER
+        fn = get_kernel(model, dims)
+        t0 = time.perf_counter()
+        carry = fn(*args, jnp.int32(budget), jnp.int32(lvl_cap),
+                   jnp.bool_(bail), *carry)
+        jax.block_until_ready(carry)
+        dt = time.perf_counter() - t0
+        if on_slice is not None:
+            on_slice(carry, dims)
+        status = int(carry[2])
+        count = int(carry[1])
+        configs = int(carry[3])
+        ovf = bool(carry[5])
+        if not ovf:
+            clean = (carry, F)
+        if status != -1 or count <= 0 or configs >= budget:
+            break
+        if deadline is not None and time.perf_counter() > deadline:
+            timed_out = True
+            break
+        if bail and ovf:
+            # widen from the last clean carry and keep going
+            new_f = _grid_width(F * 4)
+            carry = tuple(jnp.asarray(c) for c in
+                          _widen_carry(clean[0], clean[1], new_f))
+            F = new_f
+            dims = SearchDims(**{**dims.__dict__, "frontier": F})
+            clean = (carry, F)
+            first = True  # next slice includes a compile
+            continue
+        if not first:
+            lvl_cap = _adapt_lvl_cap(lvl_cap, dt)
+        first = False
+        if not ovf and count > 0:
+            new_f = _grid_width(4 * count)
+            if new_f < F:
+                # live rows sit at the frontier's prefix: truncate
+                carry = (carry[0][:new_f],) + tuple(carry[1:])
+                F = new_f
+                dims = SearchDims(**{**dims.__dict__, "frontier": F})
+                clean = (carry, F)
+                first = True  # next slice may include a compile
     status = int(carry[2])
     count = int(carry[1])
     configs = int(carry[3])
     ovf = bool(carry[5])
     if status == -1:
         # frontier died out with no goal: invalid if we never overflowed,
-        # otherwise unknown.  budget exceeded: unknown.
-        status = (UNKNOWN if ovf else INVALID) if count <= 0 else UNKNOWN
-    return status, configs, int(carry[4]), ovf, prev[0]
+        # otherwise unknown.  budget/deadline exceeded: unknown.
+        if timed_out or count > 0:
+            status = UNKNOWN
+        else:
+            status = UNKNOWN if ovf else INVALID
+    return status, configs, int(carry[4]), dims
 
 
 def greedy_witness(seq: OpSeq, model: ModelSpec) -> bool:
@@ -972,13 +1025,15 @@ def greedy_witness(seq: OpSeq, model: ModelSpec) -> bool:
 def search_opseq(seq: OpSeq, model: ModelSpec, *,
                  budget: int = 20_000_000,
                  dims: SearchDims | None = None,
-                 on_slice=None) -> dict:
+                 on_slice=None, deadline: float | None = None) -> dict:
     """Check one columnar history on device.  Returns a knossos-style map
     {"valid": True|False|"unknown", "configs": n, "max_depth": d}.
 
     ``on_slice(carry, dims)`` fires after every bounded device call — the
     checkpoint hook (see ``save_checkpoint``/``resume_opseq``); ``dims``
-    reflects any frontier escalation, so checkpoints stay loadable."""
+    reflects any frontier escalation, so checkpoints stay loadable.
+    ``deadline`` (perf_counter clock) bounds wall time; an unexhausted
+    search past it returns "unknown" with throughput still reported."""
     es = encode_search(seq)
     if es.n_det == 0 and es.n_crash == 0:
         return {"valid": True, "configs": 0, "max_depth": 0,
@@ -994,22 +1049,9 @@ def search_opseq(seq: OpSeq, model: ModelSpec, *,
 
     dims = dims or choose_dims(es, model)
     esp = pad_search(es, dims.n_det_pad, dims.n_crash_pad)
-    resume = None
-    while True:
-        status, configs, max_depth, ovf, pre_ovf = _run_kernel(
-            esp, es, model, dims, budget,
-            bail_on_overflow=dims.frontier < MAX_FRONTIER,
-            on_slice=on_slice, resume=resume)
-        # a level overflowed the frontier and the search didn't prove
-        # validity: escalate to a wider frontier — resuming from the last
-        # clean pre-overflow carry (BFS state is level-local, so only the
-        # overflowing slice's levels re-run, not the whole search)
-        if status == UNKNOWN and ovf and dims.frontier < MAX_FRONTIER:
-            new_f = min(dims.frontier * 8, MAX_FRONTIER)
-            resume = _widen_carry(pre_ovf, dims.frontier, new_f)
-            dims = SearchDims(**{**dims.__dict__, "frontier": new_f})
-            continue
-        break
+    status, configs, max_depth, dims = _run_kernel(
+        esp, es, model, dims, budget, on_slice=on_slice,
+        deadline=deadline)
     return {"valid": _STATUS[status], "configs": configs,
             "max_depth": max_depth, "engine": "tpu",
             "frontier": dims.frontier,
@@ -1080,19 +1122,8 @@ def resume_opseq(seq: OpSeq, model: ModelSpec, path: str, *,
             "checkpoint was taken on a different history (digest mismatch)")
     es = encode_search(seq)
     esp = pad_search(es, dims.n_det_pad, dims.n_crash_pad)
-    while True:
-        status, configs, max_depth, ovf, pre_ovf = _run_kernel(
-            esp, es, model, dims, budget,
-            bail_on_overflow=dims.frontier < MAX_FRONTIER,
-            on_slice=on_slice, resume=carry)
-        if status == UNKNOWN and ovf and dims.frontier < MAX_FRONTIER:
-            # overflow after resume: widen and continue from the last
-            # clean carry, same as search_opseq's ladder
-            new_f = min(dims.frontier * 8, MAX_FRONTIER)
-            carry = _widen_carry(pre_ovf, dims.frontier, new_f)
-            dims = SearchDims(**{**dims.__dict__, "frontier": new_f})
-            continue
-        break
+    status, configs, max_depth, dims = _run_kernel(
+        esp, es, model, dims, budget, on_slice=on_slice, resume=carry)
     return {"valid": _STATUS[status], "configs": configs,
             "max_depth": max_depth, "engine": "tpu(resumed)",
             "frontier": dims.frontier,

@@ -56,7 +56,8 @@ def _walk_parents(parent_of: dict, key) -> list[int]:
 
 
 def check_opseq(seq: OpSeq, model: ModelSpec, *,
-                max_configs: int = 5_000_000) -> dict:
+                max_configs: int = 5_000_000,
+                deadline: float | None = None) -> dict:
     """Run the DFS over a columnar OpSeq.  Returns a knossos-style map:
 
     valid        True | False | "unknown"
@@ -65,7 +66,12 @@ def check_opseq(seq: OpSeq, model: ModelSpec, *,
     max_depth    deepest prefix length reached
     final_ops    (invalid only) row indices of candidate ops at the
                  deepest frontier — the ops that could not be linearized
+
+    ``deadline`` (``time.perf_counter()`` clock) yields "unknown" once
+    exceeded (checked every 4096 configs) — the wall-clock twin of
+    ``max_configs`` for time-bounded throughput comparisons.
     """
+    import time
     n = len(seq)
     ok_mask = 0
     for i in range(n):
@@ -105,6 +111,11 @@ def check_opseq(seq: OpSeq, model: ModelSpec, *,
             return {"valid": "unknown", "configs": configs,
                     "max_depth": max_depth,
                     "info": f"exceeded max_configs={max_configs}"}
+        if (deadline is not None and configs % 4096 == 0
+                and time.perf_counter() > deadline):
+            return {"valid": "unknown", "configs": configs,
+                    "max_depth": max_depth,
+                    "info": "exceeded deadline"}
 
         if (mask & ok_mask) == ok_mask:
             lin = _walk_parents(parent_of, key)

@@ -784,7 +784,10 @@ def _adapt_lvl_cap(lvl_cap: int, dt: float) -> int:
 
 
 def _drive_slices(call, carry, is_active, *, on_slice=None):
-    """Shared host loop for all three sliced kernels.
+    """Shared host loop for the batch and sharded kernels.  (The
+    single-device path has its own driver inside ``_run_kernel``: it
+    re-keys the kernel between slices as the frontier width adapts,
+    which this fixed-kernel loop cannot express.)
 
     ``call(carry, lvl_cap)`` runs one bounded device slice;
     ``is_active(carry)`` says whether another slice is needed;
@@ -907,7 +910,7 @@ def _grid_width(f: int) -> int:
 def _run_kernel(esp: EncodedSearch, es: EncodedSearch, model: ModelSpec,
                 dims: SearchDims, budget: int, *,
                 escalate: bool = True, on_slice=None, resume=None,
-                deadline: float | None = None):
+                deadline: float | None = None, stop=None):
     """Drive the sliced kernel to completion with an adaptive width.
 
     The frontier width moves both ways on the power-of-four grid:
@@ -967,6 +970,9 @@ def _run_kernel(esp: EncodedSearch, es: EncodedSearch, model: ModelSpec,
         if deadline is not None and time.perf_counter() > deadline:
             timed_out = True
             break
+        if stop is not None and stop.is_set():
+            timed_out = True
+            break
         if bail and ovf:
             # widen from the last clean carry and keep going
             new_f = _grid_width(F * 4)
@@ -989,10 +995,6 @@ def _run_kernel(esp: EncodedSearch, es: EncodedSearch, model: ModelSpec,
                 dims = SearchDims(**{**dims.__dict__, "frontier": F})
                 clean = (carry, F)
                 first = True  # next slice may include a compile
-    status = int(carry[2])
-    count = int(carry[1])
-    configs = int(carry[3])
-    ovf = bool(carry[5])
     if status == -1:
         # frontier died out with no goal: invalid if we never overflowed,
         # otherwise unknown.  budget/deadline exceeded: unknown.
@@ -1025,7 +1027,8 @@ def greedy_witness(seq: OpSeq, model: ModelSpec) -> bool:
 def search_opseq(seq: OpSeq, model: ModelSpec, *,
                  budget: int = 20_000_000,
                  dims: SearchDims | None = None,
-                 on_slice=None, deadline: float | None = None) -> dict:
+                 on_slice=None, deadline: float | None = None,
+                 stop=None) -> dict:
     """Check one columnar history on device.  Returns a knossos-style map
     {"valid": True|False|"unknown", "configs": n, "max_depth": d}.
 
@@ -1033,7 +1036,9 @@ def search_opseq(seq: OpSeq, model: ModelSpec, *,
     checkpoint hook (see ``save_checkpoint``/``resume_opseq``); ``dims``
     reflects any frontier escalation, so checkpoints stay loadable.
     ``deadline`` (perf_counter clock) bounds wall time; an unexhausted
-    search past it returns "unknown" with throughput still reported."""
+    search past it returns "unknown" with throughput still reported.
+    ``stop`` (a ``threading.Event``) aborts between slices — the
+    competition hook."""
     es = encode_search(seq)
     if es.n_det == 0 and es.n_crash == 0:
         return {"valid": True, "configs": 0, "max_depth": 0,
@@ -1043,7 +1048,8 @@ def search_opseq(seq: OpSeq, model: ModelSpec, *,
                 "engine": "greedy-witness"}
     if es.window > MAX_WINDOW or es.n_crash > MAX_CRASH:
         from . import seq as seqmod
-        out = seqmod.check_opseq(seq, model)
+        out = seqmod.check_opseq(seq, model, deadline=deadline,
+                                 cancel=stop)
         out["engine"] = "host-oracle(fallback)"
         return out
 
@@ -1051,11 +1057,75 @@ def search_opseq(seq: OpSeq, model: ModelSpec, *,
     esp = pad_search(es, dims.n_det_pad, dims.n_crash_pad)
     status, configs, max_depth, dims = _run_kernel(
         esp, es, model, dims, budget, on_slice=on_slice,
-        deadline=deadline)
+        deadline=deadline, stop=stop)
     return {"valid": _STATUS[status], "configs": configs,
             "max_depth": max_depth, "engine": "tpu",
             "frontier": dims.frontier,
             "window": es.window, "concurrency": es.concurrency}
+
+
+def check_competition(seq: OpSeq, model: ModelSpec, *,
+                      budget: int = 20_000_000,
+                      max_configs: int = 50_000_000) -> dict:
+    """Race the exact host DFS oracle against the device BFS search; the
+    first conclusive verdict wins and retires the loser.
+
+    The knossos `competition` analog (jepsen/src/jepsen/checker.clj:122-126
+    selects between :linear, :wgl and :competition — the latter races two
+    algorithms and takes whichever finishes first).  The pairing here is
+    naturally complementary: the host DFS can lucky-dive to a witness on
+    well-behaved histories while the device BFS grinds breadth, and the
+    device sweeps wide state spaces that strand the host in backtracking.
+    The host runs in a daemon thread (it releases the GIL only at its
+    cancellation checks, but the device thread spends its time blocked in
+    XLA executions, which do release it).
+    """
+    import threading
+
+    from . import seq as seqmod
+
+    done = threading.Event()
+    lock = threading.Lock()
+    result: dict = {}
+
+    def submit(r: dict, engine: str) -> bool:
+        """Atomically claim the race for a CONCLUSIVE verdict."""
+        if r.get("valid") == "unknown":
+            return False
+        with lock:
+            if result:
+                return False
+            result.update(r)
+            result["engine"] = engine
+            done.set()
+            return True
+
+    def host():
+        try:
+            r = seqmod.check_opseq(seq, model, max_configs=max_configs,
+                                   cancel=done)
+        except Exception:  # noqa: BLE001 — loser errors must not win
+            return
+        submit(r, "competition(host-oracle)")
+
+    t = threading.Thread(target=host, daemon=True,
+                         name="competition-host-oracle")
+    t.start()
+    dev = search_opseq(seq, model, budget=budget, stop=done)
+    submit(dev, "competition(tpu)")
+    if not result:
+        # device inconclusive: the race is only over when the host's own
+        # bounded DFS finishes too (knossos competition waits for a
+        # winner, not for the first to give up)
+        t.join()
+    else:
+        done.set()  # retire a still-running loser
+        t.join(timeout=5.0)
+    with lock:
+        if result:
+            return dict(result)
+    # both inconclusive (budgets exhausted)
+    return {**dev, "engine": "competition(exhausted)"}
 
 
 # ---------------------------------------------------------------------------
@@ -1349,14 +1419,27 @@ class Linearizable:
 
     name = "linearizable"
 
+    #: algorithm aliases, mirroring checker.clj:122-126's
+    #: :linear / :wgl / :competition selector
+    ALGORITHMS = {"auto": "auto", "device": "device", "tpu": "device",
+                  "linear": "device", "host": "host", "wgl": "host",
+                  "competition": "competition"}
+
     def __init__(self, model: ModelSpec | None = None, *,
                  budget: int = 20_000_000,
                  host_threshold: int = 48,
-                 witness_threshold: int = 3000):
+                 witness_threshold: int = 3000,
+                 algorithm: str = "auto"):
         self.model = model
         self.budget = budget
         self.host_threshold = host_threshold
         self.witness_threshold = witness_threshold
+        try:
+            self.algorithm = self.ALGORITHMS[algorithm]
+        except KeyError:
+            raise ValueError(
+                f"unknown algorithm {algorithm!r}; one of "
+                f"{sorted(self.ALGORITHMS)}") from None
 
     def check(self, test, history, opts=None):
         from . import seq as seqmod
@@ -1367,14 +1450,19 @@ class Linearizable:
         seq = history if isinstance(history, OpSeq) else \
             encode_ops(history, model.f_codes)
 
-        if len(seq) <= self.host_threshold:
+        if (self.algorithm == "host"
+                or (self.algorithm == "auto"
+                    and len(seq) <= self.host_threshold)):
             out = seqmod.check_opseq(seq, model)
             out["engine"] = "host-oracle"
             if out["valid"] is False:
                 self._render_failure(test, seq, out, opts)
             return out
 
-        out = search_opseq(seq, model, budget=self.budget)
+        if self.algorithm == "competition":
+            out = check_competition(seq, model, budget=self.budget)
+        else:
+            out = search_opseq(seq, model, budget=self.budget)
         if out["valid"] is False:
             # exact confirmation + witness for the report, on the
             # shortest sound prefix covering the failure region

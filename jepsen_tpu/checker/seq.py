@@ -57,7 +57,8 @@ def _walk_parents(parent_of: dict, key) -> list[int]:
 
 def check_opseq(seq: OpSeq, model: ModelSpec, *,
                 max_configs: int = 5_000_000,
-                deadline: float | None = None) -> dict:
+                deadline: float | None = None,
+                cancel=None) -> dict:
     """Run the DFS over a columnar OpSeq.  Returns a knossos-style map:
 
     valid        True | False | "unknown"
@@ -69,7 +70,10 @@ def check_opseq(seq: OpSeq, model: ModelSpec, *,
 
     ``deadline`` (``time.perf_counter()`` clock) yields "unknown" once
     exceeded (checked every 4096 configs) — the wall-clock twin of
-    ``max_configs`` for time-bounded throughput comparisons.
+    ``max_configs`` for time-bounded throughput comparisons.  ``cancel``
+    (a ``threading.Event``) yields "unknown" once set — how the
+    competition mode retires the loser (see
+    ``linearizable.check_competition``).
     """
     import time
     n = len(seq)
@@ -111,11 +115,14 @@ def check_opseq(seq: OpSeq, model: ModelSpec, *,
             return {"valid": "unknown", "configs": configs,
                     "max_depth": max_depth,
                     "info": f"exceeded max_configs={max_configs}"}
-        if (deadline is not None and configs % 4096 == 0
-                and time.perf_counter() > deadline):
-            return {"valid": "unknown", "configs": configs,
-                    "max_depth": max_depth,
-                    "info": "exceeded deadline"}
+        if configs % 4096 == 0:
+            if deadline is not None and time.perf_counter() > deadline:
+                return {"valid": "unknown", "configs": configs,
+                        "max_depth": max_depth,
+                        "info": "exceeded deadline"}
+            if cancel is not None and cancel.is_set():
+                return {"valid": "unknown", "configs": configs,
+                        "max_depth": max_depth, "info": "cancelled"}
 
         if (mask & ok_mask) == ok_mask:
             lin = _walk_parents(parent_of, key)

@@ -319,3 +319,45 @@ def test_fuzzer_smoke(monkeypatch):
     assert invalid(small, model)
     assert len(small) < len(h), "shrinker must actually reduce"
     assert len(small) <= 12, f"expected a small core, got {len(small)}"
+
+
+# ---------------------------------------------------------------------------
+# competition mode (checker.clj:122-126's :competition selector)
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize("bad", [False, True])
+def test_competition_agrees_with_oracle(bad):
+    rng = random.Random(31 if bad else 13)
+    h = random_register_history(rng, n_procs=4, n_ops=60)
+    if bad:
+        h = corrupt(rng, h)
+    model = cas_register()
+    s = encode_ops(h, model.f_codes)
+    want = oracle.check_opseq(s, model)["valid"]
+    out = lin.check_competition(s, model)
+    assert out["valid"] == want
+    assert out["engine"].startswith("competition(")
+
+
+def test_competition_host_wins_when_device_stalls(monkeypatch):
+    """With a zero device budget the host oracle must carry the race."""
+    rng = random.Random(5)
+    h = corrupt(rng, random_register_history(rng, n_procs=4, n_ops=50))
+    model = cas_register()
+    s = encode_ops(h, model.f_codes)
+    out = lin.check_competition(s, model, budget=1)
+    assert out["valid"] is False
+    assert out["engine"] == "competition(host-oracle)"
+
+
+def test_linearizable_algorithm_selection():
+    rng = random.Random(77)
+    h = corrupt(rng, random_register_history(rng, n_procs=4, n_ops=60))
+    model = cas_register()
+    test = {"name": "alg", "start_time": 0}
+    for alg in ("auto", "host", "wgl", "device", "linear", "competition"):
+        chk = lin.linearizable(model, algorithm=alg)
+        assert chk.check(test, h, {})["valid"] is False, alg
+    with pytest.raises(ValueError):
+        lin.linearizable(model, algorithm="quantum")

@@ -261,3 +261,91 @@ def multi_register(width: int, initial: int = 0) -> ModelSpec:
         jstep=jstep,
         doc=f"{width} independent registers addressed by (key, value) ops",
     )
+
+
+# ---------------------------------------------------------------------------
+# unordered-queue — a bounded multiset (knossos.model/unordered-queue);
+# enqueue always adds, dequeue of v is legal iff v is present.  The
+# reference checks queue workloads by model-reducing histories
+# (checker.clj:141-147, disque.clj:305, rabbitmq_test.clj:55); this model
+# additionally makes them *searchable* on device: the multiset state is a
+# CAPACITY-lane sorted int32 array (SURVEY.md §7's "sorted-array encodings
+# with capacity caps"), so equal multisets are bit-identical and the
+# engine's exact dedup applies unchanged.
+# ---------------------------------------------------------------------------
+
+Q_ENQ, Q_DEQ = 0, 1
+
+#: empty lane marker — sorts after every real value (encoded values are
+#: small non-negative ints; 2**31-1 is reserved)
+Q_EMPTY = 2**31 - 1
+
+
+def _uq_pystep_factory(capacity: int):
+    def pystep(state, f, v1, v2):
+        if v1 == NIL:
+            # an op with an unknown value (crashed invoke) constrains
+            # nothing and changes nothing, matching the register models'
+            # NIL convention
+            return state
+        if f == Q_ENQ:
+            if state[capacity - 1] != Q_EMPTY:
+                return None  # over capacity: size the model to the history
+            s = sorted(state[:capacity - 1] + (v1,))
+            return tuple(s) + (Q_EMPTY,) * (capacity - len(s))
+        if f == Q_DEQ:
+            if v1 not in state:
+                return None
+            s = list(state)
+            s.remove(v1)
+            return tuple(s) + (Q_EMPTY,)
+        raise ValueError(f"unordered-queue: bad f code {f}")
+
+    return pystep
+
+
+def _uq_jstep_factory(capacity: int):
+    def jstep(state, f, v1, v2):
+        idx = jnp.arange(capacity)
+        nil = v1 == NIL
+
+        # enqueue: sorted insert at position cnt = |{i: state[i] <= v}|
+        room = state[capacity - 1] == Q_EMPTY
+        cnt = (state <= v1).sum()
+        prev = jnp.roll(state, 1)  # prev[0] unused (idx 0 is < or == cnt)
+        enq = jnp.where(idx < cnt, state,
+                        jnp.where(idx == cnt, v1, prev))
+
+        # dequeue: remove the first lane equal to v (duplicates keep one)
+        eq = state == v1
+        present = eq.any()
+        m = jnp.argmax(eq)
+        nxt = jnp.concatenate(
+            [state[1:], jnp.full((1,), Q_EMPTY, state.dtype)])
+        deq = jnp.where(idx < m, state, nxt)
+
+        is_enq = f == Q_ENQ
+        legal = jnp.where(nil, True, jnp.where(is_enq, room, present))
+        new_state = jnp.where(
+            nil | ~legal, state,
+            jnp.where(is_enq, enq, deq))
+        return new_state, legal
+
+    return jstep
+
+
+def unordered_queue(capacity: int = 16) -> ModelSpec:
+    """Bounded unordered queue (multiset).  ``capacity`` must be at least
+    the largest queue length any linearization of the history can reach
+    (#enqueues is always a safe bound); an enqueue past capacity is
+    treated as illegal, which would wrongly fail an over-capacity legal
+    history — size generously."""
+    return ModelSpec(
+        name=f"unordered-queue-{capacity}",
+        f_codes={"enqueue": Q_ENQ, "dequeue": Q_DEQ},
+        state_width=capacity,
+        init=(Q_EMPTY,) * capacity,
+        pystep=_uq_pystep_factory(capacity),
+        jstep=_uq_jstep_factory(capacity),
+        doc="bounded multiset; dequeue legal iff the value is present",
+    )

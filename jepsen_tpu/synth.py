@@ -254,3 +254,67 @@ def mutate(rng: random.Random, h: list[Op]) -> list[Op]:
     elif idx:
         h.insert(rng.choice(idx), h[rng.choice(idx)])
     return h
+
+
+def sim_queue_history(rng: random.Random, n_ops: int = 40,
+                      n_procs: int = 4, *,
+                      crash_p: float = 0.0) -> list[Op]:
+    """Enqueue/dequeue against a real in-memory multiset, valid by
+    construction (unordered-queue semantics: ops take effect at
+    completion; dequeues return an arbitrary present element).  Enqueued
+    values are unique integers so corruptions are unambiguous.  Crashed
+    enqueues apply their effect with probability .5 — but a crashed
+    enqueue's value may then be dequeued later, which is still valid (the
+    checker must consider the crashed op as possibly-linearized,
+    core.clj:387-397)."""
+    contents: list[int] = []
+    h: list[Op] = []
+    pending: dict = {}  # process -> (f, value-or-None)
+    crashed: set = set()
+    next_v = 0
+    done = 0
+    while done < n_ops or pending:
+        live = [p for p in range(n_procs) if p not in crashed]
+        if not live:
+            break
+        p = rng.choice(live)
+        if p in pending:
+            f, v = pending.pop(p)
+            if crash_p and rng.random() < crash_p:
+                if f == "enqueue" and rng.random() < 0.5:
+                    contents.append(v)
+                crashed.add(p)
+                h.append(info_op(p, f, v))
+                continue
+            if f == "enqueue":
+                contents.append(v)
+                h.append(ok_op(p, f, v))
+            else:  # dequeue completes only if something is present
+                if contents:
+                    got = contents.pop(rng.randrange(len(contents)))
+                    h.append(ok_op(p, f, got))
+                else:
+                    h.append(fail_op(p, f, None))
+        elif done < n_ops:
+            if rng.random() < 0.55 or not contents:
+                f, v = "enqueue", next_v
+                next_v += 1
+            else:
+                f, v = "dequeue", None
+            h.append(invoke_op(p, f, v))
+            pending[p] = (f, v)
+            done += 1
+    return h
+
+
+def corrupt_dequeue(rng: random.Random, h: list[Op]) -> list[Op]:
+    """Rewrite one ok dequeue's value to one never enqueued — a
+    from-thin-air element no linearization can explain."""
+    idx = [i for i, op in enumerate(h)
+           if op.type == "ok" and op.f == "dequeue"]
+    if not idx:
+        return h
+    i = rng.choice(idx)
+    h = list(h)
+    h[i] = replace(h[i], value=999_983)
+    return h

@@ -361,3 +361,55 @@ def test_linearizable_algorithm_selection():
         assert chk.check(test, h, {})["valid"] is False, alg
     with pytest.raises(ValueError):
         lin.linearizable(model, algorithm="quantum")
+
+
+# ---------------------------------------------------------------------------
+# unordered-queue model on device (sorted-array multiset encoding)
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize("seed", range(8))
+def test_differential_queue_histories(seed):
+    from jepsen_tpu.models import unordered_queue
+    from jepsen_tpu.synth import corrupt_dequeue, sim_queue_history
+
+    rng = random.Random(500 + seed)
+    h = sim_queue_history(rng, 30, 4,
+                          crash_p=(0.1 if seed % 2 else 0.0))
+    n_enq = sum(1 for o in h if o.f == "enqueue" and o.type == "invoke")
+    # fixed capacity so every seed shares ONE compiled kernel (the cache
+    # keys on model.name, which embeds capacity)
+    model = unordered_queue(31)
+    assert n_enq < 31
+    s = encode_ops(h, model.f_codes)
+    a = oracle.check_opseq(s, model)
+    b = lin.search_opseq(s, model)
+    assert a["valid"] is True, f"simulator produced invalid queue? {a}"
+    assert b["valid"] is True, f"device disagrees: {b}"
+
+    hb = corrupt_dequeue(random.Random(seed), h)
+    if hb is not h:
+        sb = encode_ops(hb, model.f_codes)
+        ab = oracle.check_opseq(sb, model)
+        bb = lin.search_opseq(sb, model)
+        assert bb["valid"] == ab["valid"], f"oracle={ab} device={bb}"
+
+
+def test_queue_duplicate_values_dedup():
+    """Two enqueues of the same value: the multiset must hold both, and
+    dequeuing it twice is legal while a third dequeue is not."""
+    from jepsen_tpu.models import unordered_queue
+
+    model = unordered_queue(4)
+    h = [invoke_op(0, "enqueue", 7), ok_op(0, "enqueue", 7),
+         invoke_op(0, "enqueue", 7), ok_op(0, "enqueue", 7),
+         invoke_op(0, "dequeue", None), ok_op(0, "dequeue", 7),
+         invoke_op(0, "dequeue", None), ok_op(0, "dequeue", 7)]
+    s = encode_ops(h, model.f_codes)
+    assert oracle.check_opseq(s, model)["valid"] is True
+    assert lin.search_opseq(s, model)["valid"] is True
+
+    h_bad = h + [invoke_op(0, "dequeue", None), ok_op(0, "dequeue", 7)]
+    s_bad = encode_ops(h_bad, model.f_codes)
+    assert oracle.check_opseq(s_bad, model)["valid"] is False
+    assert lin.search_opseq(s_bad, model)["valid"] is False

@@ -7,7 +7,7 @@ import pytest
 
 from jepsen_tpu.history import NIL
 from jepsen_tpu.models import (
-    cas_register, multi_register, mutex, noop, register,
+    cas_register, multi_register, mutex, noop, register, unordered_queue,
 )
 
 
@@ -87,6 +87,12 @@ CASES = {
     "multi-register": (multi_register(4, 0), [
         ("read", 0, 0), ("read", 2, 1), ("read", 1, NIL),
         ("write", 3, 7), ("write", 0, -2),
+    ]),
+    "unordered-queue": (unordered_queue(4), [
+        ("enqueue", 1, NIL), ("enqueue", 2, NIL), ("enqueue", 2, NIL),
+        ("enqueue", NIL, NIL),
+        ("dequeue", 1, NIL), ("dequeue", 2, NIL), ("dequeue", 7, NIL),
+        ("dequeue", NIL, NIL),
     ]),
 }
 

@@ -36,31 +36,48 @@ if os.environ.get("JAX_PLATFORMS") == "cpu":
 
 from jepsen_tpu.checker import linearizable as lin, seq as oracle  # noqa: E402
 from jepsen_tpu.history import Op, encode_ops, info_op, invoke_op, ok_op  # noqa: E402
-from jepsen_tpu.models import cas_register, mutex, register  # noqa: E402
+from jepsen_tpu.models import (  # noqa: E402
+    cas_register, mutex, register, unordered_queue,
+)
 
 MODELS = {
     "cas-register": cas_register,
     "register": lambda: register(0),
     "mutex": mutex,
+    # capacity bounds the multiset; #enqueues never exceeds n-ops, and
+    # the fuzzer caps queue histories at 32 ops (see gen_history)
+    "unordered-queue": lambda: unordered_queue(33),
 }
+
+#: queue configs carry a 33-lane state; keep their histories small
+QUEUE_MAX_OPS = 32
 
 
 def gen_history(rng: random.Random, model_name: str, n_ops: int,
                 n_procs: int, crash_p: float) -> list[Op]:
     """Canonical simulators live in jepsen_tpu/synth.py (shared with the
     differential tests)."""
-    from jepsen_tpu.synth import sim_mutex_history, sim_register_history
+    from jepsen_tpu.synth import (
+        sim_mutex_history, sim_queue_history, sim_register_history,
+    )
 
     if model_name == "mutex":
         return sim_mutex_history(rng, n_ops, n_procs, crash_p=crash_p)
+    if model_name == "unordered-queue":
+        return sim_queue_history(rng, min(n_ops, QUEUE_MAX_OPS), n_procs,
+                                 crash_p=crash_p)
     return sim_register_history(rng, n_procs, n_ops, crash_p=crash_p,
                                 cas=(model_name == "cas-register"),
                                 max_crashes=16)
 
 
 def corrupt(rng: random.Random, h: list[Op]) -> list[Op]:
-    from jepsen_tpu.synth import mutate
+    from jepsen_tpu.synth import corrupt_dequeue, mutate
 
+    if any(op.f == "dequeue" for op in h) and rng.random() < 0.5:
+        # queue-specific from-thin-air corruption: a dequeue of a value
+        # never enqueued (mutate's flip_read arm is a no-op on queues)
+        return corrupt_dequeue(rng, h)
     return mutate(rng, h)
 
 

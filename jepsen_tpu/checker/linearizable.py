@@ -1260,6 +1260,107 @@ def _init_batch_carry(n: int, dims: SearchDims, model: ModelSpec):
             np.zeros(n, np.int32), np.zeros(n, bool))
 
 
+def _drive_batch_compacting(fn, esps, model: ModelSpec, dims: SearchDims,
+                            budget: int):
+    """Slice driver for the vmapped batch kernel with active-key
+    compaction.
+
+    A vmapped `while_loop` runs until its SLOWEST lane finishes — already
+    -resolved keys keep executing the (masked) body, so a long-tail key
+    makes every finished key burn device time with it.  Between slices,
+    finished keys are recorded host-side and, once the live set fits a
+    smaller power-of-two batch, the stacked args/carry are rebuilt at
+    that size (pad lanes carry status=VALID, count=0: they mask out
+    immediately).  Shapes stay on the power-of-two grid so jit re-traces
+    at most log2(n) batch sizes, all served by the persistent compile
+    cache.
+
+    Returns final (status, count, configs, depth, ovf) arrays over ALL
+    keys, in input order.
+    """
+    n = len(esps)
+
+    fin = {}  # key -> (status, count, configs, depth, ovf)
+
+    def grid(k: int) -> int:
+        return max(4, _next_pow2(k))
+
+    def stack(keys, carry_rows):
+        b = grid(len(keys))
+        pad = b - len(keys)
+
+        def st(attr):
+            rows = [getattr(esps[k], attr) for k in keys]
+            rows += [rows[0]] * pad
+            return jnp.asarray(np.stack(rows))
+
+        args = (st("det_f"), st("det_v1"), st("det_v2"), st("det_inv"),
+                st("det_ret"), st("suffix_min_ret"), st("crash_f"),
+                st("crash_v1"), st("crash_v2"), st("crash_inv"),
+                jnp.asarray(np.array(
+                    [esps[k].n_det for k in keys] + [0] * pad, np.int32)),
+                jnp.asarray(np.array(
+                    [esps[k].n_crash for k in keys] + [0] * pad,
+                    np.int32)))
+        cs = []
+        for j, proto in enumerate(carry_rows[0]):
+            rows = [np.asarray(carry_rows[i][j]) for i in
+                    range(len(keys))]
+            pad_row = np.zeros_like(rows[0])
+            if j == 2:
+                pad_row = pad_row + VALID  # pad lanes: masked out
+            cs.append(jnp.asarray(np.stack(rows + [pad_row] * pad)))
+        return args, tuple(cs)
+
+    row0 = tuple(np.asarray(c)[0]
+                 for c in _init_batch_carry(1, dims, model))
+    lanes = list(range(n))  # lane position -> key id (fixed between
+    # re-stacks, so carry rows and keys never misalign; retired keys
+    # keep their dead lane until the next grid shrink)
+    args, carry = stack(lanes, [row0] * n)
+
+    lvl_cap = _SLICE_LEVELS0
+    first = True
+    while True:
+        t0 = time.perf_counter()
+        carry = fn(*args, jnp.int32(budget), jnp.int32(lvl_cap),
+                   jnp.bool_(False), *carry)
+        jax.block_until_ready(carry)
+        dt = time.perf_counter() - t0
+        status = np.asarray(carry[2])
+        count = np.asarray(carry[1])
+        configs = np.asarray(carry[3])
+        depth = np.asarray(carry[4])
+        ovf = np.asarray(carry[5])
+        live = []  # lane indices still running
+        for i, k in enumerate(lanes):
+            if k in fin:
+                continue
+            if (status[i] != -1 or count[i] <= 0
+                    or configs[i] >= budget):
+                fin[k] = (status[i], count[i], configs[i], depth[i],
+                          ovf[i])
+            else:
+                live.append(i)
+        if not live:
+            break
+        if not first:
+            lvl_cap = _adapt_lvl_cap(lvl_cap, dt)
+        first = False
+        if grid(len(live)) < grid(len(lanes)):
+            rows = [tuple(np.asarray(c)[i] for c in carry) for i in live]
+            lanes = [lanes[i] for i in live]
+            args, carry = stack(lanes, rows)
+            first = True  # new shape: next slice may include a compile
+
+    out = np.zeros((5, n), np.int64)
+    for k, vals in fin.items():
+        out[:, k] = [int(v) for v in vals]
+    return (out[0].astype(np.int32), out[1].astype(np.int32),
+            out[2].astype(np.int32), out[3].astype(np.int32),
+            out[4].astype(bool))
+
+
 def search_batch(seqs: list[OpSeq], model: ModelSpec, *,
                  budget: int = 2_000_000,
                  dims: SearchDims | None = None,
@@ -1312,29 +1413,37 @@ def search_batch(seqs: list[OpSeq], model: ModelSpec, *,
         return out
 
     dims = dims or batch_dims(ess, model)
-    args = stack_batch(seqs, model, dims)
-    carry = tuple(jnp.asarray(c) for c in
-                  _init_batch_carry(len(seqs), dims, model))
-    if sharding is not None:
-        args = tuple(jax.device_put(a, sharding) for a in args)
-        carry = tuple(jax.device_put(c, sharding) for c in carry)
     fn = get_batch_kernel(model, dims)
 
-    def call(c, lvl_cap):
-        return fn(*args, jnp.int32(budget), jnp.int32(lvl_cap),
-                  jnp.bool_(False), *c)
+    if sharding is not None:
+        # mesh-sharded batch: fixed size (the key axis must keep
+        # covering the mesh), plain slice driver
+        args = stack_batch(seqs, model, dims)
+        carry = tuple(jnp.asarray(c) for c in
+                      _init_batch_carry(len(seqs), dims, model))
+        args = tuple(jax.device_put(a, sharding) for a in args)
+        carry = tuple(jax.device_put(c, sharding) for c in carry)
 
-    def is_active(c):
-        active = ((np.asarray(c[2]) == -1) & (np.asarray(c[1]) > 0)
-                  & (np.asarray(c[3]) < budget))
-        return bool(active.any())
+        def call(c, lvl_cap):
+            return fn(*args, jnp.int32(budget), jnp.int32(lvl_cap),
+                      jnp.bool_(False), *c)
 
-    carry = _drive_slices(call, carry, is_active)
-    status = np.asarray(carry[2])
-    count = np.asarray(carry[1])
-    configs = np.asarray(carry[3])
-    depth = np.asarray(carry[4])
-    ovf = np.asarray(carry[5])
+        def is_active(c):
+            active = ((np.asarray(c[2]) == -1) & (np.asarray(c[1]) > 0)
+                      & (np.asarray(c[3]) < budget))
+            return bool(active.any())
+
+        carry = _drive_slices(call, carry, is_active)
+        status = np.asarray(carry[2])
+        count = np.asarray(carry[1])
+        configs = np.asarray(carry[3])
+        depth = np.asarray(carry[4])
+        ovf = np.asarray(carry[5])
+    else:
+        esps = [pad_search(e, dims.n_det_pad, dims.n_crash_pad)
+                for e in ess]
+        status, count, configs, depth, ovf = _drive_batch_compacting(
+            fn, esps, model, dims, budget)
     # host-side finalization of still -1 statuses (dead frontier or
     # exhausted budget), mirroring _run_kernel
     status = np.where(

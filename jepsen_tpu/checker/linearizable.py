@@ -1235,19 +1235,31 @@ def get_batch_kernel(model: ModelSpec, dims: SearchDims):
     return fn
 
 
-def stack_batch(seqs: list[OpSeq], model: ModelSpec, dims: SearchDims):
-    """Encode + pad every history and stack along a leading key axis."""
-    ess = [pad_search(encode_search(s), dims.n_det_pad, dims.n_crash_pad)
-           for s in seqs]
+#: per-key array attributes, in the exact positional order of
+#: build_search_step_fn's signature — the single source of truth for
+#: both batch stackers
+_BATCH_ARG_ATTRS = ("det_f", "det_v1", "det_v2", "det_inv", "det_ret",
+                    "suffix_min_ret", "crash_f", "crash_v1", "crash_v2",
+                    "crash_inv")
+
+
+def stack_batch(esps: list[EncodedSearch], *, pad_to: int | None = None):
+    """Stack padded EncodedSearches along a leading key axis.  Rows past
+    ``len(esps)`` (up to ``pad_to``) replicate row 0's arrays with
+    n_det = n_crash = 0 — inert pad keys."""
+    b = pad_to or len(esps)
+    pad = b - len(esps)
 
     def st(attr):
-        return jnp.asarray(np.stack([getattr(e, attr) for e in ess]))
+        rows = [getattr(e, attr) for e in esps]
+        rows += [rows[0]] * pad
+        return jnp.asarray(np.stack(rows))
 
-    return (st("det_f"), st("det_v1"), st("det_v2"), st("det_inv"),
-            st("det_ret"), st("suffix_min_ret"), st("crash_f"),
-            st("crash_v1"), st("crash_v2"), st("crash_inv"),
-            jnp.asarray(np.array([e.n_det for e in ess], np.int32)),
-            jnp.asarray(np.array([e.n_crash for e in ess], np.int32)))
+    return tuple(st(a) for a in _BATCH_ARG_ATTRS) + (
+        jnp.asarray(np.array([e.n_det for e in esps] + [0] * pad,
+                             np.int32)),
+        jnp.asarray(np.array([e.n_crash for e in esps] + [0] * pad,
+                             np.int32)))
 
 
 def _init_batch_carry(n: int, dims: SearchDims, model: ModelSpec):
@@ -1288,20 +1300,7 @@ def _drive_batch_compacting(fn, esps, model: ModelSpec, dims: SearchDims,
     def stack(keys, carry_rows):
         b = grid(len(keys))
         pad = b - len(keys)
-
-        def st(attr):
-            rows = [getattr(esps[k], attr) for k in keys]
-            rows += [rows[0]] * pad
-            return jnp.asarray(np.stack(rows))
-
-        args = (st("det_f"), st("det_v1"), st("det_v2"), st("det_inv"),
-                st("det_ret"), st("suffix_min_ret"), st("crash_f"),
-                st("crash_v1"), st("crash_v2"), st("crash_inv"),
-                jnp.asarray(np.array(
-                    [esps[k].n_det for k in keys] + [0] * pad, np.int32)),
-                jnp.asarray(np.array(
-                    [esps[k].n_crash for k in keys] + [0] * pad,
-                    np.int32)))
+        args = stack_batch([esps[k] for k in keys], pad_to=b)
         cs = []
         for j, proto in enumerate(carry_rows[0]):
             rows = [np.asarray(carry_rows[i][j]) for i in
@@ -1418,7 +1417,8 @@ def search_batch(seqs: list[OpSeq], model: ModelSpec, *,
     if sharding is not None:
         # mesh-sharded batch: fixed size (the key axis must keep
         # covering the mesh), plain slice driver
-        args = stack_batch(seqs, model, dims)
+        args = stack_batch([pad_search(e, dims.n_det_pad,
+                                       dims.n_crash_pad) for e in ess])
         carry = tuple(jnp.asarray(c) for c in
                       _init_batch_carry(len(seqs), dims, model))
         args = tuple(jax.device_put(a, sharding) for a in args)

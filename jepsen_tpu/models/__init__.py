@@ -349,3 +349,67 @@ def unordered_queue(capacity: int = 16) -> ModelSpec:
         jstep=_uq_jstep_factory(capacity),
         doc="bounded multiset; dequeue legal iff the value is present",
     )
+
+
+# ---------------------------------------------------------------------------
+# fifo-queue — knossos.model/fifo-queue: dequeue must return the OLDEST
+# element.  State is a left-aligned bounded ring (front at lane 0, empty
+# lanes = Q_EMPTY): enqueue appends at the fill count, dequeue matches
+# lane 0 and shifts left.  Left-alignment keeps the encoding canonical,
+# so the engine's exact dedup applies unchanged.
+# ---------------------------------------------------------------------------
+
+
+def _fq_pystep_factory(capacity: int):
+    def pystep(state, f, v1, v2):
+        if v1 == NIL:
+            return state
+        if f == Q_ENQ:
+            if state[capacity - 1] != Q_EMPTY:
+                return None  # over capacity: size the model generously
+            cnt = sum(1 for x in state if x != Q_EMPTY)
+            return state[:cnt] + (v1,) + state[cnt + 1:]
+        if f == Q_DEQ:
+            if state[0] == Q_EMPTY or state[0] != v1:
+                return None
+            return state[1:] + (Q_EMPTY,)
+        raise ValueError(f"fifo-queue: bad f code {f}")
+
+    return pystep
+
+
+def _fq_jstep_factory(capacity: int):
+    def jstep(state, f, v1, v2):
+        idx = jnp.arange(capacity)
+        nil = v1 == NIL
+
+        room = state[capacity - 1] == Q_EMPTY
+        cnt = (state != Q_EMPTY).sum()
+        enq = jnp.where(idx == cnt, v1, state)
+
+        head_ok = (state[0] != Q_EMPTY) & (state[0] == v1)
+        deq = jnp.concatenate(
+            [state[1:], jnp.full((1,), Q_EMPTY, state.dtype)])
+
+        is_enq = f == Q_ENQ
+        legal = jnp.where(nil, True, jnp.where(is_enq, room, head_ok))
+        new_state = jnp.where(
+            nil | ~legal, state,
+            jnp.where(is_enq, enq, deq))
+        return new_state, legal
+
+    return jstep
+
+
+def fifo_queue(capacity: int = 16) -> ModelSpec:
+    """Bounded FIFO queue; see `unordered_queue` for the capacity
+    contract (an enqueue past capacity is treated as illegal)."""
+    return ModelSpec(
+        name=f"fifo-queue-{capacity}",
+        f_codes={"enqueue": Q_ENQ, "dequeue": Q_DEQ},
+        state_width=capacity,
+        init=(Q_EMPTY,) * capacity,
+        pystep=_fq_pystep_factory(capacity),
+        jstep=_fq_jstep_factory(capacity),
+        doc="bounded FIFO; dequeue legal iff it returns the oldest",
+    )

@@ -258,15 +258,16 @@ def mutate(rng: random.Random, h: list[Op]) -> list[Op]:
 
 def sim_queue_history(rng: random.Random, n_ops: int = 40,
                       n_procs: int = 4, *,
-                      crash_p: float = 0.0) -> list[Op]:
+                      crash_p: float = 0.0,
+                      fifo: bool = False) -> list[Op]:
     """Enqueue/dequeue against a real in-memory multiset, valid by
-    construction (unordered-queue semantics: ops take effect at
-    completion; dequeues return an arbitrary present element).  Enqueued
-    values are unique integers so corruptions are unambiguous.  Crashed
-    enqueues apply their effect with probability .5 — but a crashed
-    enqueue's value may then be dequeued later, which is still valid (the
-    checker must consider the crashed op as possibly-linearized,
-    core.clj:387-397)."""
+    construction (ops take effect at completion; dequeues return an
+    arbitrary present element — or the oldest when ``fifo``, making the
+    history fifo-queue-valid).  Enqueued values are unique integers so
+    corruptions are unambiguous.  Crashed enqueues apply their effect
+    with probability .5 — but a crashed enqueue's value may then be
+    dequeued later, which is still valid (the checker must consider the
+    crashed op as possibly-linearized, core.clj:387-397)."""
     contents: list[int] = []
     h: list[Op] = []
     pending: dict = {}  # process -> (f, value-or-None)
@@ -291,7 +292,8 @@ def sim_queue_history(rng: random.Random, n_ops: int = 40,
                 h.append(ok_op(p, f, v))
             else:  # dequeue completes only if something is present
                 if contents:
-                    got = contents.pop(rng.randrange(len(contents)))
+                    got = contents.pop(
+                        0 if fifo else rng.randrange(len(contents)))
                     h.append(ok_op(p, f, got))
                 else:
                     h.append(fail_op(p, f, None))
@@ -304,6 +306,20 @@ def sim_queue_history(rng: random.Random, n_ops: int = 40,
             h.append(invoke_op(p, f, v))
             pending[p] = (f, v)
             done += 1
+    return h
+
+
+def swap_dequeues(rng: random.Random, h: list[Op]) -> list[Op]:
+    """Swap two ok dequeues' values — reorders the service order, which a
+    FIFO model must reject unless the two were concurrent."""
+    idx = [i for i, op in enumerate(h)
+           if op.type == "ok" and op.f == "dequeue"]
+    if len(idx) < 2:
+        return h
+    i, j = rng.sample(idx, 2)
+    h = list(h)
+    h[i], h[j] = (replace(h[i], value=h[j].value),
+                  replace(h[j], value=h[i].value))
     return h
 
 

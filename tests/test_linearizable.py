@@ -442,3 +442,43 @@ def test_search_batch_mixed_difficulty_compaction():
                for r in got)
     # at least the corrupted keys must have ridden the device
     assert sum(r["engine"] == "tpu-batch" for r in got) >= 6
+
+
+@pytest.mark.parametrize("seed", range(8))
+def test_differential_fifo_queue_histories(seed):
+    from jepsen_tpu.models import fifo_queue
+    from jepsen_tpu.synth import sim_queue_history, swap_dequeues
+
+    rng = random.Random(600 + seed)
+    h = sim_queue_history(rng, 28, 4, fifo=True,
+                          crash_p=(0.1 if seed % 2 else 0.0))
+    model = fifo_queue(29)
+    s = encode_ops(h, model.f_codes)
+    a = oracle.check_opseq(s, model)
+    b = lin.search_opseq(s, model)
+    assert a["valid"] is True, f"simulator produced invalid fifo? {a}"
+    assert b["valid"] is True, f"device disagrees: {b}"
+
+    hb = swap_dequeues(random.Random(seed), h)
+    if hb is not h:
+        sb = encode_ops(hb, model.f_codes)
+        ab = oracle.check_opseq(sb, model)
+        bb = lin.search_opseq(sb, model)
+        assert bb["valid"] == ab["valid"], f"oracle={ab} device={bb}"
+
+
+def test_fifo_rejects_out_of_order_service():
+    from jepsen_tpu.models import fifo_queue, unordered_queue
+
+    h = [invoke_op(0, "enqueue", 1), ok_op(0, "enqueue", 1),
+         invoke_op(0, "enqueue", 2), ok_op(0, "enqueue", 2),
+         invoke_op(0, "dequeue", None), ok_op(0, "dequeue", 2),
+         invoke_op(0, "dequeue", None), ok_op(0, "dequeue", 1)]
+    fifo, uq = fifo_queue(4), unordered_queue(4)
+    s_f = encode_ops(h, fifo.f_codes)
+    s_u = encode_ops(h, uq.f_codes)
+    # LIFO service order: fine for a multiset, fatal for FIFO
+    assert oracle.check_opseq(s_u, uq)["valid"] is True
+    assert lin.search_opseq(s_u, uq)["valid"] is True
+    assert oracle.check_opseq(s_f, fifo)["valid"] is False
+    assert lin.search_opseq(s_f, fifo)["valid"] is False

@@ -7,7 +7,8 @@ import pytest
 
 from jepsen_tpu.history import NIL
 from jepsen_tpu.models import (
-    cas_register, multi_register, mutex, noop, register, unordered_queue,
+    cas_register, fifo_queue, multi_register, mutex, noop, register,
+    unordered_queue,
 )
 
 
@@ -87,6 +88,11 @@ CASES = {
     "multi-register": (multi_register(4, 0), [
         ("read", 0, 0), ("read", 2, 1), ("read", 1, NIL),
         ("write", 3, 7), ("write", 0, -2),
+    ]),
+    "fifo-queue": (fifo_queue(4), [
+        ("enqueue", 1, NIL), ("enqueue", 2, NIL), ("enqueue", NIL, NIL),
+        ("dequeue", 1, NIL), ("dequeue", 2, NIL), ("dequeue", 9, NIL),
+        ("dequeue", NIL, NIL),
     ]),
     "unordered-queue": (unordered_queue(4), [
         ("enqueue", 1, NIL), ("enqueue", 2, NIL), ("enqueue", 2, NIL),

@@ -37,7 +37,7 @@ if os.environ.get("JAX_PLATFORMS") == "cpu":
 from jepsen_tpu.checker import linearizable as lin, seq as oracle  # noqa: E402
 from jepsen_tpu.history import Op, encode_ops, info_op, invoke_op, ok_op  # noqa: E402
 from jepsen_tpu.models import (  # noqa: E402
-    cas_register, mutex, register, unordered_queue,
+    cas_register, fifo_queue, mutex, register, unordered_queue,
 )
 
 MODELS = {
@@ -47,6 +47,7 @@ MODELS = {
     # capacity bounds the multiset; #enqueues never exceeds n-ops, and
     # the fuzzer caps queue histories at 32 ops (see gen_history)
     "unordered-queue": lambda: unordered_queue(33),
+    "fifo-queue": lambda: fifo_queue(33),
 }
 
 #: queue configs carry a 33-lane state; keep their histories small
@@ -63,9 +64,10 @@ def gen_history(rng: random.Random, model_name: str, n_ops: int,
 
     if model_name == "mutex":
         return sim_mutex_history(rng, n_ops, n_procs, crash_p=crash_p)
-    if model_name == "unordered-queue":
+    if model_name in ("unordered-queue", "fifo-queue"):
         return sim_queue_history(rng, min(n_ops, QUEUE_MAX_OPS), n_procs,
-                                 crash_p=crash_p)
+                                 crash_p=crash_p,
+                                 fifo=model_name == "fifo-queue")
     return sim_register_history(rng, n_procs, n_ops, crash_p=crash_p,
                                 cas=(model_name == "cas-register"),
                                 max_crashes=16)
@@ -75,8 +77,12 @@ def corrupt(rng: random.Random, h: list[Op]) -> list[Op]:
     from jepsen_tpu.synth import corrupt_dequeue, mutate
 
     if any(op.f == "dequeue" for op in h) and rng.random() < 0.5:
-        # queue-specific from-thin-air corruption: a dequeue of a value
-        # never enqueued (mutate's flip_read arm is a no-op on queues)
+        # queue-specific corruptions: a from-thin-air dequeue, or a
+        # service-order swap (mutate's flip_read arm is a no-op here)
+        from jepsen_tpu.synth import swap_dequeues
+
+        if rng.random() < 0.5:
+            return swap_dequeues(rng, h)
         return corrupt_dequeue(rng, h)
     return mutate(rng, h)
 

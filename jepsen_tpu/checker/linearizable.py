@@ -272,6 +272,71 @@ def _unpack_bits(words, n_words):
     return bits.reshape(shape + (n_words * 32,)).astype(bool)
 
 
+#: above this row count the packed single-key sort's hash bits get too
+#: thin (dup survival rises), so dedup falls back to the exact variadic
+#: (key, iota) sort
+_PACKED_SORT_MAX = 1 << 16
+
+
+def _sort_dedup(h1, valid, cfgs, S: int):
+    """Sort rows so identical configs become adjacent, then drop exact
+    duplicates.  Returns (svalid, scfgs) in sorted order.
+
+    Two strategies, chosen by static size:
+
+    * S <= _PACKED_SORT_MAX: ONE uint32 key packs the hash's high bits
+      with the lane index — single-operand sorts are several times
+      faster than variadic ones on both backends.  Identical configs
+      share hash high bits and so sort into one bucket; a foreign config
+      in between (bucket collision) just means the duplicate survives —
+      never a wrong drop, because dropping still requires full-word
+      equality with the neighbor.
+    * larger S: exact (hash, iota) variadic sort.
+
+    Invalid lanes sort after every valid lane in their bucket (packed:
+    all-ones key; variadic: all-ones hash + the predecessor-validity
+    guard below).  A duplicate is dropped only when its predecessor is a
+    VALID row: invalid lanes hold clamped-gather REPLICAS of real rows,
+    and without the guard a tie-broken sort could place a replica before
+    the one real copy and drop it — losing a reachable configuration.
+    """
+    iota = jnp.arange(S, dtype=jnp.uint32)
+    if S <= _PACKED_SORT_MAX:
+        low = int(S).bit_length()  # iota <= S-1 < 2^low - 1 strictly
+        high_mask = np.uint32((~((1 << low) - 1)) & 0xFFFFFFFF)
+        packed = jnp.where(valid, (h1 & high_mask) | iota,
+                           np.uint32(0xFFFFFFFF))
+        sp = lax.sort(packed)
+        perm = (sp & np.uint32((1 << low) - 1)).astype(jnp.int32)
+        perm = jnp.minimum(perm, S - 1)  # all-ones rows: clamp
+        key = sp >> low
+        # an all-ones key IS the invalid marker (a valid row's iota is
+        # strictly below 2^low - 1, so it can never produce all-ones);
+        # without this mask the clamped perm would resurrect row S-1
+        svalid = jnp.take(valid, perm) & (sp != np.uint32(0xFFFFFFFF))
+        scfgs = jnp.take(cfgs, perm, axis=0)
+        return _neighbor_dedup(key, svalid, scfgs)
+    else:
+        big = np.uint32(0xFFFFFFFF)
+        h1s = jnp.where(valid, h1, big)
+        key, perm = lax.sort(
+            (h1s, jnp.arange(S, dtype=jnp.int32)), num_keys=1)
+        svalid = jnp.take(valid, perm)
+        scfgs = jnp.take(cfgs, perm, axis=0)
+        return _neighbor_dedup(key, svalid, scfgs)
+
+
+def _neighbor_dedup(key, svalid, scfgs):
+    """Drop rows byte-identical to a VALID predecessor with an equal
+    sort key (see _sort_dedup for why predecessor validity matters)."""
+    same_key = key[1:] == key[:-1]
+    same_cfg = jnp.all(scfgs[1:] == scfgs[:-1], axis=1)
+    prev_valid = svalid[:-1]
+    dup = jnp.concatenate(
+        [jnp.zeros(1, bool), same_key & same_cfg & prev_valid])
+    return svalid & ~dup, scfgs
+
+
 def _compact_indices(mask, k_out: int):
     """Indices of the first k_out set lanes of a bool mask (stable), plus
     the total count.  Sort-free stream compaction: cumsum + binary-search
@@ -351,22 +416,10 @@ def build_search_step_fn(model: ModelSpec, dims: SearchDims):
             ccfgs = jnp.take(cfgs, vsrc, axis=0)  # [S, WORDS]
             cvalid = jnp.arange(S) < n_valid
 
-            # --- level dedup: single-key hash sort + exact neighbor
-            # compare.  Identical configs share h1 and sort adjacent (up
-            # to h1 collisions, which only cost duplicate work, never
-            # correctness: dropping requires full-word equality).
+            # --- level dedup: hash sort + exact neighbor compare --------
             wu = ccfgs.astype(jnp.uint32)
             h1 = _hash_words(wu, 0x9E3779B1)
-            big = np.uint32(0xFFFFFFFF)
-            h1s = jnp.where(cvalid, h1, big)
-            sh1, perm = lax.sort(
-                (h1s, jnp.arange(S, dtype=jnp.int32)), num_keys=1)
-            svalid = jnp.take(cvalid, perm)
-            scfgs = jnp.take(ccfgs, perm, axis=0)
-            same_hash = sh1[1:] == sh1[:-1]
-            same_cfg = jnp.all(scfgs[1:] == scfgs[:-1], axis=1)
-            dup = jnp.concatenate([jnp.zeros(1, bool), same_hash & same_cfg])
-            svalid = svalid & ~dup
+            svalid, scfgs = _sort_dedup(h1, cvalid, ccfgs, S)
 
             # --- compact into the next frontier (sort-free) ----------------
             src, new_count = _compact_indices(svalid, F)
@@ -481,16 +534,7 @@ def build_sharded_search_step_fn(model: ModelSpec, dims: SearchDims,
 
             # --- local dedup (global, since owners partition by hash) -----
             rh1 = _hash_words(rcfgs.astype(jnp.uint32), 0x9E3779B1)
-            big = np.uint32(0xFFFFFFFF)
-            h1s = jnp.where(rvalid, rh1, big)
-            sh1, perm = lax.sort(
-                (h1s, jnp.arange(D * C_CAP, dtype=jnp.int32)), num_keys=1)
-            svalid = jnp.take(rvalid, perm)
-            scfgs = jnp.take(rcfgs, perm, axis=0)
-            same_hash = sh1[1:] == sh1[:-1]
-            same_cfg = jnp.all(scfgs[1:] == scfgs[:-1], axis=1)
-            dup = jnp.concatenate([jnp.zeros(1, bool), same_hash & same_cfg])
-            svalid = svalid & ~dup
+            svalid, scfgs = _sort_dedup(rh1, rvalid, rcfgs, D * C_CAP)
 
             src, new_count = _compact_indices(svalid, F)
             new_frontier = jnp.take(scfgs, src, axis=0)

@@ -1,0 +1,175 @@
+"""Piecewise device microbenchmark for the search-kernel ops.
+
+Times one full kernel level at several frontier widths, then each
+pipeline stage in isolation (expand, hash, the dedup sort in both
+variadic and packed forms, gathers in row-major and transposed layouts,
+stream compaction), emitting one JSON line per measurement.  The point:
+locate WHERE per-level cost explodes with width on a given backend — on
+TPU the jump from F=1024 to F=8192 was measured at ~1600x (0.02 ->
+32 ms/level) while CPU scales linearly, so some op hits a cliff that
+linear reasoning cannot find.  Run this on the device, read the table,
+then optimize the guilty op.
+
+Usage:
+    python tools/tpubench.py [--widths 1024,8192,65536] [--repeat 5]
+    JAX_PLATFORMS=cpu python tools/tpubench.py   # CPU comparison
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+if os.environ.get("JAX_PLATFORMS") == "cpu":
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+
+import jax  # noqa: E402
+import jax.numpy as jnp  # noqa: E402
+import numpy as np  # noqa: E402
+from jax import lax  # noqa: E402
+
+
+def bench_one(name: str, fn, *args, repeat: int = 5) -> dict:
+    f = jax.jit(fn)
+    t0 = time.perf_counter()
+    out = f(*args)
+    jax.block_until_ready(out)
+    t_compile = time.perf_counter() - t0
+    t0 = time.perf_counter()
+    for _ in range(repeat):
+        out = f(*args)
+    jax.block_until_ready(out)
+    ms = (time.perf_counter() - t0) / repeat * 1000
+    row = {"op": name, "ms": round(ms, 4),
+           "compile_s": round(t_compile, 2)}
+    print(json.dumps(row), flush=True)
+    return row
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--widths", default="1024,8192,65536")
+    ap.add_argument("--repeat", type=int, default=5)
+    ap.add_argument("--levels", type=int, default=64)
+    args = ap.parse_args()
+    widths = [int(w) for w in args.widths.split(",")]
+    rep = args.repeat
+
+    print(json.dumps({"backend": jax.default_backend(),
+                      "device": str(jax.devices()[0])}), flush=True)
+
+    import bench as hbench
+    from jepsen_tpu.checker import linearizable as lin
+
+    seq, model = hbench.make_seq("10k")
+    es = lin.encode_search(seq)
+
+    for F in widths:
+        dims = lin.choose_dims(es, model, frontier=F)
+        esp = lin.pad_search(es, dims.n_det_pad, dims.n_crash_pad)
+        K, WORDS = dims.k, dims.words
+        S = 4 * F
+        rng = np.random.default_rng(0)
+
+        # --- full kernel level -----------------------------------------
+        fn = lin.get_kernel(model, dims)
+        kargs = (jnp.asarray(esp.det_f), jnp.asarray(esp.det_v1),
+                 jnp.asarray(esp.det_v2), jnp.asarray(esp.det_inv),
+                 jnp.asarray(esp.det_ret),
+                 jnp.asarray(esp.suffix_min_ret),
+                 jnp.asarray(esp.crash_f), jnp.asarray(esp.crash_v1),
+                 jnp.asarray(esp.crash_v2), jnp.asarray(esp.crash_inv),
+                 jnp.int32(es.n_det), jnp.int32(es.n_crash))
+        carry = tuple(jnp.asarray(c) for c in lin._init_carry(dims, model))
+        lvls = jnp.int32(args.levels)
+
+        def level_fn(*a):
+            return fn(*a[:12], jnp.int32(10**9), lvls, jnp.bool_(False),
+                      *a[12:])
+
+        t0 = time.perf_counter()
+        out = level_fn(*kargs, *carry)
+        jax.block_until_ready(out)
+        t_compile = time.perf_counter() - t0
+        t0 = time.perf_counter()
+        out = level_fn(*kargs, *carry)
+        jax.block_until_ready(out)
+        dt = time.perf_counter() - t0
+        print(json.dumps({
+            "op": f"kernel-{args.levels}-levels", "F": F, "K": K,
+            "WORDS": WORDS, "ms_per_level": round(dt / args.levels * 1000,
+                                                  4),
+            "compile_s": round(t_compile, 2)}), flush=True)
+
+        # --- isolated pieces at the same shapes ------------------------
+        keys32 = jnp.asarray(
+            rng.integers(0, 2**31, S).astype(np.uint32))
+        cfgs = jnp.asarray(
+            rng.integers(0, 1000, (S, WORDS)).astype(np.int32))
+        cfgsT = jnp.asarray(np.asarray(cfgs).T.copy())
+        idx = jnp.asarray(rng.integers(0, S, S).astype(np.int32))
+        mask = jnp.asarray(rng.random(S) < 0.2)
+        frontier = jnp.asarray(
+            rng.integers(0, 1000, (F, WORDS)).astype(np.int32))
+        alive = jnp.ones(F, bool)
+
+        pieces = lin._make_kernel_pieces(model, dims)
+        expand = pieces["expand"]
+
+        def expand_fn(fr, al):
+            c, v, g, p = expand(fr, al, *kargs)
+            return c.sum(), v.sum()
+
+        bench_one(f"expand F={F}", expand_fn, frontier, alive,
+                  repeat=rep)
+        bench_one(f"hash S={S}",
+                  lambda c: lin._hash_words(c.astype(jnp.uint32),
+                                            0x9E3779B1).sum(),
+                  cfgs, repeat=rep)
+        bench_one(
+            f"sort-variadic S={S}",
+            lambda k: lax.sort((k, jnp.arange(S, dtype=jnp.int32)),
+                               num_keys=1),
+            keys32, repeat=rep)
+        # mirror the production strategy choice and bit split exactly
+        # (_sort_dedup: packed only when S <= _PACKED_SORT_MAX, low =
+        # S.bit_length())
+        if S <= lin._PACKED_SORT_MAX:
+            low = int(S).bit_length()
+
+            def packed_sort(k):
+                p = (k & np.uint32(~((1 << low) - 1) & 0xFFFFFFFF)) \
+                    | jnp.arange(S, dtype=jnp.uint32)
+                return lax.sort(p)
+
+            bench_one(f"sort-packed32 S={S}", packed_sort, keys32,
+                      repeat=rep)
+        else:
+            print(json.dumps({
+                "op": f"sort-packed32 S={S}",
+                "skipped": f"S > _PACKED_SORT_MAX="
+                           f"{lin._PACKED_SORT_MAX}; kernel uses the "
+                           "variadic sort here"}), flush=True)
+        bench_one(f"gather-rows [S,{WORDS}] S={S}",
+                  lambda c, i: jnp.take(c, i, axis=0).sum(), cfgs, idx,
+                  repeat=rep)
+        bench_one(f"gather-cols [{WORDS},S] S={S}",
+                  lambda c, i: jnp.take(c, i, axis=1).sum(), cfgsT, idx,
+                  repeat=rep)
+        bench_one(f"compact_indices S={S}",
+                  lambda m: lin._compact_indices(m, S // 4), mask,
+                  repeat=rep)
+        bench_one(f"neighbor-dedup S={S}",
+                  lambda c: (jnp.all(c[1:] == c[:-1], axis=1)).sum(),
+                  cfgs, repeat=rep)
+
+
+if __name__ == "__main__":
+    main()

@@ -300,8 +300,8 @@ def _sort_dedup(h1, valid, cfgs, S: int):
     and without the guard a tie-broken sort could place a replica before
     the one real copy and drop it — losing a reachable configuration.
     """
-    iota = jnp.arange(S, dtype=jnp.uint32)
     if S <= _PACKED_SORT_MAX:
+        iota = jnp.arange(S, dtype=jnp.uint32)
         low = int(S).bit_length()  # iota <= S-1 < 2^low - 1 strictly
         high_mask = np.uint32((~((1 << low) - 1)) & 0xFFFFFFFF)
         packed = jnp.where(valid, (h1 & high_mask) | iota,
@@ -310,10 +310,12 @@ def _sort_dedup(h1, valid, cfgs, S: int):
         perm = (sp & np.uint32((1 << low) - 1)).astype(jnp.int32)
         perm = jnp.minimum(perm, S - 1)  # all-ones rows: clamp
         key = sp >> low
-        # an all-ones key IS the invalid marker (a valid row's iota is
-        # strictly below 2^low - 1, so it can never produce all-ones);
-        # without this mask the clamped perm would resurrect row S-1
-        svalid = jnp.take(valid, perm) & (sp != np.uint32(0xFFFFFFFF))
+        # an all-ones packed key IS the invalid marker (a valid row's
+        # iota is strictly below 2^low - 1, so a valid row can never
+        # produce all-ones — and conversely any non-all-ones key came
+        # from a valid lane); without this mask the clamped perm would
+        # resurrect row S-1
+        svalid = sp != np.uint32(0xFFFFFFFF)
         scfgs = jnp.take(cfgs, perm, axis=0)
         return _neighbor_dedup(key, svalid, scfgs)
     else:
@@ -1255,8 +1257,12 @@ def resume_opseq(seq: OpSeq, model: ModelSpec, path: str, *,
 
 
 def batch_dims(ess: list[EncodedSearch], model: ModelSpec, *,
-               frontier: int = 256) -> SearchDims:
-    """Common static dims covering every history in the batch."""
+               frontier: int = 64) -> SearchDims:
+    """Common static dims covering every history in the batch.  The
+    shared frontier starts narrow — every key pays every lane of it
+    each level, and a key whose search outgrows it is re-run solo
+    behind the adaptive ladder (search_batch's overflow path), so the
+    batch should be sized for the typical key, not the worst."""
     W = _round_up(max(e.window for e in ess), 32)
     ncr = max(e.n_crash for e in ess)
     NC = _round_up(ncr, 32) if ncr else 32

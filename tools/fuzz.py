@@ -11,7 +11,8 @@ to debug a checker divergence is the 6-op core, not the 400-op haystack.
 
 Usage:
     python tools/fuzz.py --rounds 200 [--seed 0] [--n-ops 60]
-                         [--model cas-register|register|mutex]
+                         [--model cas-register|register|mutex|
+                                  unordered-queue|fifo-queue]
 Exit code 0 = no divergence; 1 = divergence found (minimal repro printed
 as JSON ops, replayable via --replay FILE).
 """

@@ -26,6 +26,10 @@ import time
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 if os.environ.get("JAX_PLATFORMS") == "cpu":
+    # env alone does NOT stop this image's sitecustomize-registered TPU
+    # plugin (verified: `JAX_PLATFORMS=cpu python -c "import jax;
+    # jax.devices()"` hangs on the axon tunnel); the config pin must
+    # land before first backend touch (tests/conftest.py:10-23)
     import jax
 
     jax.config.update("jax_platforms", "cpu")

@@ -379,7 +379,6 @@ def build_search_step_fn(model: ModelSpec, dims: SearchDims):
     F = dims.frontier
     WORDS = dims.words
     pieces = _make_kernel_pieces(model, dims)
-    expand = pieces["expand"]
 
     def step(det_f, det_v1, det_v2, det_inv, det_ret, sfx_min,
              crash_f, crash_v1, crash_v2, crash_inv, n_det, n_crash,
@@ -387,6 +386,9 @@ def build_search_step_fn(model: ModelSpec, dims: SearchDims):
              frontier, count, status, configs, max_depth, ovf):
         carry0 = (frontier, count, status, configs, max_depth, ovf,
                   jnp.int32(0))
+        op_args = (det_f, det_v1, det_v2, det_inv, det_ret, sfx_min,
+                   crash_f, crash_v1, crash_v2, crash_inv, n_det,
+                   n_crash)
 
         def cond(c):
             _, count, status, configs, _, ovf, lvl = c
@@ -400,23 +402,10 @@ def build_search_step_fn(model: ModelSpec, dims: SearchDims):
             frontier, count, status, configs, max_depth, ovf, lvl = c
             alive = jnp.arange(F) < count
 
-            cfgs, valid, goal, p2s = expand(
-                frontier, alive, det_f, det_v1, det_v2, det_inv, det_ret,
-                sfx_min, crash_f, crash_v1, crash_v2, crash_inv, n_det,
-                n_crash)
-            cfgs = cfgs.reshape(F * K, WORDS)
-            valid = valid.reshape(F * K)
-            found = jnp.any(goal)
-
-            # --- pre-compact valid successors ------------------------------
-            # most candidate lanes are dead (narrow levels, disabled
-            # candidates, illegal steps); shrink to S rows before the
-            # sort, which dominates per-level cost
             S = 4 * F
-            vsrc, n_valid = _compact_indices(valid, S)
+            ccfgs, cvalid, found, n_valid = _expand_survivors(
+                pieces, frontier, alive, op_args, K=K, S=S, n_det=n_det)
             ovf = ovf | (n_valid > S)
-            ccfgs = jnp.take(cfgs, vsrc, axis=0)  # [S, WORDS]
-            cvalid = jnp.arange(S) < n_valid
 
             # --- level dedup: hash sort + exact neighbor compare --------
             wu = ccfgs.astype(jnp.uint32)
@@ -483,7 +472,6 @@ def build_sharded_search_step_fn(model: ModelSpec, dims: SearchDims,
     C_CAP = max(64, _round_up(S // D, 32))
 
     inner = _make_kernel_pieces(model, dims)
-    expand = inner["expand"]
 
     def step_device(det_f, det_v1, det_v2, det_inv, det_ret, sfx_min,
                     crash_f, crash_v1, crash_v2, crash_inv, n_det,
@@ -494,6 +482,9 @@ def build_sharded_search_step_fn(model: ModelSpec, dims: SearchDims,
 
         carry0 = (frontier, count, status, configs, max_depth, any_ovf,
                   total, jnp.int32(0))
+        op_args = (det_f, det_v1, det_v2, det_inv, det_ret, sfx_min,
+                   crash_f, crash_v1, crash_v2, crash_inv, n_det,
+                   n_crash)
 
         def cond(c):
             _, _, status, configs, _, any_ovf, total, lvl = c
@@ -505,21 +496,18 @@ def build_sharded_search_step_fn(model: ModelSpec, dims: SearchDims,
             frontier, count, status, configs, max_depth, ovf, _total, \
                 lvl = c
             alive = jnp.arange(F) < count
-            cfgs, valid, goal, p2s = expand(
-                frontier, alive, det_f, det_v1, det_v2, det_inv, det_ret,
-                sfx_min, crash_f, crash_v1, crash_v2, crash_inv, n_det,
-                n_crash)
-            cfgs = cfgs.reshape(F * K, WORDS)
-            valid = valid.reshape(F * K)
-            found = lax.psum(jnp.any(goal).astype(jnp.int32), axis) > 0
+            cfgs, cvalid, found_here, n_valid = _expand_survivors(
+                inner, frontier, alive, op_args, K=K, S=S, n_det=n_det)
+            ovf = ovf | (n_valid > S)
+            found = lax.psum(found_here.astype(jnp.int32), axis) > 0
 
-            # --- route successors to their home shard ----------------------
+            # --- route survivors to their home shard -----------------------
             wu = cfgs.astype(jnp.uint32)
             h1 = _hash_words(wu, 0x9E3779B1)
             owner = (h1 % np.uint32(D)).astype(jnp.int32)
 
             def bucket(d):
-                mask = valid & (owner == d)
+                mask = cvalid & (owner == d)
                 idx, cnt = _compact_indices(mask, C_CAP)
                 return jnp.take(cfgs, idx, axis=0), cnt
 
@@ -574,8 +562,25 @@ def _trailing_ones(w):
 
 
 def _make_kernel_pieces(model: ModelSpec, dims: SearchDims):
-    """Expose build_search_fn's internal pack/expand for the sharded
-    kernel (same closure construction, no search loop)."""
+    """Kernel building blocks shared by the single-device, sharded, and
+    batch step functions.
+
+    The per-level pipeline is split so the expensive successor-word
+    construction happens ONLY for compacted survivors:
+
+      * ``expand_mask`` (vmapped over the frontier): per config, find the
+        enabled candidates, step the model, and return validity + the
+        chosen candidate lane + the successor model state — K lanes per
+        config, but NO successor words are built;
+      * the step fn compacts the [F*K] valid mask down to S rows;
+      * ``succ`` (vmapped over the S survivors): build the packed
+        successor words (set-bit, trailing-ones popcount, funnel shift)
+        from (source config words, candidate lane, new state).
+
+    At K=16 and S=4F this does the word construction for a quarter of
+    the lanes the fused form paid for — and most candidate lanes are
+    dead (narrow levels, disabled candidates, illegal steps).
+    """
     out = {}
     W, K, NC = dims.window, dims.k, dims.n_crash_pad
     WW, CW, S = dims.win_words, dims.crash_words, dims.state_width
@@ -598,9 +603,9 @@ def _make_kernel_pieces(model: ModelSpec, dims: SearchDims):
             state.astype(jnp.int32),
         ])
 
-    def expand_one(cfg, alive, det_f, det_v1, det_v2, det_inv, det_ret,
-                   sfx_min, crash_f, crash_v1, crash_v2, crash_inv, n_det,
-                   n_crash):
+    def expand_mask_one(cfg, alive, det_f, det_v1, det_v2, det_inv,
+                        det_ret, sfx_min, crash_f, crash_v1, crash_v2,
+                        crash_inv, n_det, n_crash):
         p, win, crash, state = unpack(cfg)
         pos = p + jnp.arange(W, dtype=jnp.int32)
         in_range = pos < n_det
@@ -641,68 +646,96 @@ def _make_kernel_pieces(model: ModelSpec, dims: SearchDims):
         new_state, legal = jax.vmap(jstep)(st, cf, cv1, cv2)
         valid = alive & cand_on & legal
 
-        # --- successor construction, directly on the packed words ------
-        # The window/crash masks already live as uint32 words inside the
-        # config; building successors in word space (set-bit, trailing-
-        # ones popcount, funnel shift) avoids the per-candidate W-lane
-        # unpack / cumprod / cross-lane roll / repack of the naive form —
-        # expand dominates per-level cost, and this is its hot core.
+        # exact goal test WITHOUT successor words: a det candidate is a
+        # goal iff it is the last unlinearized det (p2 >= n_det is
+        # equivalent to p + popcount(win) + 1 >= n_det); a crash
+        # candidate never advances p, so it is a goal only if every det
+        # was already linearized.  Computed on ALL K lanes so a goal can
+        # never be lost to the survivor cap, even at MAX_FRONTIER where
+        # no wider re-run would come.
+        remaining = n_det - (p + win.sum(dtype=jnp.int32))
+        goal = valid & jnp.where(is_det, remaining <= 1, remaining <= 0)
+        return valid, cand, new_state, goal
+
+    def succ_one(cfg, lane, ns):
+        """Build one survivor's packed successor words."""
+        p = cfg[0]
         win_words = cfg[1:1 + WW].astype(jnp.uint32)
         crash_words = cfg[1 + WW:1 + WW + CW].astype(jnp.uint32)
 
-        def succ(ci, ns):
-            lane = cand[ci]
-            is_d = lane < W
-            d_lane = jnp.clip(lane, 0, W - 1)
-            wi = d_lane >> 5
-            bit = (d_lane & 31).astype(jnp.uint32)
-            setmask = jnp.where(jnp.arange(WW) == wi,
-                                np.uint32(1) << bit, np.uint32(0))
-            nw = win_words | setmask  # window with the new bit set
+        is_d = lane < W
+        d_lane = jnp.clip(lane, 0, W - 1)
+        wi = d_lane >> 5
+        bit = (d_lane & 31).astype(jnp.uint32)
+        setmask = jnp.where(jnp.arange(WW) == wi,
+                            np.uint32(1) << bit, np.uint32(0))
+        nw = win_words | setmask  # window with the new bit set
 
-            # shift = run of 1-bits from position 0, chained across words
-            t = _trailing_ones(nw)  # [WW]
-            shift = jnp.uint32(0)
-            open_run = jnp.bool_(True)
-            for i in range(WW):
-                shift = shift + jnp.where(open_run, t[i], np.uint32(0))
-                open_run = open_run & (t[i] == 32)
+        # shift = run of 1-bits from position 0, chained across words
+        t = _trailing_ones(nw)  # [WW]
+        shift = jnp.uint32(0)
+        open_run = jnp.bool_(True)
+        for i in range(WW):
+            shift = shift + jnp.where(open_run, t[i], np.uint32(0))
+            open_run = open_run & (t[i] == 32)
 
-            # funnel shift right by `shift` across the word array
-            s_words = (shift >> 5).astype(jnp.int32)
-            s_bits = shift & np.uint32(31)
-            idx = jnp.arange(WW) + s_words
-            lo = jnp.take(nw, idx, mode="fill", fill_value=np.uint32(0))
-            hi = jnp.take(nw, idx + 1, mode="fill",
-                          fill_value=np.uint32(0))
-            shifted = jnp.where(
-                s_bits == 0, lo,
-                (lo >> s_bits) | (hi << (np.uint32(32) - s_bits)))
+        # funnel shift right by `shift` across the word array
+        s_words = (shift >> 5).astype(jnp.int32)
+        s_bits = shift & np.uint32(31)
+        idx = jnp.arange(WW) + s_words
+        lo = jnp.take(nw, idx, mode="fill", fill_value=np.uint32(0))
+        hi = jnp.take(nw, idx + 1, mode="fill",
+                      fill_value=np.uint32(0))
+        shifted = jnp.where(
+            s_bits == 0, lo,
+            (lo >> s_bits) | (hi << (np.uint32(32) - s_bits)))
 
-            p2 = jnp.where(is_d, p + shift.astype(jnp.int32), p)
-            win2 = jnp.where(is_d, shifted, win_words)
+        p2 = jnp.where(is_d, p + shift.astype(jnp.int32), p)
+        win2 = jnp.where(is_d, shifted, win_words)
 
-            cl = jnp.clip(lane - W, 0, NC - 1)
-            csetmask = jnp.where(
-                jnp.arange(CW) == (cl >> 5),
-                np.uint32(1) << (cl & 31).astype(jnp.uint32),
-                np.uint32(0))
-            crash2 = jnp.where(is_d, crash_words,
-                               crash_words | csetmask)
-            cfg2 = jnp.concatenate([
-                p2[None].astype(jnp.int32),
-                win2.astype(jnp.int32),
-                crash2.astype(jnp.int32),
-                ns.astype(jnp.int32)])
-            return cfg2, p2
-
-        cfgs, p2s = jax.vmap(succ)(jnp.arange(K), new_state)
-        goal = valid & (p2s >= n_det)
-        return cfgs, valid, goal, p2s
+        cl = jnp.clip(lane - W, 0, NC - 1)
+        csetmask = jnp.where(
+            jnp.arange(CW) == (cl >> 5),
+            np.uint32(1) << (cl & 31).astype(jnp.uint32),
+            np.uint32(0))
+        crash2 = jnp.where(is_d, crash_words,
+                           crash_words | csetmask)
+        cfg2 = jnp.concatenate([
+            p2[None].astype(jnp.int32),
+            win2.astype(jnp.int32),
+            crash2.astype(jnp.int32),
+            ns.astype(jnp.int32)])
+        return cfg2, p2
 
     out["pack"] = pack
-    out["expand"] = jax.vmap(expand_one, in_axes=(0, 0) + (None,) * 12)
+    out["expand_mask"] = jax.vmap(expand_mask_one,
+                                  in_axes=(0, 0) + (None,) * 12)
+    out["succ"] = jax.vmap(succ_one)
     return out
+
+
+def _expand_survivors(pieces, frontier, alive, op_args, *, K: int,
+                      S: int, n_det):
+    """expand_mask -> compact to S survivors -> build successor words.
+
+    Returns (ccfgs [S, WORDS], cvalid [S], goal_found, n_valid).  The
+    goal test runs in the mask phase over ALL F*K lanes (no successor
+    words needed — see expand_mask_one), so a goal past the S survivor
+    cap is still found."""
+    F = frontier.shape[0]
+    valid2, cand2, nstate2, goal2 = pieces["expand_mask"](
+        frontier, alive, *op_args)
+    found = jnp.any(goal2)
+    validf = valid2.reshape(F * K)
+    vsrc, n_valid = _compact_indices(validf, S)
+    row = vsrc // K
+    src_cfg = jnp.take(frontier, row, axis=0)           # [S, WORDS]
+    src_lane = jnp.take(cand2.reshape(F * K), vsrc)     # [S]
+    sw = nstate2.shape[-1]
+    src_state = jnp.take(nstate2.reshape(F * K, sw), vsrc, axis=0)
+    cvalid = jnp.arange(S) < n_valid
+    ccfgs, _p2s = pieces["succ"](src_cfg, src_lane, src_state)
+    return ccfgs, cvalid, found, n_valid
 
 
 _SHARDED_CACHE: dict = {}

@@ -125,14 +125,22 @@ def main() -> None:
         alive = jnp.ones(F, bool)
 
         pieces = lin._make_kernel_pieces(model, dims)
-        expand = pieces["expand"]
 
-        def expand_fn(fr, al):
-            c, v, g, p = expand(fr, al, *kargs)
+        def mask_fn(fr, al):
+            v, c, ns, g = pieces["expand_mask"](fr, al, *kargs)
+            return v.sum(), c.sum(), ns.sum(), g.sum()
+
+        bench_one(f"expand_mask F={F}", mask_fn, frontier, alive,
+                  repeat=rep)
+
+        def survivors_fn(fr, al):
+            c, v, g, n = lin._expand_survivors(
+                pieces, fr, al, kargs, K=K, S=S,
+                n_det=jnp.int32(es.n_det))
             return c.sum(), v.sum()
 
-        bench_one(f"expand F={F}", expand_fn, frontier, alive,
-                  repeat=rep)
+        bench_one(f"expand+succ(S) F={F}", survivors_fn, frontier,
+                  alive, repeat=rep)
         bench_one(f"hash S={S}",
                   lambda c: lin._hash_words(c.astype(jnp.uint32),
                                             0x9E3779B1).sum(),

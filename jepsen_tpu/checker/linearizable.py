@@ -36,15 +36,24 @@ handful of int32 lanes instead of n bits, so millions of configs fit in
 HBM and hash in a few vector ops.
 
 Soundness: a "valid" verdict always carries a real witness path (every
-transition was model-checked on device).  Dedup is *exact*: candidate
-fingerprints are sorted and equal-fingerprint neighbors are compared on
-their full config words before dropping either, so distinct configs are
-never merged and an "invalid" verdict is not subject to hash collisions.
-The residual escalation ladder is about capacity, not hashing: if the
-frontier ring or the fingerprint table overflows, the search bails to the
-exact host oracle (checker/seq.py); Linearizable.check additionally
-re-runs short failing prefixes (≤ witness_threshold ops) on the host
-oracle to reconstruct a human-readable witness.
+transition was model-checked on device, and the goal test runs on every
+candidate lane).  Dedup is *exact*: candidates are hash-sorted (one
+packed uint32 key at moderate widths, a variadic (hash, iota) sort
+above) and equal-key neighbors are compared on their full config words
+before dropping either — hash collisions cost duplicate work, never a
+merge — so an "invalid" verdict is not subject to fingerprinting.
+Capacity is handled by the adaptive width driver (`_run_kernel`): the
+frontier width moves both ways on a power-of-four grid — a level that
+overflows bails and resumes from the last clean carry one step wider; a
+shrunken live frontier truncates back down.  Only at MAX_FRONTIER does
+an overflow degrade the verdict, and then always to "unknown", never to
+a wrong answer; exhausted budgets and deadlines also report "unknown".
+Histories whose window or crash count exceed the device encoding fall
+back to the exact host oracle (checker/seq.py); Linearizable.check
+additionally re-runs short failing prefixes (≤ witness_threshold ops)
+on the host oracle to reconstruct a human-readable witness, and
+`check_competition` races that oracle against the device search
+outright (the knossos `competition` analog).
 
 Batching: `search_batch` vmaps the whole search over a leading key axis —
 the TPU analog of the reference's independent-key sharding

@@ -1,0 +1,147 @@
+"""Multi-host device-mesh plumbing — the DCN tier of the checker backend.
+
+The reference's distributed backend is SSH from one control node
+(jepsen/src/jepsen/control.clj); all *coordination* stays
+control-node-centric and that design is kept (SURVEY.md §2.4).  What
+actually scales out in this rebuild is the CHECKER: per-key history
+batches ride a `jax.sharding.Mesh`, and when one host's chips aren't
+enough the mesh must span hosts — JAX's runtime then lays collectives
+over ICI within a host and DCN across hosts automatically, the XLA-native
+equivalent of the NCCL/MPI tier in torch-style stacks.
+
+Layout doctrine (matching the scaling-book recipe):
+
+  * the independent-keys batch axis is pure data parallelism — no
+    communication except the final verdict gather, so it can safely
+    cross the DCN boundary: put the OUTER ("hosts") axis on keys;
+  * the sharded-frontier axis (`search_opseq_sharded`) all_to_alls every
+    level — keep it INSIDE a host's ICI domain.  `multihost_mesh`
+    returns a 2-D (dcn, ici) mesh shaped that way.
+
+Usage on each host of a slice (or each CPU pod in a test rig)::
+
+    from jepsen_tpu import distributed as dist
+    dist.init_from_env()               # no-op standalone; JAX_COORD_* set
+    mesh = dist.multihost_mesh()       # ("keys", "shard") over all hosts
+    results = search_batch(seqs, model,
+                           sharding=dist.keys_sharding(mesh))
+
+Every host must run the same program (SPMD): `search_batch` callers pass
+the full key list everywhere; JAX partitions rows by the sharding.
+"""
+
+from __future__ import annotations
+
+import os
+
+import numpy as np
+
+__all__ = ["init_from_env", "is_initialized", "multihost_mesh",
+           "keys_sharding", "process_info"]
+
+_INITIALIZED = False
+
+
+def init_from_env(*, coordinator: str | None = None,
+                  num_processes: int | None = None,
+                  process_id: int | None = None) -> bool:
+    """Initialize `jax.distributed` when a cluster is configured.
+
+    Sources, in priority order: explicit arguments, then the
+    ``JEPSEN_TPU_COORDINATOR`` / ``JEPSEN_TPU_NUM_PROCS`` /
+    ``JEPSEN_TPU_PROC_ID`` environment, then JAX's own auto-detection
+    (GKE/Cloud TPU metadata) if ``JAX_COORDINATOR_ADDRESS`` is set.
+    Returns True when a multi-process runtime was brought up; standalone
+    runs return False and everything downstream behaves single-host —
+    tests and the tutorial path never need a cluster.
+    """
+    global _INITIALIZED
+    if _INITIALIZED:
+        return True
+    coordinator = coordinator or os.environ.get("JEPSEN_TPU_COORDINATOR")
+    try:
+        num = num_processes or int(
+            os.environ.get("JEPSEN_TPU_NUM_PROCS", 0))
+        pid = process_id if process_id is not None else \
+            int(os.environ.get("JEPSEN_TPU_PROC_ID", -1))
+    except ValueError as e:
+        raise ValueError(
+            "JEPSEN_TPU_NUM_PROCS / JEPSEN_TPU_PROC_ID must be "
+            f"integers: {e}") from None
+    pieces = {"JEPSEN_TPU_COORDINATOR": bool(coordinator),
+              "JEPSEN_TPU_NUM_PROCS": num > 0,
+              "JEPSEN_TPU_PROC_ID": pid >= 0}
+
+    import jax
+
+    if all(pieces.values()):
+        jax.distributed.initialize(coordinator_address=coordinator,
+                                   num_processes=num, process_id=pid)
+        _INITIALIZED = True
+        return True
+    if any(pieces.values()):
+        # silently degrading to standalone here would leave this host's
+        # peers blocked in jax.distributed.initialize() forever, with no
+        # error naming the misconfigured host
+        missing = sorted(k for k, ok in pieces.items() if not ok)
+        raise ValueError(
+            f"partial cluster configuration: missing/invalid {missing}")
+    if os.environ.get("JAX_COORDINATOR_ADDRESS"):
+        jax.distributed.initialize()  # JAX-native auto-configuration
+        _INITIALIZED = True
+        return True
+    return False
+
+
+def is_initialized() -> bool:
+    return _INITIALIZED
+
+
+def process_info() -> dict:
+    """This host's coordinates in the job (all zeros standalone)."""
+    import jax
+
+    return {"process_index": jax.process_index(),
+            "process_count": jax.process_count(),
+            "local_devices": len(jax.local_devices()),
+            "global_devices": len(jax.devices())}
+
+
+def multihost_mesh(*, ici_axis: str = "shard", dcn_axis: str = "keys"):
+    """A 2-D mesh over every device in the job: the outer axis spans
+    hosts (DCN — give it the embarrassingly-parallel keys batch) and the
+    inner axis stays within each host (ICI — the all_to_all frontier
+    axis).  Standalone, the outer axis has size 1 and the mesh degrades
+    to a plain single-host mesh."""
+    import jax
+    from jax.sharding import Mesh
+
+    devs = list(jax.devices())
+    hosts = jax.process_count()
+    if len(devs) % hosts:
+        raise ValueError(
+            f"{len(devs)} global devices do not divide evenly over "
+            f"{hosts} processes; a mesh row per host needs equal chip "
+            "counts")
+    per_host = len(devs) // hosts
+    # group rows by owning process — jax.devices() orders by device id,
+    # which is NOT guaranteed process-contiguous, and an interleaved
+    # reshape would silently put the all_to_all axis on DCN
+    by_host: dict[int, list] = {}
+    for d in devs:
+        by_host.setdefault(d.process_index, []).append(d)
+    if len(by_host) != hosts or any(len(v) != per_host
+                                    for v in by_host.values()):
+        raise ValueError(
+            "devices are not evenly spread over processes: "
+            f"{ {k: len(v) for k, v in by_host.items()} }")
+    rows = [by_host[k] for k in sorted(by_host)]
+    return Mesh(np.array(rows), (dcn_axis, ici_axis))
+
+
+def keys_sharding(mesh, axis: str = "keys"):
+    """NamedSharding that lays the leading (key) axis over the DCN axis,
+    replicating along the intra-host axis."""
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    return NamedSharding(mesh, P(axis))

@@ -92,3 +92,44 @@ def test_sharded_escalation_resumes(mesh, monkeypatch):
                                    frontier_per_device=8,
                                    budget=500_000)
     assert out["valid"] == want, f"oracle={want} sharded={out}"
+
+
+# ---------------------------------------------------------------------------
+# multi-host plumbing (jepsen_tpu.distributed) — standalone degradation:
+# process_count == 1 means the DCN ("keys") axis has size 1, the whole
+# batch stays on this host, and verdicts must be unchanged.
+# ---------------------------------------------------------------------------
+
+
+def test_distributed_standalone_degrades():
+    from jepsen_tpu import distributed as dist
+
+    assert dist.init_from_env() is False  # no cluster configured
+    info = dist.process_info()
+    assert info["process_index"] == 0 and info["process_count"] == 1
+    mesh = dist.multihost_mesh()
+    assert mesh.shape["keys"] == 1
+    assert mesh.shape["shard"] == len(jax.devices())
+    sh = dist.keys_sharding(mesh)
+    # a batch checked under the degraded sharding still gives exact
+    # verdicts (single-host path)
+    import random
+
+    from jepsen_tpu.checker import linearizable as lin, seq as oracle
+    from jepsen_tpu.history import encode_ops
+    from jepsen_tpu.models import cas_register
+    from jepsen_tpu.synth import corrupt_read, register_history
+
+    model = cas_register()
+    seqs, want = [], []
+    for k in range(8):
+        rng = random.Random(4200 + k)
+        h = register_history(rng, n_ops=24, n_procs=3, overlap=3)
+        if k % 2 == 0:
+            h = corrupt_read(rng, h, at=0.7)
+        s = encode_ops(h, model.f_codes)
+        seqs.append(s)
+        want.append(oracle.check_opseq(s, model)["valid"])
+    with mesh:
+        got = lin.search_batch(seqs, model, budget=100_000, sharding=sh)
+    assert [r["valid"] for r in got] == want

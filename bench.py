@@ -71,10 +71,16 @@ ORACLE_S = float(os.environ.get("BENCH_ORACLE_S", "45" if QUICK else "150"))
 # Per-device-tier search deadline (excludes compile).
 TIER_S = float(os.environ.get("BENCH_TIER_S", "60" if QUICK else "150"))
 
-#: (name, n_ops, n_procs, device config budget)
-TIERS = [("1k", 1_000, 32, 2_000_000),
-         ("10k", 10_000, 32, 200_000_000),
-         ("batch256", 128, 8, 2_000_000)]
+#: (name, n_ops, n_procs, device config budget, headline) — the tiers
+#: mirror BASELINE.md's configs: #2-ish (1k-op register), #4 (mutex with
+#: nemesis-induced :info ops; detail-only — lock serialization keeps its
+#: config space tiny, so it demonstrates indeterminate-op correctness,
+#: not throughput), #5 (10k-op CAS stress; the headline), #3 (the
+#: 256-key independent batch)
+TIERS = [("1k", 1_000, 32, 2_000_000, True),
+         ("mutex2k", 2_000, 16, 20_000_000, False),
+         ("10k", 10_000, 32, 200_000_000, True),
+         ("batch256", 128, 8, 2_000_000, False)]
 
 _BEST: dict | None = None
 _EXTRA: dict = {}
@@ -87,12 +93,33 @@ def make_seq(name: str):
     """Deterministic per-tier history (seeded by the tier name, so child
     processes rebuild the identical history)."""
     from jepsen_tpu.history import encode_ops
-    from jepsen_tpu.models import cas_register
-    from jepsen_tpu.synth import corrupt_read, register_history
+    from jepsen_tpu.models import cas_register, mutex
+    from jepsen_tpu.synth import (corrupt_read, register_history,
+                                  sim_mutex_history)
 
     spec = {t[0]: t for t in TIERS}[name]
-    _, n_ops, n_procs, _ = spec
+    _, n_ops, n_procs, _, _ = spec
     rng = random.Random(f"bench-{name}")
+    if name.startswith("mutex"):
+        # BASELINE config #4: lock workload with nemesis-induced :info
+        # (crashed) ops — the indeterminate-op stressor.  An acquire
+        # chain is appended so the history is invalid NO MATTER how the
+        # checker places the :info ops: each :info release can "unlock"
+        # at most once, so (#info + 2) consecutive ok acquires cannot
+        # all be explained.  (A valid history would be disposed of by
+        # the O(n) greedy witness, as knossos's DFS would lucky-dive;
+        # the tier must measure the sweep.)
+        from jepsen_tpu.history import invoke_op, ok_op
+
+        model = mutex()
+        h = sim_mutex_history(rng, n_ops=n_ops, n_procs=n_procs,
+                              crash_p=0.01, max_crashes=12)
+        n_info = sum(1 for op in h if op.type == "info")
+        for i in range(n_info + 2):
+            p = n_procs + i
+            h = h + [invoke_op(p, "acquire", None),
+                     ok_op(p, "acquire", None)]
+        return encode_ops(h, model.f_codes), model
     model = cas_register()
     h = register_history(rng, n_ops=n_ops, n_procs=n_procs, overlap=8,
                          crash_p=0.002, max_crashes=8, n_values=4)
@@ -385,7 +412,7 @@ def main():
     # length (bigint masks), so each tier compares against the oracle ON
     # ITS OWN history.
     oracle_rates: dict[str, tuple[float, dict, float]] = {}
-    for name, _n_ops, _n_procs, _b in tiers:
+    for name, _n_ops, _n_procs, _b, _headline in tiers:
         if name.startswith("batch"):
             continue
         seq_t, model = make_seq(name)
@@ -437,7 +464,7 @@ def main():
               f"({time.time()-T0:.0f}s in)", file=sys.stderr)
 
     # --- device tiers: smallest first, best completed wins --------------
-    for name, n_ops, n_procs, budget in tiers:
+    for name, n_ops, n_procs, budget, headline in tiers:
         if _remaining() < 45:
             print(f"bench: skipping tier {name} (out of budget)",
                   file=sys.stderr)
@@ -497,11 +524,18 @@ def main():
         vs = round(dev_rate / ref_rate, 2) if ref_rate else None
         _EXTRA[f"tier_{name}"] = {
             "configs": res["configs"], "valid": res["valid"],
+            # None (no comparison) when the oracle hit its deadline —
+            # 'unknown' is not a disagreement
+            "oracle_verdict_agrees":
+                (res["valid"] == ref.get("valid"))
+                if ref.get("valid") in (True, False) else None,
             "device_seconds": round(t_dev, 3),
             "configs_per_sec": round(dev_rate, 1),
             "vs_oracle_same_history": vs,
             "backend": res["backend"], "engine": res.get("engine"),
         }
+        if not headline:
+            continue
         _BEST = {
             "metric": f"configurations-explored/sec, {name}-op "
                       f"{n_procs}-proc CAS-register history (invalid "

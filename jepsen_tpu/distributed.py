@@ -124,19 +124,31 @@ def multihost_mesh(*, ici_axis: str = "shard", dcn_axis: str = "keys"):
             f"{hosts} processes; a mesh row per host needs equal chip "
             "counts")
     per_host = len(devs) // hosts
-    # group rows by owning process — jax.devices() orders by device id,
-    # which is NOT guaranteed process-contiguous, and an interleaved
-    # reshape would silently put the all_to_all axis on DCN
-    by_host: dict[int, list] = {}
-    for d in devs:
-        by_host.setdefault(d.process_index, []).append(d)
-    if len(by_host) != hosts or any(len(v) != per_host
-                                    for v in by_host.values()):
-        raise ValueError(
-            "devices are not evenly spread over processes: "
-            f"{ {k: len(v) for k, v in by_host.items()} }")
-    rows = [by_host[k] for k in sorted(by_host)]
-    return Mesh(np.array(rows), (dcn_axis, ici_axis))
+    try:
+        # topology-aware inside each host's ICI axis when available
+        from jax.experimental import mesh_utils
+
+        # shapes multiply per axis: ([1, per_host], [hosts, 1]) yields
+        # a (hosts, per_host) array with the DCN granule on axis 0
+        arr = mesh_utils.create_hybrid_device_mesh(
+            [1, per_host], [hosts, 1], devices=devs)
+        return Mesh(arr, (dcn_axis, ici_axis))
+    except Exception:
+        # fallback (e.g. CPU test rigs whose devices lack slice
+        # attributes): group rows by owning process — jax.devices()
+        # orders by device id, which is NOT guaranteed
+        # process-contiguous, and an interleaved reshape would silently
+        # put the all_to_all axis on DCN
+        by_host: dict[int, list] = {}
+        for d in devs:
+            by_host.setdefault(d.process_index, []).append(d)
+        if len(by_host) != hosts or any(len(v) != per_host
+                                        for v in by_host.values()):
+            raise ValueError(
+                "devices are not evenly spread over processes: "
+                f"{ {k: len(v) for k, v in by_host.items()} }")
+        rows = [by_host[k] for k in sorted(by_host)]
+        return Mesh(np.array(rows), (dcn_axis, ici_axis))
 
 
 def keys_sharding(mesh, axis: str = "keys"):

@@ -155,15 +155,26 @@ def sim_register_history(rng: random.Random, n_procs: int = 4,
 
 def sim_mutex_history(rng: random.Random, n_ops: int = 40,
                       n_procs: int = 4, *,
-                      crash_p: float = 0.0) -> list[Op]:
+                      crash_p: float = 0.0,
+                      max_crashes: int = 48,
+                      lease_p: float = 0.05) -> list[Op]:
     """Alternating acquire/release per process against a real lock.
 
     Always terminates: after the op budget is spent, completable pending
     ops are drained (the holder releases out-of-budget if needed) and
     anything still stuck — e.g. acquires blocked behind a crashed holder
     — becomes a crashed :info op, exactly what the harness records for
-    ops whose fate is unknown (core.clj:387-397)."""
+    ops whose fate is unknown (core.clj:387-397).
+
+    A holder that crashes still holding the lock would deadlock every
+    other process; like a real lock service, the lock's lease then
+    expires (probability ``lease_p`` per scheduling step).  The emitted
+    history stays valid: a crashed acquire is a :info op the checker may
+    linearize or skip, and the skip branch always explains later
+    acquires.  ``max_crashes`` caps :info ops so the engine's crash mask
+    stays within its width."""
     holder = None
+    holder_crashed = False
     h: list[Op] = []
     pending: dict = {}  # process -> f
     wants: dict = {}
@@ -172,12 +183,16 @@ def sim_mutex_history(rng: random.Random, n_ops: int = 40,
     while done < n_ops:
         if len(crashed) >= n_procs:
             break  # everyone crashed; the history just ends short
+        if holder_crashed and rng.random() < lease_p:
+            holder = None  # lease expiry frees a dead holder's lock
+            holder_crashed = False
         p = rng.randrange(n_procs)
         if p in crashed:
             continue
         if p in pending:
             f = pending[p]
-            if crash_p and rng.random() < crash_p:
+            if crash_p and len(crashed) < max_crashes \
+                    and rng.random() < crash_p:
                 # coin flip: did the op take effect before the crash?
                 if rng.random() < 0.5:
                     if f == "acquire" and holder is None:
@@ -186,16 +201,24 @@ def sim_mutex_history(rng: random.Random, n_ops: int = 40,
                         holder = None
                 del pending[p]
                 crashed.add(p)
+                # a dead process still holding the lock (crashed acquire
+                # that took effect, or crashed release that did NOT) must
+                # be lease-expirable, or the simulation deadlocks; a
+                # crash by a NON-holder must not touch the flag
+                if holder == p:
+                    holder_crashed = True
                 h.append(info_op(p, f, None))
                 continue
             if f == "acquire" and holder is None:
                 holder = p
+                holder_crashed = False
                 del pending[p]
                 h.append(ok_op(p, f, None))
             elif f == "release":
                 del pending[p]
                 if holder == p:
                     holder = None
+                    holder_crashed = False
                     h.append(ok_op(p, f, None))
                 else:
                     h.append(fail_op(p, f, None))

@@ -635,6 +635,10 @@ def _make_kernel_pieces(model: ModelSpec, dims: SearchDims):
     W, K, NC = dims.window, dims.k, dims.n_crash_pad
     WW, CW, S = dims.win_words, dims.crash_words, dims.state_width
     WORDS = dims.words
+    #: width of the per-level shared det-table slice (_slice_tables);
+    #: capped at the table so small histories use it whole at base 0
+    W2P = min(_round_up(2 * W + NC, 32), dims.n_det_pad)
+    out["w2p"] = W2P
     jstep = model.jstep
 
     def unpack(cfg):
@@ -653,21 +657,26 @@ def _make_kernel_pieces(model: ModelSpec, dims: SearchDims):
             state.astype(jnp.int32),
         ])
 
-    def expand_mask_one(cfg, alive, det_f, det_v1, det_v2, det_inv,
-                        det_ret, sfx_min, crash_f, crash_v1, crash_v2,
-                        crash_inv, n_det, n_crash):
+    def expand_mask_one(cfg, alive, base, det_f, det_v1, det_v2,
+                        det_inv, det_ret, sfx_min, crash_f, crash_v1,
+                        crash_v2, crash_inv, n_det, n_crash):
+        # det_* / sfx_min are the per-level W2P-entry shared slices
+        # starting at `base` (_slice_tables); positions stay absolute
+        # for comparisons and are rebased only for table lookups.
         p, win, crash, state = unpack(cfg)
         pos = p + jnp.arange(W, dtype=jnp.int32)
+        rel = pos - base
         in_range = pos < n_det
         w_ret = jnp.where(in_range & ~win,
-                          jnp.take(det_ret, pos, mode="clip"), INF32)
+                          jnp.take(det_ret, rel, mode="clip"), INF32)
         w_inv = jnp.where(in_range,
-                          jnp.take(det_inv, pos, mode="clip"), INF32)
+                          jnp.take(det_inv, rel, mode="clip"), INF32)
         m1 = jnp.min(w_ret)
         am = jnp.argmin(w_ret)
         w_ret_excl = w_ret.at[am].set(INF32)
         m2 = jnp.min(w_ret_excl)
-        sfx = jnp.take(sfx_min, jnp.minimum(p + W, n_det), mode="clip")
+        sfx = jnp.take(sfx_min,
+                       jnp.minimum(p + W, n_det) - base, mode="clip")
         m1_tot = jnp.minimum(m1, sfx)
 
         lanes = jnp.arange(W, dtype=jnp.int32)
@@ -683,7 +692,7 @@ def _make_kernel_pieces(model: ModelSpec, dims: SearchDims):
         cand_on = jnp.arange(K) < n_enabled
 
         is_det = cand < W
-        det_pos = jnp.clip(p + cand, 0, dims.n_det_pad - 1)
+        det_pos = jnp.clip(p + cand - base, 0, W2P - 1)
         c_id = jnp.clip(cand - W, 0, NC - 1)
         cf = jnp.where(is_det, jnp.take(det_f, det_pos),
                        jnp.take(crash_f, c_id))
@@ -759,9 +768,41 @@ def _make_kernel_pieces(model: ModelSpec, dims: SearchDims):
 
     out["pack"] = pack
     out["expand_mask"] = jax.vmap(expand_mask_one,
-                                  in_axes=(0, 0) + (None,) * 12)
+                                  in_axes=(0, 0) + (None,) * 13)
     out["succ"] = jax.vmap(succ_one)
     return out
+
+
+def _slice_tables(op_args, frontier, alive, *, w2p: int):
+    """Per-level shared slice of the determinate-op tables.
+
+    Every config in a BFS level shares the level's depth d = p +
+    popcount(window) + popcount(crash), so prefix positions span at most
+    window + n_crash and every table lookup the level performs lands in
+    [min_p, min_p + 2*window + n_crash).  Slicing that strip ONCE per
+    level turns every per-lane gather from an n_det_pad-entry table into
+    a w2p-entry one — small enough to live in VMEM on TPU, where big-
+    table gathers are the expensive lowering.  ``w2p`` is capped at
+    n_det_pad by the caller, so small histories degrade to a full-table
+    "slice" at base 0 and nothing changes.
+
+    Returns (base, sliced op_args) — positions INSIDE the kernel remain
+    absolute for comparisons; only table indexing is rebased.
+    """
+    (det_f, det_v1, det_v2, det_inv, det_ret, sfx_min, crash_f,
+     crash_v1, crash_v2, crash_inv, n_det, n_crash) = op_args
+    n_det_pad = det_f.shape[0]
+    p = frontier[:, 0]
+    base = jnp.min(jnp.where(alive, p, INF32))
+    base = jnp.clip(base, 0, n_det_pad - w2p)
+
+    def sl(a):
+        return lax.dynamic_slice(a, (base,), (w2p,))
+
+    sfx = lax.dynamic_slice(sfx_min, (base,), (w2p + 1,))
+    return base, (sl(det_f), sl(det_v1), sl(det_v2), sl(det_inv),
+                  sl(det_ret), sfx, crash_f, crash_v1, crash_v2,
+                  crash_inv, n_det, n_crash)
 
 
 def _expand_survivors(pieces, frontier, alive, op_args, *, K: int,
@@ -773,8 +814,10 @@ def _expand_survivors(pieces, frontier, alive, op_args, *, K: int,
     words needed — see expand_mask_one), so a goal past the S survivor
     cap is still found."""
     F = frontier.shape[0]
+    base, sargs = _slice_tables(op_args, frontier, alive,
+                                w2p=pieces["w2p"])
     valid2, cand2, nstate2, goal2 = pieces["expand_mask"](
-        frontier, alive, *op_args)
+        frontier, alive, base, *sargs)
     found = jnp.any(goal2)
     validf = valid2.reshape(F * K)
     vsrc, n_valid = _compact_indices(validf, S)

@@ -127,7 +127,9 @@ def main() -> None:
         pieces = lin._make_kernel_pieces(model, dims)
 
         def mask_fn(fr, al):
-            v, c, ns, g = pieces["expand_mask"](fr, al, *kargs)
+            base, sargs = lin._slice_tables(kargs, fr, al,
+                                            w2p=pieces["w2p"])
+            v, c, ns, g = pieces["expand_mask"](fr, al, base, *sargs)
             return v.sum(), c.sum(), ns.sum(), g.sum()
 
         bench_one(f"expand_mask F={F}", mask_fn, frontier, alive,

@@ -673,13 +673,14 @@ def _make_kernel_pieces(model: ModelSpec, dims: SearchDims):
                           jnp.take(det_inv, rel, mode="clip"), INF32)
         m1 = jnp.min(w_ret)
         am = jnp.argmin(w_ret)
-        w_ret_excl = w_ret.at[am].set(INF32)
-        m2 = jnp.min(w_ret_excl)
+        lanes = jnp.arange(W, dtype=jnp.int32)
+        # second-min via select, not scatter (.at[am].set vmaps into a
+        # serialized scatter on TPU)
+        m2 = jnp.min(jnp.where(lanes == am, INF32, w_ret))
         sfx = jnp.take(sfx_min,
                        jnp.minimum(p + W, n_det) - base, mode="clip")
         m1_tot = jnp.minimum(m1, sfx)
 
-        lanes = jnp.arange(W, dtype=jnp.int32)
         excl_w = jnp.where(lanes == am, m2, m1)
         excl_tot = jnp.minimum(excl_w, sfx)
         det_enabled = in_range & ~win & (w_inv < excl_tot)

@@ -1257,6 +1257,25 @@ def check_competition(seq: OpSeq, model: ModelSpec, *,
 
     from . import seq as seqmod
 
+    # the host DFS memoizes each config TWICE (visited + parent_of) as a
+    # (bigint linearized-set, state tuple) pair: ~n/8 bytes of mask plus
+    # a couple hundred bytes of object overhead per copy.  Cap its
+    # configs to a ~4 GB footprint so the loser thread cannot eat the
+    # machine while the device grinds a long history (the reference
+    # answers this with -Xmx32g; we'd rather lose the race than the
+    # host).
+    per_cfg = 2 * (len(seq) // 8 + 200)
+    max_configs = min(max_configs, 4_000_000_000 // per_cfg)
+
+    es = encode_search(seq)
+    if es.window > MAX_WINDOW or es.n_crash > MAX_CRASH:
+        # the device leg would itself fall back to a host DFS; racing
+        # two identical host searches (one of them uncapped) helps
+        # nobody — run the capped host check alone
+        out = seqmod.check_opseq(seq, model, max_configs=max_configs)
+        out["engine"] = "competition(host-only: device encoding limits)"
+        return out
+
     done = threading.Event()
     lock = threading.Lock()
     result: dict = {}
@@ -1745,11 +1764,22 @@ class Linearizable:
                 self._render_failure(test, seq, out, opts)
             return out
 
-        if self.algorithm == "competition":
+        if self.algorithm in ("auto", "competition"):
+            # the reference's default is :competition
+            # (checker.clj:122-126): race the exact host DFS against the
+            # device search; whichever concludes first wins.  The host
+            # thread costs one core and wins exactly the histories a DFS
+            # lucky-dives (deep valid ones); the device wins sweeps.
             out = check_competition(seq, model, budget=self.budget)
         else:
             out = search_opseq(seq, model, budget=self.budget)
         if out["valid"] is False:
+            if "host-oracle" in out.get("engine", ""):
+                # the exact engine already produced this verdict (and
+                # its final-paths witness data); re-confirming would
+                # repeat the same exponential search
+                self._render_failure(test, seq, out, opts)
+                return out
             # exact confirmation + witness for the report, on the
             # shortest sound prefix covering the failure region
             target = seq

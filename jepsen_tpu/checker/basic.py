@@ -115,6 +115,120 @@ def queue(model=None) -> Checker:
     return QueueChecker(model)
 
 
+class QueueLinearizable(Checker):
+    """FULL linearizability search over queue semantics — beyond the
+    reference, whose queue checker can only model-reduce under the
+    assumption that every non-failing enqueue happened and dequeues ran
+    in completion order (checker.clj:141-147).  This checker instead
+    asks whether ANY real-time-consistent linearization explains the
+    history, crashed enqueue/dequeue ops included, using the device
+    engine with the bounded multiset/ring models
+    (models.unordered_queue/fifo_queue).
+
+    Drains: an ok drain whose value is the drained element LIST becomes
+    one dequeue per element, each spanning the drain's WHOLE interval
+    on its own fresh process — the elements left at unknown moments
+    within the window, so the full window is exactly each dequeue's
+    real-time interval (the reference's zero-width expansion is only
+    sound for its order-insensitive reduce).  Count-valued, crashed, or
+    failed drains pin down no elements and contribute no constraints.
+
+    The model capacity is sized from the history (#enqueues + 1 is
+    always sufficient).  Linearizability search is exponential where
+    the model-reduce is O(n): gate with ``max_ops`` (histories beyond
+    it return "unknown" with a note instead of burning the budget) and
+    keep queue keys small via jepsen_tpu.independent.  Wire it as an
+    OPT-IN checker: past the gate it reports "unknown", which
+    checker.compose's merge treats as non-True.
+    """
+
+    name = "queue-linearizable"
+
+    def __init__(self, *, fifo: bool = False, max_ops: int = 2000,
+                 budget: int = 5_000_000):
+        self.fifo = fifo
+        self.max_ops = max_ops
+        self.budget = budget
+
+    @staticmethod
+    def _expand_drains(history) -> list:
+        out = []
+        fresh = 1 + max((op.process for op in history
+                         if isinstance(op.process, int)), default=0)
+        pending: dict = {}  # drain process -> invoke buffer position
+        for op in history:
+            if op.f != "drain":
+                out.append(op)
+                continue
+            if is_invoke(op):
+                pending[op.process] = len(out)
+                continue
+            at = pending.pop(op.process, len(out))
+            if is_ok(op) and isinstance(op.value, (list, tuple)):
+                # k concurrent dequeues spanning [drain invoke, ok]:
+                # invokes inserted at the drain's invoke position,
+                # completions here, each on its own fresh process
+                invs, oks = [], []
+                for element in op.value:
+                    invs.append(replace(op, type="invoke", f="dequeue",
+                                        value=None, process=fresh))
+                    oks.append(replace(op, type="ok", f="dequeue",
+                                       value=element, process=fresh))
+                    fresh += 1
+                out[at:at] = invs
+                # concurrent drains buffered earlier positions past the
+                # insertion point: shift them with the inserted block
+                for k2 in pending:
+                    if pending[k2] >= at:
+                        pending[k2] += len(invs)
+                out.extend(oks)
+            # else: fate or contents unknown — no constraint
+        return out
+
+    def check(self, test, history, opts=None):
+        from ..models import fifo_queue, unordered_queue
+        from .linearizable import Linearizable
+
+        ops = self._expand_drains(list(history))
+        n_pairs = sum(1 for op in ops if is_invoke(op))
+        if n_pairs > self.max_ops:
+            return {"valid": "unknown",
+                    "info": f"{n_pairs} ops > max_ops={self.max_ops}; "
+                            "shard the queue (independent keys) or "
+                            "raise max_ops"}
+        n_enq = sum(1 for op in ops
+                    if is_invoke(op) and op.f == "enqueue")
+        make = fifo_queue if self.fifo else unordered_queue
+        model = make(max(4, n_enq + 1))
+        out = Linearizable(model, budget=self.budget).check(
+            test, ops, opts)
+        out["model"] = model.name
+        return out
+
+
+def queue_linearizable(**kw) -> Checker:
+    return QueueLinearizable(**kw)
+
+
+def add_queue_linear_opts(p) -> None:
+    """CLI flags for the opt-in linearizability check, shared by the
+    queue suites (rabbitmq, disque)."""
+    p.add_argument("--queue-linear", action="store_true",
+                   help="Also run the device linearizability search "
+                        "over the multiset model (short runs only)")
+    p.add_argument("--queue-linear-max-ops", type=int, default=2000)
+
+
+def queue_linear_entry(opts: dict, **kw) -> dict:
+    """The compose entry for --queue-linear: {} when the flag is off
+    (past its op gate the checker reports "unknown", which would
+    degrade a long run's composed verdict — so it stays opt-in)."""
+    if not opts.get("queue_linear"):
+        return {}
+    return {"queue_linear": queue_linearizable(
+        max_ops=opts.get("queue_linear_max_ops", 2000), **kw)}
+
+
 # ---------------------------------------------------------------------------
 # set — adds followed by a final read (checker.clj:162-211)
 # ---------------------------------------------------------------------------

@@ -313,6 +313,9 @@ def disque_test(opts: dict) -> dict:
         "nemesis": nemesis,
         "checker": checker_mod.compose({
             "queue": basic.total_queue(),
+            # opt-in (--queue-linear): device linearizability over
+            # the multiset model, beyond the model-reduce
+            **basic.queue_linear_entry(opts),
             "perf": perf_mod.perf(),
         }),
         "generator": std_gen(opts, gen.delay(1, gen.queue())),
@@ -322,6 +325,7 @@ def disque_test(opts: dict) -> dict:
 def add_opts(p):
     p.add_argument("--nemesis", default="partitions",
                    choices=["partitions", "killer"])
+    basic.add_queue_linear_opts(p)
     p.add_argument("--version",
                    default="f00dd0704128707f7a5effccd5837d796f2c01e3")
 

@@ -129,6 +129,9 @@ def rabbit_test(opts: dict) -> dict:
         "checker": checker_mod.compose({
             "queue": basic.queue(),
             "total_queue": basic.total_queue(),
+            # opt-in (--queue-linear): FULL device linearizability
+            # over the multiset model, beyond the model-reduce
+            **basic.queue_linear_entry(opts),
         }),
         "nemesis": nemesis.partition_random_halves(),
         "generator": gen.phases(
@@ -148,8 +151,12 @@ def rabbit_test(opts: dict) -> dict:
     }
 
 
+def add_opts(p):
+    basic.add_queue_linear_opts(p)
+
+
 def main(argv=None):
-    cli.main(cli.single_test_cmd(rabbit_test), argv)
+    cli.main(cli.single_test_cmd(rabbit_test, add_opts=add_opts), argv)
 
 
 if __name__ == "__main__":

@@ -328,3 +328,64 @@ def test_concurrency_limit():
     chk = ccore.concurrency_limit(2, Slow())
     out = chk.check({}, [])
     assert out["valid"] is True and calls == [1]
+
+
+def test_queue_linearizable_checker():
+    """Full linearizability over queue semantics — stronger than the
+    model-reduce: a from-thin-air element or an unjustifiable FIFO
+    service order must fail; drains become windowed concurrent dequeues
+    (NOT the reference's zero-width expansion, which is only sound for
+    order-insensitive reduces)."""
+    from jepsen_tpu.checker import basic
+    from jepsen_tpu.history import info_op, invoke_op, ok_op
+
+    h = [invoke_op(0, "enqueue", 1), ok_op(0, "enqueue", 1),
+         invoke_op(0, "enqueue", 2), ok_op(0, "enqueue", 2),
+         invoke_op(0, "drain", None), ok_op(0, "drain", [2, 1])]
+    # multiset semantics: drain order is free
+    assert basic.queue_linearizable().check({}, h, {})["valid"] is True
+    # FIFO: the two drained dequeues are CONCURRENT (both span the
+    # drain window), so either service order linearizes — valid
+    assert basic.queue_linearizable(fifo=True).check(
+        {}, h, {})["valid"] is True
+
+    # sequential (non-drain) LIFO service order: invalid under FIFO
+    h_lifo = [invoke_op(0, "enqueue", 1), ok_op(0, "enqueue", 1),
+              invoke_op(0, "enqueue", 2), ok_op(0, "enqueue", 2),
+              invoke_op(0, "dequeue", None), ok_op(0, "dequeue", 2),
+              invoke_op(0, "dequeue", None), ok_op(0, "dequeue", 1)]
+    assert basic.queue_linearizable(fifo=True).check(
+        {}, h_lifo, {})["valid"] is False
+    assert basic.queue_linearizable().check(
+        {}, h_lifo, {})["valid"] is True
+
+    # the windowed-drain soundness case: a dequeue strictly inside the
+    # drain window serviced between the drained element's enqueue and
+    # the drain's completion — valid under FIFO, which the zero-width
+    # expansion would wrongly reject
+    h_win = [invoke_op(0, "enqueue", 1), ok_op(0, "enqueue", 1),
+             invoke_op(0, "drain", None),
+             invoke_op(1, "enqueue", 2), ok_op(1, "enqueue", 2),
+             invoke_op(1, "dequeue", None), ok_op(1, "dequeue", 2),
+             ok_op(0, "drain", [1])]
+    assert basic.queue_linearizable(fifo=True).check(
+        {}, h_win, {})["valid"] is True
+
+    # from-thin-air dequeue fails under both
+    h2 = [invoke_op(0, "enqueue", 1), ok_op(0, "enqueue", 1),
+          invoke_op(0, "dequeue", None), ok_op(0, "dequeue", 99)]
+    assert basic.queue_linearizable().check({}, h2, {})["valid"] is False
+
+    # count-valued (disque-style) and crashed drains: no constraint,
+    # no crash
+    h3 = [invoke_op(0, "enqueue", 1), ok_op(0, "enqueue", 1),
+          invoke_op(0, "drain", None), ok_op(0, "drain", 1),
+          invoke_op(1, "drain", None), info_op(1, "drain", None)]
+    assert basic.queue_linearizable().check({}, h3, {})["valid"] is True
+
+    # over the gate: unknown, not an hours-long search
+    big = []
+    for i in range(60):
+        big += [invoke_op(0, "enqueue", i), ok_op(0, "enqueue", i)]
+    out3 = basic.queue_linearizable(max_ops=50).check({}, big, {})
+    assert out3["valid"] == "unknown"

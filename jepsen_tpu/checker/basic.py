@@ -151,8 +151,15 @@ class QueueLinearizable(Checker):
         self.budget = budget
 
     @staticmethod
-    def _expand_drains(history) -> list:
+    def _expand_drains(history) -> tuple[list, bool]:
+        """Returns (expanded ops, lossy) — lossy marks drains whose
+        removed elements cannot be identified (ok with a count value,
+        or crashed): skipping those is sound for the unordered multiset
+        (leftover elements never make another op illegal) but NOT for
+        FIFO, where unremoved elements block the head.  A failed drain
+        removed nothing and is never lossy."""
         out = []
+        lossy = False
         fresh = 1 + max((op.process for op in history
                          if isinstance(op.process, int)), default=0)
         pending: dict = {}  # drain process -> invoke buffer position
@@ -164,6 +171,8 @@ class QueueLinearizable(Checker):
                 pending[op.process] = len(out)
                 continue
             at = pending.pop(op.process, len(out))
+            if op.type == "fail":
+                continue
             if is_ok(op) and isinstance(op.value, (list, tuple)):
                 # k concurrent dequeues spanning [drain invoke, ok]:
                 # invokes inserted at the drain's invoke position,
@@ -182,14 +191,26 @@ class QueueLinearizable(Checker):
                     if pending[k2] >= at:
                         pending[k2] += len(invs)
                 out.extend(oks)
-            # else: fate or contents unknown — no constraint
-        return out
+            else:
+                lossy = True  # removed elements unidentifiable
+        if pending:
+            # dangling drain invokes (process died, no completion ever
+            # journaled) are crashed drains in the harness's encoding:
+            # they may have removed elements we cannot identify
+            lossy = True
+        return out, lossy
 
     def check(self, test, history, opts=None):
         from ..models import fifo_queue, unordered_queue
         from .linearizable import Linearizable
 
-        ops = self._expand_drains(list(history))
+        ops, lossy = self._expand_drains(list(history))
+        if lossy and self.fifo:
+            return {"valid": "unknown",
+                    "info": "history contains drains whose removed "
+                            "elements cannot be identified (count-"
+                            "valued or crashed); FIFO order cannot be "
+                            "checked soundly against a stale head"}
         n_pairs = sum(1 for op in ops if is_invoke(op))
         if n_pairs > self.max_ops:
             return {"valid": "unknown",

@@ -376,12 +376,29 @@ def test_queue_linearizable_checker():
           invoke_op(0, "dequeue", None), ok_op(0, "dequeue", 99)]
     assert basic.queue_linearizable().check({}, h2, {})["valid"] is False
 
-    # count-valued (disque-style) and crashed drains: no constraint,
-    # no crash
+    # count-valued (disque-style) and crashed drains: no constraint
+    # for the multiset; FIFO cannot be checked soundly -> unknown
     h3 = [invoke_op(0, "enqueue", 1), ok_op(0, "enqueue", 1),
           invoke_op(0, "drain", None), ok_op(0, "drain", 1),
           invoke_op(1, "drain", None), info_op(1, "drain", None)]
     assert basic.queue_linearizable().check({}, h3, {})["valid"] is True
+    out_l = basic.queue_linearizable(fifo=True).check({}, h3, {})
+    assert out_l["valid"] == "unknown" and "stale head" in out_l["info"]
+    # a FAILED drain removed nothing: fifo stays checkable
+    h4 = [invoke_op(0, "enqueue", 1), ok_op(0, "enqueue", 1),
+          invoke_op(0, "drain", None), fail_op(0, "drain", None),
+          invoke_op(0, "dequeue", None), ok_op(0, "dequeue", 1)]
+    assert basic.queue_linearizable(fifo=True).check(
+        {}, h4, {})["valid"] is True
+    # a DANGLING drain invoke (no completion ever) is a crashed drain:
+    # lossy for fifo, no-constraint for the multiset
+    h5 = [invoke_op(0, "enqueue", 1), ok_op(0, "enqueue", 1),
+          invoke_op(0, "enqueue", 2), ok_op(0, "enqueue", 2),
+          invoke_op(1, "drain", None),
+          invoke_op(0, "dequeue", None), ok_op(0, "dequeue", 2)]
+    assert basic.queue_linearizable(fifo=True).check(
+        {}, h5, {})["valid"] == "unknown"
+    assert basic.queue_linearizable().check({}, h5, {})["valid"] is True
 
     # over the gate: unknown, not an hours-long search
     big = []
@@ -389,3 +406,26 @@ def test_queue_linearizable_checker():
         big += [invoke_op(0, "enqueue", i), ok_op(0, "enqueue", i)]
     out3 = basic.queue_linearizable(max_ops=50).check({}, big, {})
     assert out3["valid"] == "unknown"
+
+
+@pytest.mark.parametrize("seed", range(4))
+def test_queue_linear_drain_window_property(seed):
+    """Simulated (valid-by-construction) queue traffic plus a final
+    drain of the leftovers must always check valid — the windowed drain
+    expansion may never invent a real-time constraint the run didn't
+    have."""
+    import random
+
+    from jepsen_tpu.checker import basic
+    from jepsen_tpu.history import invoke_op, ok_op
+    from jepsen_tpu.synth import sim_queue_history
+
+    rng = random.Random(7100 + seed)
+    h = sim_queue_history(rng, 30, 4, fifo=bool(seed % 2))
+    enq = [o.value for o in h if o.type == "ok" and o.f == "enqueue"]
+    for o in h:
+        if o.type == "ok" and o.f == "dequeue":
+            enq.remove(o.value)
+    h = h + [invoke_op(9, "drain", None), ok_op(9, "drain", enq)]
+    chk = basic.queue_linearizable(fifo=bool(seed % 2))
+    assert chk.check({}, h, {})["valid"] is True, seed

@@ -16,7 +16,7 @@ from __future__ import annotations
 from collections import Counter
 from dataclasses import replace
 
-from ..history import Op, is_invoke, is_ok
+from ..history import Op, is_fail, is_invoke, is_ok
 from ..util import integer_interval_set_str
 from .core import Checker
 
@@ -131,7 +131,10 @@ class QueueLinearizable(Checker):
     within the window, so the full window is exactly each dequeue's
     real-time interval (the reference's zero-width expansion is only
     sound for its order-insensitive reduce).  Count-valued, crashed, or
-    failed drains pin down no elements and contribute no constraints.
+    failed drains pin down no elements and contribute no constraints to
+    the multiset check; under ``fifo=True`` ANY element-removing drain
+    yields "unknown" (see _expand_drains for why neither identifiable
+    nor unidentifiable removals can be checked soundly against a FIFO).
 
     The model capacity is sized from the history (#enqueues + 1 is
     always sufficient).  Linearizability search is exponential where
@@ -152,12 +155,18 @@ class QueueLinearizable(Checker):
 
     @staticmethod
     def _expand_drains(history) -> tuple[list, bool]:
-        """Returns (expanded ops, lossy) — lossy marks drains whose
-        removed elements cannot be identified (ok with a count value,
-        or crashed): skipping those is sound for the unordered multiset
-        (leftover elements never make another op illegal) but NOT for
-        FIFO, where unremoved elements block the head.  A failed drain
-        removed nothing and is never lossy."""
+        """Returns (expanded ops, lossy).  ``lossy`` marks any drain
+        that removed (or may have removed) elements — it defeats a
+        sound FIFO check two ways: unidentifiable removals (count
+        values, crashed or dangling drains) leave a stale head for
+        later dequeues to be judged against, and identifiable ones
+        carry an intra-drain service ORDER that static op intervals
+        cannot encode (the k dequeues are sequential within the window,
+        but splitting the window would invent real-time constraints).
+        The unordered multiset needs neither: leftovers never make
+        another op illegal and its dequeues are order-free, so only
+        the relaxed window expansion matters there.  A failed or
+        empty-handed drain removed nothing and is never lossy."""
         out = []
         lossy = False
         fresh = 1 + max((op.process for op in history
@@ -171,9 +180,10 @@ class QueueLinearizable(Checker):
                 pending[op.process] = len(out)
                 continue
             at = pending.pop(op.process, len(out))
-            if op.type == "fail":
+            if is_fail(op):
                 continue
             if is_ok(op) and isinstance(op.value, (list, tuple)):
+                lossy = lossy or len(op.value) > 0
                 # k concurrent dequeues spanning [drain invoke, ok]:
                 # invokes inserted at the drain's invoke position,
                 # completions here, each on its own fresh process
@@ -207,10 +217,11 @@ class QueueLinearizable(Checker):
         ops, lossy = self._expand_drains(list(history))
         if lossy and self.fifo:
             return {"valid": "unknown",
-                    "info": "history contains drains whose removed "
-                            "elements cannot be identified (count-"
-                            "valued or crashed); FIFO order cannot be "
-                            "checked soundly against a stale head"}
+                    "info": "history contains drains that removed "
+                            "elements; FIFO cannot be checked soundly "
+                            "(unidentifiable removals leave a stale "
+                            "head, and a drained list's service order "
+                            "is not expressible as op intervals)"}
         n_pairs = sum(1 for op in ops if is_invoke(op))
         if n_pairs > self.max_ops:
             return {"valid": "unknown",

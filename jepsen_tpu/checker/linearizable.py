@@ -1151,6 +1151,11 @@ def _run_kernel(esp: EncodedSearch, es: EncodedSearch, model: ModelSpec,
             new_f = _grid_width(F * 4)
             carry = tuple(jnp.asarray(c) for c in
                           _widen_carry(clean[0], clean[1], new_f))
+            # per-level cost scales with width: shrink the level cap by
+            # the same ratio or the first wide slice runs lvl_cap
+            # narrow-sized levels at 4x the cost (enough to blow a
+            # wall-clock deadline — or the axon worker's ~60s watchdog)
+            lvl_cap = max(8, lvl_cap * F // new_f)
             F = new_f
             dims = SearchDims(**{**dims.__dict__, "frontier": F})
             clean = (carry, F)
@@ -1164,6 +1169,9 @@ def _run_kernel(esp: EncodedSearch, es: EncodedSearch, model: ModelSpec,
             if new_f < F:
                 # live rows sit at the frontier's prefix: truncate
                 carry = (carry[0][:new_f],) + tuple(carry[1:])
+                # cheaper levels: grow the cap by the width ratio so
+                # slice wall time stays near the target
+                lvl_cap = min(_SLICE_MAX, lvl_cap * (F // new_f))
                 F = new_f
                 dims = SearchDims(**{**dims.__dict__, "frontier": F})
                 clean = (carry, F)

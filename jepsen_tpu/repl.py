@@ -9,6 +9,6 @@ from __future__ import annotations
 from . import store
 
 
-def last_test(base: str = store.BASE):
+def last_test(base: str | None = None):
     """The most recently run test, reloaded from disk (repl.clj:6-13)."""
     return store.latest(base)

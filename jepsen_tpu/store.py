@@ -158,8 +158,13 @@ def update_symlinks(test: dict) -> None:
     relink(os.path.join(base_dir(test), "latest"), run_dir)
 
 
-def tests(name: str | None = None, base: str = BASE) -> dict:
-    """Map of test name -> {start-time -> run dir} (store.clj:216-234)."""
+def tests(name: str | None = None,
+          base: str | None = None) -> dict:
+    """Map of test name -> {start-time -> run dir} (store.clj:216-234).
+
+    ``base`` defaults to BASE at call time, so module-level overrides
+    (tests, store_base plumbing) are honored."""
+    base = BASE if base is None else base
     out: dict = {}
     if not os.path.isdir(base):
         return out
@@ -175,9 +180,11 @@ def tests(name: str | None = None, base: str = BASE) -> dict:
     return out
 
 
-def load(name: str, start_time: str, base: str = BASE) -> dict:
+def load(name: str, start_time: str,
+         base: str | None = None) -> dict:
     """Reload a saved test: test map + history + results
     (store.clj:165-181)."""
+    base = BASE if base is None else base
     d = os.path.join(base, name, start_time)
     out: dict = {}
     tj = os.path.join(d, "test.json")
@@ -192,8 +199,9 @@ def load(name: str, start_time: str, base: str = BASE) -> dict:
     return out
 
 
-def latest(base: str = BASE) -> dict | None:
+def latest(base: str | None = None) -> dict | None:
     """The most recent run, via the latest symlink (repl.clj:6-13)."""
+    base = BASE if base is None else base
     link = os.path.join(base, "latest")
     if not os.path.exists(link):
         return None

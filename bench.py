@@ -221,16 +221,23 @@ def start_probe() -> subprocess.Popen:
         stdout=subprocess.PIPE, stderr=subprocess.DEVNULL, text=True)
 
 
-def finish_probe(proc: subprocess.Popen, timeout: float) -> str | None:
-    """Wait for the probe; returns the platform name or None."""
+def finish_probe(proc: subprocess.Popen, timeout: float, *,
+                 keep_alive: bool = False) -> str | None:
+    """Wait for the probe; returns the platform name or None.
+
+    With ``keep_alive``, a timed-out probe is left RUNNING: a cold axon
+    tunnel has been observed to need ~9 minutes of first-touch, so the
+    CPU ladder runs while the probe keeps warming, and the accelerator
+    gets a second chance afterwards (see main's late-probe retry)."""
     try:
         out, _ = proc.communicate(timeout=max(1.0, timeout))
     except subprocess.TimeoutExpired:
-        proc.kill()
-        try:
-            proc.wait(timeout=5)
-        except subprocess.TimeoutExpired:
-            pass
+        if not keep_alive:
+            proc.kill()
+            try:
+                proc.wait(timeout=5)
+            except subprocess.TimeoutExpired:
+                pass
         return None
     if proc.returncode != 0 or not out:
         return None
@@ -453,11 +460,13 @@ def main():
             "configs": ref_batch_configs}
 
     # --- bring up the backend ------------------------------------------
-    platform = finish_probe(probe, min(PROBE_S, _remaining() - 60))
+    platform = finish_probe(probe, min(PROBE_S, _remaining() - 60),
+                            keep_alive=True)
     force_cpu = platform is None
     if force_cpu:
         print("bench: accelerator unreachable within probe budget; "
-              "forcing CPU backend", file=sys.stderr)
+              "forcing CPU backend (probe left warming for a late "
+              "retry)", file=sys.stderr)
         platform = "cpu"
     else:
         print(f"bench: backend '{platform}' is up "
@@ -568,7 +577,61 @@ def main():
             },
         }
 
+    # --- late-probe second chance --------------------------------------
+    # a cold tunnel can outlive the probe budget but come up during the
+    # CPU ladder: if it has by now (and reports a non-cpu platform),
+    # re-run the headline tier on the accelerator and promote that
+    # result — it is the evidence this benchmark exists to produce
+    late_platform = None
+    if force_cpu and probe.poll() is not None and probe.returncode == 0:
+        late_platform = finish_probe(probe, 1.0)
+    if late_platform and late_platform != "cpu" \
+            and _remaining() > TIER_S + 120:
+        print(f"bench: accelerator '{late_platform}' came up late; "
+              "re-running the headline tier unpinned", file=sys.stderr)
+        for name, n_ops, n_procs, budget, headline in reversed(tiers):
+            if not headline:
+                continue
+            res = run_tier(name, budget, force_cpu=False,
+                           timeout=min(_remaining() - 15,
+                                       TIER_S * 2.5 + 240))
+            if res and res.get("backend") not in (None, "cpu"):
+                t_dev = res["t_dev"]
+                dev_rate = res.get("rate") or (
+                    res["configs"] / t_dev if t_dev > 0 else float("inf"))
+                ref_rate, ref, t_ref = oracle_rates.get(
+                    name, (None, {"configs": 0, "valid": None}, 0.0))
+                vs = round(dev_rate / ref_rate, 2) if ref_rate else None
+                accel = {
+                    "configs": res["configs"], "valid": res["valid"],
+                    "device_seconds": round(t_dev, 3),
+                    "configs_per_sec": round(dev_rate, 1),
+                    "vs_oracle_same_history": vs,
+                    "backend": res["backend"],
+                }
+                _EXTRA[f"tier_{name}_accel"] = accel
+                cpu_best = _BEST
+                _BEST = {
+                    "metric": f"configurations-explored/sec, {name}-op "
+                              f"{n_procs}-proc CAS-register history "
+                              "(invalid tail; deadline-bounded "
+                              "state-space sweep; late accelerator "
+                              "run)",
+                    "value": round(dev_rate, 1),
+                    "unit": "configs/s",
+                    "vs_baseline": vs,
+                    "detail": {
+                        **accel,
+                        "cpu_fallback_headline":
+                            {k: cpu_best[k] for k in
+                             ("metric", "value", "vs_baseline")}
+                            if cpu_best else None,
+                    },
+                }
+            break
+
     _emit()
+    _reap_procs()
 
 
 if __name__ == "__main__":

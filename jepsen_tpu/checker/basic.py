@@ -231,7 +231,13 @@ class QueueLinearizable(Checker):
         n_enq = sum(1 for op in ops
                     if is_invoke(op) and op.f == "enqueue")
         make = fifo_queue if self.fifo else unordered_queue
-        model = make(max(4, n_enq + 1))
+        # capacity rounds up to a power of two: model.name embeds it and
+        # keys the kernel cache, so similar-sized histories must share
+        # compiled kernels instead of compiling one family per enqueue
+        # count
+        cap = max(4, n_enq + 1)
+        cap = 1 << (cap - 1).bit_length()
+        model = make(cap)
         out = Linearizable(model, budget=self.budget).check(
             test, ops, opts)
         out["model"] = model.name

@@ -281,9 +281,10 @@ def _unpack_bits(words, n_words):
     return bits.reshape(shape + (n_words * 32,)).astype(bool)
 
 
-#: above this row count the packed single-key sort's hash bits get too
-#: thin (dup survival rises), so dedup falls back to the exact variadic
-#: (key, iota) sort
+#: at or above this row count the packed single-key sort's hash bits get
+#: too thin (at S = 2^16 only 15 bits survive — ~2 rows/bucket already),
+#: so dedup falls back to the exact variadic (key, iota) sort; the
+#: exclusive bound keeps the packed path at >= 16 hash bits always
 _PACKED_SORT_MAX = 1 << 16
 
 
@@ -293,7 +294,7 @@ def _sort_dedup(h1, valid, cfgs, S: int):
 
     Two strategies, chosen by static size:
 
-    * S <= _PACKED_SORT_MAX: ONE uint32 key packs the hash's high bits
+    * S < _PACKED_SORT_MAX: ONE uint32 key packs the hash's high bits
       with the lane index — single-operand sorts are several times
       faster than variadic ones on both backends.  Identical configs
       share hash high bits and so sort into one bucket; a foreign config
@@ -309,7 +310,7 @@ def _sort_dedup(h1, valid, cfgs, S: int):
     and without the guard a tie-broken sort could place a replica before
     the one real copy and drop it — losing a reachable configuration.
     """
-    if S <= _PACKED_SORT_MAX:
+    if S < _PACKED_SORT_MAX:
         iota = jnp.arange(S, dtype=jnp.uint32)
         low = int(S).bit_length()  # iota <= S-1 < 2^low - 1 strictly
         high_mask = np.uint32((~((1 << low) - 1)) & 0xFFFFFFFF)
@@ -454,7 +455,7 @@ def build_search_step_fn(model: ModelSpec, dims: SearchDims):
 
             S = 4 * F
             ccfgs, cvalid, found, n_valid = _expand_survivors(
-                pieces, frontier, alive, op_args, K=K, S=S, n_det=n_det)
+                pieces, frontier, alive, op_args, K=K, S=S)
             ovf = ovf | (n_valid > S)
 
             # --- level dedup: hash sort + exact neighbor compare --------
@@ -547,7 +548,7 @@ def build_sharded_search_step_fn(model: ModelSpec, dims: SearchDims,
                 lvl = c
             alive = jnp.arange(F) < count
             cfgs, cvalid, found_here, n_valid = _expand_survivors(
-                inner, frontier, alive, op_args, K=K, S=S, n_det=n_det)
+                inner, frontier, alive, op_args, K=K, S=S)
             ovf = ovf | (n_valid > S)
             found = lax.psum(found_here.astype(jnp.int32), axis) > 0
 
@@ -807,7 +808,7 @@ def _slice_tables(op_args, frontier, alive, *, w2p: int):
 
 
 def _expand_survivors(pieces, frontier, alive, op_args, *, K: int,
-                      S: int, n_det):
+                      S: int):
     """expand_mask -> compact to S survivors -> build successor words.
 
     Returns (ccfgs [S, WORDS], cvalid [S], goal_found, n_valid).  The

@@ -14,6 +14,7 @@ import io
 import json
 import logging
 import os
+import shutil
 import urllib.parse
 import zipfile
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
@@ -81,15 +82,59 @@ def dir_html(base: str, rel: str) -> str:
             f"</body></html>")
 
 
-def zip_bytes(base: str, rel: str) -> bytes:
-    """Zip a run directory (web.clj:250-292)."""
+class _CountingWriter(io.RawIOBase):
+    """File-like adapter over a socket stream for ZipFile: zipfile needs
+    ``write`` and ``tell`` (for central-directory offsets); everything
+    goes straight to the wire, nothing is buffered."""
+
+    def __init__(self, sink):
+        self._sink = sink
+        self._pos = 0
+
+    def writable(self):
+        return True
+
+    def write(self, b):
+        self._sink.write(b)
+        self._pos += len(b)
+        return len(b)
+
+    def tell(self):
+        return self._pos
+
+
+def write_zip(sink, base: str, rel: str, *, chunk: int = 1 << 20) -> None:
+    """Stream a run directory as a zip straight into ``sink`` — the
+    reference streams its zips too (web.clj:250-292); buffering a
+    multi-GB run dir in memory is not an option.  Files are copied in
+    ``chunk``-sized pieces through ``ZipFile.open(..., "w")``."""
     d = os.path.join(base, rel)
-    buf = io.BytesIO()
-    with zipfile.ZipFile(buf, "w", zipfile.ZIP_DEFLATED) as z:
+    with zipfile.ZipFile(_CountingWriter(sink), "w",
+                         zipfile.ZIP_DEFLATED) as z:
         for root, _dirs, files in os.walk(d):
-            for f in files:
+            _dirs.sort()  # deterministic archive order
+            for f in sorted(files):
                 full = os.path.join(root, f)
-                z.write(full, os.path.relpath(full, d))
+                arc = os.path.relpath(full, d)
+                try:
+                    src = open(full, "rb")
+                    zi = zipfile.ZipInfo.from_file(full, arc)
+                except OSError:
+                    # a live run dir can rotate files between walk and
+                    # open/stat; skip rather than abort the download
+                    log.warning("zip: skipping vanished file %s", full)
+                    continue
+                # ZipFile.open honors the ZipInfo's compress_type (which
+                # from_file defaults to STORED), not the constructor's
+                zi.compress_type = zipfile.ZIP_DEFLATED
+                with src, z.open(zi, "w") as dst:
+                    shutil.copyfileobj(src, dst, chunk)
+
+
+def zip_bytes(base: str, rel: str) -> bytes:
+    """Whole-zip-in-memory variant (tests / small runs)."""
+    buf = io.BytesIO()
+    write_zip(buf, base, rel)
     return buf.getvalue()
 
 
@@ -132,9 +177,14 @@ class Handler(BaseHTTPRequestHandler):
         full = os.path.join(self.base, rel)
         if parsed.query == "zip" and os.path.isdir(full):
             name = rel.replace("/", "-") + ".zip"
-            self._send(200, zip_bytes(self.base, rel), "application/zip",
-                       {"Content-Disposition":
-                        f'attachment; filename="{name}"'})
+            # streamed: no Content-Length; the body is delimited by
+            # connection close (HTTP/1.0 semantics of this handler)
+            self.send_response(200)
+            self.send_header("Content-Type", "application/zip")
+            self.send_header("Content-Disposition",
+                             f'attachment; filename="{name}"')
+            self.end_headers()
+            write_zip(self.wfile, self.base, rel)
             return
         if os.path.isdir(full):
             self._send(200, dir_html(self.base, rel).encode())

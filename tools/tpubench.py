@@ -137,8 +137,7 @@ def main() -> None:
 
         def survivors_fn(fr, al):
             c, v, g, n = lin._expand_survivors(
-                pieces, fr, al, kargs, K=K, S=S,
-                n_det=jnp.int32(es.n_det))
+                pieces, fr, al, kargs, K=K, S=S)
             return c.sum(), v.sum()
 
         bench_one(f"expand+succ(S) F={F}", survivors_fn, frontier,
@@ -153,9 +152,9 @@ def main() -> None:
                                num_keys=1),
             keys32, repeat=rep)
         # mirror the production strategy choice and bit split exactly
-        # (_sort_dedup: packed only when S <= _PACKED_SORT_MAX, low =
+        # (_sort_dedup: packed only when S < _PACKED_SORT_MAX, low =
         # S.bit_length())
-        if S <= lin._PACKED_SORT_MAX:
+        if S < lin._PACKED_SORT_MAX:
             low = int(S).bit_length()
 
             def packed_sort(k):
@@ -168,7 +167,7 @@ def main() -> None:
         else:
             print(json.dumps({
                 "op": f"sort-packed32 S={S}",
-                "skipped": f"S > _PACKED_SORT_MAX="
+                "skipped": f"S >= _PACKED_SORT_MAX="
                            f"{lin._PACKED_SORT_MAX}; kernel uses the "
                            "variadic sort here"}), flush=True)
         bench_one(f"gather-rows [S,{WORDS}] S={S}",

@@ -1,0 +1,246 @@
+"""`linear` — memoized, dominance-pruned host linearizability checker.
+
+The reference exposes three knossos algorithms — :linear, :wgl and
+:competition (jepsen/src/jepsen/checker.clj:122-126).  `checker/seq.py`
+is the WGL analog (plain DFS over configurations); this module is the
+`linear` analog: a *memoized configuration search* in the spirit of
+Lowe's algorithm and Horn & Kroening's P-compositionality (PAPERS.md,
+arXiv:1504.00204), specialized to what actually makes histories
+expensive:
+
+* **Compact configuration encoding.**  The same (prefix, window-bitmask)
+  encoding the device engine uses (checker/linearizable.py's
+  EncodedSearch): the linearized determinate set is `p` leading ops plus
+  a bitmask over the next `window` ops, so set operations are small-int
+  operations instead of n-bit bigint masks (the WGL oracle's per-config
+  cost grows linearly with history length; this one's does not).
+
+* **Per-(p, window) candidate memoization.**  Which determinate ops may
+  linearize next — and the minimum outstanding return that gates crashed
+  ops — depends only on (p, win), not on model state or crash set.  The
+  candidate scan runs once per distinct (p, win) and is shared by every
+  state/crash variant (the analog of knossos `linear`'s memoized
+  configuration cache).
+
+* **Crash-set dominance pruning.**  Crashed (:info) ops never block
+  other ops (their return is +inf) and are never *required* to linearize
+  (the goal is "every :ok op linearized" — core.clj:387-397 semantics).
+  Hence if configurations A and B share (p, win, state) and A's
+  linearized-crash set is a subset of B's, every completion of B is a
+  completion of A — B is redundant.  Each (p, win, state) keeps only an
+  antichain of minimal crash masks.  The level-synchronous device BFS
+  cannot see this (the two configs sit at different depths); here it
+  collapses the crash-subset dimension of the search, often by orders of
+  magnitude on crash-heavy histories.
+
+* **Level-synchronous sweep, level-local memory.**  Depth = number of
+  determinate ops linearized; crashed ops linearize *within* a level
+  (they do not advance depth).  A configuration's depth is a function of
+  its encoding, so dedup never needs to cross levels and memory is
+  bounded by the widest level, not the whole visited set (the WGL
+  oracle's visited set is why the reference sizes its JVM at -Xmx32g,
+  jepsen/project.clj:25).
+
+Like the WGL oracle it is exact: verdicts are True/False, with
+"unknown" only on budget/deadline/cancellation.  Differential-tested
+against checker/seq.py (tests/test_linear_algo.py).
+"""
+
+from __future__ import annotations
+
+import time
+
+from ..history import OpSeq
+from ..models import ModelSpec
+from .linearizable import INF32, encode_search
+
+
+def _advance(p: int, win: int, bit: int, n_det: int):
+    """Set ``bit`` (window-relative) in win, then slide the prefix over
+    the run of low set bits.  Returns (p', win')."""
+    win |= 1 << bit
+    # count trailing ones
+    t = ((~win) & (win + 1)).bit_length() - 1
+    return p + t, win >> t
+
+
+class _Frame:
+    """Per-(p, win) memoized expansion data (state-independent)."""
+
+    __slots__ = ("det", "crash", "goal")
+
+    def __init__(self, det, crash, goal):
+        self.det = det      # list of (window_bit, f, v1, v2)
+        self.crash = crash  # list of (crash_idx, f, v1, v2)
+        self.goal = goal    # bool: all determinate ops linearized
+
+
+def check_opseq_linear(seq: OpSeq, model: ModelSpec, *,
+                       max_configs: int = 50_000_000,
+                       deadline: float | None = None,
+                       cancel=None) -> dict:
+    """Exact linearizability check.  Returns a knossos-style map
+    {"valid": True|False|"unknown", "configs": n, "max_depth": d, ...};
+    on invalid, ``final_ops`` holds the un-linearizable candidate rows at
+    the deepest level reached (the :final-paths analog, truncated to 10
+    as checker.clj:136-139 truncates)."""
+    es = encode_search(seq)
+    n_det, n_crash, W = es.n_det, es.n_crash, es.window
+    if n_det == 0 and n_crash == 0:
+        return {"valid": True, "configs": 0, "max_depth": 0}
+
+    det_inv = [int(x) for x in es.det_inv]
+    det_ret = [int(x) for x in es.det_ret]
+    det_f = [int(x) for x in es.det_f]
+    det_v1 = [int(x) for x in es.det_v1]
+    det_v2 = [int(x) for x in es.det_v2]
+    sfx = [int(x) for x in es.suffix_min_ret]  # len n_det+1
+    crash_inv = [int(x) for x in es.crash_inv]
+    crash_f = [int(x) for x in es.crash_f]
+    crash_v1 = [int(x) for x in es.crash_v1]
+    crash_v2 = [int(x) for x in es.crash_v2]
+    # global row index per det/crash position (for final_ops reporting)
+    import numpy as np
+
+    ok = np.asarray(seq.ok, dtype=bool)
+    det_rows = np.nonzero(ok)[0]
+    crash_rows = np.nonzero(~ok)[0]
+
+    pystep = model.pystep
+    INF = int(INF32)
+
+    frames: dict[tuple, _Frame] = {}
+
+    def frame(p: int, win: int) -> _Frame:
+        fr = frames.get((p, win))
+        if fr is not None:
+            return fr
+        if len(frames) > 2_000_000:
+            frames.clear()  # cap the memo; entries are cheap to rebuild
+        # window scan: returns of unlinearized dets in [p, p+W)
+        hi = min(p + W, n_det)
+        w_ret = []
+        for j in range(p, hi):
+            w_ret.append(INF if (win >> (j - p)) & 1 else det_ret[j])
+        tail = sfx[hi] if hi < len(sfx) else INF
+        # min / second-min over w_ret + tail
+        m1 = tail
+        m2 = INF + 1
+        m1_at = -1
+        for i, r in enumerate(w_ret):
+            if r < m1:
+                m2 = m1
+                m1 = r
+                m1_at = i
+            elif r < m2:
+                m2 = r
+        det_cands = []
+        for i in range(hi - p):
+            if (win >> i) & 1:
+                continue
+            j = p + i
+            excl = m2 if i == m1_at else m1
+            if det_inv[j] < excl:
+                det_cands.append((i, det_f[j], det_v1[j], det_v2[j]))
+        crash_cands = [(c, crash_f[c], crash_v1[c], crash_v2[c])
+                       for c in range(n_crash) if crash_inv[c] < m1]
+        fr = _Frame(det_cands, crash_cands,
+                    p + bin(win).count("1") >= n_det)
+        frames[(p, win)] = fr
+        return fr
+
+    # level: {(p, win, state): [minimal cmask antichain]}
+    level: dict[tuple, list[int]] = {(0, 0, model.init): [0]}
+    configs = 0
+    depth = 0
+    t_check = 0
+
+    def over_budget() -> str | None:
+        nonlocal t_check
+        t_check += 1
+        if configs > max_configs:
+            return f"exceeded max_configs={max_configs}"
+        if t_check % 1024 == 0:
+            if deadline is not None and time.perf_counter() > deadline:
+                return "exceeded deadline"
+            if cancel is not None and cancel.is_set():
+                return "cancelled"
+        return None
+
+    def insert(d: dict, key: tuple, cmask: int) -> bool:
+        """Dominance-pruned insert; True if the config was kept."""
+        ac = d.get(key)
+        if ac is None:
+            d[key] = [cmask]
+            return True
+        for cm in ac:
+            if cm & cmask == cm:  # cm subset of cmask: dominated
+                return False
+        d[key] = [cm for cm in ac if cm & cmask != cmask] + [cmask]
+        return True
+
+    while True:
+        # --- crash closure within the level (depth unchanged) ----------
+        work = [(k, cm) for k, ac in level.items() for cm in ac]
+        while work:
+            why = over_budget()
+            if why:
+                return {"valid": "unknown", "configs": configs,
+                        "max_depth": depth, "info": why}
+            (p, win, state), cmask = work.pop()
+            fr = frame(p, win)
+            for c, f, v1, v2 in fr.crash:
+                if (cmask >> c) & 1:
+                    continue
+                ns = pystep(state, f, v1, v2)
+                if ns is None:
+                    continue
+                configs += 1
+                nk = (p, win, ns)
+                ncm = cmask | (1 << c)
+                if insert(level, nk, ncm):
+                    work.append((nk, ncm))
+
+        # --- goal test -------------------------------------------------
+        for (p, win, _s) in level:
+            if frame(p, win).goal:
+                return {"valid": True, "configs": configs,
+                        "max_depth": depth}
+
+        # --- expand determinate candidates to the next level -----------
+        nxt: dict[tuple, list[int]] = {}
+        for (p, win, state), ac in level.items():
+            fr = frame(p, win)
+            for i, f, v1, v2 in fr.det:
+                ns = pystep(state, f, v1, v2)
+                if ns is None:
+                    continue
+                p2, win2 = _advance(p, win, i, n_det)
+                nk = (p2, win2, ns)
+                for cmask in ac:
+                    configs += 1
+                    insert(nxt, nk, cmask)
+            why = over_budget()
+            if why:
+                return {"valid": "unknown", "configs": configs,
+                        "max_depth": depth, "info": why}
+        if not nxt:
+            # frontier died: collect the blocked candidates for reporting
+            final_ops: list[int] = []
+            seen = set()
+            for (p, win, _s) in list(level)[:10]:
+                fr = frame(p, win)
+                for i, *_ in fr.det:
+                    r = int(det_rows[p + i])
+                    if r not in seen:
+                        seen.add(r)
+                        final_ops.append(r)
+                for c, *_ in fr.crash:
+                    r = int(crash_rows[c])
+                    if r not in seen:
+                        seen.add(r)
+                        final_ops.append(r)
+            return {"valid": False, "configs": configs,
+                    "max_depth": depth, "final_ops": sorted(final_ops)}
+        level = nxt
+        depth += 1

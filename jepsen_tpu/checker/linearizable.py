@@ -1249,22 +1249,24 @@ def search_opseq(seq: OpSeq, model: ModelSpec, *,
 def check_competition(seq: OpSeq, model: ModelSpec, *,
                       budget: int = 20_000_000,
                       max_configs: int = 50_000_000) -> dict:
-    """Race the exact host DFS oracle against the device BFS search; the
-    first conclusive verdict wins and retires the loser.
+    """Race the exact host checkers against the device BFS search; the
+    first conclusive verdict wins and retires the losers.
 
     The knossos `competition` analog (jepsen/src/jepsen/checker.clj:122-126
-    selects between :linear, :wgl and :competition — the latter races two
-    algorithms and takes whichever finishes first).  The pairing here is
-    naturally complementary: the host DFS can lucky-dive to a witness on
-    well-behaved histories while the device BFS grinds breadth, and the
-    device sweeps wide state spaces that strand the host in backtracking.
-    The host runs in a daemon thread (it releases the GIL only at its
-    cancellation checks, but the device thread spends its time blocked in
-    XLA executions, which do release it).
+    selects between :linear, :wgl and :competition — the latter races
+    algorithms and takes whichever finishes first).  The portfolio here is
+    complementary three ways: the WGL host DFS can lucky-dive to a witness
+    on well-behaved histories; the `linear` host sweep (checker/linear.py —
+    memoized, dominance-pruned) kills invalid histories whose crash-subset
+    space strands both DFS and BFS; the device BFS brute-forces wide state
+    spaces at device throughput.  Host legs run in daemon threads (they
+    release the GIL only at cancellation checks, but the device thread
+    spends its time blocked in XLA executions, which do release it).
     """
     import threading
 
     from . import seq as seqmod
+    from .linear import check_opseq_linear
 
     # the host DFS memoizes each config TWICE (visited + parent_of) as a
     # (bigint linearized-set, state tuple) pair: ~n/8 bytes of mask plus
@@ -1275,15 +1277,6 @@ def check_competition(seq: OpSeq, model: ModelSpec, *,
     # host).
     per_cfg = 2 * (len(seq) // 8 + 200)
     max_configs = min(max_configs, 4_000_000_000 // per_cfg)
-
-    es = encode_search(seq)
-    if es.window > MAX_WINDOW or es.n_crash > MAX_CRASH:
-        # the device leg would itself fall back to a host DFS; racing
-        # two identical host searches (one of them uncapped) helps
-        # nobody — run the capped host check alone
-        out = seqmod.check_opseq(seq, model, max_configs=max_configs)
-        out["engine"] = "competition(host-only: device encoding limits)"
-        return out
 
     done = threading.Event()
     lock = threading.Lock()
@@ -1301,31 +1294,59 @@ def check_competition(seq: OpSeq, model: ModelSpec, *,
             done.set()
             return True
 
-    def host():
+    def wgl_leg():
         try:
             r = seqmod.check_opseq(seq, model, max_configs=max_configs,
                                    cancel=done)
         except Exception:  # noqa: BLE001 — loser errors must not win
             return
-        submit(r, "competition(host-oracle)")
+        submit(r, "competition(host-wgl)")
 
-    t = threading.Thread(target=host, daemon=True,
-                         name="competition-host-oracle")
-    t.start()
+    def linear_leg():
+        try:
+            r = check_opseq_linear(seq, model, max_configs=max_configs,
+                                   cancel=done)
+        except Exception:  # noqa: BLE001
+            return
+        submit(r, "competition(host-linear)")
+
+    threads = [threading.Thread(target=wgl_leg, daemon=True,
+                                name="competition-host-wgl"),
+               threading.Thread(target=linear_leg, daemon=True,
+                                name="competition-host-linear")]
+    for t in threads:
+        t.start()
+
+    es = encode_search(seq)
+    if es.window > MAX_WINDOW or es.n_crash > MAX_CRASH:
+        # the device search would itself fall back to a host DFS; let the
+        # two host legs decide it (linear has no encoding limits)
+        for t in threads:
+            t.join()
+        with lock:
+            if result:
+                out = dict(result)
+                out["engine"] += "+device-skipped(encoding limits)"
+                return out
+        return {"valid": "unknown", "configs": 0,
+                "engine": "competition(exhausted; device encoding limits)"}
+
     dev = search_opseq(seq, model, budget=budget, stop=done)
     submit(dev, "competition(tpu)")
     if not result:
-        # device inconclusive: the race is only over when the host's own
-        # bounded DFS finishes too (knossos competition waits for a
+        # device inconclusive: the race is only over when the hosts' own
+        # bounded searches finish too (knossos competition waits for a
         # winner, not for the first to give up)
-        t.join()
+        for t in threads:
+            t.join()
     else:
-        done.set()  # retire a still-running loser
-        t.join(timeout=5.0)
+        done.set()  # retire still-running losers
+        for t in threads:
+            t.join(timeout=5.0)
     with lock:
         if result:
             return dict(result)
-    # both inconclusive (budgets exhausted)
+    # all inconclusive (budgets exhausted)
     return {**dev, "engine": "competition(exhausted)"}
 
 
@@ -1734,9 +1755,12 @@ class Linearizable:
     name = "linearizable"
 
     #: algorithm aliases, mirroring checker.clj:122-126's
-    #: :linear / :wgl / :competition selector
+    #: :linear / :wgl / :competition selector.  `linear` is the memoized
+    #: dominance-pruned host sweep (checker/linear.py), `wgl`/`host` the
+    #: plain DFS oracle (checker/seq.py), `device`/`tpu` the device BFS,
+    #: `competition` races all three.
     ALGORITHMS = {"auto": "auto", "device": "device", "tpu": "device",
-                  "linear": "device", "host": "host", "wgl": "host",
+                  "linear": "linear", "host": "host", "wgl": "host",
                   "competition": "competition"}
 
     def __init__(self, model: ModelSpec | None = None, *,
@@ -1769,6 +1793,15 @@ class Linearizable:
                     and len(seq) <= self.host_threshold)):
             out = seqmod.check_opseq(seq, model)
             out["engine"] = "host-oracle"
+            if out["valid"] is False:
+                self._render_failure(test, seq, out, opts)
+            return out
+
+        if self.algorithm == "linear":
+            from .linear import check_opseq_linear
+
+            out = check_opseq_linear(seq, model)
+            out["engine"] = "host-linear"
             if out["valid"] is False:
                 self._render_failure(test, seq, out, opts)
             return out

@@ -117,11 +117,11 @@ def write_zip(sink, base: str, rel: str, *, chunk: int = 1 << 20) -> None:
                 full = os.path.join(root, f)
                 arc = os.path.relpath(full, d)
                 try:
-                    src = open(full, "rb")
                     zi = zipfile.ZipInfo.from_file(full, arc)
+                    src = open(full, "rb")
                 except OSError:
                     # a live run dir can rotate files between walk and
-                    # open/stat; skip rather than abort the download
+                    # stat/open; skip rather than abort the download
                     log.warning("zip: skipping vanished file %s", full)
                     continue
                 # ZipFile.open honors the ZipInfo's compress_type (which
@@ -131,11 +131,6 @@ def write_zip(sink, base: str, rel: str, *, chunk: int = 1 << 20) -> None:
                     shutil.copyfileobj(src, dst, chunk)
 
 
-def zip_bytes(base: str, rel: str) -> bytes:
-    """Whole-zip-in-memory variant (tests / small runs)."""
-    buf = io.BytesIO()
-    write_zip(buf, base, rel)
-    return buf.getvalue()
 
 
 CONTENT_TYPES = {".html": "text/html", ".txt": "text/plain",
@@ -184,7 +179,10 @@ class Handler(BaseHTTPRequestHandler):
             self.send_header("Content-Disposition",
                              f'attachment; filename="{name}"')
             self.end_headers()
-            write_zip(self.wfile, self.base, rel)
+            try:
+                write_zip(self.wfile, self.base, rel)
+            except (BrokenPipeError, ConnectionResetError):
+                log.debug("zip: client dropped the connection")
             return
         if os.path.isdir(full):
             self._send(200, dir_html(self.base, rel).encode())

@@ -1,0 +1,199 @@
+"""Tests for checker/linear.py — the memoized, dominance-pruned host
+checker (the knossos `linear` analog; reference selector at
+jepsen/src/jepsen/checker.clj:122-126)."""
+
+import random
+
+import pytest
+
+from jepsen_tpu import synth
+from jepsen_tpu.checker import seq as seqmod
+from jepsen_tpu.checker.linear import check_opseq_linear
+from jepsen_tpu.checker.linearizable import Linearizable, check_competition
+from jepsen_tpu.history import encode_ops, info_op, invoke_op, ok_op
+from jepsen_tpu.models import (cas_register, fifo_queue, mutex, register,
+                               unordered_queue)
+
+
+def enc(h, model):
+    return encode_ops(h, model.f_codes)
+
+
+# ---------------------------------------------------------------------------
+# fixed cases
+# ---------------------------------------------------------------------------
+
+
+def test_empty_history_valid():
+    model = register()
+    out = check_opseq_linear(enc([], model), model)
+    assert out["valid"] is True
+
+
+def test_simple_valid_register():
+    model = register()
+    h = [invoke_op(0, "write", 1), ok_op(0, "write", 1),
+         invoke_op(0, "read", 1), ok_op(0, "read", 1)]
+    out = check_opseq_linear(enc(h, model), model)
+    assert out["valid"] is True
+
+
+def test_simple_invalid_register():
+    model = register(initial=0)
+    h = [invoke_op(0, "write", 1), ok_op(0, "write", 1),
+         invoke_op(0, "read", 2), ok_op(0, "read", 2)]
+    out = check_opseq_linear(enc(h, model), model)
+    assert out["valid"] is False
+    assert out["final_ops"]  # the blocked read is reported
+
+
+def test_crashed_write_may_linearize():
+    # read of 1 is only explainable if the crashed write linearized
+    model = register(initial=0)
+    h = [invoke_op(1, "write", 1), info_op(1, "write", 1),
+         invoke_op(0, "read", 1), ok_op(0, "read", 1)]
+    out = check_opseq_linear(enc(h, model), model)
+    assert out["valid"] is True
+
+
+def test_crashed_write_is_optional():
+    # read of 0 is fine even though a crashed write of 1 is pending
+    model = register(initial=0)
+    h = [invoke_op(1, "write", 1), info_op(1, "write", 1),
+         invoke_op(0, "read", 0), ok_op(0, "read", 0)]
+    out = check_opseq_linear(enc(h, model), model)
+    assert out["valid"] is True
+
+
+def test_crash_cannot_linearize_before_invocation():
+    # the crashed write is invoked AFTER the read returns: the read of 1
+    # cannot be explained by it
+    model = register(initial=0)
+    h = [invoke_op(0, "read", 1), ok_op(0, "read", 1),
+         invoke_op(1, "write", 1), info_op(1, "write", 1)]
+    out = check_opseq_linear(enc(h, model), model)
+    assert out["valid"] is False
+
+
+def test_mutex_double_acquire_invalid():
+    model = mutex()
+    h = [invoke_op(0, "acquire", None), ok_op(0, "acquire", None),
+         invoke_op(1, "acquire", None), ok_op(1, "acquire", None)]
+    out = check_opseq_linear(enc(h, model), model)
+    assert out["valid"] is False
+
+
+def test_crashed_release_unlocks_once():
+    # acquire, crashed release, acquire — OK; a third acquire is not
+    model = mutex()
+    h = [invoke_op(0, "acquire", None), ok_op(0, "acquire", None),
+         invoke_op(0, "release", None), info_op(0, "release", None),
+         invoke_op(1, "acquire", None), ok_op(1, "acquire", None)]
+    assert check_opseq_linear(enc(h, model), model)["valid"] is True
+    h2 = h + [invoke_op(2, "acquire", None), ok_op(2, "acquire", None)]
+    assert check_opseq_linear(enc(h2, model), model)["valid"] is False
+
+
+def test_budget_yields_unknown():
+    model = cas_register()
+    rng = random.Random(7)
+    h = synth.register_history(rng, n_ops=200, n_procs=8, overlap=8,
+                               crash_p=0.05, max_crashes=6, n_values=3)
+    out = check_opseq_linear(enc(h, model), model, max_configs=10)
+    assert out["valid"] == "unknown"
+
+
+# ---------------------------------------------------------------------------
+# differential vs the WGL oracle
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize("trial", range(60))
+def test_differential_register_family(trial):
+    rng = random.Random(1000 + trial)
+    model = cas_register() if trial % 2 else register()
+    h = synth.register_history(rng, n_ops=rng.randint(8, 120),
+                               n_procs=rng.randint(2, 6),
+                               overlap=rng.randint(1, 6),
+                               crash_p=0.08, max_crashes=6, n_values=3)
+    if trial % 2 == 0:
+        h = [op for op in h if op.f != "cas"]
+    if rng.random() < 0.5:
+        h = synth.corrupt_read(rng, h, at=rng.uniform(0.3, 0.95))
+    seq = enc(h, model)
+    a = check_opseq_linear(seq, model, max_configs=2_000_000)
+    b = seqmod.check_opseq(seq, model, max_configs=2_000_000)
+    if "unknown" not in (a["valid"], b["valid"]):
+        assert a["valid"] == b["valid"]
+
+
+@pytest.mark.parametrize("trial", range(30))
+def test_differential_mutex_and_queues(trial):
+    rng = random.Random(2000 + trial)
+    if trial % 2 == 0:
+        model = mutex()
+        h = synth.sim_mutex_history(rng, n_ops=rng.randint(8, 100),
+                                    n_procs=rng.randint(2, 5),
+                                    crash_p=0.1, max_crashes=6)
+        if rng.random() < 0.3:
+            h = h + [invoke_op(97, "acquire", None),
+                     ok_op(97, "acquire", None),
+                     invoke_op(98, "acquire", None),
+                     ok_op(98, "acquire", None)]
+    else:
+        model = unordered_queue(16) if rng.random() < 0.5 \
+            else fifo_queue(16)
+        h = synth.sim_queue_history(rng, n_ops=rng.randint(8, 60),
+                                    n_procs=rng.randint(2, 4))
+        if rng.random() < 0.4:
+            h = synth.corrupt_dequeue(rng, h)
+        elif rng.random() < 0.4:
+            h = synth.swap_dequeues(rng, h)
+    seq = enc(h, model)
+    a = check_opseq_linear(seq, model, max_configs=2_000_000)
+    b = seqmod.check_opseq(seq, model, max_configs=2_000_000)
+    if "unknown" not in (a["valid"], b["valid"]):
+        assert a["valid"] == b["valid"]
+
+
+# ---------------------------------------------------------------------------
+# wiring: algorithm menu + competition
+# ---------------------------------------------------------------------------
+
+
+def test_linearizable_algorithm_linear(tmp_path):
+    model = cas_register()
+    rng = random.Random(3)
+    h = synth.register_history(rng, n_ops=120, n_procs=4, overlap=4,
+                               n_values=3)
+    h = synth.corrupt_read(rng, h, at=0.7)
+    chk = Linearizable(model, algorithm="linear")
+    out = chk.check({"name": "t", "start-time": "now",
+                     "store-base": str(tmp_path)}, h)
+    assert out["valid"] is False
+    assert out["engine"] == "host-linear"
+
+
+def test_competition_includes_linear_leg():
+    # a history past the device encoding limits (too many crashes) is
+    # now decided by the host legs instead of a single capped DFS
+    model = register()
+    h = []
+    for i in range(70):  # 70 crashed writes > MAX_CRASH=64
+        h += [invoke_op(100 + i, "write", 1), info_op(100 + i, "write", 1)]
+    h += [invoke_op(0, "read", 0), ok_op(0, "read", 0)]
+    seq = enc(h, model)
+    out = check_competition(seq, model)
+    assert out["valid"] is True
+    assert "competition" in out["engine"]
+
+
+def test_competition_decides_invalid():
+    model = cas_register()
+    rng = random.Random(11)
+    h = synth.register_history(rng, n_ops=160, n_procs=6, overlap=6,
+                               crash_p=0.03, max_crashes=4, n_values=3)
+    h = synth.corrupt_read(rng, h, at=0.8)
+    seq = enc(h, model)
+    out = check_competition(seq, model)
+    assert out["valid"] is False

@@ -348,7 +348,8 @@ def test_competition_host_wins_when_device_stalls(monkeypatch):
     s = encode_ops(h, model.f_codes)
     out = lin.check_competition(s, model, budget=1)
     assert out["valid"] is False
-    assert out["engine"] == "competition(host-oracle)"
+    assert out["engine"] in ("competition(host-wgl)",
+                             "competition(host-linear)")
 
 
 def test_linearizable_algorithm_selection():

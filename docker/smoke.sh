@@ -71,4 +71,31 @@ docker exec jepsen-control \
   --time-limit 60 --concurrency 5
 check_valid "store/etcd*/latest/results.json"
 
+# --- suite matrix: real servers, partition nemesis ------------------------
+# Each suite installs its database on n1..n5 over SSH, drives a workload
+# with the partition nemesis active, and must produce a valid
+# results.json.  The control image ships the client drivers (kazoo,
+# pika, pymysql).  Skip any suite with SMOKE_SKIP="zookeeper rabbitmq".
+run_suite() {
+  # $1 suite module, $2 store glob, rest: extra args
+  local mod="$1" glob="$2"; shift 2
+  case " ${SMOKE_SKIP:-} " in *" ${mod##*.} "*)
+    echo "== skipping ${mod##*.} (SMOKE_SKIP)"; return 0;; esac
+  echo "== tier 3: ${mod##*.} over SSH against n1..n5"
+  docker exec jepsen-control \
+    python -m "$mod" test \
+    --node n1 --node n2 --node n3 --node n4 --node n5 \
+    --concurrency 5 "$@"
+  check_valid "$glob"
+}
+
+run_suite jepsen_tpu.suites.zookeeper "store/zookeeper*/latest/results.json" \
+  --time-limit 60
+run_suite jepsen_tpu.suites.rabbitmq "store/rabbitmq*/latest/results.json" \
+  --time-limit 60
+# galera's default dirty-reads workload runs nemesis-free by design;
+# the set workload is the one that drives faults during writes
+run_suite jepsen_tpu.suites.galera "store/galera*/latest/results.json" \
+  --workload set --time-limit 90
+
 echo "== smoke OK"

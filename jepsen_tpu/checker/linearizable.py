@@ -402,15 +402,118 @@ def _compact_indices(mask, k_out: int):
     return jnp.minimum(idx, n - 1).astype(jnp.int32), csum[-1]
 
 
+#: dominance-pass window: each sorted row is tested for domination
+#: against this many predecessors.  Misses past the window keep
+#: redundant configs (wasted work), never drop reachable ones.
+_DOM_WINDOW = 8
+
+
+def _pw_parts(cfgs, dims: SearchDims):
+    """(hash over the non-crash words, crash popcount) per row.
+
+    The dominance sort groups rows by (p, window, state) — the crash
+    words are excluded from the hash so every crash variant of one
+    det-configuration lands in the same bucket, ordered small-mask-first
+    by the popcount key."""
+    u = cfgs.astype(jnp.uint32)
+    a = 1 + dims.win_words
+    b = a + dims.crash_words
+    pw = jnp.concatenate([u[:, :a], u[:, b:]], axis=1)
+    pwh = _hash_words(pw, 0x9E3779B1)
+    popc = lax.population_count(u[:, a:b]).sum(
+        axis=1, dtype=jnp.uint32)
+    return pwh, popc
+
+
+def _sort_dominance(pwh, popc, valid, cfgs, M: int, dims: SearchDims,
+                    R: int = _DOM_WINDOW):
+    """Sort rows so equal-(p, win, state) configs group together with
+    smaller crash masks first, then drop every row *dominated* by an
+    earlier row: same (p, win, state) and the earlier row's crash mask
+    a subset of this row's.
+
+    Soundness: crashed ops never block other ops (ret = +inf) and are
+    never required to linearize, so any completion of the dominated row
+    is a completion of the dominator — dropping the dominated row can
+    never lose a reachable goal, and a frontier that dies without one
+    still proves invalidity.  Domination is decided on FULL word
+    equality + a real subset test (hashes only order), so a collision
+    can only *miss* a drop, never cause a wrong one.  A dominator that
+    was itself dropped is fine: ⊆ is transitive, so a kept row
+    dominates transitively.
+
+    Sort keys are (pw-hash, crash-popcount, full-hash, iota): identical
+    rows tie on all three hashes and so sort ADJACENT (the o=1 window
+    is exact dedup, modulo a 2^-32 full-hash collision that merely
+    keeps a duplicate), and any dominator of a row sorts earlier (equal
+    pw-hash, smaller-or-equal popcount).  Two reaches of the prune:
+
+      * a backward window of R rows (nearby dominators, exact dups);
+      * the row's RUN FIRST (run = maximal span of equal (p, win,
+        state) words): the run's minimum-popcount row, tested at any
+        distance — this is what keeps huge crash-variant buckets from
+        retaining duplicates of their minimal masks.
+
+    Returns (kept, sorted_cfgs, perm) — perm maps sorted rows to input
+    rows (callers use it to detect which survivors came from which
+    input block)."""
+    big = np.uint32(0xFFFFFFFF)
+    h2 = _hash_words(cfgs.astype(jnp.uint32), 0x7FEB352D)
+    k1 = jnp.where(valid, pwh, big)
+    k2 = jnp.where(valid, popc, big)
+    k3 = jnp.where(valid, h2, big)
+    _s1, _s2, _s3, perm = lax.sort(
+        (k1, k2, k3, jnp.arange(M, dtype=jnp.int32)), num_keys=3)
+    svalid = jnp.take(valid, perm)
+    scfgs = jnp.take(cfgs, perm, axis=0)
+    a = 1 + dims.win_words
+    b = a + dims.crash_words
+    spw = jnp.concatenate([scfgs[:, :a], scfgs[:, b:]], axis=1)
+    scr = scfgs[:, a:b].astype(jnp.uint32)
+    drop = jnp.zeros(M, bool)
+    for o in range(1, R + 1):
+        eq = jnp.all(spw[o:] == spw[:-o], axis=1)
+        sub = jnp.all((scr[:-o] & ~scr[o:]) == 0, axis=1)
+        d = svalid[:-o] & eq & sub
+        drop = drop | jnp.concatenate([jnp.zeros(o, bool), d])
+    # run-first domination at any distance
+    iota = jnp.arange(M, dtype=jnp.int32)
+    boundary = jnp.concatenate(
+        [jnp.ones(1, bool), jnp.any(spw[1:] != spw[:-1], axis=1)])
+    starts = lax.cummax(jnp.where(boundary, iota, 0))
+    fcr = jnp.take(scr, starts, axis=0)
+    fdom = (jnp.all((fcr & ~scr) == 0, axis=1) & (iota != starts)
+            & jnp.take(svalid, starts))
+    drop = drop | fdom
+    return svalid & ~drop, scfgs, perm
+
+
 def build_search_step_fn(model: ModelSpec, dims: SearchDims):
     """Compile one *slice* of the frontier search for a (model, dims) pair.
 
-    Level-synchronous BFS with a double-buffered frontier: a configuration
-    at depth d (d = ops linearized) can only ever be generated at level d,
-    so deduplication never needs to cross levels — there is no global
-    visited table, and per-level dedup is a sort plus an exact neighbor
-    compare on the full config words (no fingerprint-collision soundness
-    hole, and no random-index scatters, which TPUs serialize).
+    Level-synchronous search where a level's depth counts DETERMINATE
+    (:ok) linearizations only; crashed (:info) ops linearize *within* a
+    level via an inner closure loop.  Per level:
+
+      1. expand the frontier (mask phase: enabled candidates + model
+         steps + goal test on every lane);
+      2. crash closure: while any crash successor survives, merge crash
+         successors into the level (sort + dominance prune) and
+         re-expand — at most n_crash+1 rounds closes the level under
+         crash linearization (each genuinely new config adds a crash
+         bit), and levels with no enabled crash candidate (the common
+         case) skip the loop entirely;
+      3. expand determinate successors into the next level (sort +
+         dominance prune).
+
+    Co-locating every crash variant of a configuration in one level is
+    what makes the dominance prune (`_sort_dominance`) possible — under
+    the old depth-counts-everything scheme the variants sat at different
+    depths and the crash-subset dimension exploded the frontier (8.5x
+    more configs and ~40x wider levels on the 10k-op bench history).
+    Depth remains a function of the configuration (d = p + popcount(win),
+    crash bits excluded), so dedup still never needs to cross levels and
+    there is no global visited table.
 
     The search state (frontier, count, status, configs, max_depth, ovf) is
     an explicit *carry* passed in and returned, and each call runs at most
@@ -428,7 +531,8 @@ def build_search_step_fn(model: ModelSpec, dims: SearchDims):
     """
     K = dims.k
     F = dims.frontier
-    WORDS = dims.words
+    W = dims.window
+    S = 4 * F
     pieces = _make_kernel_pieces(model, dims)
 
     def step(det_f, det_v1, det_v2, det_inv, det_ret, sfx_min,
@@ -440,6 +544,24 @@ def build_search_step_fn(model: ModelSpec, dims: SearchDims):
         op_args = (det_f, det_v1, det_v2, det_inv, det_ret, sfx_min,
                    crash_f, crash_v1, crash_v2, crash_inv, n_det,
                    n_crash)
+
+        def mask_phase(frontier, alive):
+            base, sargs = _slice_tables(op_args, frontier, alive,
+                                        w2p=pieces["w2p"])
+            return pieces["expand_mask"](frontier, alive, base, *sargs)
+
+        def succ_block(frontier, validf, cand2, ns2, cap: int):
+            """Compact the [F*K] valid mask to ``cap`` survivors and
+            build their successor words."""
+            vsrc, n_valid = _compact_indices(validf, cap)
+            row = vsrc // K
+            src_cfg = jnp.take(frontier, row, axis=0)
+            src_lane = jnp.take(cand2.reshape(F * K), vsrc)
+            sw = ns2.shape[-1]
+            src_state = jnp.take(ns2.reshape(F * K, sw), vsrc, axis=0)
+            cvalid = jnp.arange(cap) < n_valid
+            ccfgs, _p2s = pieces["succ"](src_cfg, src_lane, src_state)
+            return ccfgs, cvalid, n_valid
 
         def cond(c):
             _, count, status, configs, _, ovf, lvl = c
@@ -453,18 +575,85 @@ def build_search_step_fn(model: ModelSpec, dims: SearchDims):
             frontier, count, status, configs, max_depth, ovf, lvl = c
             alive = jnp.arange(F) < count
 
-            S = 4 * F
-            ccfgs, cvalid, found, n_valid = _expand_survivors(
-                pieces, frontier, alive, op_args, K=K, S=S)
+            valid2, cand2, ns2, goal2 = mask_phase(frontier, alive)
+            found = jnp.any(goal2)
+            crash_any = jnp.any(valid2 & (cand2 >= W))
+
+            # --- crash closure (within-level) --------------------------
+            def cl_cond(cc):
+                it, progress = cc[8], cc[9]
+                first = it == 0
+                return ((first & crash_any)
+                        | (~first & progress & (it < n_crash + 1)))
+
+            def cl_body(cc):
+                (frontier, count, valid2, cand2, ns2, _goal2, configs,
+                 ovf, it, _pr, found) = cc
+                alive = jnp.arange(F) < count
+                cvalidf = (valid2 & (cand2 >= W)).reshape(F * K)
+                # crash successors are capped at F rows (not S): they
+                # merge back into a <= F-row level, so more than F of
+                # them overflows the level anyway — and the merge sort
+                # stays at 2F rows instead of 5F
+                ccfgs, cvalid, n_valid = succ_block(
+                    frontier, cvalidf, cand2, ns2, F)
+                ovf = ovf | (n_valid > F)
+                merged = jnp.concatenate([frontier, ccfgs], axis=0)
+                mvalid = jnp.concatenate([alive, cvalid])
+                pwh, popc = _pw_parts(merged, dims)
+                kept, scfgs, perm = _sort_dominance(
+                    pwh, popc, mvalid, merged, 2 * F, dims)
+                src, new_count = _compact_indices(kept, F)
+                new_frontier = jnp.take(scfgs, src, axis=0)
+                ovf = ovf | (new_count > F)
+                new_count = jnp.minimum(new_count, F)
+                # progress iff any successor-block row survived the
+                # merge (perm >= F).  A merge that only DROPPED existing
+                # rows does not require another round: surviving rows'
+                # crash successors were all generated and merged this
+                # round, and dropped rows are covered by their
+                # dominators — the level is closed.
+                progress = jnp.any(kept & (perm >= F))
+                # configs is NOT bumped here: closure-added rows are
+                # part of this level and the det phase counts the closed
+                # level's rows once — counting per closure round would
+                # inflate the figure (and eat the budget) k+1 times on
+                # k-round levels, losing comparability with the host
+                # checkers' per-config counts
+                # re-expand so the carried expansion always aligns with
+                # the (sorted, compacted) frontier rows the det phase
+                # will gather from
+                alive2 = jnp.arange(F) < new_count
+                v2, c2, n2, g2 = mask_phase(new_frontier, alive2)
+                found = found | jnp.any(g2)
+                return (new_frontier, new_count, v2, c2, n2, g2,
+                        configs, ovf, it + 1, progress, found)
+
+            # progress starts False: the first iteration is gated on
+            # crash_any, and an unentered loop must exit "closed"
+            cc0 = (frontier, count, valid2, cand2, ns2, goal2, configs,
+                   ovf, jnp.int32(0), jnp.bool_(False), found)
+            (frontier, count, valid2, cand2, ns2, goal2, configs, ovf,
+             _it, pr_exit, found) = lax.while_loop(cl_cond, cl_body,
+                                                   cc0)
+            # exiting via the iteration cap while still adding rows
+            # means the level was NOT proven closed under crash
+            # linearization; that must degrade like an overflow
+            # (escalate / unknown), never decide invalid.  Real chains
+            # add a crash bit per round (length <= n_crash < cap), so
+            # this only fires on pathological duplicate survival.
+            ovf = ovf | pr_exit
+            alive = jnp.arange(F) < count
+
+            # --- determinate expansion to the next level ---------------
+            dvalidf = (valid2 & (cand2 < W)).reshape(F * K)
+            dcfgs, dvalid, n_valid = succ_block(
+                frontier, dvalidf, cand2, ns2, S)
             ovf = ovf | (n_valid > S)
-
-            # --- level dedup: hash sort + exact neighbor compare --------
-            wu = ccfgs.astype(jnp.uint32)
-            h1 = _hash_words(wu, 0x9E3779B1)
-            svalid, scfgs = _sort_dedup(h1, cvalid, ccfgs, S)
-
-            # --- compact into the next frontier (sort-free) ----------------
-            src, new_count = _compact_indices(svalid, F)
+            pwh, popc = _pw_parts(dcfgs, dims)
+            kept, scfgs, _perm = _sort_dominance(
+                pwh, popc, dvalid, dcfgs, S, dims)
+            src, new_count = _compact_indices(kept, F)
             new_frontier = jnp.take(scfgs, src, axis=0)
             ovf = ovf | (new_count > F)
             new_count = jnp.minimum(new_count, F)
@@ -927,7 +1116,7 @@ def search_opseq_sharded(seq: OpSeq, model: ModelSpec, mesh, *,
     return {"valid": _STATUS[status],
             "configs": configs,
             "max_depth": int(np.asarray(carry[4]).reshape(-1)[0]),
-            "engine": f"tpu-sharded-x{mesh.shape[axis]}",
+            "engine": f"device-sharded-x{mesh.shape[axis]}",
             "frontier_per_device": dims.frontier}
 
 
@@ -1241,7 +1430,7 @@ def search_opseq(seq: OpSeq, model: ModelSpec, *,
         esp, es, model, dims, budget, on_slice=on_slice,
         deadline=deadline, stop=stop)
     return {"valid": _STATUS[status], "configs": configs,
-            "max_depth": max_depth, "engine": "tpu",
+            "max_depth": max_depth, "engine": "device-bfs",
             "frontier": dims.frontier,
             "window": es.window, "concurrency": es.concurrency}
 
@@ -1332,7 +1521,7 @@ def check_competition(seq: OpSeq, model: ModelSpec, *,
                 "engine": "competition(exhausted; device encoding limits)"}
 
     dev = search_opseq(seq, model, budget=budget, stop=done)
-    submit(dev, "competition(tpu)")
+    submit(dev, "competition(device)")
     if not result:
         # device inconclusive: the race is only over when the hosts' own
         # bounded searches finish too (knossos competition waits for a
@@ -1417,7 +1606,7 @@ def resume_opseq(seq: OpSeq, model: ModelSpec, path: str, *,
     status, configs, max_depth, dims = _run_kernel(
         esp, es, model, dims, budget, on_slice=on_slice, resume=carry)
     return {"valid": _STATUS[status], "configs": configs,
-            "max_depth": max_depth, "engine": "tpu(resumed)",
+            "max_depth": max_depth, "engine": "device-bfs(resumed)",
             "frontier": dims.frontier,
             "window": es.window, "concurrency": es.concurrency}
 
@@ -1642,29 +1831,45 @@ def search_batch(seqs: list[OpSeq], model: ModelSpec, *,
 
     if sharding is not None:
         # mesh-sharded batch: fixed size (the key axis must keep
-        # covering the mesh), plain slice driver
+        # covering the mesh), plain slice driver.  Arrays go to the mesh
+        # straight from host numpy: in a MULTI-PROCESS job (DCN tier,
+        # distributed.multihost_mesh) each process owns only its
+        # addressable shards, and device_put from replicated host data
+        # is the supported construction path.
         args = stack_batch([pad_search(e, dims.n_det_pad,
                                        dims.n_crash_pad) for e in ess])
-        carry = tuple(jnp.asarray(c) for c in
-                      _init_batch_carry(len(seqs), dims, model))
-        args = tuple(jax.device_put(a, sharding) for a in args)
-        carry = tuple(jax.device_put(c, sharding) for c in carry)
+        args = tuple(jax.device_put(np.asarray(a), sharding)
+                     for a in args)
+        carry = tuple(jax.device_put(np.asarray(c), sharding)
+                      for c in _init_batch_carry(len(seqs), dims, model))
 
         def call(c, lvl_cap):
             return fn(*args, jnp.int32(budget), jnp.int32(lvl_cap),
                       jnp.bool_(False), *c)
 
+        # the liveness reduction runs jitted: its output is replicated,
+        # so it stays readable when the carry itself is sharded over
+        # processes (np.asarray on a non-fully-addressable array throws)
+        active_fn = jax.jit(
+            lambda s, c, g: jnp.any((s == -1) & (c > 0) & (g < budget)))
+
         def is_active(c):
-            active = ((np.asarray(c[2]) == -1) & (np.asarray(c[1]) > 0)
-                      & (np.asarray(c[3]) < budget))
-            return bool(active.any())
+            return bool(active_fn(c[2], c[1], c[3]))
+
+        def gather(x):
+            if getattr(x, "is_fully_addressable", True):
+                return np.asarray(x)
+            from jax.experimental import multihost_utils
+
+            return np.asarray(
+                multihost_utils.process_allgather(x, tiled=True))
 
         carry = _drive_slices(call, carry, is_active)
-        status = np.asarray(carry[2])
-        count = np.asarray(carry[1])
-        configs = np.asarray(carry[3])
-        depth = np.asarray(carry[4])
-        ovf = np.asarray(carry[5])
+        status = gather(carry[2])
+        count = gather(carry[1])
+        configs = gather(carry[3])
+        depth = gather(carry[4])
+        ovf = gather(carry[5])
     else:
         esps = [pad_search(e, dims.n_det_pad, dims.n_crash_pad)
                 for e in ess]
@@ -1686,7 +1891,7 @@ def search_batch(seqs: list[OpSeq], model: ModelSpec, *,
             out.append({"valid": _STATUS[int(status[i])],
                         "configs": int(configs[i]),
                         "max_depth": int(depth[i]),
-                        "engine": "tpu-batch"})
+                        "engine": "device-batch"})
     return out
 
 

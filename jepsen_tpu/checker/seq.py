@@ -58,7 +58,8 @@ def _walk_parents(parent_of: dict, key) -> list[int]:
 def check_opseq(seq: OpSeq, model: ModelSpec, *,
                 max_configs: int = 5_000_000,
                 deadline: float | None = None,
-                cancel=None) -> dict:
+                cancel=None,
+                order_seed: int | None = None) -> dict:
     """Run the DFS over a columnar OpSeq.  Returns a knossos-style map:
 
     valid        True | False | "unknown"
@@ -73,8 +74,12 @@ def check_opseq(seq: OpSeq, model: ModelSpec, *,
     ``max_configs`` for time-bounded throughput comparisons.  ``cancel``
     (a ``threading.Event``) yields "unknown" once set — how the
     competition mode retires the loser (see
-    ``linearizable.check_competition``).
+    ``linearizable.check_competition``).  ``order_seed`` randomizes the
+    DFS candidate-push order: the verdict is unchanged, but different
+    seeds dive different subtrees first — the diversity knob for the
+    portfolio comparator (checker/parallel.py).
     """
+    import random as _random
     import time
     n = len(seq)
     ok_mask = 0
@@ -169,7 +174,12 @@ def check_opseq(seq: OpSeq, model: ModelSpec, *,
                     first = False
                 elif r < m2:
                     m2 = r
-        for idx, j2 in enumerate(cand):
+        order = range(len(cand))
+        if order_seed is not None:
+            order = list(order)
+            _random.Random(order_seed ^ hash(key)).shuffle(order)
+        for idx in order:
+            j2 = cand[idx]
             excl = m2 if rets[idx] == m1 and m1_count == 1 else m1
             if inv[j2] >= excl:
                 continue

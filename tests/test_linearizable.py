@@ -241,7 +241,7 @@ def test_checkpoint_resume(tmp_path, monkeypatch):
     assert digest == lin.history_digest(s, model)
     out = lin.resume_opseq(s, model, ckpt)
     assert out["valid"] == want
-    assert out["engine"].startswith("tpu")
+    assert out["engine"].startswith("device")
 
     # resuming against a different history must be refused
     h2 = corrupt(random.Random(99),
@@ -439,10 +439,10 @@ def test_search_batch_mixed_difficulty_compaction():
     got = lin.search_batch(seqs, model, budget=500_000)
     assert [r["valid"] for r in got] == want
     assert all(r["engine"] in
-               ("tpu-batch", "greedy-witness", "tpu", "trivial")
+               ("device-batch", "greedy-witness", "device-bfs", "trivial")
                for r in got)
     # at least the corrupted keys must have ridden the device
-    assert sum(r["engine"] == "tpu-batch" for r in got) >= 6
+    assert sum(r["engine"] == "device-batch" for r in got) >= 6
 
 
 @pytest.mark.parametrize("seed", range(8))

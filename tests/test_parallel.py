@@ -1,0 +1,52 @@
+"""Tests for checker/parallel.py — the multi-core host comparator
+(the knossos-competition-on-N-cores stand-in, BASELINE.json)."""
+
+import random
+
+from jepsen_tpu.checker.parallel import batch_check_pool, portfolio_check
+from jepsen_tpu.history import encode_ops
+from jepsen_tpu.models import cas_register
+from jepsen_tpu.synth import corrupt_read, register_history
+
+
+def _mk_history(seed: int, corrupt: bool):
+    model = cas_register()
+    rng = random.Random(seed)
+    h = register_history(rng, n_ops=60, n_procs=4, overlap=4, n_values=3)
+    if corrupt:
+        h = corrupt_read(rng, h, at=0.7)
+    return encode_ops(h, model.f_codes), model
+
+
+# module-level builders (spawned workers re-import this module)
+
+
+def build_invalid():
+    return _mk_history(5, True)
+
+
+def build_valid():
+    return _mk_history(6, False)
+
+
+def build_key(k: int):
+    return _mk_history(100 + k, k % 2 == 0)
+
+
+def test_portfolio_decides_invalid():
+    out = portfolio_check(build_invalid, n_procs=2, deadline_s=120)
+    assert out["valid"] is False
+    assert out["engine"].startswith("host2(")
+    assert out["seconds"] >= 0
+
+
+def test_portfolio_decides_valid():
+    out = portfolio_check(build_valid, n_procs=2, deadline_s=120)
+    assert out["valid"] is True
+
+
+def test_batch_pool_all_keys():
+    out = batch_check_pool(build_key, 6, n_procs=2, deadline_s=240)
+    assert out["keys_done"] == 6
+    for k, v in out["verdicts"].items():
+        assert v is (k % 2 != 0), (k, v)

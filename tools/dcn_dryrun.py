@@ -1,0 +1,127 @@
+"""Two-process jax.distributed dry run — the DCN tier without hardware.
+
+Validates the multi-HOST path (SURVEY.md §2.4/§5.8): two OS processes,
+each owning 4 virtual CPU devices, bring up `jax.distributed`, build
+`distributed.multihost_mesh()` (a hosts×chips = 2×4 mesh with the
+independent-keys axis on DCN), and run `search_batch` with the key axis
+sharded across BOTH processes.  This is the same SPMD program the real
+multi-host TPU deployment runs — the reference's analog is its
+control-node-centric SSH fan-out, which never needed this tier; the
+checker's scale-out does.
+
+Run with no arguments: forks the two ranks, waits, prints one OK line.
+Exit code 0 = both ranks agreed on every verdict.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import socket
+import subprocess
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+N_PROCS = 2
+DEVICES_PER_PROC = 4
+N_KEYS = 8
+
+
+def child(proc_id: int, port: int) -> None:
+    os.environ["XLA_FLAGS"] = (
+        f"--xla_force_host_platform_device_count={DEVICES_PER_PROC}")
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+
+    from jepsen_tpu import distributed as dist
+
+    ok = dist.init_from_env(coordinator=f"127.0.0.1:{port}",
+                            num_processes=N_PROCS, process_id=proc_id)
+    assert ok, "jax.distributed did not initialize"
+    info = dist.process_info()
+    assert info["process_count"] == N_PROCS, info
+    assert info["global_devices"] == N_PROCS * DEVICES_PER_PROC, info
+
+    mesh = dist.multihost_mesh()
+    assert dict(mesh.shape) == {"keys": N_PROCS,
+                                "shard": DEVICES_PER_PROC}, mesh.shape
+
+    # identical batch on every rank (SPMD): half the keys corrupted so
+    # they must ride the device kernel, half valid
+    import random
+
+    from jepsen_tpu.checker import linearizable as lin
+    from jepsen_tpu.checker import seq as oracle
+    from jepsen_tpu.history import encode_ops
+    from jepsen_tpu.models import cas_register
+    from jepsen_tpu.synth import corrupt_read, register_history
+
+    model = cas_register()
+    seqs, want = [], []
+    for k in range(N_KEYS):
+        rng = random.Random(3000 + k)
+        h = register_history(rng, n_ops=20, n_procs=3, overlap=3,
+                             n_values=3)
+        if k % 2 == 0:
+            h = corrupt_read(rng, h, at=0.7)
+        s = encode_ops(h, model.f_codes)
+        seqs.append(s)
+        want.append(oracle.check_opseq(s, model)["valid"])
+
+    with mesh:
+        results = lin.search_batch(seqs, model, budget=200_000,
+                                   sharding=dist.keys_sharding(mesh))
+    got = [r["valid"] for r in results]
+    assert got == want, f"rank {proc_id}: {got} != {want}"
+    if proc_id == 0:
+        print(json.dumps({
+            "ok": True, "phase": "dcn-2proc",
+            "processes": N_PROCS,
+            "devices_per_proc": DEVICES_PER_PROC,
+            "mesh": dict(mesh.shape),
+            "keys": N_KEYS,
+            "verdicts": ["invalid" if v is False else "valid"
+                         for v in got],
+        }), flush=True)
+
+
+def main() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
+    procs = []
+    for pid in range(N_PROCS):
+        env = dict(os.environ)
+        env.pop("XLA_FLAGS", None)
+        env.pop("JAX_PLATFORMS", None)
+        procs.append(subprocess.Popen(
+            [sys.executable, os.path.abspath(__file__),
+             "--proc-id", str(pid), "--port", str(port)],
+            env=env,
+            stdout=None if pid == 0 else subprocess.DEVNULL))
+    rc = 0
+    for pid, p in enumerate(procs):
+        try:
+            p.wait(timeout=600)
+        except subprocess.TimeoutExpired:
+            p.kill()
+            print(f"dcn_dryrun: rank {pid} timed out", file=sys.stderr)
+            rc = 1
+            continue
+        if p.returncode != 0:
+            print(f"dcn_dryrun: rank {pid} rc={p.returncode}",
+                  file=sys.stderr)
+            rc = 1
+    return rc
+
+
+if __name__ == "__main__":
+    if "--proc-id" in sys.argv:
+        pid = int(sys.argv[sys.argv.index("--proc-id") + 1])
+        port = int(sys.argv[sys.argv.index("--port") + 1])
+        child(pid, port)
+    else:
+        sys.exit(main())

@@ -179,6 +179,15 @@ def main() -> None:
         bench_one(f"compact_indices S={S}",
                   lambda m: lin._compact_indices(m, S // 4), mask,
                   repeat=rep)
+
+        def dom_fn(c, m):
+            pwh, popc = lin._pw_parts(c, dims)
+            kept, sc, perm = lin._sort_dominance(pwh, popc, m, c, S,
+                                                 dims)
+            return kept.sum(), sc.sum()
+
+        bench_one(f"sort_dominance S={S}", dom_fn, cfgs, mask,
+                  repeat=rep)
         bench_one(f"neighbor-dedup S={S}",
                   lambda c: (jnp.all(c[1:] == c[:-1], axis=1)).sum(),
                   cfgs, repeat=rep)

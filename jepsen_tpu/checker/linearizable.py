@@ -49,11 +49,12 @@ shrunken live frontier truncates back down.  Only at MAX_FRONTIER does
 an overflow degrade the verdict, and then always to "unknown", never to
 a wrong answer; exhausted budgets and deadlines also report "unknown".
 Histories whose window or crash count exceed the device encoding fall
-back to the exact host oracle (checker/seq.py); Linearizable.check
-additionally re-runs short failing prefixes (≤ witness_threshold ops)
-on the host oracle to reconstruct a human-readable witness, and
-`check_competition` races that oracle against the device search
-outright (the knossos `competition` analog).
+back to the exact `linear` host sweep (checker/linear.py);
+Linearizable.check additionally re-runs short failing prefixes
+(≤ witness_threshold ops) on the WGL host oracle (checker/seq.py) to
+reconstruct a human-readable witness, and `check_competition` races
+both host engines against the device search outright (the knossos
+`competition` analog).
 
 Batching: `search_batch` vmaps the whole search over a leading key axis —
 the TPU analog of the reference's independent-key sharding
@@ -442,11 +443,12 @@ def _sort_dominance(pwh, popc, valid, cfgs, M: int, dims: SearchDims,
     was itself dropped is fine: ⊆ is transitive, so a kept row
     dominates transitively.
 
-    Sort keys are (pw-hash, crash-popcount, full-hash, iota): identical
-    rows tie on all three hashes and so sort ADJACENT (the o=1 window
-    is exact dedup, modulo a 2^-32 full-hash collision that merely
-    keeps a duplicate), and any dominator of a row sorts earlier (equal
-    pw-hash, smaller-or-equal popcount).  Two reaches of the prune:
+    Sort keys are (pw-hash, [crash-popcount | full-hash bits], iota):
+    identical rows tie on 57 hash bits and so sort ADJACENT (the o=1
+    window is exact dedup, modulo a ~2^-57 collision that merely keeps
+    a duplicate), and any dominator of a row sorts earlier (equal
+    pw-hash, smaller-or-equal popcount in the second key's top bits).
+    Two reaches of the prune:
 
       * a backward window of R rows (nearby dominators, exact dups);
       * the row's RUN FIRST (run = maximal span of equal (p, win,
@@ -460,10 +462,15 @@ def _sort_dominance(pwh, popc, valid, cfgs, M: int, dims: SearchDims,
     big = np.uint32(0xFFFFFFFF)
     h2 = _hash_words(cfgs.astype(jnp.uint32), 0x7FEB352D)
     k1 = jnp.where(valid, pwh, big)
-    k2 = jnp.where(valid, popc, big)
-    k3 = jnp.where(valid, h2, big)
-    _s1, _s2, _s3, perm = lax.sort(
-        (k1, k2, k3, jnp.arange(M, dtype=jnp.int32)), num_keys=3)
+    # one packed secondary key: popcount (<= 64, 7 bits) above 25 bits
+    # of the full-config hash — popcount-ascending within a pw bucket
+    # (dominators first), identical rows adjacent on 32+25 hash bits.
+    # A valid row's key2 top bits are < 127 << 25 so the all-ones
+    # invalid marker still sorts strictly last.
+    k2 = jnp.where(valid, (popc << np.uint32(25)) | (h2 >> np.uint32(7)),
+                   big)
+    _s1, _s2, perm = lax.sort(
+        (k1, k2, jnp.arange(M, dtype=jnp.int32)), num_keys=2)
     svalid = jnp.take(valid, perm)
     scfgs = jnp.take(cfgs, perm, axis=0)
     a = 1 + dims.win_words
@@ -1038,10 +1045,10 @@ def search_opseq_sharded(seq: OpSeq, model: ModelSpec, mesh, *,
         return {"valid": True, "configs": es.n_det, "max_depth": es.n_det,
                 "engine": "greedy-witness"}
     if es.window > MAX_WINDOW or es.n_crash > MAX_CRASH:
-        from . import seq as seqmod
+        from .linear import check_opseq_linear
 
-        out = seqmod.check_opseq(seq, model)
-        out["engine"] = "host-oracle(fallback)"
+        out = check_opseq_linear(seq, model)
+        out["engine"] = "host-linear(fallback)"
         return out
 
     dims = choose_dims(es, model, frontier=frontier_per_device)
@@ -1418,10 +1425,14 @@ def search_opseq(seq: OpSeq, model: ModelSpec, *,
         return {"valid": True, "configs": es.n_det, "max_depth": es.n_det,
                 "engine": "greedy-witness"}
     if es.window > MAX_WINDOW or es.n_crash > MAX_CRASH:
-        from . import seq as seqmod
-        out = seqmod.check_opseq(seq, model, deadline=deadline,
+        # past the device encoding limits: the linear host sweep has no
+        # window/crash caps and dominates the WGL DFS on exactly the
+        # crash-heavy histories that land here
+        from .linear import check_opseq_linear
+
+        out = check_opseq_linear(seq, model, deadline=deadline,
                                  cancel=stop)
-        out["engine"] = "host-oracle(fallback)"
+        out["engine"] = "host-linear(fallback)"
         return out
 
     dims = dims or choose_dims(es, model)
@@ -1815,12 +1826,13 @@ def search_batch(seqs: list[OpSeq], model: ModelSpec, *,
             if e.window > MAX_WINDOW or e.n_crash > MAX_CRASH]
     if hard:
         # outliers fall back to individual host checks
-        from . import seq as seqmod
+        from .linear import check_opseq_linear
+
         out = []
         for i, s in enumerate(seqs):
             if i in hard:
-                r = seqmod.check_opseq(s, model)
-                r["engine"] = "host-oracle(fallback)"
+                r = check_opseq_linear(s, model)
+                r["engine"] = "host-linear(fallback)"
                 out.append(r)
             else:
                 out.append(search_opseq(s, model, budget=budget))
@@ -2021,10 +2033,11 @@ class Linearizable:
         else:
             out = search_opseq(seq, model, budget=self.budget)
         if out["valid"] is False:
-            if "host-oracle" in out.get("engine", ""):
-                # the exact engine already produced this verdict (and
-                # its final-paths witness data); re-confirming would
-                # repeat the same exponential search
+            eng = out.get("engine", "")
+            if "host-oracle" in eng or "host-linear" in eng:
+                # an exact host engine already produced this verdict
+                # (and its final_ops/final_paths report data);
+                # re-confirming would repeat the same search
                 self._render_failure(test, seq, out, opts)
                 return out
             # exact confirmation + witness for the report, on the

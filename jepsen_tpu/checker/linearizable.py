@@ -282,74 +282,6 @@ def _unpack_bits(words, n_words):
     return bits.reshape(shape + (n_words * 32,)).astype(bool)
 
 
-#: at or above this row count the packed single-key sort's hash bits get
-#: too thin (at S = 2^16 only 15 bits survive — ~2 rows/bucket already),
-#: so dedup falls back to the exact variadic (key, iota) sort; the
-#: exclusive bound keeps the packed path at >= 16 hash bits always
-_PACKED_SORT_MAX = 1 << 16
-
-
-def _sort_dedup(h1, valid, cfgs, S: int):
-    """Sort rows so identical configs become adjacent, then drop exact
-    duplicates.  Returns (svalid, scfgs) in sorted order.
-
-    Two strategies, chosen by static size:
-
-    * S < _PACKED_SORT_MAX: ONE uint32 key packs the hash's high bits
-      with the lane index — single-operand sorts are several times
-      faster than variadic ones on both backends.  Identical configs
-      share hash high bits and so sort into one bucket; a foreign config
-      in between (bucket collision) just means the duplicate survives —
-      never a wrong drop, because dropping still requires full-word
-      equality with the neighbor.
-    * larger S: exact (hash, iota) variadic sort.
-
-    Invalid lanes sort after every valid lane in their bucket (packed:
-    all-ones key; variadic: all-ones hash + the predecessor-validity
-    guard below).  A duplicate is dropped only when its predecessor is a
-    VALID row: invalid lanes hold clamped-gather REPLICAS of real rows,
-    and without the guard a tie-broken sort could place a replica before
-    the one real copy and drop it — losing a reachable configuration.
-    """
-    if S < _PACKED_SORT_MAX:
-        iota = jnp.arange(S, dtype=jnp.uint32)
-        low = int(S).bit_length()  # iota <= S-1 < 2^low - 1 strictly
-        high_mask = np.uint32((~((1 << low) - 1)) & 0xFFFFFFFF)
-        packed = jnp.where(valid, (h1 & high_mask) | iota,
-                           np.uint32(0xFFFFFFFF))
-        sp = lax.sort(packed)
-        perm = (sp & np.uint32((1 << low) - 1)).astype(jnp.int32)
-        perm = jnp.minimum(perm, S - 1)  # all-ones rows: clamp
-        key = sp >> low
-        # an all-ones packed key IS the invalid marker (a valid row's
-        # iota is strictly below 2^low - 1, so a valid row can never
-        # produce all-ones — and conversely any non-all-ones key came
-        # from a valid lane); without this mask the clamped perm would
-        # resurrect row S-1
-        svalid = sp != np.uint32(0xFFFFFFFF)
-        scfgs = jnp.take(cfgs, perm, axis=0)
-        return _neighbor_dedup(key, svalid, scfgs)
-    else:
-        big = np.uint32(0xFFFFFFFF)
-        h1s = jnp.where(valid, h1, big)
-        key, perm = lax.sort(
-            (h1s, jnp.arange(S, dtype=jnp.int32)), num_keys=1)
-        svalid = jnp.take(valid, perm)
-        scfgs = jnp.take(cfgs, perm, axis=0)
-        return _neighbor_dedup(key, svalid, scfgs)
-
-
-def _neighbor_dedup(key, svalid, scfgs):
-    """Drop rows byte-identical to a VALID predecessor with an equal
-    sort key (see _sort_dedup for why predecessor validity matters)."""
-    same_key = key[1:] == key[:-1]
-    same_cfg = jnp.all(scfgs[1:] == scfgs[:-1], axis=1)
-    prev_valid = svalid[:-1]
-    dup = jnp.concatenate(
-        [jnp.zeros(1, bool), same_key & same_cfg & prev_valid])
-    return svalid & ~dup, scfgs
-
-
 def _kth_bit_in_word(w, r):
     """Index of the (r+1)-th set bit of uint32 ``w`` (branchless binary
     search over chunk popcounts); garbage when w has <= r set bits —
@@ -495,6 +427,29 @@ def _sort_dominance(pwh, popc, valid, cfgs, M: int, dims: SearchDims,
     return svalid & ~drop, scfgs, perm
 
 
+def _level_mask(pieces, op_args, frontier, alive):
+    """Run the mask phase (enabled candidates + model steps + goal test)
+    over a frontier, with the per-level shared table slice."""
+    base, sargs = _slice_tables(op_args, frontier, alive,
+                                w2p=pieces["w2p"])
+    return pieces["expand_mask"](frontier, alive, base, *sargs)
+
+
+def _succ_block(pieces, frontier, validf, cand2, ns2, cap: int, K: int):
+    """Compact the [F*K] valid lane mask to ``cap`` survivors and build
+    their packed successor words."""
+    F = frontier.shape[0]
+    vsrc, n_valid = _compact_indices(validf, cap)
+    row = vsrc // K
+    src_cfg = jnp.take(frontier, row, axis=0)
+    src_lane = jnp.take(cand2.reshape(F * K), vsrc)
+    sw = ns2.shape[-1]
+    src_state = jnp.take(ns2.reshape(F * K, sw), vsrc, axis=0)
+    cvalid = jnp.arange(cap) < n_valid
+    ccfgs, _p2s = pieces["succ"](src_cfg, src_lane, src_state)
+    return ccfgs, cvalid, n_valid
+
+
 def build_search_step_fn(model: ModelSpec, dims: SearchDims):
     """Compile one *slice* of the frontier search for a (model, dims) pair.
 
@@ -553,22 +508,11 @@ def build_search_step_fn(model: ModelSpec, dims: SearchDims):
                    n_crash)
 
         def mask_phase(frontier, alive):
-            base, sargs = _slice_tables(op_args, frontier, alive,
-                                        w2p=pieces["w2p"])
-            return pieces["expand_mask"](frontier, alive, base, *sargs)
+            return _level_mask(pieces, op_args, frontier, alive)
 
         def succ_block(frontier, validf, cand2, ns2, cap: int):
-            """Compact the [F*K] valid mask to ``cap`` survivors and
-            build their successor words."""
-            vsrc, n_valid = _compact_indices(validf, cap)
-            row = vsrc // K
-            src_cfg = jnp.take(frontier, row, axis=0)
-            src_lane = jnp.take(cand2.reshape(F * K), vsrc)
-            sw = ns2.shape[-1]
-            src_state = jnp.take(ns2.reshape(F * K, sw), vsrc, axis=0)
-            cvalid = jnp.arange(cap) < n_valid
-            ccfgs, _p2s = pieces["succ"](src_cfg, src_lane, src_state)
-            return ccfgs, cvalid, n_valid
+            return _succ_block(pieces, frontier, validf, cand2, ns2,
+                               cap, K)
 
         def cond(c):
             _, count, status, configs, _, ovf, lvl = c
@@ -687,12 +631,16 @@ def build_sharded_search_step_fn(model: ModelSpec, dims: SearchDims,
                                  mesh, axis: str = "shard"):
     """One *slice* of a search whose frontier is sharded over a mesh.
 
-    Each device owns the hash partition ``h1 % D`` of the configuration
-    space.  Per BFS level: devices expand their local frontier slice,
-    route successors to their home shard with `lax.all_to_all`
-    (identical configs hash alike, so global dedup reduces to local
-    dedup after the exchange), then dedup and compact locally.
-    Termination and the goal test are `psum` reductions.  This is the
+    Each device owns the hash partition ``pw_hash % D`` of the
+    configuration space — the hash EXCLUDES the crash words, so every
+    crash variant of one (p, window, state) configuration lands on the
+    same shard and the local dominance prune (`_sort_dominance`) is
+    globally complete, exactly as on a single device.  Per det level:
+    devices expand their local slice, close it under crashed-op
+    linearization (the closure loop routes crash successors to their
+    home shard each round), then route determinate successors home and
+    dominance-prune into the next level.  Termination, the goal test,
+    closure progress, and overflow are `psum` reductions.  This is the
     scale-out path for histories whose levels outgrow one chip's
     frontier — the reference's analog is simply "buy a bigger JVM heap"
     (-Xmx32g, jepsen/project.clj:25).
@@ -701,9 +649,11 @@ def build_sharded_search_step_fn(model: ModelSpec, dims: SearchDims,
     and each call runs at most ``lvl_cap`` levels, so device executions
     stay bounded.  The per-device frontier slice travels as a global
     ``[D*F, WORDS]`` array sharded on its leading axis; loop-control
-    scalars (status, configs, total, any_ovf) are replicated (psum'd in
-    the body, never in the cond — collectives inside a while cond can
-    diverge between devices and deadlock/corrupt the all_to_alls).
+    scalars (status, configs, total, any_ovf, closure progress) are
+    replicated (psum'd in the body, never in a cond — collectives
+    inside a while cond can diverge between devices and deadlock or
+    corrupt the all_to_alls; every shard must run the same number of
+    closure rounds).
 
     dims.frontier is the PER-DEVICE frontier width.
     """
@@ -713,12 +663,55 @@ def build_sharded_search_step_fn(model: ModelSpec, dims: SearchDims,
     K = dims.k
     F = dims.frontier
     S = 4 * F
+    W = dims.window
     WORDS = dims.words
     D = mesh.shape[axis]
-    # per-destination-device routing capacity per level
-    C_CAP = max(64, _round_up(S // D, 32))
+    # per-destination routing capacities (det successors / crash
+    # successors per closure round)
+    C_DET = max(64, _round_up(S // D, 32))
+    C_CR = max(64, _round_up(2 * F // D, 32))
 
-    inner = _make_kernel_pieces(model, dims)
+    pieces = _make_kernel_pieces(model, dims)
+
+    def route(cfgs, valid, cap: int):
+        """all_to_all home-routing by pw-hash.  Returns the received
+        rows + validity + a did-any-bucket-overflow flag."""
+        pwh, _popc = _pw_parts(cfgs, dims)
+        owner = (pwh % np.uint32(D)).astype(jnp.int32)
+
+        def bucket(d):
+            mask = valid & (owner == d)
+            idx, cnt = _compact_indices(mask, cap)
+            return jnp.take(cfgs, idx, axis=0), cnt
+
+        send_cfgs, send_cnt = jax.vmap(bucket)(
+            jnp.arange(D, dtype=jnp.int32))  # [D, cap, WORDS], [D]
+        r_ovf = jnp.any(send_cnt > cap)
+        send_cnt = jnp.minimum(send_cnt, cap)
+        recv_cfgs = lax.all_to_all(send_cfgs, axis, 0, 0, tiled=False)
+        recv_cnt = lax.all_to_all(send_cnt, axis, 0, 0, tiled=False)
+        rcfgs = recv_cfgs.reshape(D * cap, WORDS)
+        lane = jnp.arange(D * cap) % cap
+        rvalid = lane < jnp.repeat(recv_cnt, cap)
+        return rcfgs, rvalid, r_ovf
+
+    def merge_dominance(local_cfgs, local_valid, in_cfgs, in_valid):
+        """Dominance-prune the union of resident + received rows into a
+        fresh F-row frontier.  Locality = globality: both inputs are
+        pw-home on this shard.  (Exception: the root config starts on
+        device 0 whatever its hash — at level 0 it has no siblings, so
+        a missed prune there only wastes a row, never drops one.)"""
+        merged = jnp.concatenate([local_cfgs, in_cfgs], axis=0)
+        mvalid = jnp.concatenate([local_valid, in_valid])
+        m = merged.shape[0]
+        pwh, popc = _pw_parts(merged, dims)
+        kept, scfgs, perm = _sort_dominance(pwh, popc, mvalid, merged,
+                                            m, dims)
+        src, new_count = _compact_indices(kept, F)
+        new_frontier = jnp.take(scfgs, src, axis=0)
+        m_ovf = new_count > F
+        progress = jnp.any(kept & (perm >= local_cfgs.shape[0]))
+        return new_frontier, jnp.minimum(new_count, F), m_ovf, progress
 
     def step_device(det_f, det_v1, det_v2, det_inv, det_ret, sfx_min,
                     crash_f, crash_v1, crash_v2, crash_inv, n_det,
@@ -743,41 +736,64 @@ def build_sharded_search_step_fn(model: ModelSpec, dims: SearchDims,
             frontier, count, status, configs, max_depth, ovf, _total, \
                 lvl = c
             alive = jnp.arange(F) < count
-            cfgs, cvalid, found_here, n_valid = _expand_survivors(
-                inner, frontier, alive, op_args, K=K, S=S)
+            valid2, cand2, ns2, goal2 = _level_mask(pieces, op_args,
+                                                    frontier, alive)
+            found_loc = jnp.any(goal2)
+            crash_any = lax.psum(
+                jnp.any(valid2 & (cand2 >= W)).astype(jnp.int32),
+                axis) > 0
+
+            # --- crash closure (within-level; replicated control) ------
+            def cl_cond(cc):
+                it, progress = cc[8], cc[9]
+                first = it == 0
+                return ((first & crash_any)
+                        | (~first & progress & (it < n_crash + 1)))
+
+            def cl_body(cc):
+                (frontier, count, valid2, cand2, ns2, _goal2, ovf,
+                 found_loc, it, _pr) = cc
+                alive = jnp.arange(F) < count
+                cvalidf = (valid2 & (cand2 >= W)).reshape(F * K)
+                ccfgs, cvalid, n_valid = _succ_block(
+                    pieces, frontier, cvalidf, cand2, ns2, F, K)
+                ovf = ovf | (n_valid > F)
+                rcfgs, rvalid, r_ovf = route(ccfgs, cvalid, C_CR)
+                ovf = ovf | r_ovf
+                new_frontier, new_count, m_ovf, progress_loc = \
+                    merge_dominance(frontier, alive, rcfgs, rvalid)
+                ovf = ovf | m_ovf
+                progress = lax.psum(progress_loc.astype(jnp.int32),
+                                    axis) > 0
+                alive2 = jnp.arange(F) < new_count
+                v2, c2, n2, g2 = _level_mask(pieces, op_args,
+                                             new_frontier, alive2)
+                found_loc = found_loc | jnp.any(g2)
+                return (new_frontier, new_count, v2, c2, n2, g2, ovf,
+                        found_loc, it + 1, progress)
+
+            cc0 = (frontier, count, valid2, cand2, ns2, goal2, ovf,
+                   found_loc, jnp.int32(0), jnp.bool_(False))
+            (frontier, count, valid2, cand2, ns2, goal2, ovf, found_loc,
+             _it, pr_exit) = lax.while_loop(cl_cond, cl_body, cc0)
+            # cap-exit while still adding rows: level not proven closed
+            # — degrade like an overflow, never decide invalid
+            ovf = ovf | pr_exit
+            alive = jnp.arange(F) < count
+
+            # --- determinate successors to the next level --------------
+            dvalidf = (valid2 & (cand2 < W)).reshape(F * K)
+            dcfgs, dvalid, n_valid = _succ_block(
+                pieces, frontier, dvalidf, cand2, ns2, S, K)
             ovf = ovf | (n_valid > S)
-            found = lax.psum(found_here.astype(jnp.int32), axis) > 0
+            rcfgs, rvalid, r_ovf = route(dcfgs, dvalid, C_DET)
+            ovf = ovf | r_ovf
+            empty = jnp.zeros((0, WORDS), jnp.int32)
+            new_frontier, new_count, m_ovf, _pr = merge_dominance(
+                empty, jnp.zeros((0,), bool), rcfgs, rvalid)
+            ovf = ovf | m_ovf
 
-            # --- route survivors to their home shard -----------------------
-            wu = cfgs.astype(jnp.uint32)
-            h1 = _hash_words(wu, 0x9E3779B1)
-            owner = (h1 % np.uint32(D)).astype(jnp.int32)
-
-            def bucket(d):
-                mask = cvalid & (owner == d)
-                idx, cnt = _compact_indices(mask, C_CAP)
-                return jnp.take(cfgs, idx, axis=0), cnt
-
-            send_cfgs, send_cnt = jax.vmap(bucket)(
-                jnp.arange(D, dtype=jnp.int32))  # [D, C_CAP, WORDS], [D]
-            ovf = ovf | jnp.any(send_cnt > C_CAP)
-            send_cnt = jnp.minimum(send_cnt, C_CAP)
-            recv_cfgs = lax.all_to_all(send_cfgs, axis, 0, 0, tiled=False)
-            recv_cnt = lax.all_to_all(send_cnt, axis, 0, 0, tiled=False)
-
-            rcfgs = recv_cfgs.reshape(D * C_CAP, WORDS)
-            lane = jnp.arange(D * C_CAP) % C_CAP
-            rvalid = lane < jnp.repeat(recv_cnt, C_CAP)
-
-            # --- local dedup (global, since owners partition by hash) -----
-            rh1 = _hash_words(rcfgs.astype(jnp.uint32), 0x9E3779B1)
-            svalid, scfgs = _sort_dedup(rh1, rvalid, rcfgs, D * C_CAP)
-
-            src, new_count = _compact_indices(svalid, F)
-            new_frontier = jnp.take(scfgs, src, axis=0)
-            ovf = ovf | (new_count > F)
-            new_count = jnp.minimum(new_count, F)
-
+            found = lax.psum(found_loc.astype(jnp.int32), axis) > 0
             configs = configs + lax.psum(count, axis)
             max_depth = jnp.maximum(max_depth, lax.pmax(jnp.max(
                 jnp.where(alive, frontier[:, 0], 0)), axis))
@@ -1001,32 +1017,6 @@ def _slice_tables(op_args, frontier, alive, *, w2p: int):
     return base, (sl(det_f), sl(det_v1), sl(det_v2), sl(det_inv),
                   sl(det_ret), sfx, crash_f, crash_v1, crash_v2,
                   crash_inv, n_det, n_crash)
-
-
-def _expand_survivors(pieces, frontier, alive, op_args, *, K: int,
-                      S: int):
-    """expand_mask -> compact to S survivors -> build successor words.
-
-    Returns (ccfgs [S, WORDS], cvalid [S], goal_found, n_valid).  The
-    goal test runs in the mask phase over ALL F*K lanes (no successor
-    words needed — see expand_mask_one), so a goal past the S survivor
-    cap is still found."""
-    F = frontier.shape[0]
-    base, sargs = _slice_tables(op_args, frontier, alive,
-                                w2p=pieces["w2p"])
-    valid2, cand2, nstate2, goal2 = pieces["expand_mask"](
-        frontier, alive, base, *sargs)
-    found = jnp.any(goal2)
-    validf = valid2.reshape(F * K)
-    vsrc, n_valid = _compact_indices(validf, S)
-    row = vsrc // K
-    src_cfg = jnp.take(frontier, row, axis=0)           # [S, WORDS]
-    src_lane = jnp.take(cand2.reshape(F * K), vsrc)     # [S]
-    sw = nstate2.shape[-1]
-    src_state = jnp.take(nstate2.reshape(F * K, sw), vsrc, axis=0)
-    cvalid = jnp.arange(S) < n_valid
-    ccfgs, _p2s = pieces["succ"](src_cfg, src_lane, src_state)
-    return ccfgs, cvalid, found, n_valid
 
 
 _SHARDED_CACHE: dict = {}

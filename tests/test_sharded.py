@@ -1,6 +1,9 @@
-"""Mesh-sharded frontier search: verdicts must match the host oracle, and
-exploration must be exact (no configs lost in the all_to_all routing) and
-deterministic.  Runs on the virtual 8-device CPU mesh (conftest)."""
+"""Mesh-sharded frontier search: verdicts must match the host oracle;
+exploration must be deterministic and (with dominance pruning) explore
+at most the oracle's configuration space.  Exactness of the all_to_all
+routing is guarded indirectly: a lost config flips an invalid-history
+verdict, and the differential cases here include invalid histories.
+Runs on the virtual 8-device CPU mesh (conftest)."""
 
 import random
 
@@ -52,9 +55,13 @@ def test_sharded_exact_and_deterministic(mesh):
                                        frontier_per_device=256)
         assert out["valid"] == ref["valid"]
         counts.add(out["configs"])
-    # both engines dedup over the identical configuration space
-    assert counts == {ref["configs"]}, \
-        f"sharded explored {counts}, oracle {ref['configs']}"
+    # deterministic across runs; dominance pruning means the sharded
+    # engine explores AT MOST the oracle's configuration space (the
+    # crash-subset dimension collapses to minimal antichains)
+    assert len(counts) == 1, f"nondeterministic: {counts}"
+    c = counts.pop()
+    assert c <= ref["configs"], \
+        f"sharded explored {c}, oracle {ref['configs']}"
 
 
 def test_sharded_escalates_on_overflow(mesh):

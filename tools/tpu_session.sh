@@ -1,0 +1,53 @@
+#!/bin/bash
+# One-shot TPU evidence collection — run the moment the axon tunnel is up.
+#
+#   tools/tpu_session.sh [outdir]
+#
+# Produces, in outdir (default /tmp/tpu_session):
+#   probe.json        backend + device name
+#   tpubench.jsonl    per-op microbenchmarks at the widths that matter
+#   bench.json        the full bench (unpinned: tiers run on the TPU)
+# and prints a summary.  Each step has its own timeout so a mid-session
+# tunnel drop costs one artifact, not the session.
+set -uo pipefail
+cd "$(dirname "$0")/.."
+
+OUT=${1:-/tmp/tpu_session}
+mkdir -p "$OUT"
+
+echo "== probe"
+timeout 600 python - <<'PY' | tee "$OUT/probe.json"
+import json
+import jax
+d = jax.devices()[0]
+import jax.numpy as jnp
+x = jnp.ones((256, 256)); (x @ x).block_until_ready()
+print(json.dumps({"platform": d.platform, "device": str(d),
+                  "n_devices": len(jax.devices())}))
+PY
+rc=$?
+if [ $rc -ne 0 ]; then
+  echo "probe failed rc=$rc — tunnel down?"; exit 1
+fi
+
+echo "== tpubench (microbenchmarks)"
+timeout 900 python tools/tpubench.py --widths 1024,4096,16384 \
+  --levels 64 --repeat 5 2>"$OUT/tpubench.err" | tee "$OUT/tpubench.jsonl"
+
+echo "== full bench (unpinned)"
+BENCH_BUDGET_S=1100 timeout 1200 python bench.py \
+  2>"$OUT/bench.err" | tail -1 | tee "$OUT/bench.json"
+
+echo "== summary"
+python - "$OUT" <<'PY'
+import json, sys, os
+out = sys.argv[1]
+try:
+    b = json.load(open(os.path.join(out, "bench.json")))
+    print("metric:", b.get("metric"))
+    print("value:", b.get("value"), b.get("unit"),
+          "vs_baseline:", b.get("vs_baseline"))
+    print("backend:", (b.get("detail") or {}).get("backend"))
+except Exception as e:
+    print("no bench.json:", e)
+PY

@@ -135,41 +135,30 @@ def main() -> None:
         bench_one(f"expand_mask F={F}", mask_fn, frontier, alive,
                   repeat=rep)
 
-        def survivors_fn(fr, al):
-            c, v, g, n = lin._expand_survivors(
-                pieces, fr, al, kargs, K=K, S=S)
-            return c.sum(), v.sum()
+        def succ_fn(fr, al):
+            base, sargs = lin._slice_tables(kargs, fr, al,
+                                            w2p=pieces["w2p"])
+            v, c, ns, g = pieces["expand_mask"](fr, al, base, *sargs)
+            cc, cv, n = lin._succ_block(pieces, fr,
+                                        v.reshape(F * K), c, ns, S, K)
+            return cc.sum(), cv.sum()
 
-        bench_one(f"expand+succ(S) F={F}", survivors_fn, frontier,
+        bench_one(f"expand+succ(S) F={F}", succ_fn, frontier,
                   alive, repeat=rep)
         bench_one(f"hash S={S}",
                   lambda c: lin._hash_words(c.astype(jnp.uint32),
                                             0x9E3779B1).sum(),
                   cfgs, repeat=rep)
+        # the production dominance sort is 3-operand / 2-key
+        # (_sort_dominance); these two isolate the raw lax.sort cost at
+        # the same row count for single- vs multi-operand forms
         bench_one(
             f"sort-variadic S={S}",
             lambda k: lax.sort((k, jnp.arange(S, dtype=jnp.int32)),
                                num_keys=1),
             keys32, repeat=rep)
-        # mirror the production strategy choice and bit split exactly
-        # (_sort_dedup: packed only when S < _PACKED_SORT_MAX, low =
-        # S.bit_length())
-        if S < lin._PACKED_SORT_MAX:
-            low = int(S).bit_length()
-
-            def packed_sort(k):
-                p = (k & np.uint32(~((1 << low) - 1) & 0xFFFFFFFF)) \
-                    | jnp.arange(S, dtype=jnp.uint32)
-                return lax.sort(p)
-
-            bench_one(f"sort-packed32 S={S}", packed_sort, keys32,
-                      repeat=rep)
-        else:
-            print(json.dumps({
-                "op": f"sort-packed32 S={S}",
-                "skipped": f"S >= _PACKED_SORT_MAX="
-                           f"{lin._PACKED_SORT_MAX}; kernel uses the "
-                           "variadic sort here"}), flush=True)
+        bench_one(f"sort-packed32 S={S}", lambda k: lax.sort(k),
+                  keys32, repeat=rep)
         bench_one(f"gather-rows [S,{WORDS}] S={S}",
                   lambda c, i: jnp.take(c, i, axis=0).sum(), cfgs, idx,
                   repeat=rep)

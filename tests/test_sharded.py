@@ -1,9 +1,10 @@
 """Mesh-sharded frontier search: verdicts must match the host oracle;
 exploration must be deterministic and (with dominance pruning) explore
 at most the oracle's configuration space.  Exactness of the all_to_all
-routing is guarded indirectly: a lost config flips an invalid-history
-verdict, and the differential cases here include invalid histories.
-Runs on the virtual 8-device CPU mesh (conftest)."""
+routing is guarded indirectly: a config lost in routing flips a VALID
+history's verdict (the witness path dies out), and the differential
+cases here include uncorrupted, valid histories for exactly that
+reason.  Runs on the virtual 8-device CPU mesh (conftest)."""
 
 import random
 

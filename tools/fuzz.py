@@ -95,20 +95,23 @@ DEVICE_BUDGET = 120_000
 
 
 def verdicts(h: list[Op], model) -> tuple:
+    """Three-way: (WGL oracle, device BFS, linear host sweep)."""
+    from jepsen_tpu.checker.linear import check_opseq_linear
+
     try:
         s = encode_ops(h, model.f_codes)
     except Exception as e:
-        return ("encode-error", str(e)), ("encode-error", str(e))
+        err = ("encode-error", str(e))
+        return err, err, err
     a = oracle.check_opseq(s, model, max_configs=ORACLE_CAP)
     b = lin.search_opseq(s, model, budget=DEVICE_BUDGET)
-    return a["valid"], b["valid"]
+    c = check_opseq_linear(s, model, max_configs=ORACLE_CAP)
+    return a["valid"], b["valid"], c["valid"]
 
 
 def diverges(h: list[Op], model) -> bool:
-    a, b = verdicts(h, model)
-    if a == "unknown" or b == "unknown":
-        return False  # a capped-out engine is not a divergence
-    return a != b
+    vs = [v for v in verdicts(h, model) if v != "unknown"]
+    return len(set(vs)) > 1  # capped-out engines are not divergences
 
 
 def shrink(h: list[Op], model, *, max_passes: int = 8) -> list[Op]:
@@ -150,9 +153,11 @@ def shrink(h: list[Op], model, *, max_passes: int = 8) -> list[Op]:
 def replay(path: str, model_name: str) -> int:
     model = MODELS[model_name]()
     ops = [Op.from_dict(d) for d in json.load(open(path))]
-    a, b = verdicts(ops, model)
-    print(f"oracle={a} device={b} ({'DIVERGES' if a != b else 'agree'})")
-    return 1 if a != b else 0
+    a, b, c = verdicts(ops, model)
+    div = len({v for v in (a, b, c) if v != "unknown"}) > 1
+    print(f"oracle={a} device={b} linear={c} "
+          f"({'DIVERGES' if div else 'agree'})")
+    return 1 if div else 0
 
 
 def main() -> int:
@@ -180,16 +185,16 @@ def main() -> int:
         if rng.random() < 0.7:
             h = corrupt(rng, h)
         if diverges(h, model):
-            a, b = verdicts(h, model)
+            a, b, c = verdicts(h, model)
             print(f"DIVERGENCE at round {i} (seed {args.seed + i}): "
-                  f"oracle={a} device={b}; shrinking...",
+                  f"oracle={a} device={b} linear={c}; shrinking...",
                   file=sys.stderr)
             small = shrink(h, model)
-            a2, b2 = verdicts(small, model)
+            a2, b2, c2 = verdicts(small, model)
             json.dump([op.to_dict() for op in small], open(args.out, "w"),
                       indent=1)
             print(f"minimal repro: {len(small)} ops (from {len(h)}) -> "
-                  f"{args.out}; oracle={a2} device={b2}")
+                  f"{args.out}; oracle={a2} device={b2} linear={c2}")
             for op in small:
                 print(" ", op.to_dict())
             return 1

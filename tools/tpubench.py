@@ -136,9 +136,7 @@ def main() -> None:
                   repeat=rep)
 
         def succ_fn(fr, al):
-            base, sargs = lin._slice_tables(kargs, fr, al,
-                                            w2p=pieces["w2p"])
-            v, c, ns, g = pieces["expand_mask"](fr, al, base, *sargs)
+            v, c, ns, g = lin._level_mask(pieces, kargs, fr, al)
             cc, cv, n = lin._succ_block(pieces, fr,
                                         v.reshape(F * K), c, ns, S, K)
             return cc.sum(), cv.sum()

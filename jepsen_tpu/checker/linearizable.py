@@ -43,7 +43,7 @@ above) and equal-key neighbors are compared on their full config words
 before dropping either — hash collisions cost duplicate work, never a
 merge — so an "invalid" verdict is not subject to fingerprinting.
 Capacity is handled by the adaptive width driver (`_run_kernel`): the
-frontier width moves both ways on a power-of-four grid — a level that
+frontier width moves both ways on a power-of-two grid — a level that
 overflows bails and resumes from the last clean carry one step wider; a
 shrunken live frontier truncates back down.  Only at MAX_FRONTIER does
 an overflow degrade the verdict, and then always to "unknown", never to
@@ -1231,7 +1231,7 @@ def choose_dims(es: EncodedSearch, model: ModelSpec, *,
     if frontier is None:
         # start narrow: most BFS levels are far smaller than the history;
         # the adaptive driver widens on overflow and narrows again when
-        # the live frontier shrinks (on the power-of-four width grid)
+        # the live frontier shrinks (on the power-of-two width grid)
         frontier = _grid_width(min(4096, (es.n_det + es.n_crash) // 8))
     return SearchDims(
         n_det_pad=max(64, _next_pow2(es.n_det)),
@@ -1276,7 +1276,8 @@ def _run_kernel(esp: EncodedSearch, es: EncodedSearch, model: ModelSpec,
                 deadline: float | None = None, stop=None):
     """Drive the sliced kernel to completion with an adaptive width.
 
-    The frontier width moves both ways on the power-of-four grid:
+    The frontier width moves both ways on the power-of-two grid
+    (escalation climbs two steps at a time, the downshift settles one):
 
     * a slice that overflows the current width bails immediately (the
       kernel's ``bail`` flag) and the search resumes from the last clean
@@ -1989,11 +1990,18 @@ class Linearizable:
         self.budget = budget
         self.host_threshold = host_threshold
         self.witness_threshold = witness_threshold
+        src = "algorithm"
+        if algorithm == "auto":
+            # fleet-wide experiment knob: suites construct their own
+            # checkers, so a per-suite flag can't reach them all
+            env = os.environ.get("JEPSEN_TPU_LIN_ALGORITHM")
+            if env:
+                algorithm, src = env, "JEPSEN_TPU_LIN_ALGORITHM"
         try:
             self.algorithm = self.ALGORITHMS[algorithm]
         except KeyError:
             raise ValueError(
-                f"unknown algorithm {algorithm!r}; one of "
+                f"unknown algorithm {algorithm!r} (from {src}); one of "
                 f"{sorted(self.ALGORITHMS)}") from None
 
     def check(self, test, history, opts=None):

@@ -197,3 +197,15 @@ def test_competition_decides_invalid():
     seq = enc(h, model)
     out = check_competition(seq, model)
     assert out["valid"] is False
+
+
+def test_algorithm_env_override(monkeypatch):
+    monkeypatch.setenv("JEPSEN_TPU_LIN_ALGORITHM", "linear")
+    chk = Linearizable(cas_register())
+    assert chk.algorithm == "linear"
+    # an explicit algorithm beats the env override
+    chk2 = Linearizable(cas_register(), algorithm="wgl")
+    assert chk2.algorithm == "host"
+    monkeypatch.setenv("JEPSEN_TPU_LIN_ALGORITHM", "bogus")
+    with pytest.raises(ValueError):
+        Linearizable(cas_register())

@@ -78,16 +78,26 @@ class _Frame:
 def check_opseq_linear(seq: OpSeq, model: ModelSpec, *,
                        max_configs: int = 50_000_000,
                        deadline: float | None = None,
-                       cancel=None) -> dict:
+                       cancel=None,
+                       witness_cap: int = 0) -> dict:
     """Exact linearizability check.  Returns a knossos-style map
     {"valid": True|False|"unknown", "configs": n, "max_depth": d, ...};
     on invalid, ``final_ops`` holds the un-linearizable candidate rows at
     the deepest level reached (the :final-paths analog, truncated to 10
-    as checker.clj:136-139 truncates)."""
+    as checker.clj:136-139 truncates).  With ``witness_cap`` > 0, a
+    valid verdict carries ``linearization`` — witness row indices in
+    linearization order — as long as the parent table stayed under the
+    cap (a big sweep drops witness tracking rather than memory-bloat).
+    The default is OFF: verdict-only callers (competition legs, the
+    portfolio, fuzzers) keep the level-local memory profile; the
+    user-facing Linearizable checker opts in."""
     es = encode_search(seq)
     n_det, n_crash, W = es.n_det, es.n_crash, es.window
     if n_det == 0 and n_crash == 0:
-        return {"valid": True, "configs": 0, "max_depth": 0}
+        out = {"valid": True, "configs": 0, "max_depth": 0}
+        if witness_cap:
+            out["linearization"] = []
+        return out
 
     det_inv = [int(x) for x in es.det_inv]
     det_ret = [int(x) for x in es.det_ret]
@@ -150,10 +160,36 @@ def check_opseq_linear(seq: OpSeq, model: ModelSpec, *,
         return fr
 
     # level: {(p, win, state): [minimal cmask antichain]}
-    level: dict[tuple, list[int]] = {(0, 0, model.init): [0]}
+    root = ((0, 0, model.init), 0)
+    level: dict[tuple, list[int]] = {root[0]: [0]}
     configs = 0
     depth = 0
     t_check = 0
+    # (key, cmask) -> (op row, parent (key, cmask)); None once capped
+    parents: dict | None = {root: None} if witness_cap else None
+
+    def remember(child_key, child_cm, op_row, par_key, par_cm):
+        nonlocal parents
+        if parents is None:
+            return
+        if len(parents) >= witness_cap:
+            parents = None  # witness off; the verdict is unaffected
+            return
+        parents.setdefault((child_key, child_cm),
+                           (op_row, (par_key, par_cm)))
+
+    def walk(key, cm):
+        if parents is None:
+            return None
+        lin: list[int] = []
+        node = (key, cm)
+        while node != root:
+            # every kept config was remembered while parents was live,
+            # and the cap nulls the whole table — a live table is whole
+            op_row, node = parents[node]
+            lin.append(op_row)
+        lin.reverse()
+        return lin
 
     def over_budget() -> str | None:
         nonlocal t_check
@@ -199,13 +235,19 @@ def check_opseq_linear(seq: OpSeq, model: ModelSpec, *,
                 nk = (p, win, ns)
                 ncm = cmask | (1 << c)
                 if insert(level, nk, ncm):
+                    remember(nk, ncm, int(crash_rows[c]),
+                             (p, win, state), cmask)
                     work.append((nk, ncm))
 
         # --- goal test -------------------------------------------------
-        for (p, win, _s) in level:
+        for (p, win, _s), ac in level.items():
             if frame(p, win).goal:
-                return {"valid": True, "configs": configs,
-                        "max_depth": depth}
+                out = {"valid": True, "configs": configs,
+                       "max_depth": depth}
+                lin = walk((p, win, _s), ac[0])
+                if lin is not None:
+                    out["linearization"] = lin
+                return out
 
         # --- expand determinate candidates to the next level -----------
         nxt: dict[tuple, list[int]] = {}
@@ -219,7 +261,9 @@ def check_opseq_linear(seq: OpSeq, model: ModelSpec, *,
                 nk = (p2, win2, ns)
                 for cmask in ac:
                     configs += 1
-                    insert(nxt, nk, cmask)
+                    if insert(nxt, nk, cmask):
+                        remember(nk, cmask, int(det_rows[p + i]),
+                                 (p, win, state), cmask)
             why = over_budget()
             if why:
                 return {"valid": "unknown", "configs": configs,

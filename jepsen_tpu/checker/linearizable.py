@@ -2025,7 +2025,10 @@ class Linearizable:
         if self.algorithm == "linear":
             from .linear import check_opseq_linear
 
-            out = check_opseq_linear(seq, model)
+            # user-facing path: track the valid-verdict witness (the
+            # verdict-only callers — competition legs, portfolio,
+            # fuzzers — leave it off and keep level-local memory)
+            out = check_opseq_linear(seq, model, witness_cap=2_000_000)
             out["engine"] = "host-linear"
             if out["valid"] is False:
                 self._render_failure(test, seq, out, opts)

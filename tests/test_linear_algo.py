@@ -209,3 +209,36 @@ def test_algorithm_env_override(monkeypatch):
     monkeypatch.setenv("JEPSEN_TPU_LIN_ALGORITHM", "bogus")
     with pytest.raises(ValueError):
         Linearizable(cas_register())
+
+
+def test_valid_witness_linearization():
+    """A valid verdict carries a replayable witness: applying the ops in
+    linearization order must be model-legal and cover every ok op."""
+    model = cas_register()
+    rng = random.Random(21)
+    h = synth.register_history(rng, n_ops=80, n_procs=4, overlap=4,
+                               crash_p=0.1, max_crashes=5, n_values=3)
+    s = enc(h, model)
+    out = check_opseq_linear(s, model, witness_cap=2_000_000)
+    assert out["valid"] is True
+    lin = out.get("linearization")
+    assert lin is not None
+    # replay
+    state = model.init
+    for row in lin:
+        state = model.pystep(state, int(s.f[row]), int(s.v1[row]),
+                             int(s.v2[row]))
+        assert state is not None, f"illegal step at row {row}"
+    ok_rows = {i for i in range(len(s)) if bool(s.ok[i])}
+    assert ok_rows.issubset(set(lin)), "witness missing ok ops"
+
+
+def test_witness_cap_disables_gracefully():
+    model = cas_register()
+    rng = random.Random(22)
+    h = synth.register_history(rng, n_ops=60, n_procs=4, overlap=4,
+                               n_values=3)
+    s = enc(h, model)
+    out = check_opseq_linear(s, model, witness_cap=0)
+    assert out["valid"] is True
+    assert "linearization" not in out

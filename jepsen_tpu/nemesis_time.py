@@ -7,7 +7,10 @@ then bumps (51), strobes (56), or NTP-resets (45) clocks; clock-nemesis
 clock-fault schedules (95-128).
 
 The shipped sources are this repo's own C++ implementations
-(native/bump_time.cc, native/strobe_time.cc).
+(native/bump_time.cc, native/strobe_time.cc,
+native/strobe_time_experiment.cc — the offset-pinning strobe variant of
+the reference's resources/strobe-time-experiment.c, used via
+{:f strobe-pin} when drift under strobing must not accumulate).
 """
 
 from __future__ import annotations
@@ -48,6 +51,9 @@ def install(sess: control.Session) -> None:
     debian.install(sess, ["build-essential"])
     compile_source(sess, os.path.join(NATIVE_DIR, "strobe_time.cc"),
                    "strobe-time")
+    compile_source(sess,
+                   os.path.join(NATIVE_DIR, "strobe_time_experiment.cc"),
+                   "strobe-time-experiment")
     compile_source(sess, os.path.join(NATIVE_DIR, "bump_time.cc"),
                    "bump-time")
 
@@ -69,8 +75,24 @@ def strobe_time(sess: control.Session, delta_ms: int, period_ms: int,
                    str(duration_s))
 
 
+def strobe_time_pinned(sess: control.Session, delta_ms: int,
+                       period_ms: int, duration_s: float) -> int:
+    """Offset-pinning strobe (resources/strobe-time-experiment.c analog):
+    overwrites accumulated drift each tick and restores the original
+    wall-monotonic offset on exit.  Returns the adjustment count the
+    binary reports."""
+    out = sess.su().exec(f"{OPT_DIR}/strobe-time-experiment",
+                         str(delta_ms), str(period_ms),
+                         str(max(1, round(duration_s))))
+    try:
+        return int(str(out).strip().splitlines()[-1])
+    except (ValueError, IndexError):
+        return -1
+
+
 class ClockNemesis(Nemesis):
-    """{:f reset|bump|strobe} clock manipulation (time.clj:62-93)."""
+    """{:f reset|bump|strobe|strobe-pin} clock manipulation
+    (time.clj:62-93; strobe-pin drives the offset-pinning variant)."""
 
     def setup(self, test):
         control.on_nodes(test,
@@ -95,6 +117,20 @@ class ClockNemesis(Nemesis):
                 strobe_time(control.session(n, t), s["delta"], s["period"],
                             s["duration"])
             control.on_nodes(test, f, list(v.keys()))
+        elif op.f == "strobe-pin":
+            counts = {}
+
+            def f(t, n):
+                s = v[n]
+                counts[n] = strobe_time_pinned(
+                    control.session(n, t), s["delta"], s["period"],
+                    s["duration"])
+            control.on_nodes(test, f, list(v.keys()))
+            # the adjustment count is the experiment's observable: a 0
+            # or -1 here means the strobe did NOT run as asked
+            return replace(op, type="info",
+                           value={n: {**v[n], "adjustments": counts[n]}
+                                  for n in v})
         else:
             raise ValueError(f"clock nemesis: unknown f {op.f!r}")
         return replace(op, type="info")

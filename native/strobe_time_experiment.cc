@@ -1,0 +1,102 @@
+// strobe_time_experiment — the offset-pinning strobe variant.
+//
+// Usage: strobe_time_experiment DELTA_MS PERIOD_MS DURATION_S
+//
+// TPU-native rebuild of the capability in the reference's experimental
+// jepsen/resources/strobe-time-experiment.c (SURVEY.md §2.2): where the
+// production strobe (native/strobe_time.cc) SHIFTS the wall clock by
+// ±delta each phase, this variant PINS the wall clock to one of two
+// fixed offsets from CLOCK_MONOTONIC — "normal" (the offset observed at
+// startup) or "weird" (normal + delta) — every period.  Pinning rather
+// than shifting means drift accumulated while strobing (NTP slews,
+// other nemeses bumping the clock) is overwritten each tick, so the
+// clock is guaranteed to land back exactly on its original track when
+// the run ends.  On exit it restores the normal offset and prints the
+// number of adjustments made (the experiment's observable), so the
+// harness can assert the strobe actually ran.  Fresh implementation,
+// C++17.
+
+#include <cstdio>
+#include <cstdlib>
+#include <ctime>
+#include <sys/time.h>
+
+namespace {
+
+constexpr long long kNanosPerSec = 1000000000LL;
+
+long long monotonic_ns() {
+  struct timespec ts;
+  clock_gettime(CLOCK_MONOTONIC, &ts);
+  return ts.tv_sec * kNanosPerSec + ts.tv_nsec;
+}
+
+long long wall_ns() {
+  struct timeval tv;
+  if (gettimeofday(&tv, nullptr) != 0) {
+    std::perror("gettimeofday");
+    std::exit(1);
+  }
+  return tv.tv_sec * kNanosPerSec + tv.tv_usec * 1000LL;
+}
+
+// Pin the wall clock to monotonic-now + offset nanoseconds.
+void set_wall_to(long long offset_ns) {
+  long long target = monotonic_ns() + offset_ns;
+  struct timeval tv;
+  tv.tv_sec = static_cast<time_t>(target / kNanosPerSec);
+  tv.tv_usec =
+      static_cast<suseconds_t>((target % kNanosPerSec) / 1000LL);
+  if (settimeofday(&tv, nullptr) != 0) {
+    std::perror("settimeofday");
+    std::exit(2);
+  }
+}
+
+void sleep_ms(long long ms) {
+  struct timespec d;
+  d.tv_sec = static_cast<time_t>(ms / 1000);
+  d.tv_nsec = (ms % 1000) * 1000000L;
+  // a wall-clock jump must not disturb the cadence: nanosleep measures
+  // CLOCK_MONOTONIC-style relative time, and EINTR just resumes
+  struct timespec rem;
+  while (nanosleep(&d, &rem) != 0) d = rem;
+}
+
+}  // namespace
+
+int main(int argc, char **argv) {
+  if (argc != 4) {
+    std::fprintf(stderr,
+                 "usage: %s delta-ms period-ms duration-s\n"
+                 "Every period, pin the wall clock to monotonic + "
+                 "normal or monotonic + normal + delta (alternating) "
+                 "for duration seconds, then restore and print the "
+                 "adjustment count.\n",
+                 argv[0]);
+    return 2;
+  }
+  const long long delta_ns = std::atoll(argv[1]) * 1000000LL;
+  const long long period_ms = std::atoll(argv[2]);
+  const long long duration_ns = std::atoll(argv[3]) * kNanosPerSec;
+  if (period_ms <= 0) {
+    std::fprintf(stderr, "period must be > 0\n");
+    return 2;
+  }
+
+  const long long normal = wall_ns() - monotonic_ns();
+  const long long weird = normal + delta_ns;
+  const long long end = monotonic_ns() + duration_ns;
+
+  bool in_weird = false;
+  long long count = 0;
+  while (monotonic_ns() < end) {
+    set_wall_to(in_weird ? normal : weird);
+    in_weird = !in_weird;
+    ++count;
+    sleep_ms(period_ms);
+  }
+  set_wall_to(normal);
+  std::printf("%lld\n", count);
+  return 0;
+}

@@ -162,6 +162,13 @@ def test_clock_nemesis_ops():
     cn.invoke(test, info_op("nemesis", "reset", ["n4"]))
     assert any("ntpdate -b pool.ntp.org" in e[2] for e in r.log
                if e[0] == "n4")
+    out = cn.invoke(test, info_op("nemesis", "strobe-pin",
+                                  {"n5": {"delta": 200, "period": 10,
+                                          "duration": 5}}))
+    assert any("/opt/jepsen/strobe-time-experiment 200 10 5" in e[2]
+               for e in r.log if e[0] == "n5")
+    # the adjustment count (the experiment's observable) rides the op
+    assert "adjustments" in out.value["n5"]
 
 
 def test_clock_gens():

@@ -359,6 +359,7 @@ def run_tier_child(name: str, budget: int) -> None:
             rate = out["configs"] / t_dev
     print(json.dumps({
         "configs": out["configs"],
+        "max_depth": out.get("max_depth"),
         "t_dev": t_dev,
         "t_first": t_first,
         "rate": rate,
@@ -431,7 +432,9 @@ def host_comparators(tiers) -> dict:
         t_lin = time.perf_counter() - t0
         out[name] = {"host_linear": {
             "valid": r["valid"], "seconds": round(t_lin, 3),
-            "configs": r["configs"]}}
+            "configs": r["configs"],
+            "failing_depth": r.get("max_depth")
+            if r["valid"] is False else None}}
         print(f"bench: host_linear[{name}] {r['valid']} in {t_lin:.1f}s "
               f"({r['configs']} configs)", file=sys.stderr)
         if n_procs >= 2 and _remaining() > 180:
@@ -524,6 +527,10 @@ def main():
                 "device_seconds": round(t_dev, 3),
                 "device_seconds_incl_compile": round(res["t_first"], 3),
                 "device_configs": res["configs"],
+                # the failing det-depth (the obstruction's index) on an
+                # invalid verdict
+                "device_failing_depth": res.get("max_depth")
+                if res["valid"] is False else None,
                 "speedup_vs_host_linear_1core": vslin,
                 "speedup_vs_host16": vs16,
                 "host_linear": hlin or None,

@@ -79,7 +79,10 @@ def check_opseq_linear(seq: OpSeq, model: ModelSpec, *,
                        max_configs: int = 50_000_000,
                        deadline: float | None = None,
                        cancel=None,
-                       witness_cap: int = 0) -> dict:
+                       witness_cap: int = 0,
+                       checkpoint_path: str | None = None,
+                       checkpoint_every: int = 0,
+                       resume_from: str | None = None) -> dict:
     """Exact linearizability check.  Returns a knossos-style map
     {"valid": True|False|"unknown", "configs": n, "max_depth": d, ...};
     on invalid, ``final_ops`` holds the un-linearizable candidate rows at
@@ -90,7 +93,15 @@ def check_opseq_linear(seq: OpSeq, model: ModelSpec, *,
     cap (a big sweep drops witness tracking rather than memory-bloat).
     The default is OFF: verdict-only callers (competition legs, the
     portfolio, fuzzers) keep the level-local memory profile; the
-    user-facing Linearizable checker opts in."""
+    user-facing Linearizable checker opts in.
+
+    Checkpointing (SURVEY §5.4's search-checkpoint story, host side):
+    with ``checkpoint_path`` and ``checkpoint_every`` N, the level set
+    is snapshotted every N levels (atomic rename); ``resume_from``
+    continues a run from such a snapshot after verifying it binds to
+    this exact (history, model) — the level set IS the whole search
+    state, so nothing else needs saving.  Resumed runs report verdicts
+    only (no witness: the parent table is not serialized)."""
     es = encode_search(seq)
     n_det, n_crash, W = es.n_det, es.n_crash, es.window
     if n_det == 0 and n_crash == 0:
@@ -165,6 +176,15 @@ def check_opseq_linear(seq: OpSeq, model: ModelSpec, *,
     configs = 0
     depth = 0
     t_check = 0
+    _digest = None
+    if checkpoint_path or resume_from:
+        from .linearizable import history_digest
+
+        _digest = history_digest(seq, model)  # computed once per run
+    if resume_from is not None:
+        level, depth, configs = _load_linear_checkpoint(
+            resume_from, model, _digest)
+        witness_cap = 0  # parent chains do not survive a snapshot
     # (key, cmask) -> (op row, parent (key, cmask)); None once capped
     parents: dict | None = {root: None} if witness_cap else None
 
@@ -216,6 +236,10 @@ def check_opseq_linear(seq: OpSeq, model: ModelSpec, *,
         return True
 
     while True:
+        if (checkpoint_path and checkpoint_every
+                and depth and depth % checkpoint_every == 0):
+            _save_linear_checkpoint(checkpoint_path, model, _digest,
+                                    level, depth, configs)
         # --- crash closure within the level (depth unchanged) ----------
         work = [(k, cm) for k, ac in level.items() for cm in ac]
         while work:
@@ -288,3 +312,51 @@ def check_opseq_linear(seq: OpSeq, model: ModelSpec, *,
                     "max_depth": depth, "final_ops": sorted(final_ops)}
         level = nxt
         depth += 1
+
+
+# ---------------------------------------------------------------------------
+# Checkpointing (SURVEY §5.4 — the host-sweep counterpart of the device
+# engine's carry checkpoint in checker/linearizable.py)
+# ---------------------------------------------------------------------------
+
+
+def _save_linear_checkpoint(path: str, model: ModelSpec, digest: str,
+                            level: dict, depth: int, configs: int
+                            ) -> None:
+    import json
+    import os
+
+    # JSON, not pickle: a checkpoint may travel between machines, and
+    # loading untrusted pickle executes code (the device checkpoint
+    # uses npz with allow_pickle=False for the same reason); the
+    # payload is pure ints/lists, so JSON loses nothing
+    payload = {
+        "digest": digest,
+        "model": model.name,
+        "depth": depth,
+        "configs": configs,
+        "level": [[k[0], k[1], list(k[2]), list(ac)]
+                  for k, ac in level.items()],
+    }
+    tmp = f"{path}.tmp"
+    with open(tmp, "w") as f:
+        json.dump(payload, f)
+    os.replace(tmp, path)  # atomic: a crash never leaves a torn file
+
+
+def _load_linear_checkpoint(path: str, model: ModelSpec, digest: str):
+    import json
+
+    with open(path) as f:
+        payload = json.load(f)
+    if payload["model"] != model.name:
+        raise ValueError(
+            f"checkpoint is for model {payload['model']!r}, "
+            f"got {model.name!r}")
+    if payload["digest"] != digest:
+        raise ValueError(
+            "checkpoint was taken on a different history or model "
+            "parameterization (digest mismatch)")
+    level = {(p, win, tuple(state)): list(ac)
+             for p, win, state, ac in payload["level"]}
+    return level, payload["depth"], payload["configs"]

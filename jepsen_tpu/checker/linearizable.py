@@ -1558,13 +1558,16 @@ def check_competition(seq: OpSeq, model: ModelSpec, *,
 
 def history_digest(seq: OpSeq, model: ModelSpec) -> str:
     """Identity of (history, model) — resuming against the wrong history
-    would silently produce a garbage verdict."""
+    would silently produce a garbage verdict.  The model's PARAMETERS
+    bind too, not just its name: register(0) and register(7) share a
+    name but give different verdicts."""
     import hashlib
 
     h = hashlib.sha256()
     for a in (seq.f, seq.v1, seq.v2, seq.inv, seq.ret, seq.ok):
         h.update(np.ascontiguousarray(np.asarray(a)).tobytes())
     h.update(model.name.encode())
+    h.update(repr((model.init, model.state_width)).encode())
     return h.hexdigest()
 
 

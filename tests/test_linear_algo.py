@@ -242,3 +242,34 @@ def test_witness_cap_disables_gracefully():
     out = check_opseq_linear(s, model, witness_cap=0)
     assert out["valid"] is True
     assert "linearization" not in out
+
+
+def test_checkpoint_resume_roundtrip(tmp_path):
+    """A snapshot taken mid-run resumes to the same verdict; a snapshot
+    bound to a different history refuses to load."""
+    model = cas_register()
+    rng = random.Random(41)
+    h = synth.register_history(rng, n_ops=200, n_procs=6, overlap=6,
+                               crash_p=0.05, max_crashes=5, n_values=3)
+    h = synth.corrupt_read(rng, h, at=0.85)
+    s = enc(h, model)
+    want = check_opseq_linear(s, model)
+    ckpt = str(tmp_path / "lin.ckpt")
+    out = check_opseq_linear(s, model, checkpoint_path=ckpt,
+                             checkpoint_every=5)
+    assert out["valid"] == want["valid"]
+    import os
+    assert os.path.exists(ckpt)
+    resumed = check_opseq_linear(s, model, resume_from=ckpt)
+    assert resumed["valid"] == want["valid"]
+    # determinism: snapshot + replayed remainder lands exactly where the
+    # uninterrupted run did, and the snapshot really was mid-run
+    assert resumed["configs"] == want["configs"]
+    assert resumed["max_depth"] == want["max_depth"]
+    import json
+    assert json.load(open(ckpt))["depth"] > 0
+
+    h2 = h + [invoke_op(90, "write", 2), ok_op(90, "write", 2)]
+    s2 = enc(h2, model)
+    with pytest.raises(ValueError, match="digest"):
+        check_opseq_linear(s2, model, resume_from=ckpt)

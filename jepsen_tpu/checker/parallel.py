@@ -31,9 +31,26 @@ from __future__ import annotations
 
 import multiprocessing as mp
 import os
+import queue as _queue
 import time
 
 __all__ = ["portfolio_check", "batch_check_pool"]
+
+
+def _await_ready(procs, readies, *, timeout: float):
+    """Wait for worker READY signals against ONE shared deadline.
+
+    Returns the ready subset (same order).  Workers that never signal
+    are terminated and excluded — a dead or wedged worker must neither
+    stall startup serially nor be billed into the portfolio."""
+    t_end = time.monotonic() + timeout
+    ready_procs = []
+    for p, r in zip(procs, readies):
+        if r.wait(timeout=max(0.1, t_end - time.monotonic())):
+            ready_procs.append(p)
+        else:
+            p.terminate()
+    return ready_procs
 
 
 def _portfolio_worker(builder, builder_args, algo, seed, max_configs,
@@ -86,21 +103,35 @@ def portfolio_check(builder, builder_args=(), *, n_procs: int = 16,
         p.start()
         procs.append(p)
         readies.append(ready)
-    for r in readies:
-        r.wait(timeout=120.0)
+    ready_procs = _await_ready(procs, readies, timeout=120.0)
+    n_billed = len(ready_procs)
     t0 = time.perf_counter()
     go.set()
     deadline = None if deadline_s is None else t0 + deadline_s
     result = None
-    pending = len(procs)
-    while pending:
-        timeout = None if deadline is None else \
-            max(0.1, deadline - time.perf_counter())
-        try:
-            algo, seed, r = q.get(timeout=timeout)
-        except Exception:  # queue.Empty
+    received = 0
+    # bounded q.get in a loop, polling worker liveness: a leg that dies
+    # without enqueueing (segfault / OOM-kill) must not hang the
+    # portfolio forever under deadline_s=None
+    while received < n_billed:
+        now = time.perf_counter()
+        if deadline is not None and now >= deadline:
             break
-        pending -= 1
+        step = 1.0 if deadline is None else min(1.0, max(0.1,
+                                                         deadline - now))
+        try:
+            algo, seed, r = q.get(timeout=step)
+        except _queue.Empty:
+            if not any(p.is_alive() for p in ready_procs):
+                # every worker is gone; drain any result that raced the
+                # liveness check, then stop waiting
+                try:
+                    algo, seed, r = q.get_nowait()
+                except _queue.Empty:
+                    break
+            else:
+                continue
+        received += 1
         if r.get("valid") != "unknown":
             result = (algo, seed, r)
             break
@@ -110,11 +141,11 @@ def portfolio_check(builder, builder_args=(), *, n_procs: int = 16,
     for p in procs:
         p.join(timeout=5.0)
     if result is None:
-        return {"valid": "unknown", "engine": f"host{len(procs)}(none)",
-                "n_procs": len(procs), "seconds": seconds}
+        return {"valid": "unknown", "engine": f"host{n_billed}(none)",
+                "n_procs": n_billed, "seconds": seconds}
     algo, seed, r = result
-    r["engine"] = f"host{len(procs)}({algo})"
-    r["n_procs"] = len(procs)
+    r["engine"] = f"host{n_billed}({algo})"
+    r["n_procs"] = n_billed
     r["seconds"] = seconds
     return r
 
@@ -163,14 +194,15 @@ def batch_check_pool(builder, n_keys: int, *, n_procs: int = 16,
         p.start()
         procs.append(p)
         readies.append(ready)
-    for r in readies:
-        r.wait(timeout=300.0)
+    ready_procs = _await_ready(procs, readies, timeout=300.0)
+    ready_set = {wid for wid, p in enumerate(procs) if p in ready_procs}
     t0 = time.perf_counter()
     go.set()
     deadline = None if deadline_s is None else t0 + deadline_s
     verdicts: dict = {}
     configs = 0
-    dead_wids: set = set()
+    # a worker that never signalled ready will never produce its keys
+    dead_wids: set = set(range(n_procs)) - ready_set
 
     def expected() -> int:
         # a dead worker's unseen keys will never arrive; keep draining
@@ -179,18 +211,38 @@ def batch_check_pool(builder, n_keys: int, *, n_procs: int = 16,
                       if k % n_procs in dead_wids and k not in verdicts)
         return n_keys - missing
 
-    while len(verdicts) < expected():
-        timeout = None if deadline is None else \
-            max(0.1, deadline - time.perf_counter())
-        try:
-            k, valid, c = q.get(timeout=timeout)
-        except Exception:  # queue.Empty
-            break
+    def take(item) -> None:
+        nonlocal configs
+        k, valid, c = item
         if k < 0:
             dead_wids.add(int(valid))  # valid slot carries the wid
-            continue
-        verdicts[k] = valid
-        configs += int(c)
+        else:
+            verdicts[k] = valid
+            configs += int(c)
+
+    while len(verdicts) < expected():
+        now = time.perf_counter()
+        if deadline is not None and now >= deadline:
+            break
+        step = 1.0 if deadline is None else min(1.0, max(0.1,
+                                                         deadline - now))
+        try:
+            take(q.get(timeout=step))
+        except _queue.Empty:
+            # liveness poll: a worker killed without enqueueing (-1, wid)
+            # must not hang the pool under deadline_s=None.  A normally-
+            # finished worker is also not alive; marking it dead is
+            # harmless because its keys are either in `verdicts` already
+            # or still in the queue — the post-loop drain collects them.
+            for wid in ready_set - dead_wids:
+                if not procs[wid].is_alive():
+                    dead_wids.add(wid)
+    # drain results that raced a liveness check or the deadline
+    while True:
+        try:
+            take(q.get_nowait())
+        except _queue.Empty:
+            break
     seconds = time.perf_counter() - t0
     for p in procs:
         p.terminate()
@@ -198,4 +250,6 @@ def batch_check_pool(builder, n_keys: int, *, n_procs: int = 16,
         p.join(timeout=5.0)
     return {"verdicts": verdicts, "seconds": seconds,
             "configs": configs, "keys_done": len(verdicts),
-            "n_procs": n_procs}
+            # bill only workers that actually ran (signalled ready) —
+            # per-core rates derived from this must not be understated
+            "n_procs": len(ready_set)}

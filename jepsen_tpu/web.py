@@ -173,7 +173,11 @@ class Handler(BaseHTTPRequestHandler):
         if parsed.query == "zip" and os.path.isdir(full):
             name = rel.replace("/", "-") + ".zip"
             # streamed: no Content-Length; the body is delimited by
-            # connection close (HTTP/1.0 semantics of this handler)
+            # connection close, which REQUIRES the handler to stay on
+            # HTTP/1.0 (BaseHTTPRequestHandler's default) — with
+            # keep-alive the client could not tell where the zip ends
+            assert self.protocol_version == "HTTP/1.0", \
+                "streamed zip framing relies on close-delimited bodies"
             self.send_response(200)
             self.send_header("Content-Type", "application/zip")
             self.send_header("Content-Disposition",
@@ -183,6 +187,12 @@ class Handler(BaseHTTPRequestHandler):
                 write_zip(self.wfile, self.base, rel)
             except (BrokenPipeError, ConnectionResetError):
                 log.debug("zip: client dropped the connection")
+            except Exception:  # noqa: BLE001 — status already sent: the
+                # archive is truncated/corrupt; sabotage the framing by
+                # closing mid-stream and say so (a zlib or read error
+                # here must not masquerade as a clean 200)
+                log.warning("zip: stream aborted mid-archive for %r",
+                            rel, exc_info=True)
             return
         if os.path.isdir(full):
             self._send(200, dir_html(self.base, rel).encode())

@@ -28,24 +28,38 @@ NATIVE_DIR = os.path.join(os.path.dirname(os.path.dirname(
 
 DIR = "/opt/jepsen/faultfs"
 BIN = f"{DIR}/build/faultfs"
+RAW_BIN = f"{DIR}/build/faultfs_raw"
 CTL = f"{DIR}/build/faultfsctl"
 REAL = "/real"
 FAULTY = "/faulty"
 SOCK = f"{REAL}/.faultfs.sock"
 
+SOURCES = ("faultfs.cc", "faultfs_raw.cc", "faultfs_common.h",
+           "faultfsctl.cc", "CMakeLists.txt")
+
 
 def install(sess: control.Session) -> None:
-    """Upload, build, and mount (charybdefs.clj:40-70 surface)."""
+    """Upload, build, and mount (charybdefs.clj:40-70 surface).
+
+    Both frontends are shipped; cmake builds the libfuse3 one only
+    where fuse3 exists, and `faultfs_raw` (raw /dev/fuse protocol, no
+    libfuse) everywhere — mount() prefers libfuse3, falls back to raw.
+    """
     from . import control_util as cu
     from .os import debian
 
     su = sess.su()
-    if not cu.exists(sess, BIN):
-        debian.install(sess, ["build-essential", "cmake", "pkg-config",
-                              "libfuse3-dev", "fuse3"])
+    if not cu.exists(sess, RAW_BIN):
+        # fuse3 packages are best-effort: the raw frontend needs none
+        debian.install(sess, ["build-essential", "cmake", "pkg-config"])
+        try:
+            debian.install(sess, ["libfuse3-dev", "fuse3"])
+        except Exception as e:
+            log.info("faultfs: no fuse3 packages (%s); raw frontend only",
+                     e)
         su.exec("mkdir", "-p", DIR)
         su.exec("chmod", "777", DIR)
-        for f in ("faultfs.cc", "faultfsctl.cc", "CMakeLists.txt"):
+        for f in SOURCES:
             sess.upload(os.path.join(NATIVE_DIR, f), f"{DIR}/{f}")
         at = sess.cd(DIR)
         at.exec("cmake", "-B", "build", "-DCMAKE_BUILD_TYPE=Release", ".")
@@ -54,14 +68,42 @@ def install(sess: control.Session) -> None:
 
 
 def mount(sess: control.Session) -> None:
-    """Mount /faulty over /real (charybdefs.clj:62-70)."""
-    from .control import lit
+    """Mount /faulty over /real (charybdefs.clj:62-70).
+
+    Blocks until the FUSE mount is visible in /proc/mounts: returning
+    before that would let the workload write into the bare mountpoint
+    directory and get shadowed when the mount lands.
+    """
+    import time
+
+    from . import control_util as cu
+    from .control import RemoteError, lit
 
     su = sess.su()
     su.exec("modprobe", "fuse")
     su.exec("umount", FAULTY, lit("||"), "/bin/true")
     su.exec("mkdir", "-p", REAL, FAULTY)
-    su.exec(BIN, REAL, FAULTY, "-o", "allow_other")
+    if cu.exists(sess, BIN):
+        su.exec(BIN, REAL, FAULTY, "-o", "allow_other")
+    else:
+        # raw frontend mounts /dev/fuse itself and stays foreground;
+        # start-stop-daemon gives us a pidfile + idempotent restart
+        cu.start_daemon(su, RAW_BIN, REAL, FAULTY,
+                        logfile=f"{DIR}/faultfs_raw.log",
+                        pidfile=f"{DIR}/faultfs_raw.pid")
+    deadline = time.monotonic() + 10.0
+    while True:
+        try:
+            # first field (fsname) differs between frontends; match
+            # "<anything> /faulty fuse..." instead
+            su.exec("grep", "-q", f" {FAULTY} fuse", "/proc/mounts")
+            break
+        except RemoteError:
+            if time.monotonic() > deadline:
+                raise RuntimeError(
+                    f"faultfs never appeared in /proc/mounts on "
+                    f"{sess.node}; see {DIR}/faultfs_raw.log")
+            time.sleep(0.1)
     su.exec("chmod", "777", REAL, FAULTY)
 
 

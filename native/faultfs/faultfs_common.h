@@ -1,0 +1,180 @@
+// faultfs_common.h — fault configuration + unix-socket control plane
+// shared by the two faultfs frontends:
+//
+//   * faultfs.cc      — libfuse3 high-level API (needs libfuse3-dev)
+//   * faultfs_raw.cc  — raw /dev/fuse kernel protocol (no libfuse at
+//                       all; linux/fuse.h only)
+//
+// Both speak the same one-line text protocol on <realdir>/.faultfs.sock:
+//
+//   set errno=EIO p=1.0 methods=read,write,*   -> inject
+//   set errno=EIO p=0.01 delay_us=500000       -> 1% failures + latency
+//   clear                                      -> stop injecting
+//   status                                     -> current config
+//
+// Reference capability: charybdefs/src/jepsen/charybdefs.clj:38-92 (its
+// control plane is Thrift; ours is a unix socket).
+#ifndef FAULTFS_COMMON_H_
+#define FAULTFS_COMMON_H_
+
+#include <cerrno>
+#include <cstdio>
+#include <cstdlib>
+#include <cstring>
+#include <mutex>
+#include <random>
+#include <set>
+#include <string>
+
+#include <sys/socket.h>
+#include <sys/stat.h>
+#include <sys/un.h>
+#include <unistd.h>
+
+namespace faultfs {
+
+// ---------------------------------------------------------------------------
+// fault configuration
+// ---------------------------------------------------------------------------
+
+struct FaultConfig {
+  bool active = false;
+  int err = EIO;
+  double probability = 1.0;
+  long delay_us = 0;
+  bool all_methods = true;
+  std::set<std::string> methods;
+};
+
+inline std::mutex g_mutex;
+inline FaultConfig g_fault;
+inline thread_local std::mt19937_64 g_rng{std::random_device{}()};
+
+// Returns 0, or a negative errno to inject for this method.
+inline int check_fault(const char *method) {
+  FaultConfig cfg;
+  {
+    std::lock_guard<std::mutex> lock(g_mutex);
+    if (!g_fault.active) return 0;
+    cfg = g_fault;
+  }
+  if (!cfg.all_methods && cfg.methods.count(method) == 0) return 0;
+  std::uniform_real_distribution<double> dist(0.0, 1.0);
+  if (dist(g_rng) >= cfg.probability) return 0;
+  if (cfg.delay_us > 0) usleep(static_cast<useconds_t>(cfg.delay_us));
+  return -cfg.err;
+}
+
+// ---------------------------------------------------------------------------
+// control server
+// ---------------------------------------------------------------------------
+
+inline int parse_errno(const std::string &name) {
+  static const struct { const char *n; int e; } table[] = {
+      {"EIO", EIO},       {"ENOSPC", ENOSPC}, {"EACCES", EACCES},
+      {"ENOENT", ENOENT}, {"EDQUOT", EDQUOT}, {"EROFS", EROFS},
+      {"EMFILE", EMFILE}, {"ENOMEM", ENOMEM}, {"EAGAIN", EAGAIN},
+      {"EBADF", EBADF},
+  };
+  for (const auto &row : table)
+    if (name == row.n) return row.e;
+  // A purely numeric value is authoritative — including "0", which means
+  // "no error" (delay-only injection, see faultfs.py slow()).  Only an
+  // unparseable symbolic name falls back to EIO.
+  char *end = nullptr;
+  long v = strtol(name.c_str(), &end, 10);
+  if (end != name.c_str() && *end == '\0' && v >= 0 && v <= 4096)
+    return (int)v;
+  return EIO;
+}
+
+inline std::string handle_command(const std::string &line) {
+  // tokenize on spaces; first token is the verb
+  std::lock_guard<std::mutex> lock(g_mutex);
+  if (line.rfind("clear", 0) == 0) {
+    g_fault = FaultConfig{};
+    return "ok cleared\n";
+  }
+  if (line.rfind("status", 0) == 0) {
+    char buf[256];
+    snprintf(buf, sizeof buf, "active=%d errno=%d p=%g delay_us=%ld\n",
+             g_fault.active ? 1 : 0, g_fault.err, g_fault.probability,
+             g_fault.delay_us);
+    return buf;
+  }
+  if (line.rfind("set", 0) == 0) {
+    FaultConfig cfg;
+    cfg.active = true;
+    size_t pos = 3;
+    while (pos < line.size()) {
+      while (pos < line.size() && line[pos] == ' ') pos++;
+      size_t end = line.find(' ', pos);
+      if (end == std::string::npos) end = line.size();
+      std::string kv = line.substr(pos, end - pos);
+      pos = end;
+      size_t eq = kv.find('=');
+      if (eq == std::string::npos) continue;
+      std::string key = kv.substr(0, eq), val = kv.substr(eq + 1);
+      if (key == "errno") {
+        cfg.err = parse_errno(val);
+      } else if (key == "p") {
+        cfg.probability = atof(val.c_str());
+      } else if (key == "delay_us") {
+        cfg.delay_us = atol(val.c_str());
+      } else if (key == "methods") {
+        cfg.all_methods = false;
+        size_t mp = 0;
+        while (mp < val.size()) {
+          size_t comma = val.find(',', mp);
+          if (comma == std::string::npos) comma = val.size();
+          std::string m = val.substr(mp, comma - mp);
+          if (m == "*") cfg.all_methods = true;
+          if (!m.empty()) cfg.methods.insert(m);
+          mp = comma + 1;
+        }
+      }
+    }
+    g_fault = cfg;
+    return "ok set\n";
+  }
+  return "err unknown command\n";
+}
+
+inline void control_server(const std::string &sock_path) {
+  unlink(sock_path.c_str());
+  int srv = socket(AF_UNIX, SOCK_STREAM, 0);
+  if (srv < 0) {
+    perror("faultfs control socket");
+    return;
+  }
+  sockaddr_un addr{};
+  addr.sun_family = AF_UNIX;
+  snprintf(addr.sun_path, sizeof addr.sun_path, "%s", sock_path.c_str());
+  if (bind(srv, reinterpret_cast<sockaddr *>(&addr), sizeof addr) != 0 ||
+      listen(srv, 8) != 0) {
+    perror("faultfs control bind/listen");
+    close(srv);
+    return;
+  }
+  chmod(sock_path.c_str(), 0777);
+  for (;;) {
+    int conn = accept(srv, nullptr, nullptr);
+    if (conn < 0) continue;
+    char buf[1024];
+    ssize_t n = read(conn, buf, sizeof buf - 1);
+    if (n > 0) {
+      buf[n] = '\0';
+      // strip trailing newline
+      while (n > 0 && (buf[n - 1] == '\n' || buf[n - 1] == '\r'))
+        buf[--n] = '\0';
+      std::string reply = handle_command(buf);
+      ssize_t ignored = write(conn, reply.data(), reply.size());
+      (void)ignored;
+    }
+    close(conn);
+  }
+}
+
+}  // namespace faultfs
+
+#endif  // FAULTFS_COMMON_H_

@@ -1,0 +1,790 @@
+// faultfs_raw — the faultfs passthrough filesystem speaking the RAW
+// /dev/fuse kernel protocol.  No libfuse of any version is required:
+// the only dependencies are <linux/fuse.h> (kernel uapi) and a libc.
+//
+// Usage: faultfs_raw REALDIR MOUNTPOINT
+//
+// Same capability surface as faultfs.cc (the libfuse3 frontend) and
+// the same control protocol on <REALDIR>/.faultfs.sock — see
+// faultfs_common.h.  Reference capability: CharybdeFS
+// (charybdefs/src/jepsen/charybdefs.clj:38-92, validated in the
+// reference by an EIO-observing remote test,
+// charybdefs/test/jepsen/charybdefs/remote_test.clj:7-21).
+//
+// Why this exists: the libfuse3 frontend needs libfuse3-dev on the db
+// node; this frontend needs only the kernel — as root it open()s
+// /dev/fuse, mount(2)s the fd itself, and serves the request loop
+// directly, so errno injection demonstrably crosses the kernel
+// boundary on any Linux with CONFIG_FUSE_FS.
+//
+// Design notes:
+//   * Path-keyed inode table (node id -> path under REALDIR), root = 1.
+//     FORGET decrements lookup counts; RENAME re-keys the subtree.
+//   * All replies use attr/entry validity 0 and FOPEN_DIRECT_IO, so
+//     every read/write hits this daemon and fault flips take effect
+//     immediately (no page-cache masking) — the property the EIO test
+//     needs.
+//   * Single-threaded request loop: fault delays serialize the fs,
+//     which matches the global-latency recipe semantics.
+//   * The fault-method names match the libfuse3 frontend's table
+//     (getattr, read, write, ...); LOOKUP checks "getattr" because the
+//     high-level API implements lookup via getattr.
+//
+// Build:  g++ -O2 -std=c++17 faultfs_raw.cc -o faultfs_raw -lpthread
+
+#include "faultfs_common.h"
+
+#include <linux/fuse.h>
+
+#include <cerrno>
+#include <csignal>
+#include <cstdio>
+#include <cstring>
+#include <string>
+#include <thread>
+#include <unordered_map>
+#include <vector>
+
+#include <dirent.h>
+#include <fcntl.h>
+#include <sys/mount.h>
+#include <sys/stat.h>
+#include <sys/statvfs.h>
+#include <sys/uio.h>
+#include <unistd.h>
+
+namespace {
+
+using faultfs::check_fault;
+using faultfs::control_server;
+
+std::string g_real;   // backing directory (no trailing slash)
+std::string g_mount;  // mountpoint, for teardown
+int g_fd = -1;        // /dev/fuse
+
+// ---------------------------------------------------------------------------
+// inode table: node id <-> path ("" = root, else "/a/b")
+// ---------------------------------------------------------------------------
+
+struct Node {
+  std::string path;
+  uint64_t nlookup = 0;
+};
+
+std::unordered_map<uint64_t, Node> g_nodes;
+std::unordered_map<std::string, uint64_t> g_by_path;
+uint64_t g_next_id = 2;  // FUSE_ROOT_ID is 1
+
+std::string real_path(const std::string &sub) { return g_real + sub; }
+
+const std::string *node_path(uint64_t id) {
+  if (id == FUSE_ROOT_ID) {
+    static const std::string root;
+    return &root;
+  }
+  auto it = g_nodes.find(id);
+  return it == g_nodes.end() ? nullptr : &it->second.path;
+}
+
+uint64_t intern(const std::string &path) {
+  if (path.empty()) return FUSE_ROOT_ID;
+  auto it = g_by_path.find(path);
+  if (it != g_by_path.end()) {
+    g_nodes[it->second].nlookup++;
+    return it->second;
+  }
+  uint64_t id = g_next_id++;
+  g_nodes[id] = Node{path, 1};
+  g_by_path[path] = id;
+  return id;
+}
+
+void forget(uint64_t id, uint64_t n) {
+  auto it = g_nodes.find(id);
+  if (it == g_nodes.end()) return;
+  if (it->second.nlookup <= n) {
+    // after unlink+recreate (or rename-clobber) the path may already
+    // map to a NEWER node; only erase the mapping if it is still ours
+    auto pit = g_by_path.find(it->second.path);
+    if (pit != g_by_path.end() && pit->second == id) g_by_path.erase(pit);
+    g_nodes.erase(it);
+  } else {
+    it->second.nlookup -= n;
+  }
+}
+
+// RENAME moves a whole subtree: re-key every tracked path under `from`.
+void rekey(const std::string &from, const std::string &to) {
+  std::vector<std::pair<std::string, uint64_t>> moves;
+  for (const auto &kv : g_by_path) {
+    const std::string &p = kv.first;
+    if (p == from ||
+        (p.size() > from.size() && p.compare(0, from.size(), from) == 0 &&
+         p[from.size()] == '/'))
+      moves.emplace_back(p, kv.second);
+  }
+  for (const auto &mv : moves) {
+    std::string np = to + mv.first.substr(from.size());
+    g_by_path.erase(mv.first);
+    g_by_path[np] = mv.second;
+    g_nodes[mv.second].path = np;
+  }
+}
+
+// ---------------------------------------------------------------------------
+// replies
+// ---------------------------------------------------------------------------
+
+void send_reply(uint64_t unique, int error, const void *data, size_t len) {
+  fuse_out_header out{};
+  out.len = static_cast<uint32_t>(sizeof out + len);
+  out.error = error;  // 0 or negative errno
+  out.unique = unique;
+  iovec iov[2] = {{&out, sizeof out}, {const_cast<void *>(data), len}};
+  ssize_t n = writev(g_fd, iov, len ? 2 : 1);
+  if (n < 0 && errno != ENOENT)  // ENOENT: request was interrupted
+    perror("faultfs_raw: reply writev");
+}
+
+void reply_err(uint64_t unique, int neg_errno) {
+  send_reply(unique, neg_errno, nullptr, 0);
+}
+
+void fill_attr(const struct stat &st, fuse_attr *a) {
+  a->ino = st.st_ino;
+  a->size = static_cast<uint64_t>(st.st_size);
+  a->blocks = static_cast<uint64_t>(st.st_blocks);
+  a->atime = static_cast<uint64_t>(st.st_atim.tv_sec);
+  a->mtime = static_cast<uint64_t>(st.st_mtim.tv_sec);
+  a->ctime = static_cast<uint64_t>(st.st_ctim.tv_sec);
+  a->atimensec = static_cast<uint32_t>(st.st_atim.tv_nsec);
+  a->mtimensec = static_cast<uint32_t>(st.st_mtim.tv_nsec);
+  a->ctimensec = static_cast<uint32_t>(st.st_ctim.tv_nsec);
+  a->mode = st.st_mode;
+  a->nlink = static_cast<uint32_t>(st.st_nlink);
+  a->uid = st.st_uid;
+  a->gid = st.st_gid;
+  a->rdev = static_cast<uint32_t>(st.st_rdev);
+  a->blksize = static_cast<uint32_t>(st.st_blksize);
+}
+
+// lstat `path` and send a fuse_entry_out interning it.  Validities are
+// 0: the kernel re-LOOKUPs every time, so injected faults surface
+// immediately.
+void reply_entry(uint64_t unique, const std::string &path) {
+  struct stat st {};
+  if (lstat(real_path(path).c_str(), &st) == -1) {
+    reply_err(unique, -errno);
+    return;
+  }
+  fuse_entry_out e{};
+  e.nodeid = intern(path);
+  e.generation = 0;
+  fill_attr(st, &e.attr);
+  send_reply(unique, 0, &e, sizeof e);
+}
+
+void reply_attr(uint64_t unique, const struct stat &st) {
+  fuse_attr_out a{};
+  fill_attr(st, &a.attr);
+  send_reply(unique, 0, &a, sizeof a);
+}
+
+// child path of a directory node; nullptr reply already sent on error
+bool child_path(uint64_t unique, uint64_t parent, const char *name,
+                std::string *out) {
+  const std::string *pp = node_path(parent);
+  if (pp == nullptr) {
+    reply_err(unique, -ENOENT);
+    return false;
+  }
+  *out = *pp + "/" + name;
+  return true;
+}
+
+// shared FAULT check for raw handlers: true = fault injected + replied
+bool fault(uint64_t unique, const char *method) {
+  int fe = check_fault(method);
+  if (fe != 0) {
+    reply_err(unique, fe);
+    return true;
+  }
+  return false;
+}
+
+// ---------------------------------------------------------------------------
+// request dispatch
+// ---------------------------------------------------------------------------
+
+void do_init(uint64_t unique, const void *body) {
+  const auto *in = static_cast<const fuse_init_in *>(body);
+  fuse_init_out out{};
+  out.major = FUSE_KERNEL_VERSION;
+  // we implement the 7.31-era surface; the kernel uses min(theirs, ours)
+  out.minor = in->minor < 31 ? in->minor : 31;
+  out.max_readahead = in->max_readahead;
+  out.flags = 0;  // no big-writes flag needed: max_write <= 32 pages
+  out.max_background = 16;
+  out.congestion_threshold = 12;
+  out.max_write = 1 << 17;  // 128 KiB (32 pages, the no-flag maximum)
+  out.time_gran = 1;
+  send_reply(unique, 0, &out, sizeof out);
+}
+
+void do_lookup(uint64_t unique, uint64_t nodeid, const char *name) {
+  if (fault(unique, "getattr")) return;  // lookup == getattr in libfuse3
+  std::string path;
+  if (!child_path(unique, nodeid, name, &path)) return;
+  reply_entry(unique, path);
+}
+
+void do_getattr(uint64_t unique, uint64_t nodeid, const void *body) {
+  if (fault(unique, "getattr")) return;
+  const auto *in = static_cast<const fuse_getattr_in *>(body);
+  struct stat st {};
+  int res;
+  if (in->getattr_flags & FUSE_GETATTR_FH) {
+    res = fstat(static_cast<int>(in->fh), &st);
+  } else {
+    const std::string *p = node_path(nodeid);
+    if (p == nullptr) {
+      reply_err(unique, -ENOENT);
+      return;
+    }
+    res = lstat(real_path(*p).c_str(), &st);
+  }
+  if (res == -1) {
+    reply_err(unique, -errno);
+    return;
+  }
+  reply_attr(unique, st);
+}
+
+void do_setattr(uint64_t unique, uint64_t nodeid, const void *body) {
+  const auto *in = static_cast<const fuse_setattr_in *>(body);
+  const std::string *p = node_path(nodeid);
+  if (p == nullptr) {
+    reply_err(unique, -ENOENT);
+    return;
+  }
+  std::string rp = real_path(*p);
+  // the high-level API splits SETATTR into chmod/chown/truncate/utimens
+  // calls; check each sub-op's fault the same way
+  if (in->valid & FATTR_MODE) {
+    if (fault(unique, "chmod")) return;
+    if (chmod(rp.c_str(), in->mode) == -1) {
+      reply_err(unique, -errno);
+      return;
+    }
+  }
+  if (in->valid & (FATTR_UID | FATTR_GID)) {
+    if (fault(unique, "chown")) return;
+    uid_t u = (in->valid & FATTR_UID) ? in->uid : static_cast<uid_t>(-1);
+    gid_t g = (in->valid & FATTR_GID) ? in->gid : static_cast<gid_t>(-1);
+    if (lchown(rp.c_str(), u, g) == -1) {
+      reply_err(unique, -errno);
+      return;
+    }
+  }
+  if (in->valid & FATTR_SIZE) {
+    if (fault(unique, "truncate")) return;
+    int res = (in->valid & FATTR_FH)
+                  ? ftruncate(static_cast<int>(in->fh),
+                              static_cast<off_t>(in->size))
+                  : truncate(rp.c_str(), static_cast<off_t>(in->size));
+    if (res == -1) {
+      reply_err(unique, -errno);
+      return;
+    }
+  }
+  if (in->valid & (FATTR_ATIME | FATTR_MTIME | FATTR_ATIME_NOW |
+                   FATTR_MTIME_NOW)) {
+    if (fault(unique, "utimens")) return;
+    timespec ts[2];
+    ts[0].tv_nsec = UTIME_OMIT;
+    ts[1].tv_nsec = UTIME_OMIT;
+    if (in->valid & FATTR_ATIME_NOW) {
+      ts[0].tv_nsec = UTIME_NOW;
+    } else if (in->valid & FATTR_ATIME) {
+      ts[0].tv_sec = static_cast<time_t>(in->atime);
+      ts[0].tv_nsec = in->atimensec;
+    }
+    if (in->valid & FATTR_MTIME_NOW) {
+      ts[1].tv_nsec = UTIME_NOW;
+    } else if (in->valid & FATTR_MTIME) {
+      ts[1].tv_sec = static_cast<time_t>(in->mtime);
+      ts[1].tv_nsec = in->mtimensec;
+    }
+    if (utimensat(AT_FDCWD, rp.c_str(), ts, AT_SYMLINK_NOFOLLOW) == -1) {
+      reply_err(unique, -errno);
+      return;
+    }
+  }
+  struct stat st {};
+  if (lstat(rp.c_str(), &st) == -1) {
+    reply_err(unique, -errno);
+    return;
+  }
+  reply_attr(unique, st);
+}
+
+void do_open(uint64_t unique, uint64_t nodeid, const void *body,
+             bool create, const char *name, uint32_t mode) {
+  if (fault(unique, create ? "create" : "open")) return;
+  std::string path;
+  if (create) {
+    if (!child_path(unique, nodeid, name, &path)) return;
+  } else {
+    const std::string *p = node_path(nodeid);
+    if (p == nullptr) {
+      reply_err(unique, -ENOENT);
+      return;
+    }
+    path = *p;
+  }
+  uint32_t flags = create
+                       ? static_cast<const fuse_create_in *>(body)->flags
+                       : static_cast<const fuse_open_in *>(body)->flags;
+  int fd = create ? open(real_path(path).c_str(),
+                         static_cast<int>(flags) | O_CREAT, mode)
+                  : open(real_path(path).c_str(), static_cast<int>(flags));
+  if (fd == -1) {
+    reply_err(unique, -errno);
+    return;
+  }
+  fuse_open_out oo{};
+  oo.fh = static_cast<uint64_t>(fd);
+  oo.open_flags = FOPEN_DIRECT_IO;  // bypass page cache: faults surface
+  if (!create) {
+    send_reply(unique, 0, &oo, sizeof oo);
+    return;
+  }
+  struct stat st {};
+  if (fstat(fd, &st) == -1) {
+    int e = errno;
+    close(fd);
+    reply_err(unique, -e);
+    return;
+  }
+  struct {
+    fuse_entry_out e;
+    fuse_open_out o;
+  } out{};
+  out.e.nodeid = intern(path);
+  fill_attr(st, &out.e.attr);
+  out.o = oo;
+  send_reply(unique, 0, &out, sizeof out);
+}
+
+void do_read(uint64_t unique, const void *body, std::vector<char> *scratch) {
+  if (fault(unique, "read")) return;
+  const auto *in = static_cast<const fuse_read_in *>(body);
+  scratch->resize(in->size);
+  ssize_t n = pread(static_cast<int>(in->fh), scratch->data(), in->size,
+                    static_cast<off_t>(in->offset));
+  if (n == -1) {
+    reply_err(unique, -errno);
+    return;
+  }
+  send_reply(unique, 0, scratch->data(), static_cast<size_t>(n));
+}
+
+void do_write(uint64_t unique, const void *body) {
+  if (fault(unique, "write")) return;
+  const auto *in = static_cast<const fuse_write_in *>(body);
+  const char *data = static_cast<const char *>(body) + sizeof *in;
+  ssize_t n = pwrite(static_cast<int>(in->fh), data, in->size,
+                     static_cast<off_t>(in->offset));
+  if (n == -1) {
+    reply_err(unique, -errno);
+    return;
+  }
+  fuse_write_out out{};
+  out.size = static_cast<uint32_t>(n);
+  send_reply(unique, 0, &out, sizeof out);
+}
+
+void do_readdir(uint64_t unique, const void *body) {
+  if (fault(unique, "readdir")) return;
+  const auto *in = static_cast<const fuse_read_in *>(body);
+  DIR *dp = reinterpret_cast<DIR *>(static_cast<uintptr_t>(in->fh));
+  if (in->offset == 0)
+    rewinddir(dp);
+  else
+    seekdir(dp, static_cast<long>(in->offset));
+  std::vector<char> buf;
+  buf.reserve(in->size);
+  for (;;) {
+    long mark = telldir(dp);
+    errno = 0;
+    struct dirent *de = readdir(dp);
+    if (de == nullptr) {
+      if (errno != 0 && buf.empty()) {
+        reply_err(unique, -errno);
+        return;
+      }
+      break;
+    }
+    size_t namelen = strlen(de->d_name);
+    size_t entlen = FUSE_DIRENT_ALIGN(FUSE_NAME_OFFSET + namelen);
+    if (buf.size() + entlen > in->size) {
+      seekdir(dp, mark);  // didn't fit: re-deliver next round
+      break;
+    }
+    size_t base = buf.size();
+    buf.resize(base + entlen, 0);
+    auto *ent = reinterpret_cast<fuse_dirent *>(buf.data() + base);
+    ent->ino = de->d_ino;
+    ent->off = static_cast<uint64_t>(telldir(dp));
+    ent->namelen = static_cast<uint32_t>(namelen);
+    ent->type = de->d_type;
+    memcpy(ent->name, de->d_name, namelen);
+  }
+  send_reply(unique, 0, buf.data(), buf.size());
+}
+
+void do_statfs(uint64_t unique, uint64_t nodeid) {
+  if (fault(unique, "statfs")) return;
+  const std::string *p = node_path(nodeid);
+  struct statvfs sv {};
+  if (statvfs(real_path(p ? *p : "").c_str(), &sv) == -1) {
+    reply_err(unique, -errno);
+    return;
+  }
+  fuse_statfs_out out{};
+  out.st.blocks = sv.f_blocks;
+  out.st.bfree = sv.f_bfree;
+  out.st.bavail = sv.f_bavail;
+  out.st.files = sv.f_files;
+  out.st.ffree = sv.f_ffree;
+  out.st.bsize = static_cast<uint32_t>(sv.f_bsize);
+  out.st.namelen = static_cast<uint32_t>(sv.f_namemax);
+  out.st.frsize = static_cast<uint32_t>(sv.f_frsize);
+  send_reply(unique, 0, &out, sizeof out);
+}
+
+void unmount_and_exit(int code) {
+  if (!g_mount.empty()) umount2(g_mount.c_str(), MNT_DETACH);
+  _exit(code);
+}
+
+void on_signal(int) { unmount_and_exit(0); }
+
+void serve() {
+  // max_write (128K) + readdir/overhead slack
+  std::vector<char> buf((1 << 17) + 8192);
+  std::vector<char> scratch;
+  for (;;) {
+    ssize_t n = read(g_fd, buf.data(), buf.size());
+    if (n < 0) {
+      if (errno == EINTR || errno == EAGAIN) continue;
+      if (errno == ENODEV) break;  // unmounted
+      perror("faultfs_raw: /dev/fuse read");
+      break;
+    }
+    if (static_cast<size_t>(n) < sizeof(fuse_in_header)) continue;
+    const auto *h = reinterpret_cast<const fuse_in_header *>(buf.data());
+    const void *body = buf.data() + sizeof *h;
+    const char *cbody = static_cast<const char *>(body);
+    uint64_t u = h->unique;
+    switch (h->opcode) {
+      case FUSE_INIT:
+        do_init(u, body);
+        break;
+      case FUSE_LOOKUP:
+        do_lookup(u, h->nodeid, cbody);
+        break;
+      case FUSE_FORGET:
+        forget(h->nodeid,
+               static_cast<const fuse_forget_in *>(body)->nlookup);
+        break;  // no reply
+      case FUSE_BATCH_FORGET: {
+        const auto *bf = static_cast<const fuse_batch_forget_in *>(body);
+        const auto *one = reinterpret_cast<const fuse_forget_one *>(
+            cbody + sizeof *bf);
+        for (uint32_t i = 0; i < bf->count; i++)
+          forget(one[i].nodeid, one[i].nlookup);
+        break;  // no reply
+      }
+      case FUSE_GETATTR:
+        do_getattr(u, h->nodeid, body);
+        break;
+      case FUSE_SETATTR:
+        do_setattr(u, h->nodeid, body);
+        break;
+      case FUSE_READLINK: {
+        if (fault(u, "readlink")) break;
+        const std::string *p = node_path(h->nodeid);
+        if (p == nullptr) {
+          reply_err(u, -ENOENT);
+          break;
+        }
+        char lbuf[4096];
+        ssize_t ln = readlink(real_path(*p).c_str(), lbuf, sizeof lbuf);
+        if (ln == -1)
+          reply_err(u, -errno);
+        else
+          send_reply(u, 0, lbuf, static_cast<size_t>(ln));
+        break;
+      }
+      case FUSE_SYMLINK: {  // body: name\0 target\0
+        if (fault(u, "symlink")) break;
+        const char *name = cbody;
+        const char *target = name + strlen(name) + 1;
+        std::string path;
+        if (!child_path(u, h->nodeid, name, &path)) break;
+        if (symlink(target, real_path(path).c_str()) == -1)
+          reply_err(u, -errno);
+        else
+          reply_entry(u, path);
+        break;
+      }
+      case FUSE_MKNOD: {
+        if (fault(u, "mknod")) break;
+        const auto *in = static_cast<const fuse_mknod_in *>(body);
+        const char *name = cbody + sizeof *in;
+        std::string path;
+        if (!child_path(u, h->nodeid, name, &path)) break;
+        if (mknod(real_path(path).c_str(), in->mode, in->rdev) == -1)
+          reply_err(u, -errno);
+        else
+          reply_entry(u, path);
+        break;
+      }
+      case FUSE_MKDIR: {
+        if (fault(u, "mkdir")) break;
+        const auto *in = static_cast<const fuse_mkdir_in *>(body);
+        const char *name = cbody + sizeof *in;
+        std::string path;
+        if (!child_path(u, h->nodeid, name, &path)) break;
+        if (mkdir(real_path(path).c_str(), in->mode) == -1)
+          reply_err(u, -errno);
+        else
+          reply_entry(u, path);
+        break;
+      }
+      case FUSE_UNLINK:
+      case FUSE_RMDIR: {
+        if (fault(u, h->opcode == FUSE_UNLINK ? "unlink" : "rmdir")) break;
+        std::string path;
+        if (!child_path(u, h->nodeid, cbody, &path)) break;
+        int res = h->opcode == FUSE_UNLINK
+                      ? unlink(real_path(path).c_str())
+                      : rmdir(real_path(path).c_str());
+        if (res == -1) {
+          reply_err(u, -errno);
+        } else {
+          // the path no longer names this node; FORGET finishes cleanup
+          auto it = g_by_path.find(path);
+          if (it != g_by_path.end()) g_by_path.erase(it);
+          reply_err(u, 0);
+        }
+        break;
+      }
+      case FUSE_RENAME:
+      case FUSE_RENAME2: {
+        if (fault(u, "rename")) break;
+        uint64_t newdir;
+        uint32_t flags = 0;
+        const char *oldname;
+        if (h->opcode == FUSE_RENAME2) {
+          const auto *in = static_cast<const fuse_rename2_in *>(body);
+          newdir = in->newdir;
+          flags = in->flags;
+          oldname = cbody + sizeof *in;
+        } else {
+          const auto *in = static_cast<const fuse_rename_in *>(body);
+          newdir = in->newdir;
+          oldname = cbody + sizeof *in;
+        }
+        if (flags != 0) {  // parity with the libfuse3 frontend
+          reply_err(u, -EINVAL);
+          break;
+        }
+        const char *newname = oldname + strlen(oldname) + 1;
+        std::string from, to;
+        if (!child_path(u, h->nodeid, oldname, &from)) break;
+        if (!child_path(u, newdir, newname, &to)) break;
+        if (rename(real_path(from).c_str(), real_path(to).c_str()) == -1) {
+          reply_err(u, -errno);
+        } else {
+          g_by_path.erase(to);  // clobbered target, if tracked
+          rekey(from, to);
+          reply_err(u, 0);
+        }
+        break;
+      }
+      case FUSE_LINK: {
+        if (fault(u, "link")) break;
+        const auto *in = static_cast<const fuse_link_in *>(body);
+        const char *name = cbody + sizeof *in;
+        const std::string *oldp = node_path(in->oldnodeid);
+        std::string path;
+        if (oldp == nullptr) {
+          reply_err(u, -ENOENT);
+          break;
+        }
+        if (!child_path(u, h->nodeid, name, &path)) break;
+        if (link(real_path(*oldp).c_str(), real_path(path).c_str()) == -1)
+          reply_err(u, -errno);
+        else
+          reply_entry(u, path);
+        break;
+      }
+      case FUSE_OPEN:
+        do_open(u, h->nodeid, body, false, nullptr, 0);
+        break;
+      case FUSE_CREATE: {
+        const auto *in = static_cast<const fuse_create_in *>(body);
+        do_open(u, h->nodeid, body, true, cbody + sizeof *in, in->mode);
+        break;
+      }
+      case FUSE_READ:
+        do_read(u, body, &scratch);
+        break;
+      case FUSE_WRITE:
+        do_write(u, body);
+        break;
+      case FUSE_STATFS:
+        do_statfs(u, h->nodeid);
+        break;
+      case FUSE_RELEASE:
+        close(static_cast<int>(
+            static_cast<const fuse_release_in *>(body)->fh));
+        reply_err(u, 0);
+        break;
+      case FUSE_FLUSH: {
+        if (fault(u, "flush")) break;
+        // emulate close-without-closing via dup (parity with faultfs.cc)
+        int dup_fd = dup(static_cast<int>(
+            static_cast<const fuse_flush_in *>(body)->fh));
+        if (dup_fd == -1) {
+          reply_err(u, -errno);
+          break;
+        }
+        reply_err(u, close(dup_fd) == -1 ? -errno : 0);
+        break;
+      }
+      case FUSE_FSYNC: {
+        if (fault(u, "fsync")) break;
+        const auto *in = static_cast<const fuse_fsync_in *>(body);
+        int res = (in->fsync_flags & 1)
+                      ? fdatasync(static_cast<int>(in->fh))
+                      : fsync(static_cast<int>(in->fh));
+        reply_err(u, res == -1 ? -errno : 0);
+        break;
+      }
+      case FUSE_OPENDIR: {
+        if (fault(u, "opendir")) break;
+        const std::string *p = node_path(h->nodeid);
+        if (p == nullptr) {
+          reply_err(u, -ENOENT);
+          break;
+        }
+        DIR *dp = opendir(real_path(*p).c_str());
+        if (dp == nullptr) {
+          reply_err(u, -errno);
+          break;
+        }
+        fuse_open_out oo{};
+        oo.fh = static_cast<uint64_t>(reinterpret_cast<uintptr_t>(dp));
+        send_reply(u, 0, &oo, sizeof oo);
+        break;
+      }
+      case FUSE_READDIR:
+        do_readdir(u, body);
+        break;
+      case FUSE_RELEASEDIR:
+        closedir(reinterpret_cast<DIR *>(static_cast<uintptr_t>(
+            static_cast<const fuse_release_in *>(body)->fh)));
+        reply_err(u, 0);
+        break;
+      case FUSE_FSYNCDIR:
+        reply_err(u, 0);
+        break;
+      case FUSE_ACCESS: {
+        if (fault(u, "access")) break;
+        const auto *in = static_cast<const fuse_access_in *>(body);
+        const std::string *p = node_path(h->nodeid);
+        if (p == nullptr) {
+          reply_err(u, -ENOENT);
+          break;
+        }
+        int res = faccessat(AT_FDCWD, real_path(*p).c_str(),
+                            static_cast<int>(in->mask), 0);
+        reply_err(u, res == -1 ? -errno : 0);
+        break;
+      }
+      case FUSE_FALLOCATE: {
+        if (fault(u, "fallocate")) break;
+        const auto *in = static_cast<const fuse_fallocate_in *>(body);
+        if (in->mode != 0) {
+          reply_err(u, -EOPNOTSUPP);
+          break;
+        }
+        int res = posix_fallocate(static_cast<int>(in->fh),
+                                  static_cast<off_t>(in->offset),
+                                  static_cast<off_t>(in->length));
+        reply_err(u, res == 0 ? 0 : -res);
+        break;
+      }
+      case FUSE_INTERRUPT:
+        break;  // best-effort: the interrupted op completes normally
+      case FUSE_DESTROY:
+        reply_err(u, 0);
+        return;
+      default:
+        reply_err(u, -ENOSYS);
+        break;
+    }
+  }
+}
+
+}  // namespace
+
+int main(int argc, char *argv[]) {
+  if (argc != 3) {
+    fprintf(stderr,
+            "usage: %s REALDIR MOUNTPOINT\n"
+            "control socket: REALDIR/.faultfs.sock\n"
+            "(needs root: mounts /dev/fuse directly, no fusermount)\n",
+            argv[0]);
+    return 2;
+  }
+  g_real = argv[1];
+  while (!g_real.empty() && g_real.back() == '/') g_real.pop_back();
+  g_mount = argv[2];
+
+  struct stat st {};
+  if (stat(g_real.c_str(), &st) == -1 || !S_ISDIR(st.st_mode)) {
+    fprintf(stderr, "faultfs_raw: %s is not a directory\n", g_real.c_str());
+    return 2;
+  }
+
+  g_fd = open("/dev/fuse", O_RDWR);
+  if (g_fd == -1) {
+    perror("faultfs_raw: open /dev/fuse");
+    return 2;
+  }
+  char opts[256];
+  snprintf(opts, sizeof opts,
+           "fd=%d,rootmode=%o,user_id=%u,group_id=%u,allow_other", g_fd,
+           st.st_mode & S_IFMT, getuid(), getgid());
+  if (mount("faultfs", g_mount.c_str(), "fuse.faultfs",
+            MS_NOSUID | MS_NODEV, opts) == -1) {
+    perror("faultfs_raw: mount");
+    return 2;
+  }
+
+  signal(SIGTERM, on_signal);
+  signal(SIGINT, on_signal);
+
+  std::thread server(control_server, g_real + "/.faultfs.sock");
+  server.detach();
+
+  printf("MOUNTED %s\n", g_mount.c_str());
+  fflush(stdout);
+
+  serve();
+  unmount_and_exit(0);
+}

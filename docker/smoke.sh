@@ -58,6 +58,11 @@ print("valid:", paths[-1])
 PY
 }
 
+echo "== tier 3 (local): localnode — real daemons, kill -9 nemesis"
+docker exec jepsen-control \
+  python -m jepsen_tpu.suites.localnode test --time-limit 10
+check_valid "store/localnode*/latest/results.json"
+
 echo "== tier 2: atomdemo (in-process db, full pipeline)"
 docker exec jepsen-control \
   python -m jepsen_tpu.suites.atomdemo test --time-limit 10 \

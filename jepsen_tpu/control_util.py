@@ -30,6 +30,29 @@ STD_WGET_OPTS = ["--tries", "20", "--waitretry", "60",
                  "--connect-timeout", "60", "--read-timeout", "60"]
 
 
+def poll_until(probe, *, timeout_s: float, desc: str,
+               interval: float = 0.1):
+    """Readiness wait: call ``probe()`` until it returns truthy without
+    raising; past the deadline raise RuntimeError(desc).  Exceptions
+    from the probe are treated as not-ready-yet (it is a *readiness*
+    probe: transient refusals are the expected state).  Generous
+    timeouts are deliberate — a loaded single-core host can take many
+    seconds to fork+exec a daemon."""
+    import time as _time
+
+    deadline = _time.monotonic() + timeout_s
+    while True:
+        try:
+            v = probe()
+            if v:
+                return v
+        except Exception:  # noqa: BLE001 — not-ready signals vary by probe
+            pass
+        if _time.monotonic() > deadline:
+            raise RuntimeError(desc)
+        _time.sleep(interval)
+
+
 def exists(sess: Session, filename: str) -> bool:
     """Is a path present? (control/util.clj:18-23)"""
     try:

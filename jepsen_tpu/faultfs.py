@@ -74,10 +74,8 @@ def mount(sess: control.Session) -> None:
     before that would let the workload write into the bare mountpoint
     directory and get shadowed when the mount lands.
     """
-    import time
-
     from . import control_util as cu
-    from .control import RemoteError, lit
+    from .control import lit
 
     su = sess.su()
     su.exec("modprobe", "fuse")
@@ -85,25 +83,22 @@ def mount(sess: control.Session) -> None:
     su.exec("mkdir", "-p", REAL, FAULTY)
     if cu.exists(sess, BIN):
         su.exec(BIN, REAL, FAULTY, "-o", "allow_other")
+        hint = "the libfuse3 frontend prints mount errors to stderr"
     else:
         # raw frontend mounts /dev/fuse itself and stays foreground;
         # start-stop-daemon gives us a pidfile + idempotent restart
         cu.start_daemon(su, RAW_BIN, REAL, FAULTY,
                         logfile=f"{DIR}/faultfs_raw.log",
                         pidfile=f"{DIR}/faultfs_raw.pid")
-    deadline = time.monotonic() + 10.0
-    while True:
-        try:
-            # first field (fsname) differs between frontends; match
-            # "<anything> /faulty fuse..." instead
-            su.exec("grep", "-q", f" {FAULTY} fuse", "/proc/mounts")
-            break
-        except RemoteError:
-            if time.monotonic() > deadline:
-                raise RuntimeError(
-                    f"faultfs never appeared in /proc/mounts on "
-                    f"{sess.node}; see {DIR}/faultfs_raw.log")
-            time.sleep(0.1)
+        hint = f"see {DIR}/faultfs_raw.log"
+    # first field (fsname) differs between frontends; match
+    # "<anything> /faulty fuse..." instead
+    cu.poll_until(
+        lambda: (su.exec("grep", "-q", f" {FAULTY} fuse", "/proc/mounts")
+                 or True),
+        timeout_s=15.0,
+        desc=f"faultfs never appeared in /proc/mounts on {sess.node}; "
+             f"{hint}")
     su.exec("chmod", "777", REAL, FAULTY)
 
 

@@ -22,7 +22,7 @@ SUITES = ["atomdemo", "etcdemo", "zookeeper", "hazelcast", "registry",
           "mongodb", "disque", "chronos", "aerospike", "crate",
           "rethinkdb", "tidb", "etcd", "logcabin", "raftis",
           "robustirc", "percona", "mysql_cluster", "postgres_rds",
-          "dgraph"]
+          "dgraph", "localnode"]
 
 
 def suite(name: str):

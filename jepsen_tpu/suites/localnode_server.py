@@ -1,0 +1,113 @@
+"""localnode register server — a REAL database process for Tier-3.
+
+A deliberately small but honest linearizable key->int register service:
+
+  * text protocol over TCP (one line per op):
+        R k            -> "OK <v>" | "OK nil"
+        W k v          -> "OK"
+        CAS k old new  -> "OK" | "FAIL"
+  * durability: every state-changing op is appended to an oplog and
+    fsync()ed BEFORE the reply is sent, under one global lock — the
+    linearization point is inside the lock, and a kill -9 at any moment
+    loses at most un-acked ops (which the harness records as :info,
+    exactly the "maybe happened" semantics the checker must cope with,
+    core.clj:387-397).
+  * recovery: replays the oplog on startup.
+
+This is the database the localnode suite (suites/localnode.py) deploys
+as a real OS process per logical node — the executable analog of the
+reference's ssh-test fixture cluster (jepsen/test/jepsen/
+core_test.clj:32-86) for images with no sshd/docker.
+
+Usage:  python -m jepsen_tpu.suites.localnode_server PORT DATA_DIR
+"""
+
+from __future__ import annotations
+
+import os
+import socket
+import socketserver
+import sys
+import threading
+
+
+class Store:
+    def __init__(self, data_dir: str):
+        self.lock = threading.Lock()
+        self.state: dict[str, int] = {}
+        os.makedirs(data_dir, exist_ok=True)
+        self.path = os.path.join(data_dir, "oplog")
+        self._recover()
+        self.log = open(self.path, "ab")
+
+    def _recover(self) -> None:
+        if not os.path.exists(self.path):
+            return
+        with open(self.path, "rb") as f:
+            for raw in f:
+                parts = raw.decode("ascii", "replace").split()
+                if len(parts) == 3 and parts[0] == "W":
+                    self.state[parts[1]] = int(parts[2])
+                elif len(parts) == 4 and parts[0] == "C":
+                    if self.state.get(parts[1]) == int(parts[2]):
+                        self.state[parts[1]] = int(parts[3])
+
+    def _durable(self, line: str) -> None:
+        self.log.write(line.encode("ascii"))
+        self.log.flush()
+        os.fsync(self.log.fileno())
+
+    def apply(self, parts: list[str]) -> str:
+        with self.lock:
+            if parts[0] == "R" and len(parts) == 2:
+                v = self.state.get(parts[1])
+                return f"OK {'nil' if v is None else v}"
+            if parts[0] == "W" and len(parts) == 3:
+                self._durable(f"W {parts[1]} {int(parts[2])}\n")
+                self.state[parts[1]] = int(parts[2])
+                return "OK"
+            if parts[0] == "CAS" and len(parts) == 4:
+                if self.state.get(parts[1]) != int(parts[2]):
+                    return "FAIL"
+                self._durable(f"C {parts[1]} {int(parts[2])} "
+                              f"{int(parts[3])}\n")
+                self.state[parts[1]] = int(parts[3])
+                return "OK"
+            return "ERR bad command"
+
+
+class Handler(socketserver.StreamRequestHandler):
+    def handle(self):
+        while True:
+            raw = self.rfile.readline()
+            if not raw:
+                return
+            try:
+                parts = raw.decode("ascii", "replace").split()
+                reply = self.server.store.apply(parts) if parts \
+                    else "ERR empty"
+            except (ValueError, IndexError):
+                reply = "ERR parse"
+            self.wfile.write((reply + "\n").encode("ascii"))
+            self.wfile.flush()
+
+
+class Server(socketserver.ThreadingTCPServer):
+    allow_reuse_address = True  # rebind fast after kill -9
+    daemon_threads = True
+
+
+def main(argv=None) -> None:
+    argv = sys.argv[1:] if argv is None else argv
+    if len(argv) != 2:
+        print("usage: localnode_server PORT DATA_DIR", file=sys.stderr)
+        raise SystemExit(2)
+    port, data_dir = int(argv[0]), argv[1]
+    srv = Server(("127.0.0.1", port), Handler)
+    srv.store = Store(data_dir)
+    print(f"localnode_server: listening on 127.0.0.1:{port}", flush=True)
+    srv.serve_forever()
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,110 @@
+"""Executed Tier-3: the localnode suite — real OS processes as nodes.
+
+The reference proves its stack against real remote processes
+(core_test.clj:32-86 ssh-test; docker/smoke.sh).  This image has no
+sshd/docker, so the executable analog is the localnode suite: real
+daemons via start-stop-daemon, real TCP clients, real kill -9 crashes,
+full runner -> nemesis -> checker -> store pipeline.
+"""
+
+import json
+import os
+import signal
+import socket
+import subprocess
+import sys
+import time
+
+import pytest
+
+from jepsen_tpu.suites import localnode, localnode_server
+
+SERVER = os.path.abspath(localnode_server.__file__)
+
+
+def _connect(port, deadline_s=10.0):
+    deadline = time.monotonic() + deadline_s
+    while True:
+        try:
+            return socket.create_connection(("127.0.0.1", port),
+                                            timeout=1.0)
+        except OSError:
+            if time.monotonic() > deadline:
+                raise
+            time.sleep(0.05)
+
+
+def _rt(sock, line):
+    sock.sendall((line + "\n").encode())
+    buf = b""
+    while not buf.endswith(b"\n"):
+        buf += sock.recv(4096)
+    return buf.decode().strip()
+
+
+def test_server_survives_kill_minus_9(tmp_path):
+    """Acked writes are fsynced before the reply, so they survive a
+    SIGKILL and reappear after restart (oplog replay)."""
+    port = 17990
+    data = str(tmp_path / "data")
+
+    def start():
+        return subprocess.Popen([sys.executable, SERVER, str(port), data],
+                                stdout=subprocess.DEVNULL,
+                                stderr=subprocess.STDOUT)
+
+    proc = start()
+    try:
+        s = _connect(port)
+        assert _rt(s, "W a 3") == "OK"
+        assert _rt(s, "CAS a 3 4") == "OK"
+        assert _rt(s, "CAS a 9 7") == "FAIL"
+        assert _rt(s, "R a") == "OK 4"
+        os.kill(proc.pid, signal.SIGKILL)
+        proc.wait(timeout=5)
+        proc = start()
+        s2 = _connect(port)
+        assert _rt(s2, "R a") == "OK 4"  # durable across the crash
+        assert _rt(s2, "R nope") == "OK nil"
+    finally:
+        proc.kill()
+        proc.wait(timeout=5)
+
+
+def test_full_stack_real_processes(tmp_path):
+    """core.run end to end: real server daemons per node, a kill -9 /
+    restart nemesis, linearizable verdict, store artifacts."""
+    from jepsen_tpu import core
+
+    test = localnode.localnode_test({
+        "nodes": ["n1", "n2", "n3"],
+        "base_port": 17920,
+        "data_root": str(tmp_path / "nodes"),
+        "store_base": str(tmp_path / "store"),
+        "time_limit": 6,
+        "rate": 20,
+        "concurrency": 6,
+        "ops_per_key": 25,
+    })
+    test = core.run(test)
+    res = test["results"]
+    assert res.get("valid") is True, res
+    hist = test["history"]
+    assert any(op.process == "nemesis" and op.f == "kill"
+               for op in hist), "nemesis never killed a server"
+    assert any(op.process == "nemesis" and op.f == "restart"
+               for op in hist)
+    client_ops = [op for op in hist if isinstance(op.process, int)]
+    assert len(client_ops) > 40, f"too few ops: {len(client_ops)}"
+    # store artifacts on disk
+    from jepsen_tpu import store
+
+    d = os.path.dirname(store.path(test, "x"))
+    assert os.path.isfile(os.path.join(d, "results.json"))
+    r = json.load(open(os.path.join(d, "results.json")))
+    assert r.get("valid") is True
+    # every server process is gone after teardown
+    for i in range(3):
+        with pytest.raises(OSError):
+            socket.create_connection(("127.0.0.1", 17920 + i),
+                                     timeout=0.3).close()

@@ -68,7 +68,7 @@ from __future__ import annotations
 
 import os
 import time
-from dataclasses import dataclass
+from dataclasses import dataclass, replace as _dc_replace
 
 import jax
 import jax.numpy as jnp
@@ -1250,6 +1250,9 @@ _STATUS = {2: True, 1: False, 0: "unknown"}
 #: refuse device search past these (fall back to host oracle)
 MAX_WINDOW = 512
 MAX_CRASH = 64
+#: widest shared-batch frontier rung; keys needing more go solo (the
+#: solo ladder resumes from clean carries and widens to MAX_FRONTIER)
+BATCH_FRONTIER_CAP = 512
 
 
 #: frontier-width grid: powers of two from 64 to 256k.  Per-level cost
@@ -1637,12 +1640,13 @@ def resume_opseq(seq: OpSeq, model: ModelSpec, path: str, *,
 
 
 def batch_dims(ess: list[EncodedSearch], model: ModelSpec, *,
-               frontier: int = 64) -> SearchDims:
+               frontier: int = 32) -> SearchDims:
     """Common static dims covering every history in the batch.  The
     shared frontier starts narrow — every key pays every lane of it
-    each level, and a key whose search outgrows it is re-run solo
-    behind the adaptive ladder (search_batch's overflow path), so the
-    batch should be sized for the typical key, not the worst."""
+    each level, so the batch is sized for the typical key, not the
+    worst: keys that outgrow a rung escalate TOGETHER through 4x-wider
+    batch rungs (search_batch's ladder) up to BATCH_FRONTIER_CAP, and
+    only past that fall back to solo adaptive-ladder runs."""
     W = _round_up(max(e.window for e in ess), 32)
     ncr = max(e.n_crash for e in ess)
     NC = _round_up(ncr, 32) if ncr else 32
@@ -1703,19 +1707,19 @@ def _init_batch_carry(n: int, dims: SearchDims, model: ModelSpec):
 
 
 def _drive_batch_compacting(fn, esps, model: ModelSpec, dims: SearchDims,
-                            budget: int):
+                            budget: int, *, bail: bool = False):
     """Slice driver for the vmapped batch kernel with active-key
     compaction.
 
     A vmapped `while_loop` runs until its SLOWEST lane finishes — already
     -resolved keys keep executing the (masked) body, so a long-tail key
     makes every finished key burn device time with it.  Between slices,
-    finished keys are recorded host-side and, once the live set fits a
-    smaller power-of-two batch, the stacked args/carry are rebuilt at
-    that size (pad lanes carry status=VALID, count=0: they mask out
-    immediately).  Shapes stay on the power-of-two grid so jit re-traces
-    at most log2(n) batch sizes, all served by the persistent compile
-    cache.
+    finished keys are recorded host-side and, once the live set fits
+    HALF the current lanes, the stacked args/carry are rebuilt at the
+    smaller grid size (pad lanes carry status=VALID, count=0: they mask
+    out immediately).  The grid steps in multiples of 32 above 32 lanes
+    (pow2 below), and the halving rule bounds re-traces to ~log2(n)
+    batch sizes per drive, all served by the persistent compile cache.
 
     Returns final (status, count, configs, depth, ovf) arrays over ALL
     keys, in input order.
@@ -1725,7 +1729,12 @@ def _drive_batch_compacting(fn, esps, model: ModelSpec, dims: SearchDims,
     fin = {}  # key -> (status, count, configs, depth, ovf)
 
     def grid(k: int) -> int:
-        return max(4, _next_pow2(k))
+        # pow2 up to 32 lanes, then multiples of 32: a 84-key batch runs
+        # at 96 lanes instead of 128 (25% less padded work) while the
+        # shape set stays small enough for the persistent compile cache
+        if k <= 32:
+            return max(4, _next_pow2(k))
+        return ((k + 31) // 32) * 32
 
     def stack(keys, carry_rows):
         b = grid(len(keys))
@@ -1753,7 +1762,7 @@ def _drive_batch_compacting(fn, esps, model: ModelSpec, dims: SearchDims,
     while True:
         t0 = time.perf_counter()
         carry = fn(*args, jnp.int32(budget), jnp.int32(lvl_cap),
-                   jnp.bool_(False), *carry)
+                   jnp.bool_(bail), *carry)
         jax.block_until_ready(carry)
         dt = time.perf_counter() - t0
         status = np.asarray(carry[2])
@@ -1765,8 +1774,11 @@ def _drive_batch_compacting(fn, esps, model: ModelSpec, dims: SearchDims,
         for i, k in enumerate(lanes):
             if k in fin:
                 continue
+            # with bail, an overflowed lane halts inside the kernel (a
+            # wider re-run is coming): it must retire here or the driver
+            # would spin on it forever
             if (status[i] != -1 or count[i] <= 0
-                    or configs[i] >= budget):
+                    or configs[i] >= budget or (bail and ovf[i])):
                 fin[k] = (status[i], count[i], configs[i], depth[i],
                           ovf[i])
             else:
@@ -1776,7 +1788,10 @@ def _drive_batch_compacting(fn, esps, model: ModelSpec, dims: SearchDims,
         if not first:
             lvl_cap = _adapt_lvl_cap(lvl_cap, dt)
         first = False
-        if grid(len(live)) < grid(len(lanes)):
+        # re-stack only when the live set fits HALF the current lanes:
+        # bounds shape churn to ~log2(n) stacks per drive even though
+        # the grid itself steps in multiples of 32
+        if grid(len(live)) * 2 <= grid(len(lanes)):
             rows = [tuple(np.asarray(c)[i] for c in carry) for i in live]
             lanes = [lanes[i] for i in live]
             args, carry = stack(lanes, rows)
@@ -1842,10 +1857,15 @@ def search_batch(seqs: list[OpSeq], model: ModelSpec, *,
                 out.append(search_opseq(s, model, budget=budget))
         return out
 
-    dims = dims or batch_dims(ess, model)
-    fn = get_batch_kernel(model, dims)
+    # the sharded path has no escalation ladder (the key axis must keep
+    # covering the mesh at a fixed shape), so it starts at the wider
+    # frontier; the ladder path starts narrow and escalates in batches
+    dims = dims or batch_dims(
+        ess, model, frontier=64 if sharding is not None else 32)
+    pending: list[int] = []
 
     if sharding is not None:
+        fn = get_batch_kernel(model, dims)
         # mesh-sharded batch: fixed size (the key axis must keep
         # covering the mesh), plain slice driver.  Arrays go to the mesh
         # straight from host numpy: in a MULTI-PROCESS job (DCN tier,
@@ -1887,10 +1907,47 @@ def search_batch(seqs: list[OpSeq], model: ModelSpec, *,
         depth = gather(carry[4])
         ovf = gather(carry[5])
     else:
+        # batched escalation ladder: every pending key runs at the
+        # current frontier rung; keys that overflow it re-run TOGETHER
+        # at 4x width (one kernel call per rung, not one solo search
+        # per overflowing key — solo re-runs each pay dispatch/compile,
+        # which is exactly what hurts on a real accelerator).  Keys
+        # still overflowing past the rung cap fall back to the solo
+        # adaptive ladder.
         esps = [pad_search(e, dims.n_det_pad, dims.n_crash_pad)
                 for e in ess]
-        status, count, configs, depth, ovf = _drive_batch_compacting(
-            fn, esps, model, dims, budget)
+        n = len(seqs)
+        status = np.full(n, UNKNOWN, np.int32)
+        count = np.zeros(n, np.int32)
+        configs = np.zeros(n, np.int64)
+        depth = np.zeros(n, np.int32)
+        ovf = np.zeros(n, bool)
+        pending = list(range(n))
+        spent = np.zeros(n, np.int64)  # configs across ALL rungs
+        rung = dims.frontier
+        while pending:
+            d = _dc_replace(dims, frontier=rung)
+            fnr = get_batch_kernel(model, d)
+            st, ct, cf, dp, ov = _drive_batch_compacting(
+                fnr, [esps[i] for i in pending], model, d, budget,
+                bail=True)
+            nxt = []
+            for j, i in enumerate(pending):
+                spent[i] += int(cf[j])
+                if st[j] == -1 and bool(ov[j]) and spent[i] < budget:
+                    nxt.append(i)  # overflowed this rung: escalate
+                else:
+                    # configs reports cumulative exploration across
+                    # rungs, and the per-key budget bounds the total —
+                    # a key never escalates once its cumulative spend
+                    # crosses it (worst case: budget + one rung)
+                    status[i], count[i] = st[j], ct[j]
+                    configs[i] = spent[i]
+                    depth[i], ovf[i] = dp[j], ov[j]
+            pending = nxt
+            if pending and rung >= BATCH_FRONTIER_CAP:
+                break  # stragglers go solo below
+            rung = min(rung * 4, BATCH_FRONTIER_CAP)
     # host-side finalization of still -1 statuses (dead frontier or
     # exhausted budget), mirroring _run_kernel
     status = np.where(
@@ -1898,10 +1955,11 @@ def search_batch(seqs: list[OpSeq], model: ModelSpec, *,
         np.where(count <= 0, np.where(ovf, UNKNOWN, INVALID), UNKNOWN),
         status)
     out = []
+    solo = set(pending if sharding is None else [])
     for i in range(len(seqs)):
-        if int(status[i]) == UNKNOWN and bool(ovf[i]):
-            # this key's search overflowed the shared frontier: redo it
-            # solo with the escalation ladder
+        if i in solo or (int(status[i]) == UNKNOWN and bool(ovf[i])):
+            # overflowed every shared rung: redo solo with the adaptive
+            # ladder
             out.append(search_opseq(seqs[i], model, budget=budget))
         else:
             out.append({"valid": _STATUS[int(status[i])],

@@ -1734,7 +1734,7 @@ def _drive_batch_compacting(fn, esps, model: ModelSpec, dims: SearchDims,
         # shape set stays small enough for the persistent compile cache
         if k <= 32:
             return max(4, _next_pow2(k))
-        return ((k + 31) // 32) * 32
+        return _round_up(k, 32)
 
     def stack(keys, carry_rows):
         b = grid(len(keys))
@@ -1955,12 +1955,27 @@ def search_batch(seqs: list[OpSeq], model: ModelSpec, *,
         np.where(count <= 0, np.where(ovf, UNKNOWN, INVALID), UNKNOWN),
         status)
     out = []
-    solo = set(pending if sharding is None else [])
+    ladder = sharding is None
+    solo = set(pending) if ladder else set()
     for i in range(len(seqs)):
-        if i in solo or (int(status[i]) == UNKNOWN and bool(ovf[i])):
+        needs_solo = i in solo or (int(status[i]) == UNKNOWN
+                                   and bool(ovf[i]))
+        if needs_solo and ladder and spent[i] >= budget:
+            # cumulative ladder spend already exhausted this key's
+            # budget: a solo re-run would amplify work past the cap.
+            # UNKNOWN stands, with the true cumulative count.
+            out.append({"valid": "unknown", "configs": int(spent[i]),
+                        "max_depth": int(depth[i]),
+                        "engine": "device-batch"})
+        elif needs_solo:
             # overflowed every shared rung: redo solo with the adaptive
-            # ladder
-            out.append(search_opseq(seqs[i], model, budget=budget))
+            # ladder, on the REMAINING budget, reporting cumulative
+            # configs (ladder spend + solo spend)
+            rem = budget - int(spent[i]) if ladder else budget
+            r = search_opseq(seqs[i], model, budget=max(1000, rem))
+            if ladder:
+                r["configs"] = int(r.get("configs", 0)) + int(spent[i])
+            out.append(r)
         else:
             out.append({"valid": _STATUS[int(status[i])],
                         "configs": int(configs[i]),

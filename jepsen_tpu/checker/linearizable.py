@@ -43,9 +43,10 @@ above) and equal-key neighbors are compared on their full config words
 before dropping either — hash collisions cost duplicate work, never a
 merge — so an "invalid" verdict is not subject to fingerprinting.
 Capacity is handled by the adaptive width driver (`_run_kernel`): the
-frontier width moves both ways on a power-of-two grid — a level that
-overflows bails and resumes from the last clean carry one step wider; a
-shrunken live frontier truncates back down.  Only at MAX_FRONTIER does
+frontier width moves both ways on a power-of-two grid — an overflowing
+level is uncommitted by the kernel and the search resumes 4x wider from
+the very level that overflowed (zero levels re-run); a shrunken live
+frontier truncates back down.  Only at MAX_FRONTIER does
 an overflow degrade the verdict, and then always to "unknown", never to
 a wrong answer; exhausted budgets and deadlines also report "unknown".
 Histories whose window or crash count exceed the device encoding fall
@@ -524,6 +525,13 @@ def build_search_step_fn(model: ModelSpec, dims: SearchDims):
 
         def body(c):
             frontier, count, status, configs, max_depth, ovf, lvl = c
+            # entry snapshot: if THIS level overflows under bail, the
+            # level is not committed and the carry exits at the last
+            # clean state — the wider re-run resumes with zero lost
+            # levels (the old behavior re-ran every level since the
+            # slice began)
+            f_in, c_in, cfg_in, md_in, ovf_in = (frontier, count,
+                                                 configs, max_depth, ovf)
             alive = jnp.arange(F) < count
 
             valid2, cand2, ns2, goal2 = mask_phase(frontier, alive)
@@ -613,6 +621,14 @@ def build_search_step_fn(model: ModelSpec, dims: SearchDims):
             max_depth = jnp.maximum(max_depth, jnp.max(
                 jnp.where(alive, frontier[:, 0], 0)))
             status = jnp.where(found, 2, status)
+            # uncommit an overflowing level when a wider re-run is
+            # coming (bail) and no goal was found (a found goal is
+            # sound regardless: it was reached through real rows)
+            revert = bail & (ovf & ~ovf_in) & ~found
+            new_frontier = jnp.where(revert, f_in, new_frontier)
+            new_count = jnp.where(revert, c_in, new_count)
+            configs = jnp.where(revert, cfg_in, configs)
+            max_depth = jnp.where(revert, md_in, max_depth)
             return (new_frontier, new_count, status, configs, max_depth,
                     ovf, lvl + 1)
 
@@ -1266,8 +1282,14 @@ MAX_FRONTIER = 1 << 18
 
 
 def _grid_width(f: int) -> int:
-    """Snap up to the power-of-two width grid, clamped to MAX_FRONTIER."""
-    w = 64
+    """Snap up to the power-of-two width grid, clamped to MAX_FRONTIER.
+
+    Floor 16, not 64: near-deterministic histories (a mutex under low
+    contention holds ONE live config for thousands of levels) ride the
+    narrow rungs, where per-level cost tracks the frontier actually
+    alive — at a floor of 64 such searches paid 64 lanes for 1 live row
+    every level."""
+    w = 16
     while w < f and w < MAX_FRONTIER:
         w *= 2
     return w
@@ -1282,11 +1304,10 @@ def _run_kernel(esp: EncodedSearch, es: EncodedSearch, model: ModelSpec,
     The frontier width moves both ways on the power-of-two grid
     (escalation climbs two steps at a time, the downshift settles one):
 
-    * a slice that overflows the current width bails immediately (the
-      kernel's ``bail`` flag) and the search resumes from the last clean
-      pre-overflow carry at the next wider kernel — BFS state is
-      level-local, so only the bailed slice's levels re-run, never the
-      whole search;
+    * a level that overflows the current width is UNCOMMITTED by the
+      kernel (the ``bail`` flag): the slice exits holding the last clean
+      frontier, and the search resumes two grid steps (4x) wider from
+      exactly there — zero levels re-run;
     * when the live frontier shrinks well below the current width, the
       carry (live rows are prefix-compacted by the kernel) is truncated
       a grid step down, so per-level cost tracks the frontier actually
@@ -1312,10 +1333,10 @@ def _run_kernel(esp: EncodedSearch, es: EncodedSearch, model: ModelSpec,
                   (resume if resume is not None
                    else _init_carry(dims, model)))
     F = dims.frontier
-    clean = (carry, F)  # last pre-overflow (carry, width)
     lvl_cap = _SLICE_LEVELS0
     first = True
     timed_out = False
+    low_streak = 0  # consecutive slices whose live width fit a lower rung
     while True:
         bail = escalate and F < MAX_FRONTIER
         fn = get_kernel(model, dims)
@@ -1330,8 +1351,6 @@ def _run_kernel(esp: EncodedSearch, es: EncodedSearch, model: ModelSpec,
         count = int(carry[1])
         configs = int(carry[3])
         ovf = bool(carry[5])
-        if not ovf:
-            clean = (carry, F)
         if status != -1 or count <= 0 or configs >= budget:
             break
         if deadline is not None and time.perf_counter() > deadline:
@@ -1341,13 +1360,17 @@ def _run_kernel(esp: EncodedSearch, es: EncodedSearch, model: ModelSpec,
             timed_out = True
             break
         if bail and ovf:
-            # widen from the last clean carry and keep going
-            # climb fast (x4): a growth phase that doubles per level
-            # would otherwise pay a bailed slice per grid step; the 2x
-            # downshift below settles onto the tight width afterwards
+            # the kernel uncommits an overflowing level before bailing,
+            # so the carry it returned IS the last clean state: resume
+            # wider from right here, zero levels re-run.  climb fast
+            # (x4): a growth phase that doubles per level would
+            # otherwise pay a bailed slice per grid step; the downshift
+            # below settles onto the tight width afterwards
             new_f = _grid_width(F * 4)
+            base = tuple(carry[:5]) + (jnp.bool_(False),)
             carry = tuple(jnp.asarray(c) for c in
-                          _widen_carry(clean[0], clean[1], new_f))
+                          _widen_carry(base, F, new_f))
+            low_streak = 0  # a burst just proved the width necessary
             # per-level cost scales with width: shrink the level cap by
             # the same ratio or the first wide slice runs lvl_cap
             # narrow-sized levels at 4x the cost (enough to blow a
@@ -1355,19 +1378,27 @@ def _run_kernel(esp: EncodedSearch, es: EncodedSearch, model: ModelSpec,
             lvl_cap = max(8, lvl_cap * F // new_f)
             F = new_f
             dims = SearchDims(**{**dims.__dict__, "frontier": F})
-            clean = (carry, F)
             first = True  # next slice includes a compile
             continue
         if not first:
             lvl_cap = _adapt_lvl_cap(lvl_cap, dt)
         first = False
         if not ovf and count > 0:
-            # 2x headroom over the live width: tight enough to ride the
-            # finer grid down, loose enough not to thrash on small
-            # fluctuations (a bounce costs one bailed slice + a cached
-            # compile)
-            new_f = _grid_width(2 * count)
+            # 4x headroom over the live width, with hysteresis: only
+            # downshift after TWO consecutive slices fit the lower rung.
+            # A transient valley between wide bursts would otherwise
+            # bounce the width (each bounce = a bailed slice + re-run
+            # levels), which costs more than it saves — the register
+            # tier thrashed 2x when the floor dropped to 16 without
+            # this guard, while sustained-narrow searches (mutex) still
+            # settle onto the tight width one slice later.
+            new_f = _grid_width(4 * count)
             if new_f < F:
+                low_streak += 1
+            else:
+                low_streak = 0
+            if new_f < F and low_streak >= 2:
+                low_streak = 0
                 # live rows sit at the frontier's prefix: truncate
                 carry = (carry[0][:new_f],) + tuple(carry[1:])
                 # cheaper levels: grow the cap by the width ratio so
@@ -1375,7 +1406,6 @@ def _run_kernel(esp: EncodedSearch, es: EncodedSearch, model: ModelSpec,
                 lvl_cap = min(_SLICE_MAX, lvl_cap * (F // new_f))
                 F = new_f
                 dims = SearchDims(**{**dims.__dict__, "frontier": F})
-                clean = (carry, F)
                 first = True  # next slice may include a compile
     if status == -1:
         # frontier died out with no goal: invalid if we never overflowed,

@@ -1148,13 +1148,15 @@ _SLICE_TARGET_S = float(os.environ.get("JEPSEN_TPU_SLICE_TARGET_S", "2.0"))
 _SLICE_MAX = 16384
 
 
-def _adapt_lvl_cap(lvl_cap: int, dt: float) -> int:
+def _adapt_lvl_cap(lvl_cap: int, dt: float,
+                   target_s: float | None = None) -> int:
     """Grow/shrink the per-call level cap toward the target slice time."""
-    if dt < _SLICE_TARGET_S / 4:
+    t = _SLICE_TARGET_S if target_s is None else target_s
+    if dt < t / 4:
         return min(lvl_cap * 4, _SLICE_MAX)
-    if dt < _SLICE_TARGET_S / 2:
+    if dt < t / 2:
         return min(lvl_cap * 2, _SLICE_MAX)
-    if dt > _SLICE_TARGET_S * 2:
+    if dt > t * 2:
         return max(lvl_cap // 2, 8)
     return lvl_cap
 
@@ -1381,11 +1383,20 @@ def _run_kernel(esp: EncodedSearch, es: EncodedSearch, model: ModelSpec,
             first = True  # next slice includes a compile
             continue
         if not first:
-            lvl_cap = _adapt_lvl_cap(lvl_cap, dt)
+            # shorter slices while WIDE: the downshift check runs only
+            # between slices, so a full-length slice at F=2048 would run
+            # hundreds of post-burst narrow levels at 8x their cost
+            # before the width could settle back down
+            lvl_cap = _adapt_lvl_cap(
+                lvl_cap, dt,
+                target_s=(_SLICE_TARGET_S if F <= 512
+                          else _SLICE_TARGET_S / 4))
         first = False
         if not ovf and count > 0:
             # 4x headroom over the live width, with hysteresis: only
-            # downshift after TWO consecutive slices fit the lower rung.
+            # downshift after TWO consecutive slices fit the lower rung
+            # (A/B'd against one-slice hysteresis: the register tier
+            # thrashed 2x; see docs/perf-notes.md round 4).
             # A transient valley between wide bursts would otherwise
             # bounce the width (each bounce = a bailed slice + re-run
             # levels), which costs more than it saves — the register

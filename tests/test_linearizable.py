@@ -189,7 +189,7 @@ def test_slicing_equivalence(monkeypatch):
     """Tiny slices (1 level per device call) must give the same verdict
     as big ones — the slice boundary is invisible to the search."""
     monkeypatch.setattr(lin, "_SLICE_LEVELS0", 1)
-    monkeypatch.setattr(lin, "_adapt_lvl_cap", lambda cap, dt: cap)
+    monkeypatch.setattr(lin, "_adapt_lvl_cap", lambda cap, dt, **kw: cap)
     rng = random.Random(77)
     h = corrupt(rng, random_register_history(rng, n_procs=4, n_ops=40))
     model = cas_register()
@@ -206,7 +206,7 @@ def test_checkpoint_resume(tmp_path, monkeypatch):
     """Stop a search mid-flight, persist the carry, resume in a 'new'
     driver, and get the same verdict as an uninterrupted run."""
     monkeypatch.setattr(lin, "_SLICE_LEVELS0", 2)
-    monkeypatch.setattr(lin, "_adapt_lvl_cap", lambda cap, dt: cap)
+    monkeypatch.setattr(lin, "_adapt_lvl_cap", lambda cap, dt, **kw: cap)
     rng = random.Random(78)
     h = corrupt(rng, random_register_history(rng, n_procs=4, n_ops=40))
     model = cas_register()
@@ -258,7 +258,7 @@ def test_escalation_resumes_not_restarts(seed, monkeypatch):
     must widen and RESUME from the pre-overflow carry, producing the
     oracle's verdict."""
     monkeypatch.setattr(lin, "_SLICE_LEVELS0", 4)
-    monkeypatch.setattr(lin, "_adapt_lvl_cap", lambda cap, dt: cap)
+    monkeypatch.setattr(lin, "_adapt_lvl_cap", lambda cap, dt, **kw: cap)
     rng = random.Random(4000 + seed)
     h = corrupt(rng, random_register_history(rng, n_procs=4, n_ops=40))
     model = cas_register()

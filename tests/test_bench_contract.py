@@ -1,0 +1,64 @@
+"""Bench honesty contracts (VERDICT r3 weak #3 / item 6).
+
+The benchmark's labels must not overstate the verified work: a tier
+named "1k" must carry EXACTLY 1000 encoded ops, and the per-core batch
+accounting must bill only workers that actually ran.
+"""
+
+import os
+import sys
+
+import pytest
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+import bench  # noqa: E402
+
+
+@pytest.mark.parametrize("name,nominal", [("1k", 1_000), ("10k", 10_000)])
+def test_register_tiers_encode_to_nominal(name, nominal):
+    seq, _model = bench.make_seq(name)
+    assert len(seq) == nominal
+
+
+def test_mutex_tier_close_to_nominal():
+    # the mutex generator's acquire-chain suffix makes exact hits rare;
+    # the scan must land within 0.2% (the emitted metric string always
+    # carries the actual count either way)
+    seq, _model = bench.make_seq("mutex2k")
+    assert abs(len(seq) - 2_000) <= 4
+
+
+def test_tier_history_deterministic_across_processes():
+    # children rebuild the identical history from the resolved nominal
+    # (shared via BENCH_NOMINAL_* env)
+    import numpy as np
+
+    s1, _ = bench.make_seq("1k")
+    bench._SEQ_CACHE.clear()
+    s2, _ = bench.make_seq("1k")
+    assert np.array_equal(s1.f, s2.f) and np.array_equal(s1.inv, s2.inv)
+
+
+def test_batch_stats_per_core_math():
+    res = {"n_keys": 256, "t_first": 9.9}
+    host = {"batch256": {"host_pool": {
+        "keys_done": 128, "n_keys": 256, "seconds": 4.0,
+        "configs": 1, "n_procs": 2}}}
+    s = bench.batch_stats(res, host, t_dev=2.0)
+    # pool: 128 keys / 4s = 32 keys/s on 2 procs -> 16 keys/s/core
+    assert s["host_pool_keys_per_sec"] == 32.0
+    assert s["host_pool_keys_per_sec_per_core"] == 16.0
+    # full pool time extrapolates to 8s for all 256 keys
+    assert s["speedup_vs_host_pool"] == 4.0
+    # device: 128 keys/s vs 16/core
+    assert s["speedup_vs_host_pool_per_core"] == 8.0
+    # 16-core extrapolation: 256/(16*16) = 1s vs 2s device
+    assert s["vs_baseline"] == 0.5
+    assert "EXTRAPOLATED" in s["vs_baseline_basis"]
+
+
+def test_batch_stats_no_pool():
+    s = bench.batch_stats({"n_keys": 4, "t_first": 1.0}, {}, t_dev=1.0)
+    assert s["vs_baseline"] is None

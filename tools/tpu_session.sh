@@ -31,7 +31,12 @@ if [ $rc -ne 0 ]; then
 fi
 
 echo "== tpubench (microbenchmarks)"
-timeout 900 python tools/tpubench.py --widths 1024,4096,16384 \
+# widths cover the round-4 policy range: narrow rungs (16-512, where
+# dominance-pruned searches live), the downshift threshold, and the
+# r2 width-cliff region (1024 fast / 8192 slow).  Highest-value widths
+# FIRST so a timeout truncates the least interesting rows; timeout
+# raised for the doubled compile count on a cold cache.
+timeout 1500 python tools/tpubench.py --widths 8192,1024,16,64,256,4096 \
   --levels 64 --repeat 5 2>"$OUT/tpubench.err" | tee "$OUT/tpubench.jsonl"
 
 echo "== full bench (unpinned)"

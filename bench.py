@@ -74,6 +74,11 @@ TIERS = [("1k", 1_000, 32, 5_000_000, False, 90.0),
          ("batch256", 128, 8, 2_000_000, False, 120.0)]
 
 _BEST: dict | None = None
+#: priority of the tier behind _BEST: (headline-tier?, n_ops) — lets a
+#: BENCH_TIER_ORDER subset without the 10k tier still emit its best
+#: completed tier as the headline instead of the error payload
+_BEST_PRIO: tuple = (-1, -1)
+_BEST_TIER: str | None = None
 _EXTRA: dict = {}
 _EMITTED = False
 _PROBE: "subprocess.Popen | None" = None
@@ -245,20 +250,29 @@ def _emit():
     print(json.dumps(result), flush=True)
 
 
+def _kill_proc(proc) -> None:
+    """Kill (if alive) and release a probe/child Popen: stderr log
+    handle and stdout pipe both close, so probe restarts don't leak
+    fds across a long bench."""
+    if proc is None:
+        return
+    if proc.poll() is None:
+        try:
+            proc.kill()
+            proc.wait(timeout=5)
+        except Exception:
+            pass
+    for f in (getattr(proc, "_errf", None), proc.stdout):
+        if f is not None:
+            try:
+                f.close()
+            except Exception:
+                pass
+
+
 def _reap_procs():
     for proc in (_PROBE, _CHILD):
-        if proc is not None and proc.poll() is None:
-            try:
-                proc.kill()
-                proc.wait(timeout=5)
-            except Exception:
-                pass
-        errf = getattr(proc, "_errf", None)
-        if errf is not None:
-            try:
-                errf.close()
-            except Exception:
-                pass
+        _kill_proc(proc)
 
 
 def _bail(why: str):
@@ -640,12 +654,24 @@ def host_comparators(tiers) -> dict:
 
 
 def main():
-    global _BEST, _PROBE
+    global _BEST, _BEST_PRIO, _BEST_TIER, _PROBE
 
     _install_guards()
     probe = _PROBE = start_probe()
 
     tiers = TIERS[:1] if QUICK else TIERS
+    # BENCH_TIER_ORDER: comma-separated tier names — reorder/subset the
+    # ladder.  Lets a brief accelerator window be spent on the cheap
+    # tiers first (a wedged-mid-run tunnel was observed r4), or on one
+    # tier alone; unknown names are ignored.
+    order = os.environ.get("BENCH_TIER_ORDER")
+    if order and not QUICK:
+        by_name = {t[0]: t for t in TIERS}
+        picked = [by_name[n] for n in
+                  (s.strip() for s in order.split(",")) if n in by_name]
+        if picked:
+            tiers = picked
+            _EXTRA["tier_order"] = [t[0] for t in picked]
 
     host = host_comparators(tiers)
     cores = host.get("host_cpus", 1)
@@ -666,21 +692,58 @@ def main():
         print(f"bench: backend '{platform}' is up "
               f"({time.time()-T0:.0f}s in)", file=sys.stderr)
 
+    probe_restarts = 0
+    # the restart clock measures silence BEYOND the initial probe
+    # window — a cold tunnel gets PROBE_S + BENCH_PROBE_RESTART_S of
+    # undisturbed warming before its first restart (the keep_alive
+    # design must survive the restart logic)
+    t_probe_start = time.time()
+
     def late_probe_check():
         """Re-check the still-warming probe (called between tiers): a
         cold tunnel can come up mid-ladder, and every remaining tier
-        should then run on the accelerator, not just the headline."""
-        nonlocal force_cpu, platform
-        if not force_cpu or probe.poll() is None:
+        should then run on the accelerator, not just the headline.
+
+        A probe child whose first backend touch HUNG (tunnel wedged
+        mid-session — observed r4: device calls block forever, outliving
+        the client that issued them) will never exit, so polling it
+        forever detects nothing even after the tunnel recovers.  After
+        ``BENCH_PROBE_RESTART_S`` of silence the stuck child is killed
+        and a FRESH probe starts: a recovered tunnel answers a fresh
+        first-touch in seconds."""
+        nonlocal force_cpu, platform, probe_restarts, t_probe_start
+        global _PROBE
+        if not force_cpu:
+            return
+        probe = _PROBE
+        if probe.poll() is None:
+            restart_s = float(os.environ.get("BENCH_PROBE_RESTART_S",
+                                             "240"))
+            if (time.time() - t_probe_start > restart_s
+                    and _remaining() > 90):
+                _kill_proc(probe)
+                probe_restarts += 1
+                t_probe_start = time.time()
+                _PROBE = start_probe()
+                print(f"bench: probe hung >{restart_s:.0f}s; restarted "
+                      f"(attempt {probe_restarts + 1})", file=sys.stderr)
             return
         late = finish_probe(probe, 1.0) if probe.returncode == 0 else None
         _EXTRA["probe"] = probe_diag(probe, late, time.time() - t_probe0)
+        _EXTRA["probe"]["restarts"] = probe_restarts
         if late and late != "cpu":
             print(f"bench: accelerator '{late}' came up late "
                   f"({time.time()-T0:.0f}s in); unpinning remaining "
                   "tiers", file=sys.stderr)
             force_cpu = False
             platform = late
+        elif probe.returncode is not None and _remaining() > 90:
+            # probe child exited uselessly (crash or cpu-only report):
+            # keep trying — the tunnel may open later in the budget
+            _kill_proc(probe)
+            probe_restarts += 1
+            t_probe_start = time.time()
+            _PROBE = start_probe()
 
     def tier_headline(name, n_ops, n_procs, res, t_dev, comp):
         """Build the headline dict for a decided single-history tier."""
@@ -713,16 +776,17 @@ def main():
                 "speed the winning leg; measured >=8-core portfolio "
                 f"unavailable on this {cores}-cpu host")
         backend = res["backend"]
+        wl = "mutex" if name.startswith("mutex") else "CAS-register"
         if decided:
             metric = (f"ops-verified/sec, {res['n_ops']}-op "
-                      f"{n_procs}-proc CAS-register history, decided "
+                      f"{n_procs}-proc {wl} history, decided "
                       f"verdict ({'valid' if res['valid'] else 'invalid'}"
                       f"), {backend} backend")
             value = round(res["n_ops"] / t_dev, 1)
             unit = "ops/s"
         else:
             metric = (f"configurations-explored/sec, {res['n_ops']}-op "
-                      f"{n_procs}-proc CAS-register history "
+                      f"{n_procs}-proc {wl} history "
                       f"(UNDECIDED within deadline), {backend} backend")
             value = round(res.get("rate") or 0.0, 1)
             unit = "configs/s"
@@ -797,9 +861,10 @@ def main():
         if name == "batch256":
             _EXTRA["batch256"] = batch_detail(res, host, t_dev)
             if _BEST is None:
-                # only the batch tier completed: better a batch headline
-                # than the 'no tier completed' error payload
+                # only the batch tier completed (so far): better a batch
+                # headline than the 'no tier completed' error payload
                 _BEST = batch_headline(res, host, t_dev)
+                _BEST_PRIO, _BEST_TIER = (0, 0), name
             continue
         comp = host.get(name) or {}
         tier_detail = tier_headline(name, n_ops, n_procs, res, t_dev,
@@ -808,8 +873,14 @@ def main():
         hl = (comp.get("host_linear") or {}).get("valid")
         if res["valid"] in (True, False) and hl in (True, False):
             agree = res["valid"] == hl
-        if headline or QUICK:  # quick mode: its only tier IS the headline
-            _BEST = tier_headline(name, n_ops, n_procs, res, t_dev, comp)
+        prio = (1 if (headline or QUICK) else 0, n_ops)
+        if prio > _BEST_PRIO:
+            # the largest completed register tier is the headline when
+            # the designated headline tier never runs (quick mode,
+            # BENCH_TIER_ORDER subsets, budget exhaustion)
+            _BEST = tier_detail
+            _BEST_PRIO, _BEST_TIER = prio, name
+        if headline or QUICK:
             # the headline already carries the full detail; avoid a
             # duplicate copy in the extras
             _EXTRA[f"tier_{name}"] = {"host_agrees": agree,
@@ -844,14 +915,15 @@ def main():
             t_dev = res["t_dev"]
             if name == "batch256":
                 _EXTRA["batch256"] = batch_detail(res, host, t_dev)
-                if _BEST is not None and _BEST.get("unit") == "keys/s":
+                if _BEST_TIER == name:
                     _BEST = batch_headline(res, host, t_dev)
                 continue
             promoted = tier_headline(name, n_ops, n_procs, res, t_dev,
                                      host.get(name) or {})
-            if headline or QUICK:
+            if headline or QUICK or _BEST_TIER == name:
                 cpu_best = _BEST
                 _BEST = promoted
+                _BEST_TIER = name
                 _BEST["detail"]["cpu_fallback_headline"] = (
                     {k: cpu_best[k] for k in
                      ("metric", "value", "vs_baseline")}

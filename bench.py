@@ -693,6 +693,7 @@ def main():
               f"({time.time()-T0:.0f}s in)", file=sys.stderr)
 
     probe_restarts = 0
+    cpu_only = False  # sticky: a probe reported plain CPU (no plugin)
     # the restart clock measures silence BEYOND the initial probe
     # window — a cold tunnel gets PROBE_S + BENCH_PROBE_RESTART_S of
     # undisturbed warming before its first restart (the keep_alive
@@ -712,8 +713,9 @@ def main():
         and a FRESH probe starts: a recovered tunnel answers a fresh
         first-touch in seconds."""
         nonlocal force_cpu, platform, probe_restarts, t_probe_start
+        nonlocal cpu_only
         global _PROBE
-        if not force_cpu:
+        if not force_cpu or cpu_only:
             return
         probe = _PROBE
         if probe.poll() is None:
@@ -725,6 +727,12 @@ def main():
                 probe_restarts += 1
                 t_probe_start = time.time()
                 _PROBE = start_probe()
+                # the emitted diag must record the restart history even
+                # if the final probe is still hung at emit time (the
+                # whole-run-wedged case is the one this exists for)
+                _EXTRA["probe"] = probe_diag(_PROBE, None,
+                                             time.time() - t_probe0)
+                _EXTRA["probe"]["restarts"] = probe_restarts
                 print(f"bench: probe hung >{restart_s:.0f}s; restarted "
                       f"(attempt {probe_restarts + 1})", file=sys.stderr)
             return
@@ -737,9 +745,15 @@ def main():
                   "tiers", file=sys.stderr)
             force_cpu = False
             platform = late
+        elif late == "cpu":
+            # the probe reached a backend and it is plain CPU: no
+            # accelerator plugin exists on this host, so further
+            # restarts can never change the outcome (and would add
+            # measurement noise next to the running tiers)
+            cpu_only = True
         elif probe.returncode is not None and _remaining() > 90:
-            # probe child exited uselessly (crash or cpu-only report):
-            # keep trying — the tunnel may open later in the budget
+            # probe child crashed (tunnel flake): keep trying — it may
+            # open later in the budget
             _kill_proc(probe)
             probe_restarts += 1
             t_probe_start = time.time()

@@ -36,6 +36,11 @@ PY
       BENCH_PROBE_S=90 BENCH_HOST_S=60 BENCH_BUDGET_S=900 \
       timeout 960 python bench.py \
       > "$OUT/bench_tpu_$stamp.json" 2> "$OUT/bench_tpu_$stamp.err"
+    # while the tunnel is (maybe still) hot: the width-sweep microbench
+    # table with honest levels_run accounting (VERDICT r3 item 3)
+    timeout 900 python tools/tpubench.py \
+      --widths 16,64,256,1024,4096,8192 --levels 64 --repeat 5 \
+      > "$OUT/tpubench_$stamp.jsonl" 2>> "$OUT/bench_tpu_$stamp.err"
     if python - "$OUT/bench_tpu_$stamp.json" <<'PY'
 import json, sys
 try:

@@ -14,6 +14,10 @@ cd "$(dirname "$0")/.."
 
 OUT=${1:-/tmp/tpu_session}
 mkdir -p "$OUT"
+# persistent XLA compile cache for tpubench.py and the probe (which
+# set no cache dir of their own); bench.py's tier children pin the
+# same directory in-process
+export JAX_COMPILATION_CACHE_DIR="$PWD/.jax_cache"
 
 echo "== probe"
 timeout 600 python - <<'PY' | tee "$OUT/probe.json"

@@ -2,21 +2,27 @@
 # Tunnel watcher — the axon tunnel has been observed to open for brief
 # windows (~5 min, r4: up 00:59-01:04 then wedged), so waiting for a
 # human-scheduled session loses them.  This loop probes with a short
-# timeout; the moment the tunnel answers it runs the full bench
-# UNPINNED, cheap tiers first, so even a short window banks TPU-backed
-# artifacts (and populates .jax_cache so the next window — or the
-# driver's end-of-round run — skips the compiles).
+# timeout; the moment the tunnel answers it spends the window on the
+# highest-value missing artifact:
+#
+#   window 1: the full bench, unpinned, cheap tiers first  -> bench_tpu_*.json
+#   window 2: the width-sweep microbench                   -> tpubench_*.jsonl
+#   then exits.
 #
 #   nohup tools/tpu_watch.sh [outdir] &
 #
 # Artifacts land in outdir (default docs/tpu/r4 — inside the repo, so
-# the end-of-round commit picks them up).  Exits after a bench whose
-# headline ran on the TPU; otherwise keeps watching.
+# the end-of-round commit picks them up).
 set -uo pipefail
 cd "$(dirname "$0")/.."
 
 OUT=${1:-docs/tpu/r4}
 mkdir -p "$OUT"
+# persistent XLA compile cache: bench.py's children pin the same dir
+# in-process; this export covers tpubench.py and the probe below,
+# which set no cache dir of their own
+export JAX_COMPILATION_CACHE_DIR="$PWD/.jax_cache"
+
 n=0
 while true; do
   n=$((n + 1))
@@ -30,18 +36,14 @@ PY
 )
   if [ "$up" = "tpu" ]; then
     stamp=$(date -u +%H%M%S)
-    echo "$(date -u +%FT%TZ) tunnel UP (probe $n); bench -> bench_tpu_$stamp" \
-      >> "$OUT/watch.log"
-    BENCH_TIER_ORDER=1k,batch256,mutex2k,10k \
-      BENCH_PROBE_S=90 BENCH_HOST_S=60 BENCH_BUDGET_S=900 \
-      timeout 960 python bench.py \
-      > "$OUT/bench_tpu_$stamp.json" 2> "$OUT/bench_tpu_$stamp.err"
-    # while the tunnel is (maybe still) hot: the width-sweep microbench
-    # table with honest levels_run accounting (VERDICT r3 item 3)
-    timeout 900 python tools/tpubench.py \
-      --widths 16,64,256,1024,4096,8192 --levels 64 --repeat 5 \
-      > "$OUT/tpubench_$stamp.jsonl" 2>> "$OUT/bench_tpu_$stamp.err"
-    if python - "$OUT/bench_tpu_$stamp.json" <<'PY'
+    if [ ! -f "$OUT/.bench_done" ]; then
+      echo "$(date -u +%FT%TZ) tunnel UP (probe $n); bench -> bench_tpu_$stamp" \
+        >> "$OUT/watch.log"
+      BENCH_TIER_ORDER=1k,batch256,mutex2k,10k \
+        BENCH_PROBE_S=90 BENCH_HOST_S=60 BENCH_BUDGET_S=900 \
+        timeout 960 python bench.py \
+        > "$OUT/bench_tpu_$stamp.json" 2> "$OUT/bench_tpu_$stamp.err"
+      if python - "$OUT/bench_tpu_$stamp.json" <<'PY'
 import json, sys
 try:
     b = json.load(open(sys.argv[1]))
@@ -50,13 +52,33 @@ except Exception:
     ok = False
 sys.exit(0 if ok else 1)
 PY
-    then
-      echo "$(date -u +%FT%TZ) tpu-backed headline captured; exiting" \
+      then
+        touch "$OUT/.bench_done"
+        echo "$(date -u +%FT%TZ) tpu-backed headline captured" >> "$OUT/watch.log"
+      else
+        echo "$(date -u +%FT%TZ) bench finished without a tpu headline" \
+          >> "$OUT/watch.log"
+      fi
+    elif [ ! -f "$OUT/.sweep_done" ]; then
+      # highest-value widths FIRST so a truncated sweep drops the least
+      # interesting rows (the F=8192 row is the r4 artifact to recapture)
+      echo "$(date -u +%FT%TZ) tunnel UP (probe $n); sweep -> tpubench_$stamp" \
         >> "$OUT/watch.log"
+      timeout 1500 python tools/tpubench.py \
+        --widths 8192,1024,16,64,256,4096 --levels 64 --repeat 5 \
+        > "$OUT/tpubench_$stamp.jsonl" 2> "$OUT/tpubench_$stamp.err"
+      if grep -q '"op": "kernel' "$OUT/tpubench_$stamp.jsonl" \
+         && head -1 "$OUT/tpubench_$stamp.jsonl" | grep -q '"backend": "tpu"'; then
+        touch "$OUT/.sweep_done"
+        echo "$(date -u +%FT%TZ) tpu width sweep captured; exiting" \
+          >> "$OUT/watch.log"
+        exit 0
+      fi
+      echo "$(date -u +%FT%TZ) sweep incomplete; resuming watch" \
+        >> "$OUT/watch.log"
+    else
       exit 0
     fi
-    echo "$(date -u +%FT%TZ) bench finished without a tpu headline; resuming watch" \
-      >> "$OUT/watch.log"
   else
     echo "$(date -u +%FT%TZ) tunnel down (probe $n)" >> "$OUT/watch.log"
   fi

@@ -1283,15 +1283,51 @@ BATCH_FRONTIER_CAP = 512
 MAX_FRONTIER = 1 << 18
 
 
-def _grid_width(f: int) -> int:
-    """Snap up to the power-of-two width grid, clamped to MAX_FRONTIER.
+_WIDTH_FLOOR: int | None = None
 
-    Floor 16, not 64: near-deterministic histories (a mutex under low
+
+def _width_floor() -> int:
+    """Narrowest frontier rung, decided per backend (lazily — the
+    backend may be pinned after import).
+
+    CPU floor 16: near-deterministic histories (a mutex under low
     contention holds ONE live config for thousands of levels) ride the
     narrow rungs, where per-level cost tracks the frontier actually
-    alive — at a floor of 64 such searches paid 64 lanes for 1 live row
-    every level."""
-    w = 16
+    alive — at a floor of 64 such searches paid 64 lanes for 1 live
+    row every level.  TPU floor 64: measured on-chip per-level cost is
+    flat below F~64 (0.55 ms @ F=16 vs 0.67 ms @ F=64,
+    docs/tpu/r4/tpubench.jsonl) — the VPU pads tiny shapes to its lane
+    count anyway — while every extra rung visited costs an escalation
+    bail and a 10-40 s kernel compile in a tunnel window."""
+    global _WIDTH_FLOOR
+    if _WIDTH_FLOOR is not None:
+        return _WIDTH_FLOOR
+    want = 0
+    env = os.environ.get("JEPSEN_TPU_WIDTH_FLOOR")
+    if env:
+        try:
+            want = max(8, min(int(env), MAX_FRONTIER))
+        except ValueError:
+            want = 0  # unparsable override: fall back to the backend
+    if not want:
+        try:
+            backend = jax.default_backend()
+        except Exception:  # noqa: BLE001 — no backend: assume host
+            backend = "cpu"
+        want = 64 if backend == "tpu" else 16
+    # snap onto the power-of-two grid (and under MAX_FRONTIER) so
+    # differently-sized histories keep sharing compiled kernels
+    w = 8
+    while w < want:
+        w *= 2
+    _WIDTH_FLOOR = min(w, MAX_FRONTIER)
+    return _WIDTH_FLOOR
+
+
+def _grid_width(f: int) -> int:
+    """Snap up to the power-of-two width grid, clamped to MAX_FRONTIER
+    and floored per backend (see :func:`_width_floor`)."""
+    w = _width_floor()
     while w < f and w < MAX_FRONTIER:
         w *= 2
     return w

@@ -11,6 +11,8 @@ crash-heavy variants that are frequently invalid.
 
 import random
 
+import jax
+
 import pytest
 
 from jepsen_tpu.history import (
@@ -483,3 +485,20 @@ def test_fifo_rejects_out_of_order_service():
     assert lin.search_opseq(s_u, uq)["valid"] is True
     assert oracle.check_opseq(s_f, fifo)["valid"] is False
     assert lin.search_opseq(s_f, fifo)["valid"] is False
+
+
+def test_width_floor_backend_policy(monkeypatch):
+    """The narrowest rung is backend-dependent: 16 on CPU (narrow
+    valleys are cheap there), 64 on TPU (on-chip per-level cost is
+    flat below F~64 while every rung costs a compile — see
+    docs/tpu/r4/tpubench.jsonl), env-overridable either way."""
+    monkeypatch.setattr(lin, "_WIDTH_FLOOR", None)
+    monkeypatch.delenv("JEPSEN_TPU_WIDTH_FLOOR", raising=False)
+    assert lin._width_floor() == (
+        64 if jax.default_backend() == "tpu" else 16)
+    monkeypatch.setattr(lin, "_WIDTH_FLOOR", None)
+    monkeypatch.setenv("JEPSEN_TPU_WIDTH_FLOOR", "128")
+    assert lin._grid_width(1) == 128
+    assert lin._grid_width(129) == 256
+    # reset so later tests see the real policy
+    monkeypatch.setattr(lin, "_WIDTH_FLOOR", None)

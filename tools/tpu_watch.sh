@@ -23,6 +23,14 @@ mkdir -p "$OUT"
 # which set no cache dir of their own
 export JAX_COMPILATION_CACHE_DIR="$PWD/.jax_cache"
 
+# nothing left to collect: exit immediately (a restarted watcher must
+# not probe forever after both artifacts are banked)
+if [ -f "$OUT/.bench_done" ] && [ -f "$OUT/.sweep_done" ]; then
+  echo "$(date -u +%FT%TZ) both artifacts already banked; exiting" \
+    >> "$OUT/watch.log"
+  exit 0
+fi
+
 n=0
 while true; do
   n=$((n + 1))
@@ -67,7 +75,9 @@ PY
       timeout 1500 python tools/tpubench.py \
         --widths 8192,1024,16,64,256,4096 --levels 64 --repeat 5 \
         > "$OUT/tpubench_$stamp.jsonl" 2> "$OUT/tpubench_$stamp.err"
-      if grep -q '"op": "kernel' "$OUT/tpubench_$stamp.jsonl" \
+      # complete = all 6 widths produced their kernel row on the TPU
+      # (a timeout-truncated sweep must be retried in a later window)
+      if [ "$(grep -c '"op": "kernel' "$OUT/tpubench_$stamp.jsonl")" -ge 6 ] \
          && head -1 "$OUT/tpubench_$stamp.jsonl" | grep -q '"backend": "tpu"'; then
         touch "$OUT/.sweep_done"
         echo "$(date -u +%FT%TZ) tpu width sweep captured; exiting" \

@@ -1306,9 +1306,12 @@ def _width_floor() -> int:
     env = os.environ.get("JEPSEN_TPU_WIDTH_FLOOR")
     if env:
         try:
-            want = max(8, min(int(env), MAX_FRONTIER))
+            v = int(env)
         except ValueError:
-            want = 0  # unparsable override: fall back to the backend
+            v = 0  # unparsable override: fall back to the backend
+        # values below the 8-row minimum (incl. 0) also fall back —
+        # "0" must mean "no override", not "narrowest possible"
+        want = min(v, MAX_FRONTIER) if v >= 8 else 0
     if not want:
         try:
             backend = jax.default_backend()

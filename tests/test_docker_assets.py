@@ -1,0 +1,85 @@
+"""Structural validation of the Tier-3 docker harness assets.
+
+No docker daemon exists in any round's build image, so `docker/` can
+never be EXECUTED here (docker/smoke.sh runs on any docker host); these
+tests keep the assets from bit-rotting invisibly in the meantime —
+the compose topology, the sshd node image, and the smoke script's
+step contract are all asserted against the files (the reference's
+harness shape: docker/README.md, jepsen-control + n1..n5).
+"""
+
+import os
+import re
+import stat
+import subprocess
+
+import pytest
+
+DOCKER = os.path.join(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))), "docker")
+
+
+def read(*parts: str) -> str:
+    with open(os.path.join(DOCKER, *parts)) as f:
+        return f.read()
+
+
+def test_compose_topology():
+    """control + n1..n5, nodes privileged (nemesis needs iptables/tc),
+    repo mounted read-only into the control container."""
+    yml = read("docker-compose.yml")
+    services = re.findall(r"^  (\w+):", yml, re.M)
+    assert "control" in services
+    assert [f"n{i}" for i in range(1, 6)] == \
+        [s for s in services if re.fullmatch(r"n\d", s)]
+    assert yml.count("build: ./node") == 5
+    assert yml.count("privileged: true") >= 6
+    assert "/jepsen_tpu:ro" in yml
+    # control waits for every node
+    dep = re.search(r"depends_on: \[([^\]]+)\]", yml)
+    assert dep and {s.strip() for s in dep.group(1).split(",")} == \
+        {f"n{i}" for i in range(1, 6)}
+
+
+def test_node_image_runs_sshd():
+    """Each db node is an sshd container the control node can exec
+    into — the whole point of the harness (SSHRemote's real path)."""
+    df = read("node", "Dockerfile")
+    assert "openssh-server" in df
+    assert re.search(r'CMD.*sshd.*-D', df)
+    # net-manipulation tooling the nemesis path needs (start-stop-daemon
+    # ships in the debian base image; no install line to assert)
+    for pkg in ("iptables", "iproute2"):
+        assert pkg in df, f"node image lost {pkg}"
+
+
+def test_control_image_has_framework_deps():
+    df = read("control", "Dockerfile")
+    assert "openssh-client" in df
+    # the harness itself is volume-mounted, not baked, so the image must
+    # carry python (base image or installed package)
+    assert re.search(r"FROM python|python3", df)
+    assert "PYTHONPATH=/jepsen_tpu" in df
+
+
+def test_smoke_script_contract():
+    """smoke.sh is executable, bash-clean, and runs both the atomdemo
+    (in-process) and etcdemo (over-SSH) legs, plus the localnode tier
+    folded in per VERDICT r3 item 8."""
+    path = os.path.join(DOCKER, "smoke.sh")
+    assert os.stat(path).st_mode & stat.S_IXUSR
+    subprocess.run(["bash", "-n", path], check=True)
+    sh = read("smoke.sh")
+    for leg in ("atomdemo", "etcdemo", "localnode", "results.json"):
+        assert leg in sh, f"smoke.sh lost its {leg} leg"
+
+
+def test_up_script_is_clean():
+    subprocess.run(["bash", "-n", os.path.join(DOCKER, "up.sh")],
+                   check=True)
+
+
+@pytest.mark.skipif(True, reason="no docker daemon in the build image; "
+                    "run docker/smoke.sh on a docker host")
+def test_smoke_executed():  # pragma: no cover — documentation marker
+    raise AssertionError("unreachable")

@@ -72,12 +72,14 @@ PY
       # interesting rows (the F=8192 row is the r4 artifact to recapture)
       echo "$(date -u +%FT%TZ) tunnel UP (probe $n); sweep -> tpubench_$stamp" \
         >> "$OUT/watch.log"
+      WIDTHS=8192,1024,16,64,256,4096
+      NW=$(echo "$WIDTHS" | tr ',' '\n' | wc -l)
       timeout 1500 python tools/tpubench.py \
-        --widths 8192,1024,16,64,256,4096 --levels 64 --repeat 5 \
+        --widths "$WIDTHS" --levels 64 --repeat 5 \
         > "$OUT/tpubench_$stamp.jsonl" 2> "$OUT/tpubench_$stamp.err"
-      # complete = all 6 widths produced their kernel row on the TPU
+      # complete = every width produced its kernel row on the TPU
       # (a timeout-truncated sweep must be retried in a later window)
-      if [ "$(grep -c '"op": "kernel' "$OUT/tpubench_$stamp.jsonl")" -ge 6 ] \
+      if [ "$(grep -c '"op": "kernel' "$OUT/tpubench_$stamp.jsonl")" -ge "$NW" ] \
          && head -1 "$OUT/tpubench_$stamp.jsonl" | grep -q '"backend": "tpu"'; then
         touch "$OUT/.sweep_done"
         echo "$(date -u +%FT%TZ) tpu width sweep captured; exiting" \

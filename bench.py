@@ -68,10 +68,13 @@ HOST_S = float(os.environ.get("BENCH_HOST_S", "60" if QUICK else "240"))
 #: (name, n_ops, n_procs, device config budget, headline, tier deadline s)
 #: the 10k deadline covers a cold-cache CPU-fallback decide (~250s search
 #: + compiles); on a warm TPU it finishes far earlier
+#: batch256 runs BEFORE the 10k headline: the 10k is the longest search
+#: and the one observed to wedge an open tunnel mid-run (r4) — a wedge
+#: there must not cost the batch tier its only accelerator window
 TIERS = [("1k", 1_000, 32, 5_000_000, False, 90.0),
          ("mutex2k", 2_000, 16, 30_000_000, False, 90.0),
-         ("10k", 10_000, 32, 100_000_000, True, 420.0),
-         ("batch256", 128, 8, 2_000_000, False, 120.0)]
+         ("batch256", 128, 8, 2_000_000, False, 120.0),
+         ("10k", 10_000, 32, 100_000_000, True, 420.0)]
 
 _BEST: dict | None = None
 #: priority of the tier behind _BEST: (headline-tier?, n_ops) — lets a
@@ -435,17 +438,85 @@ def run_tier_child(name: str, budget: int) -> None:
 
     slices: list[tuple[float, int]] = []  # (wall time, cumulative configs)
 
-    def on_slice(carry, dims):
-        slices.append((time.perf_counter(), int(carry[3])))
+    # cross-run checkpointing: a wedged-tunnel kill (observed r4 — the
+    # 10k child died at 950s with every explored config lost) must not
+    # restart the search from scratch.  Every slice persists the carry;
+    # the next child — same tier on the next tunnel window, or the
+    # pinned-CPU retry — resumes it, and the reported timing carries an
+    # honest "resumed" flag plus the cumulative elapsed seconds.
+    # BENCH_CKPT_DIR= (empty) disables.
+    ckpt_dir = os.environ.get("BENCH_CKPT_DIR",
+                              os.path.join(REPO, ".bench_ckpt"))
+    ckpt = os.path.join(ckpt_dir, f"{name}.npz") if ckpt_dir else None
+    prior_elapsed = 0.0
+    prior_slices = 0
+    resumed = False
+    prior_backends: set = set()
+    if ckpt:
+        os.makedirs(ckpt_dir, exist_ok=True)
+        try:
+            with open(ckpt + ".meta.json") as f:
+                m = json.load(f)
+            prior_elapsed = float(m.get("elapsed", 0.0))
+            prior_slices = int(m.get("slices", 0))
+            prior_backends = set(m.get("backends", []))
+        except (OSError, ValueError):
+            pass
 
     t0 = time.perf_counter()
-    out = lin.search_opseq(seq, model, budget=budget,
-                           deadline=t0 + tier_deadline, on_slice=on_slice)
+    backend_now = jax.default_backend()
+
+    def on_slice(carry, dims):
+        slices.append((time.perf_counter(), int(carry[3])))
+        if ckpt:
+            lin.save_checkpoint(ckpt + ".tmp.npz", carry, dims, model,
+                                budget, seq=seq)
+            os.replace(ckpt + ".tmp.npz", ckpt)
+            tmp = ckpt + ".meta.tmp"
+            with open(tmp, "w") as f:
+                json.dump({"elapsed": prior_elapsed
+                           + (time.perf_counter() - t0),
+                           "slices": prior_slices + len(slices),
+                           "backends": sorted(prior_backends
+                                              | {backend_now})}, f)
+            os.replace(tmp, ckpt + ".meta.json")
+
+    out = None
+    if ckpt and os.path.exists(ckpt):
+        try:
+            out = lin.resume_opseq(seq, model, ckpt, on_slice=on_slice,
+                                   deadline=t0 + tier_deadline)
+            resumed = True
+        except Exception as e:  # noqa: BLE001 — stale/foreign checkpoint
+            print(f"bench: checkpoint resume failed ({e!r}); searching "
+                  "fresh", file=sys.stderr)
+            t0 = time.perf_counter()
+    if out is None:
+        out = lin.search_opseq(seq, model, budget=budget,
+                               deadline=t0 + tier_deadline,
+                               on_slice=on_slice)
     t_first = time.perf_counter() - t0
+    if ckpt and out["valid"] in (True, False):
+        # decided: later runs must start fresh, not replay a finished
+        # carry (and the re-time below must not find a checkpoint).
+        # EXCEPT: a CPU fallback deciding a search that TPU windows had
+        # been accumulating must not destroy that accumulation — the
+        # on-chip decision is the artifact the checkpoint system exists
+        # to produce; keep the carry so the next tunnel window finishes
+        # it on the TPU (one near-final slice) and deletes it then.
+        if not (backend_now == "cpu" and "tpu" in prior_backends):
+            for p in (ckpt, ckpt + ".meta.json"):
+                try:
+                    os.remove(p)
+                except OSError:
+                    pass
     t_dev = t_first  # compile-inclusive, as a floor
     # re-run compile-free when the first run finished well under the
-    # deadline (then timing measures the kernel, not the compile)
-    if t_first < tier_deadline * 0.6:
+    # deadline (then timing measures the kernel, not the compile).
+    # A RESUMED run never re-times: its fresh re-run could blow the
+    # deadline and replace a decided verdict with an unknown one —
+    # the resumed timing is reported as cumulative instead.
+    if not resumed and t_first < tier_deadline * 0.6:
         t0 = time.perf_counter()
         out = lin.search_opseq(seq, model, budget=budget,
                                deadline=t0 + tier_deadline)
@@ -482,7 +553,12 @@ def run_tier_child(name: str, budget: int) -> None:
             if tot_t > 0 and tot_c > 0:
                 rate = tot_c / tot_t
         if rate is None and t_dev > 0:
-            rate = out["configs"] / t_dev
+            # a resumed carry's configs counter is CUMULATIVE across
+            # contributing runs — divide by the cumulative elapsed, not
+            # this run's tail, or a one-slice resumed run reports the
+            # whole search's work at this run's wall clock
+            rate = out["configs"] / (prior_elapsed + t_dev
+                                     if resumed else t_dev)
     print(json.dumps({
         "configs": out["configs"],
         "max_depth": out.get("max_depth"),
@@ -495,6 +571,8 @@ def run_tier_child(name: str, budget: int) -> None:
         "engine": out.get("engine"),
         "n_ops": len(seq),
         "backend": jax.default_backend(),
+        "resumed": resumed,
+        "elapsed_total": round(prior_elapsed + t_first, 3),
     }), flush=True)
 
 
@@ -673,14 +751,27 @@ def main():
             tiers = picked
             _EXTRA["tier_order"] = [t[0] for t in picked]
 
-    host = host_comparators(tiers)
-    cores = host.get("host_cpus", 1)
-    _EXTRA["host_cpus"] = cores
-
     # --- bring up the backend ------------------------------------------
+    # short early probe FIRST: when the tunnel is already open, every
+    # second belongs to the device tiers (r4: windows lasted ~5-8 min
+    # and 69s of one went to host comparators that need no tunnel).
+    # Host comparators then run AFTER the device ladder, and the tier
+    # headlines are re-recorded against them.
     t_probe0 = time.time()
-    platform = finish_probe(probe, min(PROBE_S, _remaining() - 60),
+    early_s = float(os.environ.get("BENCH_EARLY_PROBE_S", "20"))
+    platform = finish_probe(probe,
+                            min(early_s, max(1.0, _remaining() - 60)),
                             keep_alive=True)
+    defer_host = platform is not None and platform != "cpu"
+    host: dict = {}
+    if not defer_host:
+        host = host_comparators(tiers)
+        if platform is None:
+            platform = finish_probe(probe,
+                                    min(PROBE_S, _remaining() - 60),
+                                    keep_alive=True)
+    cores = host.get("host_cpus", os.cpu_count() or 1)
+    _EXTRA["host_cpus"] = cores
     _EXTRA["probe"] = probe_diag(probe, platform, time.time() - t_probe0)
     force_cpu = platform is None
     if force_cpu:
@@ -700,6 +791,20 @@ def main():
     # design must survive the restart logic)
     t_probe_start = time.time()
 
+    def restart_probe():
+        """Kill the current probe, start a fresh one, and stamp the
+        restart history into the emitted JSON — the diag must survive
+        even if the final probe is still hung at emit time (the
+        whole-run-wedged case is the one this exists for)."""
+        nonlocal probe_restarts, t_probe_start
+        global _PROBE
+        _kill_proc(_PROBE)
+        probe_restarts += 1
+        t_probe_start = time.time()
+        _PROBE = start_probe()
+        _EXTRA["probe"] = probe_diag(_PROBE, None, time.time() - t_probe0)
+        _EXTRA["probe"]["restarts"] = probe_restarts
+
     def late_probe_check():
         """Re-check the still-warming probe (called between tiers): a
         cold tunnel can come up mid-ladder, and every remaining tier
@@ -712,9 +817,7 @@ def main():
         ``BENCH_PROBE_RESTART_S`` of silence the stuck child is killed
         and a FRESH probe starts: a recovered tunnel answers a fresh
         first-touch in seconds."""
-        nonlocal force_cpu, platform, probe_restarts, t_probe_start
-        nonlocal cpu_only
-        global _PROBE
+        nonlocal force_cpu, platform, cpu_only
         if not force_cpu or cpu_only:
             return
         probe = _PROBE
@@ -723,16 +826,7 @@ def main():
                                              "240"))
             if (time.time() - t_probe_start > restart_s
                     and _remaining() > 90):
-                _kill_proc(probe)
-                probe_restarts += 1
-                t_probe_start = time.time()
-                _PROBE = start_probe()
-                # the emitted diag must record the restart history even
-                # if the final probe is still hung at emit time (the
-                # whole-run-wedged case is the one this exists for)
-                _EXTRA["probe"] = probe_diag(_PROBE, None,
-                                             time.time() - t_probe0)
-                _EXTRA["probe"]["restarts"] = probe_restarts
+                restart_probe()
                 print(f"bench: probe hung >{restart_s:.0f}s; restarted "
                       f"(attempt {probe_restarts + 1})", file=sys.stderr)
             return
@@ -754,22 +848,26 @@ def main():
         elif probe.returncode is not None and _remaining() > 90:
             # probe child crashed (tunnel flake): keep trying — it may
             # open later in the budget
-            _kill_proc(probe)
-            probe_restarts += 1
-            t_probe_start = time.time()
-            _PROBE = start_probe()
+            restart_probe()
 
     def tier_headline(name, n_ops, n_procs, res, t_dev, comp):
         """Build the headline dict for a decided single-history tier."""
         decided = res["valid"] in (True, False)
+        # a resumed search's verdict cost the CUMULATIVE device seconds
+        # across every contributing run (tunnel windows + retries), not
+        # this run's tail — all speedups and the headline rate use that
+        # basis, and the metric string says so
+        resumed = bool(res.get("resumed"))
+        t_basis = ((res.get("elapsed_total") or t_dev)
+                   if resumed else t_dev)
         h16 = comp.get("host16") or {}
         hlin = comp.get("host_linear") or {}
         vs16 = None
-        if decided and h16.get("valid") in (True, False) and t_dev > 0:
-            vs16 = round(h16["seconds"] / t_dev, 2)
+        if decided and h16.get("valid") in (True, False) and t_basis > 0:
+            vs16 = round(h16["seconds"] / t_basis, 2)
         vslin = None
-        if decided and hlin.get("valid") in (True, False) and t_dev > 0:
-            vslin = round(hlin["seconds"] / t_dev, 2)
+        if decided and hlin.get("valid") in (True, False) and t_basis > 0:
+            vslin = round(hlin["seconds"] / t_basis, 2)
         # vs_baseline: measured when the portfolio had >= 8 cores
         # (BASELINE.json names a 16-core comparator); otherwise a
         # clearly-labeled extrapolation (VERDICT r3 item 4) — a
@@ -795,8 +893,10 @@ def main():
             metric = (f"ops-verified/sec, {res['n_ops']}-op "
                       f"{n_procs}-proc {wl} history, decided "
                       f"verdict ({'valid' if res['valid'] else 'invalid'}"
-                      f"), {backend} backend")
-            value = round(res["n_ops"] / t_dev, 1)
+                      f"), {backend} backend"
+                      + (", cumulative over resumed runs" if resumed
+                         else ""))
+            value = round(res["n_ops"] / t_basis, 1)
             unit = "ops/s"
         else:
             metric = (f"configurations-explored/sec, {res['n_ops']}-op "
@@ -815,6 +915,9 @@ def main():
                 "device_verdict": res["valid"],
                 "device_seconds": round(t_dev, 3),
                 "device_seconds_incl_compile": round(res["t_first"], 3),
+                "resumed": resumed or None,
+                "device_seconds_cumulative": (round(t_basis, 3)
+                                              if resumed else None),
                 "device_configs": res["configs"],
                 # the failing det-depth (the obstruction's index) on an
                 # invalid verdict
@@ -835,43 +938,11 @@ def main():
             },
         }
 
-    # --- device tiers: smallest first, best completed wins --------------
-    ran_on_cpu_fallback: list[tuple] = []  # tier specs to re-run on a late
-    #                                        accelerator arrival
-    for name, n_ops, n_procs, budget, headline, tier_s in tiers:
-        late_probe_check()
-        if _remaining() < 45:
-            print(f"bench: skipping tier {name} (out of budget)",
-                  file=sys.stderr)
-            break
-        # compile slack on top of the search deadline: the adaptive
-        # driver may compile several frontier widths (~20-40s each on a
-        # cold TPU; near-zero with a warm .jax_cache)
-        timeout = min(_remaining() - 20, tier_s * 2.2 + 240)
-        res = run_tier(name, budget, tier_s, force_cpu=force_cpu,
-                       timeout=timeout)
-        if res is None and not force_cpu:
-            # accelerator child crashed (worker watchdog / tunnel): the
-            # tier retries on a pinned-CPU child, isolated from the wreck
-            print(f"bench: tier {name} retrying on CPU", file=sys.stderr)
-            if _remaining() > 45:
-                res = run_tier(name, budget, tier_s, force_cpu=True,
-                               timeout=min(_remaining() - 15,
-                                           tier_s * 2.2 + 60))
-        if res is None:
-            continue
-        if res["backend"] == "cpu" and not force_cpu:
-            # the child silently fell back (plugin present, chip not):
-            # remember the tier so a late arrival re-runs it
-            ran_on_cpu_fallback.append((name, n_ops, n_procs, budget,
-                                        headline, tier_s))
-        elif force_cpu:
-            ran_on_cpu_fallback.append((name, n_ops, n_procs, budget,
-                                        headline, tier_s))
-        t_dev = res["t_dev"]
-        print(f"bench: tier {name}: verdict={res['valid']} in "
-              f"{t_dev:.2f}s ({res['configs']} configs) "
-              f"backend={res['backend']}", file=sys.stderr)
+    def record_tier(name, n_ops, n_procs, headline, res, t_dev):
+        """Fold one completed tier into _BEST/_EXTRA against the
+        CURRENT `host` comparators (called in-loop, and again from the
+        deferred-host re-record pass)."""
+        global _BEST, _BEST_PRIO, _BEST_TIER
         if name == "batch256":
             _EXTRA["batch256"] = batch_detail(res, host, t_dev)
             if _BEST is None:
@@ -879,7 +950,7 @@ def main():
                 # headline than the 'no tier completed' error payload
                 _BEST = batch_headline(res, host, t_dev)
                 _BEST_PRIO, _BEST_TIER = (0, 0), name
-            continue
+            return
         comp = host.get(name) or {}
         tier_detail = tier_headline(name, n_ops, n_procs, res, t_dev,
                                     comp)
@@ -902,6 +973,73 @@ def main():
         else:
             _EXTRA[f"tier_{name}"] = {**tier_detail["detail"],
                                       "host_agrees": agree}
+
+    # --- device tiers: smallest first, best completed wins --------------
+    ran_on_cpu_fallback: list[tuple] = []  # tier specs to re-run on a late
+    #                                        accelerator arrival
+    completed: list[tuple] = []  # (spec..., res, t_dev) for re-recording
+    # with a deferred host phase, the device ladder must LEAVE room for
+    # it: the comparators are what turn tier times into speedups, and a
+    # ladder that spends _remaining() to the floor would bank a bench
+    # with null vs_baseline forever
+    host_reserve = (float(os.environ.get("BENCH_HOST_RESERVE_S", "150"))
+                    if defer_host else 20.0)
+    for name, n_ops, n_procs, budget, headline, tier_s in tiers:
+        late_probe_check()
+        if _remaining() < 45 + (host_reserve if defer_host else 0):
+            print(f"bench: skipping tier {name} (out of budget)",
+                  file=sys.stderr)
+            break
+        # compile slack on top of the search deadline: the adaptive
+        # driver may compile several frontier widths (~20-40s each on a
+        # cold TPU; near-zero with a warm .jax_cache)
+        timeout = min(_remaining() - host_reserve, tier_s * 2.2 + 240)
+        res = run_tier(name, budget, tier_s, force_cpu=force_cpu,
+                       timeout=timeout)
+        if res is None and not force_cpu:
+            # accelerator child crashed or hung (worker watchdog /
+            # tunnel wedge).  The wedge outlives the child and would
+            # hang every later unpinned child too — pin the REST of the
+            # ladder to CPU and restart the probe: if the tunnel
+            # recovers, the late-probe path unpins and re-runs.
+            print(f"bench: tier {name} child died; pinning remaining "
+                  "tiers to CPU (probe restarted)", file=sys.stderr)
+            force_cpu = True
+            restart_probe()
+            if _remaining() > 45:
+                res = run_tier(name, budget, tier_s, force_cpu=True,
+                               timeout=min(_remaining() - 15,
+                                           tier_s * 2.2 + 60))
+        if res is None:
+            continue
+        if res["backend"] == "cpu" and not force_cpu:
+            # the child silently fell back (plugin present, chip not):
+            # remember the tier so a late arrival re-runs it
+            ran_on_cpu_fallback.append((name, n_ops, n_procs, budget,
+                                        headline, tier_s))
+        elif force_cpu:
+            ran_on_cpu_fallback.append((name, n_ops, n_procs, budget,
+                                        headline, tier_s))
+        t_dev = res["t_dev"]
+        print(f"bench: tier {name}: verdict={res['valid']} in "
+              f"{t_dev:.2f}s ({res['configs']} configs) "
+              f"backend={res['backend']}", file=sys.stderr)
+        completed.append((name, n_ops, n_procs, budget, headline,
+                          tier_s, res, t_dev))
+        record_tier(name, n_ops, n_procs, headline, res, t_dev)
+
+    # --- deferred host comparators --------------------------------------
+    # the early probe found an open tunnel, so the device ladder ran
+    # first; now pay the host phase and re-record every tier headline
+    # against the fresh comparator numbers
+    if defer_host:
+        host.update(host_comparators(tiers))
+        cores = host.get("host_cpus", cores)
+        _EXTRA["host_cpus"] = cores
+        _BEST, _BEST_PRIO, _BEST_TIER = None, (-1, -1), None
+        for (name, n_ops, n_procs, budget, headline, tier_s,
+             res, t_dev) in completed:
+            record_tier(name, n_ops, n_procs, headline, res, t_dev)
 
     # --- late-probe second chance --------------------------------------
     # a cold tunnel can outlive the probe budget but come up during the

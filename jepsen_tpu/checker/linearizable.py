@@ -68,6 +68,7 @@ gather.
 from __future__ import annotations
 
 import os
+import sys
 import time
 from dataclasses import dataclass, replace as _dc_replace
 
@@ -1147,6 +1148,42 @@ _SLICE_LEVELS0 = int(os.environ.get("JEPSEN_TPU_SLICE_LEVELS", "32"))
 _SLICE_TARGET_S = float(os.environ.get("JEPSEN_TPU_SLICE_TARGET_S", "2.0"))
 _SLICE_MAX = 16384
 
+#: per-slice trace lines on stderr (width, cap, wall, live rows, configs,
+#: depth) — the r4 10k wedge gave ZERO visibility into which slice hung;
+#: with this on, the last trace line IS the diagnosis
+_TRACE_SLICES = os.environ.get("JEPSEN_TPU_TRACE_SLICES", "") not in ("",
+                                                                      "0")
+
+
+def _trace(msg: str) -> None:
+    if _TRACE_SLICES:
+        print(f"slice: {msg}", file=sys.stderr, flush=True)
+
+
+_SLICE_HARD_S: float | None = None
+
+
+def _slice_hard_s() -> float:
+    """Hard bound on a single device execution's predicted wall time.
+
+    The axon worker kills executions past its ~60 s watchdog and the
+    kill wedges the tunnel for every later client (docs/perf-notes.md
+    round 4).  On TPU the level cap is clamped so a slice predicted
+    from the measured per-level rate stays well under that; hosts get
+    no bound (a long CPU slice is merely slow)."""
+    global _SLICE_HARD_S
+    if _SLICE_HARD_S is None:
+        env = os.environ.get("JEPSEN_TPU_SLICE_HARD_S")
+        if env:
+            _SLICE_HARD_S = float(env)
+        else:
+            try:
+                backend = jax.default_backend()
+            except Exception:  # noqa: BLE001 — no backend: assume host
+                backend = "cpu"
+            _SLICE_HARD_S = 20.0 if backend == "tpu" else float("inf")
+    return _SLICE_HARD_S
+
 
 def _adapt_lvl_cap(lvl_cap: int, dt: float,
                    target_s: float | None = None) -> int:
@@ -1378,9 +1415,22 @@ def _run_kernel(esp: EncodedSearch, es: EncodedSearch, model: ModelSpec,
     first = True
     timed_out = False
     low_streak = 0  # consecutive slices whose live width fit a lower rung
+    per_lvl: float | None = None  # measured seconds/level at width F
+    prev_depth = int(np.asarray(carry[4]))
+    hard_s = _slice_hard_s()
+
+    def _clamp_cap(cap: int) -> int:
+        # keep a slice's PREDICTED wall under the worker watchdog; the
+        # estimate tracks the current width (scaled on width changes)
+        if per_lvl and per_lvl > 0 and hard_s != float("inf"):
+            return max(8, min(cap, int(hard_s / per_lvl)))
+        return cap
+
     while True:
         bail = escalate and F < MAX_FRONTIER
         fn = get_kernel(model, dims)
+        _trace(f"run F={F} cap={lvl_cap} first={int(first)} "
+               f"depth={prev_depth}")
         t0 = time.perf_counter()
         carry = fn(*args, jnp.int32(budget), jnp.int32(lvl_cap),
                    jnp.bool_(bail), *carry)
@@ -1392,6 +1442,14 @@ def _run_kernel(esp: EncodedSearch, es: EncodedSearch, model: ModelSpec,
         count = int(carry[1])
         configs = int(carry[3])
         ovf = bool(carry[5])
+        depth = int(carry[4])
+        _trace(f"done F={F} cap={lvl_cap} dt={dt:.3f}s count={count} "
+               f"configs={configs} depth={depth} ovf={int(ovf)} "
+               f"status={status}")
+        levels_run = depth - prev_depth
+        prev_depth = depth
+        if not first and levels_run > 0:
+            per_lvl = dt / levels_run
         if status != -1 or count <= 0 or configs >= budget:
             break
         if deadline is not None and time.perf_counter() > deadline:
@@ -1417,6 +1475,9 @@ def _run_kernel(esp: EncodedSearch, es: EncodedSearch, model: ModelSpec,
             # narrow-sized levels at 4x the cost (enough to blow a
             # wall-clock deadline — or the axon worker's ~60s watchdog)
             lvl_cap = max(8, lvl_cap * F // new_f)
+            if per_lvl:
+                per_lvl *= new_f / F  # per-level cost tracks width
+            lvl_cap = _clamp_cap(lvl_cap)
             F = new_f
             dims = SearchDims(**{**dims.__dict__, "frontier": F})
             first = True  # next slice includes a compile
@@ -1426,10 +1487,10 @@ def _run_kernel(esp: EncodedSearch, es: EncodedSearch, model: ModelSpec,
             # between slices, so a full-length slice at F=2048 would run
             # hundreds of post-burst narrow levels at 8x their cost
             # before the width could settle back down
-            lvl_cap = _adapt_lvl_cap(
+            lvl_cap = _clamp_cap(_adapt_lvl_cap(
                 lvl_cap, dt,
                 target_s=(_SLICE_TARGET_S if F <= 512
-                          else _SLICE_TARGET_S / 4))
+                          else _SLICE_TARGET_S / 4)))
         first = False
         if not ovf and count > 0:
             # 4x headroom over the live width, with hysteresis: only
@@ -1442,7 +1503,13 @@ def _run_kernel(esp: EncodedSearch, es: EncodedSearch, model: ModelSpec,
             # tier thrashed 2x when the floor dropped to 16 without
             # this guard, while sustained-narrow searches (mutex) still
             # settle onto the tight width one slice later.
-            new_f = _grid_width(4 * count)
+            # ONE grid step down, not straight to grid(4*count): the
+            # overflow that sets the needed width is the EXPANSION burst
+            # (successors before prune), which runs far above the pruned
+            # live count — dropping to the count-derived width was
+            # observed (r4 10k trace) to re-overflow within a level or
+            # two, costing a bail + reclimb every few slices
+            new_f = max(_grid_width(4 * count), F // 2)
             if new_f < F:
                 low_streak += 1
             else:
@@ -1454,6 +1521,9 @@ def _run_kernel(esp: EncodedSearch, es: EncodedSearch, model: ModelSpec,
                 # cheaper levels: grow the cap by the width ratio so
                 # slice wall time stays near the target
                 lvl_cap = min(_SLICE_MAX, lvl_cap * (F // new_f))
+                if per_lvl:
+                    per_lvl *= new_f / F
+                lvl_cap = _clamp_cap(lvl_cap)
                 F = new_f
                 dims = SearchDims(**{**dims.__dict__, "frontier": F})
                 first = True  # next slice may include a compile
@@ -1690,8 +1760,14 @@ def load_checkpoint(path: str):
 
 
 def resume_opseq(seq: OpSeq, model: ModelSpec, path: str, *,
-                 on_slice=None) -> dict:
-    """Continue a checkpointed `search_opseq` from `save_checkpoint`."""
+                 on_slice=None, deadline: float | None = None,
+                 stop=None) -> dict:
+    """Continue a checkpointed `search_opseq` from `save_checkpoint`.
+
+    ``deadline``/``stop`` bound the continued run exactly as in
+    `search_opseq` — a resumed search interrupted AGAIN is still a
+    checkpoint (the bench's cross-tunnel-window accumulation relies on
+    this)."""
     carry, dims, model_name, budget, digest = load_checkpoint(path)
     if model_name != model.name:
         raise ValueError(
@@ -1702,7 +1778,8 @@ def resume_opseq(seq: OpSeq, model: ModelSpec, path: str, *,
     es = encode_search(seq)
     esp = pad_search(es, dims.n_det_pad, dims.n_crash_pad)
     status, configs, max_depth, dims = _run_kernel(
-        esp, es, model, dims, budget, on_slice=on_slice, resume=carry)
+        esp, es, model, dims, budget, on_slice=on_slice, resume=carry,
+        deadline=deadline, stop=stop)
     return {"valid": _STATUS[status], "configs": configs,
             "max_depth": max_depth, "engine": "device-bfs(resumed)",
             "frontier": dims.frontier,

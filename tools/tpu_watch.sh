@@ -1,13 +1,18 @@
 #!/bin/bash
-# Tunnel watcher — the axon tunnel has been observed to open for brief
-# windows (~5 min, r4: up 00:59-01:04 then wedged), so waiting for a
-# human-scheduled session loses them.  This loop probes with a short
-# timeout; the moment the tunnel answers it spends the window on the
-# highest-value missing artifact:
+# Tunnel watcher — the axon tunnel opens for brief windows (~5-8 min
+# observed r4: 00:59-01:04, 03:15-03:23, both ending in a wedge), so
+# waiting for a human-scheduled session loses them.  This loop probes
+# cheaply; the moment the tunnel answers it spends the window on the
+# highest-value MISSING artifact, in order:
 #
-#   window 1: the full bench, unpinned, cheap tiers first  -> bench_tpu_*.json
-#   window 2: the width-sweep microbench                   -> tpubench_*.jsonl
-#   then exits.
+#   1. batch256 tier child on the chip      -> batch256_tpu_*.json
+#   2. the 10k tier child, checkpointed     -> tenk_tpu_*.json
+#      (slices persist to .bench_ckpt; a wedged window RESUMES next
+#      window instead of restarting — the search accumulates until a
+#      window finishes it)
+#   3. one full bench, unpinned             -> bench_tpu_*.json
+#      (bench.py now defers host comparators when the tunnel is open
+#      and resumes tier checkpoints, so this is cheap once 1-2 landed)
 #
 #   nohup tools/tpu_watch.sh [outdir] &
 #
@@ -19,17 +24,29 @@ cd "$(dirname "$0")/.."
 OUT=${1:-docs/tpu/r4}
 mkdir -p "$OUT"
 # persistent XLA compile cache: bench.py's children pin the same dir
-# in-process; this export covers tpubench.py and the probe below,
-# which set no cache dir of their own
+# in-process; this export covers the probe
 export JAX_COMPILATION_CACHE_DIR="$PWD/.jax_cache"
+# per-slice trace on stderr: when a window wedges, the last trace line
+# is the diagnosis (the r4 950s silent hang motivated this)
+export JEPSEN_TPU_TRACE_SLICES=1
 
-# nothing left to collect: exit immediately (a restarted watcher must
-# not probe forever after both artifacts are banked)
-if [ -f "$OUT/.bench_done" ] && [ -f "$OUT/.sweep_done" ]; then
-  echo "$(date -u +%FT%TZ) both artifacts already banked; exiting" \
-    >> "$OUT/watch.log"
+log() { echo "$(date -u +%FT%TZ) $*" >> "$OUT/watch.log"; }
+
+if [ -f "$OUT/.batch_done" ] && [ -f "$OUT/.tenk_done" ] \
+   && [ -f "$OUT/.bench_done" ]; then
+  log "all artifacts already banked; exiting"
   exit 0
 fi
+
+backend_of() {  # $1: tier-child json file; prints backend or nothing
+  python - "$1" 2>/dev/null <<'PY'
+import json, sys
+try:
+    print(json.load(open(sys.argv[1])).get("backend", ""))
+except Exception:
+    pass
+PY
+}
 
 n=0
 while true; do
@@ -44,12 +61,42 @@ PY
 )
   if [ "$up" = "tpu" ]; then
     stamp=$(date -u +%H%M%S)
-    if [ ! -f "$OUT/.bench_done" ]; then
-      echo "$(date -u +%FT%TZ) tunnel UP (probe $n); bench -> bench_tpu_$stamp" \
-        >> "$OUT/watch.log"
-      BENCH_TIER_ORDER=1k,batch256,mutex2k,10k \
-        BENCH_PROBE_S=90 BENCH_HOST_S=60 BENCH_BUDGET_S=900 \
-        timeout 960 python bench.py \
+    if [ ! -f "$OUT/.batch_done" ]; then
+      log "tunnel UP (probe $n); batch256 child -> batch256_tpu_$stamp"
+      BENCH_TIER_S=120 timeout 420 python bench.py \
+        --run-tier batch256 --budget 2000000 \
+        > "$OUT/batch256_tpu_$stamp.json" \
+        2> "$OUT/batch256_tpu_$stamp.err"
+      if [ "$(backend_of "$OUT/batch256_tpu_$stamp.json")" = "tpu" ]; then
+        touch "$OUT/.batch_done"
+        log "batch256 on-chip banked"
+        continue  # same window: go straight to the 10k
+      fi
+      log "batch256 child did not land on tpu; resuming watch"
+    elif [ ! -f "$OUT/.tenk_done" ]; then
+      log "tunnel UP (probe $n); 10k child (ckpt-resumed) -> tenk_tpu_$stamp"
+      BENCH_TIER_S=420 timeout 600 python bench.py \
+        --run-tier 10k --budget 100000000 \
+        > "$OUT/tenk_tpu_$stamp.json" 2> "$OUT/tenk_tpu_$stamp.err"
+      decided=$(python - "$OUT/tenk_tpu_$stamp.json" 2>/dev/null <<'PY'
+import json, sys
+try:
+    d = json.load(open(sys.argv[1]))
+    print("yes" if d.get("valid") in (True, False)
+          and d.get("backend") == "tpu" else "no")
+except Exception:
+    print("no")
+PY
+)
+      if [ "$decided" = "yes" ]; then
+        touch "$OUT/.tenk_done"
+        log "10k DECIDED on-chip banked"
+        continue  # same window: try the full bench
+      fi
+      log "10k undecided this window (progress checkpointed); resuming"
+    elif [ ! -f "$OUT/.bench_done" ]; then
+      log "tunnel UP (probe $n); full bench -> bench_tpu_$stamp"
+      BENCH_PROBE_S=90 BENCH_BUDGET_S=900 timeout 960 python bench.py \
         > "$OUT/bench_tpu_$stamp.json" 2> "$OUT/bench_tpu_$stamp.err"
       if python - "$OUT/bench_tpu_$stamp.json" <<'PY'
 import json, sys
@@ -62,37 +109,15 @@ sys.exit(0 if ok else 1)
 PY
       then
         touch "$OUT/.bench_done"
-        echo "$(date -u +%FT%TZ) tpu-backed headline captured" >> "$OUT/watch.log"
-      else
-        echo "$(date -u +%FT%TZ) bench finished without a tpu headline" \
-          >> "$OUT/watch.log"
-      fi
-    elif [ ! -f "$OUT/.sweep_done" ]; then
-      # highest-value widths FIRST so a truncated sweep drops the least
-      # interesting rows (the F=8192 row is the r4 artifact to recapture)
-      echo "$(date -u +%FT%TZ) tunnel UP (probe $n); sweep -> tpubench_$stamp" \
-        >> "$OUT/watch.log"
-      WIDTHS=8192,1024,16,64,256,4096
-      NW=$(echo "$WIDTHS" | tr ',' '\n' | wc -l)
-      timeout 1500 python tools/tpubench.py \
-        --widths "$WIDTHS" --levels 64 --repeat 5 \
-        > "$OUT/tpubench_$stamp.jsonl" 2> "$OUT/tpubench_$stamp.err"
-      # complete = every width produced its kernel row on the TPU
-      # (a timeout-truncated sweep must be retried in a later window)
-      if [ "$(grep -c '"op": "kernel' "$OUT/tpubench_$stamp.jsonl")" -ge "$NW" ] \
-         && head -1 "$OUT/tpubench_$stamp.jsonl" | grep -q '"backend": "tpu"'; then
-        touch "$OUT/.sweep_done"
-        echo "$(date -u +%FT%TZ) tpu width sweep captured; exiting" \
-          >> "$OUT/watch.log"
+        log "tpu-backed full bench banked; exiting"
         exit 0
       fi
-      echo "$(date -u +%FT%TZ) sweep incomplete; resuming watch" \
-        >> "$OUT/watch.log"
+      log "bench finished without a tpu headline; resuming watch"
     else
       exit 0
     fi
   else
-    echo "$(date -u +%FT%TZ) tunnel down (probe $n)" >> "$OUT/watch.log"
+    log "tunnel down (probe $n)"
   fi
   sleep 30
 done

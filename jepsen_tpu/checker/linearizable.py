@@ -429,6 +429,81 @@ def _sort_dominance(pwh, popc, valid, cfgs, M: int, dims: SearchDims,
     return svalid & ~drop, scfgs, perm
 
 
+def _allpairs_dominance(cfgs, valid, dims: SearchDims):
+    """EXACT dominance/dedup prune as one [M, M] comparison — the
+    TPU-shaped alternative to `_sort_dominance`.
+
+    The sort pipeline compiles to hundreds of tiny ops (bitonic stages,
+    windowed compares, run-first gathers) whose fixed per-op overhead
+    floors the on-chip level cost (~1.3 ms/level at F=16..256 measured,
+    docs/tpu/r4/tpubench_resweep.jsonl) no matter how narrow the live
+    frontier is.  This form is a handful of LARGE elementwise ops: for
+    every pair (i, j), row i is dropped when a valid row j has the same
+    (p, window, state) words and j's crash mask is a subset of i's —
+    strictly, or with identical rows tie-broken to the lowest index.
+
+    Unlike the sorted prune (window R=8 + run-first: may KEEP dominated
+    rows), this is exact, so it can only shrink levels further — the
+    soundness argument of `_sort_dominance` applies unchanged, and
+    domination is decided on full words (hashes are never trusted).
+
+    Returns kept over the INPUT row order (no permutation): callers
+    compact against the original cfgs, and block-origin tests are plain
+    index-range tests.  O(M^2 * WORDS) work and [M, M] intermediates:
+    meant for the narrow rungs (S <= ~8k) where the op-count floor —
+    not FLOPs — dominates; the driver picks per backend/width."""
+    M = cfgs.shape[0]
+    u = cfgs.astype(jnp.uint32)
+    a = 1 + dims.win_words
+    b = a + dims.crash_words
+    pw = jnp.concatenate([u[:, :a], u[:, b:]], axis=1)
+    cr = u[:, a:b]
+    # pairwise equal (p, window, state): fold word compares into [M, M]
+    eq_pw = jnp.ones((M, M), bool)
+    for w in range(pw.shape[1]):
+        col = pw[:, w]
+        eq_pw &= col[:, None] == col[None, :]
+    # pairwise crash-mask subset (j's ⊆ i's) and equality
+    sub = jnp.ones((M, M), bool)   # sub[i, j]: cr_j subset of cr_i
+    eq_cr = jnp.ones((M, M), bool)
+    for w in range(cr.shape[1]):
+        col = cr[:, w]
+        sub &= (col[None, :] & ~col[:, None]) == 0
+        eq_cr &= col[:, None] == col[None, :]
+    iota = jnp.arange(M, dtype=jnp.int32)
+    identical = eq_pw & eq_cr
+    strict = eq_pw & sub & ~eq_cr
+    dom = valid[None, :] & (strict
+                            | (identical & (iota[None, :] < iota[:, None])))
+    return valid & ~jnp.any(dom, axis=1)
+
+
+#: dominance-prune implementation: "sort" (windowed sorted prune),
+#: "allpairs" (exact [M,M] prune), or "auto" — allpairs on TPU at
+#: S <= _ALLPAIRS_MAX rows (where per-op overhead, not FLOPs, floors
+#: the level cost), sort everywhere else
+_DOMINANCE_MODE = os.environ.get("JEPSEN_TPU_DOMINANCE", "auto")
+_ALLPAIRS_MAX = int(os.environ.get("JEPSEN_TPU_ALLPAIRS_MAX", "8192"))
+#: cap on batch * M * M elements for a vmapped all-pairs prune — the
+#: pairwise masks are [batch, M, M]; past ~256M bools the intermediates
+#: stop fitting comfortably between fusions
+_ALLPAIRS_ELEMS = int(os.environ.get("JEPSEN_TPU_ALLPAIRS_ELEMS",
+                                     str(1 << 28)))
+
+
+def _use_allpairs(M: int, batch: int = 1) -> bool:
+    if _DOMINANCE_MODE == "allpairs":
+        return batch * M * M <= _ALLPAIRS_ELEMS
+    if _DOMINANCE_MODE == "sort":
+        return False
+    try:
+        backend = jax.default_backend()
+    except Exception:  # noqa: BLE001 — no backend: assume host
+        backend = "cpu"
+    return (backend == "tpu" and M <= _ALLPAIRS_MAX
+            and batch * M * M <= _ALLPAIRS_ELEMS)
+
+
 def _level_mask(pieces, op_args, frontier, alive):
     """Run the mask phase (enabled candidates + model steps + goal test)
     over a frontier, with the per-level shared table slice."""
@@ -452,8 +527,13 @@ def _succ_block(pieces, frontier, validf, cand2, ns2, cap: int, K: int):
     return ccfgs, cvalid, n_valid
 
 
-def build_search_step_fn(model: ModelSpec, dims: SearchDims):
+def build_search_step_fn(model: ModelSpec, dims: SearchDims,
+                         batch: int = 1):
     """Compile one *slice* of the frontier search for a (model, dims) pair.
+
+    ``batch`` is a hint for the dominance-prune selector only: a vmapped
+    instance multiplies every [M, M] all-pairs intermediate by the batch
+    size, so the selector needs it to stay inside the memory budget.
 
     Level-synchronous search where a level's depth counts DETERMINATE
     (:ok) linearizations only; crashed (:info) ops linearize *within* a
@@ -498,6 +578,16 @@ def build_search_step_fn(model: ModelSpec, dims: SearchDims):
     W = dims.window
     S = 4 * F
     pieces = _make_kernel_pieces(model, dims)
+
+    def prune(cfgs, valid, M: int):
+        """Dominance prune, implementation chosen at BUILD time per
+        (backend, M, batch): returns (kept, cfgs_out, perm) where perm
+        is None for the order-preserving all-pairs path (kept/cfgs_out
+        are in input order) and the sort permutation otherwise."""
+        if _use_allpairs(M, batch):
+            return _allpairs_dominance(cfgs, valid, dims), cfgs, None
+        pwh, popc = _pw_parts(cfgs, dims)
+        return _sort_dominance(pwh, popc, valid, cfgs, M, dims)
 
     def step(det_f, det_v1, det_v2, det_inv, det_ret, sfx_min,
              crash_f, crash_v1, crash_v2, crash_inv, n_det, n_crash,
@@ -560,20 +650,20 @@ def build_search_step_fn(model: ModelSpec, dims: SearchDims):
                 ovf = ovf | (n_valid > F)
                 merged = jnp.concatenate([frontier, ccfgs], axis=0)
                 mvalid = jnp.concatenate([alive, cvalid])
-                pwh, popc = _pw_parts(merged, dims)
-                kept, scfgs, perm = _sort_dominance(
-                    pwh, popc, mvalid, merged, 2 * F, dims)
+                kept, scfgs, perm = prune(merged, mvalid, 2 * F)
                 src, new_count = _compact_indices(kept, F)
                 new_frontier = jnp.take(scfgs, src, axis=0)
                 ovf = ovf | (new_count > F)
                 new_count = jnp.minimum(new_count, F)
                 # progress iff any successor-block row survived the
-                # merge (perm >= F).  A merge that only DROPPED existing
-                # rows does not require another round: surviving rows'
-                # crash successors were all generated and merged this
-                # round, and dropped rows are covered by their
-                # dominators — the level is closed.
-                progress = jnp.any(kept & (perm >= F))
+                # merge (input rows >= F).  A merge that only DROPPED
+                # existing rows does not require another round:
+                # surviving rows' crash successors were all generated
+                # and merged this round, and dropped rows are covered by
+                # their dominators — the level is closed.
+                origin = (jnp.arange(2 * F, dtype=jnp.int32)
+                          if perm is None else perm)
+                progress = jnp.any(kept & (origin >= F))
                 # configs is NOT bumped here: closure-added rows are
                 # part of this level and the det phase counts the closed
                 # level's rows once — counting per closure round would
@@ -610,9 +700,7 @@ def build_search_step_fn(model: ModelSpec, dims: SearchDims):
             dcfgs, dvalid, n_valid = succ_block(
                 frontier, dvalidf, cand2, ns2, S)
             ovf = ovf | (n_valid > S)
-            pwh, popc = _pw_parts(dcfgs, dims)
-            kept, scfgs, _perm = _sort_dominance(
-                pwh, popc, dvalid, dcfgs, S, dims)
+            kept, scfgs, _perm = prune(dcfgs, dvalid, S)
             src, new_count = _compact_indices(kept, F)
             new_frontier = jnp.take(scfgs, src, axis=0)
             ovf = ovf | (new_count > F)
@@ -1263,8 +1351,19 @@ def _widen_sharded_carry(carry, d: int, old_f: int, new_f: int):
         np.asarray(c) for c in carry[1:])
 
 
+def _dominance_key():
+    """Everything `_use_allpairs` depends on — part of the kernel cache
+    key so a mode flip (tests; env overrides) can't reuse a kernel built
+    for the other prune."""
+    try:
+        backend = jax.default_backend()
+    except Exception:  # noqa: BLE001
+        backend = "cpu"
+    return (_DOMINANCE_MODE, _ALLPAIRS_MAX, _ALLPAIRS_ELEMS, backend)
+
+
 def get_kernel(model: ModelSpec, dims: SearchDims):
-    key = (model.name, dims)
+    key = (model.name, dims, _dominance_key())
     fn = _KERNEL_CACHE.get(key)
     if fn is None:
         fn = jax.jit(build_search_step_fn(model, dims))
@@ -1815,12 +1914,19 @@ def batch_dims(ess: list[EncodedSearch], model: ModelSpec, *,
         state_width=model.state_width, frontier=frontier)
 
 
-def get_batch_kernel(model: ModelSpec, dims: SearchDims):
-    key = ("batch", model.name, dims)
+def get_batch_kernel(model: ModelSpec, dims: SearchDims,
+                     batch: int = 256):
+    # the batch size reaches the built HLO only through the two prune
+    # selections (closure merge at 2F, det expansion at 4F) — key on
+    # those booleans, not the raw count, so a ladder whose live set
+    # shrinks between rungs keeps sharing compiled kernels
+    sel = (_use_allpairs(2 * dims.frontier, batch),
+           _use_allpairs(4 * dims.frontier, batch))
+    key = ("batch", model.name, dims, sel, _dominance_key())
     fn = _KERNEL_CACHE.get(key)
     if fn is None:
         fn = jax.jit(jax.vmap(
-            build_search_step_fn(model, dims),
+            build_search_step_fn(model, dims, batch=batch),
             in_axes=(0,) * 12 + (None, None, None) + (0,) * 6))
         _KERNEL_CACHE[key] = fn
     return fn
@@ -2022,7 +2128,7 @@ def search_batch(seqs: list[OpSeq], model: ModelSpec, *,
     pending: list[int] = []
 
     if sharding is not None:
-        fn = get_batch_kernel(model, dims)
+        fn = get_batch_kernel(model, dims, batch=len(seqs))
         # mesh-sharded batch: fixed size (the key axis must keep
         # covering the mesh), plain slice driver.  Arrays go to the mesh
         # straight from host numpy: in a MULTI-PROCESS job (DCN tier,
@@ -2084,7 +2190,7 @@ def search_batch(seqs: list[OpSeq], model: ModelSpec, *,
         rung = dims.frontier
         while pending:
             d = _dc_replace(dims, frontier=rung)
-            fnr = get_batch_kernel(model, d)
+            fnr = get_batch_kernel(model, d, batch=len(pending))
             st, ct, cf, dp, ov = _drive_batch_compacting(
                 fnr, [esps[i] for i in pending], model, d, budget,
                 bail=True)

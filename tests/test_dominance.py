@@ -1,0 +1,125 @@
+"""All-pairs dominance prune (`_allpairs_dominance`) — exactness
+properties and whole-engine equivalence against the windowed sorted
+prune (`_sort_dominance`).
+
+The sorted prune trades exactness for sort-pipeline locality: its
+window (R=8) + run-first reach may KEEP dominated rows.  The all-pairs
+form is exact.  Both must agree on everything that matters:
+
+  * soundness — every pruned row is covered by a kept dominator;
+  * minimality (all-pairs only) — no kept row dominates another;
+  * verdicts — the device search decides identically under either.
+"""
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from jepsen_tpu.checker import linearizable as lin
+
+DIMS = lin.SearchDims(n_det_pad=64, n_crash_pad=32, window=32, k=4,
+                      state_width=1, frontier=32)
+
+
+def random_cfgs(rng, m, dims, dup_bias=True):
+    """Random config rows shaped like kernel rows: [p | win | crash |
+    state].  With dup_bias, rows cluster on few (p, win, state) homes so
+    dominance/dup relations actually occur."""
+    p = rng.integers(0, 3 if dup_bias else 1000, (m, 1))
+    win = rng.integers(0, 4 if dup_bias else 2**31, (m, dims.win_words))
+    crash = rng.integers(0, 16, (m, dims.crash_words))
+    state = rng.integers(0, 3 if dup_bias else 2**31,
+                         (m, dims.state_width))
+    return np.concatenate([p, win, crash, state], axis=1).astype(np.int32)
+
+
+def dominates(a, b, dims):
+    """Row a dominates row b: equal (p, win, state), crash(a) ⊆
+    crash(b)."""
+    lo = 1 + dims.win_words
+    hi = lo + dims.crash_words
+    pwa = np.concatenate([a[:lo], a[hi:]])
+    pwb = np.concatenate([b[:lo], b[hi:]])
+    if not np.array_equal(pwa, pwb):
+        return False
+    ca = a[lo:hi].astype(np.uint32)
+    cb = b[lo:hi].astype(np.uint32)
+    return bool(np.all((ca & ~cb) == 0))
+
+
+@pytest.mark.parametrize("seed", range(6))
+def test_allpairs_exactness_properties(seed):
+    rng = np.random.default_rng(seed)
+    m = 64
+    cfgs = random_cfgs(rng, m, DIMS)
+    valid = rng.random(m) < 0.8
+    kept = np.asarray(lin._allpairs_dominance(
+        jnp.asarray(cfgs), jnp.asarray(valid), DIMS))
+    assert not np.any(kept & ~valid)
+    kept_idx = np.flatnonzero(kept)
+    # soundness: every valid row is dominated-or-equal by a kept row
+    for i in np.flatnonzero(valid):
+        assert any(dominates(cfgs[j], cfgs[i], DIMS) for j in kept_idx), i
+    # minimality: no kept row is strictly dominated by (or duplicates)
+    # another kept row
+    for i in kept_idx:
+        for j in kept_idx:
+            if i == j:
+                continue
+            if np.array_equal(cfgs[i], cfgs[j]):
+                pytest.fail(f"duplicate rows {i}, {j} both kept")
+            if dominates(cfgs[j], cfgs[i], DIMS):
+                pytest.fail(f"kept row {i} dominated by kept row {j}")
+
+
+@pytest.mark.parametrize("seed", range(6))
+def test_allpairs_keeps_subset_of_sort_distinct_values(seed):
+    """The exact prune keeps a subset of the windowed prune's surviving
+    VALUES (the sort prune may keep dominated extras, never fewer
+    minimal ones)."""
+    rng = np.random.default_rng(100 + seed)
+    m = 64
+    cfgs = random_cfgs(rng, m, DIMS)
+    valid = np.ones(m, bool)
+    kept_ap = np.asarray(lin._allpairs_dominance(
+        jnp.asarray(cfgs), jnp.asarray(valid), DIMS))
+    pwh, popc = lin._pw_parts(jnp.asarray(cfgs), DIMS)
+    kept_s, scfgs, _perm = lin._sort_dominance(
+        pwh, popc, jnp.asarray(valid), jnp.asarray(cfgs), m, DIMS)
+    ap_vals = {tuple(r) for r in cfgs[kept_ap]}
+    s_vals = {tuple(r) for r in np.asarray(scfgs)[np.asarray(kept_s)]}
+    assert ap_vals <= s_vals
+
+
+def _fuzz_history(seed, n_ops=40, n_procs=4, crash_p=0.15):
+    import random
+
+    from jepsen_tpu.synth import corrupt_read, register_history
+
+    rng = random.Random(seed)
+    h = register_history(rng, n_ops=n_ops, n_procs=n_procs,
+                         overlap=4, crash_p=crash_p)
+    if seed % 2:  # alternate valid-by-construction / corrupted-invalid
+        h = corrupt_read(rng, h, at=0.7)
+    return h
+
+
+@pytest.mark.parametrize("seed", range(8))
+def test_engine_verdicts_match_across_prunes(seed, monkeypatch):
+    """search_opseq decides identically with either prune (the all-pairs
+    path forced on CPU, where auto would pick sort)."""
+    from jepsen_tpu.history import encode_ops
+    from jepsen_tpu.models import cas_register
+
+    model = cas_register()
+    h = _fuzz_history(2000 + seed)
+    seq = encode_ops(h, model.f_codes)
+    out_sort = lin.search_opseq(seq, model, budget=2_000_000)
+    monkeypatch.setattr(lin, "_DOMINANCE_MODE", "allpairs")
+    out_ap = lin.search_opseq(seq, model, budget=2_000_000)
+    assert out_sort["valid"] == out_ap["valid"], (
+        f"seed {seed}: sort={out_sort} allpairs={out_ap}")
+    # the exact prune can only explore the same or fewer configs
+    if out_sort.get("engine") == out_ap.get("engine") == "device-bfs":
+        assert out_ap["configs"] <= out_sort["configs"]

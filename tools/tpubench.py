@@ -82,8 +82,11 @@ def main() -> None:
         S = 4 * F
         rng = np.random.default_rng(0)
 
-        # --- full kernel level -----------------------------------------
-        fn = lin.get_kernel(model, dims)
+        # --- full kernel level, BOTH dominance prunes ------------------
+        # the all-pairs prune exists to beat the sort pipeline's per-op
+        # overhead floor at narrow widths; these paired rows are the
+        # decisive on-chip measurement (skip all-pairs where its [M,M]
+        # intermediates get silly — auto never picks it there either)
         kargs = (jnp.asarray(esp.det_f), jnp.asarray(esp.det_v1),
                  jnp.asarray(esp.det_v2), jnp.asarray(esp.det_inv),
                  jnp.asarray(esp.det_ret),
@@ -91,43 +94,54 @@ def main() -> None:
                  jnp.asarray(esp.crash_f), jnp.asarray(esp.crash_v1),
                  jnp.asarray(esp.crash_v2), jnp.asarray(esp.crash_inv),
                  jnp.int32(es.n_det), jnp.int32(es.n_crash))
-        carry = tuple(jnp.asarray(c) for c in lin._init_carry(dims, model))
         lvls = jnp.int32(args.levels)
+        modes = ["sort"] + (["allpairs"] if S <= lin._ALLPAIRS_MAX
+                            else [])
+        mode0 = lin._DOMINANCE_MODE
+        for mode in modes:
+            lin._DOMINANCE_MODE = mode
+            try:
+                fn = lin.get_kernel(model, dims)
+                carry = tuple(jnp.asarray(c)
+                              for c in lin._init_carry(dims, model))
 
-        def level_fn(*a):
-            return fn(*a[:12], jnp.int32(10**9), lvls, jnp.bool_(False),
-                      *a[12:])
+                def level_fn(*a):
+                    return fn(*a[:12], jnp.int32(10**9), lvls,
+                              jnp.bool_(False), *a[12:])
 
-        t0 = time.perf_counter()
-        out = level_fn(*kargs, *carry)
-        jax.block_until_ready(out)
-        t_compile = time.perf_counter() - t0
-        # repeat like every other row: a single-shot reading straight
-        # after a ~30s tunnel compile has been observed BELOW the
-        # ~14ms dispatch floor (r4, F=8192) — an artifact, not physics
-        dts = []
-        for _ in range(rep):
-            t0 = time.perf_counter()
-            out = level_fn(*kargs, *carry)
-            jax.block_until_ready(out)
-            dts.append(time.perf_counter() - t0)
-        _fr, count, status, configs, max_depth, ovf = out
-        # levels actually executed (each level linearizes one det op);
-        # the while_loop exits early on frontier death / verdict.
-        # max_depth snapshots the ENTRY frontier of the last body
-        # iteration (depth starts at 0), so L executed levels report
-        # max_depth = L-1
-        lvls_run = int(max_depth) + 1
-        print(json.dumps({
-            "op": f"kernel-{args.levels}-levels", "F": F, "K": K,
-            "WORDS": WORDS,
-            "ms_per_level": round(min(dts) / lvls_run * 1000, 4),
-            "ms_per_level_mean": round(sum(dts) / len(dts) / lvls_run
-                                       * 1000, 4),
-            "levels_run": lvls_run,
-            "carry": {"count": int(count), "status": int(status),
-                      "configs": int(configs), "ovf": bool(ovf)},
-            "compile_s": round(t_compile, 2)}), flush=True)
+                t0 = time.perf_counter()
+                out = level_fn(*kargs, *carry)
+                jax.block_until_ready(out)
+                t_compile = time.perf_counter() - t0
+                # repeat like every other row: a single-shot reading
+                # straight after a ~30s tunnel compile has been observed
+                # BELOW the ~14ms dispatch floor (r4, F=8192) — an
+                # artifact, not physics
+                dts = []
+                for _ in range(rep):
+                    t0 = time.perf_counter()
+                    out = level_fn(*kargs, *carry)
+                    jax.block_until_ready(out)
+                    dts.append(time.perf_counter() - t0)
+            finally:
+                lin._DOMINANCE_MODE = mode0
+            _fr, count, status, configs, max_depth, ovf = out
+            # levels actually executed (each level linearizes one det
+            # op); the while_loop exits early on frontier death /
+            # verdict.  max_depth snapshots the ENTRY frontier of the
+            # last body iteration (depth starts at 0), so L executed
+            # levels report max_depth = L-1
+            lvls_run = int(max_depth) + 1
+            print(json.dumps({
+                "op": f"kernel-{args.levels}-levels", "F": F, "K": K,
+                "WORDS": WORDS, "dominance": mode,
+                "ms_per_level": round(min(dts) / lvls_run * 1000, 4),
+                "ms_per_level_mean": round(sum(dts) / len(dts)
+                                           / lvls_run * 1000, 4),
+                "levels_run": lvls_run,
+                "carry": {"count": int(count), "status": int(status),
+                          "configs": int(configs), "ovf": bool(ovf)},
+                "compile_s": round(t_compile, 2)}), flush=True)
 
         # --- isolated pieces at the same shapes ------------------------
         keys32 = jnp.asarray(
@@ -192,6 +206,37 @@ def main() -> None:
 
         bench_one(f"sort_dominance S={S}", dom_fn, cfgs, mask,
                   repeat=rep)
+
+        # 64 chained prunes in ONE dispatch: the standalone rows above
+        # are floored by the ~14ms tunnel dispatch cost; these isolate
+        # the true in-kernel per-application cost of each prune form
+        # (the chain is data-dependent, so nothing hoists)
+        def loop64(prune_fn):
+            def run(c, m):
+                def body(_i, carry):
+                    cc, mm = carry
+                    kept, sc = prune_fn(cc, mm)
+                    # the output must differ from the input or XLA
+                    # recognizes the loop body as identity and deletes
+                    # the chain (observed: a 0.0005 ms "prune")
+                    return sc + kept[:, None].astype(jnp.int32), mm
+                return lax.fori_loop(0, 64, body, (c, m))[0].sum()
+            return run
+
+        def sort_prune(c, m):
+            pwh, popc = lin._pw_parts(c, dims)
+            kept, sc, _ = lin._sort_dominance(pwh, popc, m, c, S, dims)
+            return kept, sc
+
+        bench_one(f"sort_dominance-loop64 S={S}", loop64(sort_prune),
+                  cfgs, mask, repeat=rep)
+        if S <= lin._ALLPAIRS_MAX:
+            def ap_prune(c, m):
+                kept = lin._allpairs_dominance(c, m, dims)
+                return kept, c
+
+            bench_one(f"allpairs_dominance-loop64 S={S}",
+                      loop64(ap_prune), cfgs, mask, repeat=rep)
         bench_one(f"neighbor-dedup S={S}",
                   lambda c: (jnp.all(c[1:] == c[:-1], axis=1)).sum(),
                   cfgs, repeat=rep)

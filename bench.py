@@ -505,6 +505,10 @@ def run_tier_child(name: str, budget: int) -> None:
             # elapsed would inflate cumulative time)
             prior_elapsed, prior_slices = 0.0, 0
             prior_backends = set()
+            # slices recorded during the failed attempt would corrupt
+            # the rate telescoping (the fresh run's config counter
+            # restarts near 0 — negative deltas across the boundary)
+            slices.clear()
             for p in (ckpt, ckpt + ".meta.json"):
                 try:
                     os.remove(p)

@@ -492,6 +492,10 @@ _ALLPAIRS_ELEMS = int(os.environ.get("JEPSEN_TPU_ALLPAIRS_ELEMS",
 
 
 def _use_allpairs(M: int, batch: int = 1) -> bool:
+    """Decide the prune implementation for an M-row site.  Called at
+    kernel BUILD time only (the builders hoist the result), so the
+    decision is always consistent with the cache key computed from the
+    same module state."""
     if _DOMINANCE_MODE == "allpairs":
         return batch * M * M <= _ALLPAIRS_ELEMS
     if _DOMINANCE_MODE == "sort":
@@ -502,6 +506,20 @@ def _use_allpairs(M: int, batch: int = 1) -> bool:
         backend = "cpu"
     return (backend == "tpu" and M <= _ALLPAIRS_MAX
             and batch * M * M <= _ALLPAIRS_ELEMS)
+
+
+def _prune_rows(cfgs, valid, M: int, dims: SearchDims,
+                use_allpairs: bool):
+    """Dominance prune over M rows — the ONE dispatch point shared by
+    the single-device, batch, and sharded kernels.  Returns (kept,
+    cfgs_out, origin): origin[i] is the input row behind output row i
+    (identity for the order-preserving all-pairs path, the sort
+    permutation otherwise), so block-origin tests work uniformly."""
+    if use_allpairs:
+        return (_allpairs_dominance(cfgs, valid, dims), cfgs,
+                jnp.arange(M, dtype=jnp.int32))
+    pwh, popc = _pw_parts(cfgs, dims)
+    return _sort_dominance(pwh, popc, valid, cfgs, M, dims)
 
 
 def _level_mask(pieces, op_args, frontier, alive):
@@ -578,16 +596,10 @@ def build_search_step_fn(model: ModelSpec, dims: SearchDims,
     W = dims.window
     S = 4 * F
     pieces = _make_kernel_pieces(model, dims)
-
-    def prune(cfgs, valid, M: int):
-        """Dominance prune, implementation chosen at BUILD time per
-        (backend, M, batch): returns (kept, cfgs_out, perm) where perm
-        is None for the order-preserving all-pairs path (kept/cfgs_out
-        are in input order) and the sort permutation otherwise."""
-        if _use_allpairs(M, batch):
-            return _allpairs_dominance(cfgs, valid, dims), cfgs, None
-        pwh, popc = _pw_parts(cfgs, dims)
-        return _sort_dominance(pwh, popc, valid, cfgs, M, dims)
+    # prune implementation per site, decided at BUILD time (consistent
+    # with the cache keys, which carry _dominance_key())
+    ap_cl = _use_allpairs(2 * F, batch)
+    ap_det = _use_allpairs(S, batch)
 
     def step(det_f, det_v1, det_v2, det_inv, det_ret, sfx_min,
              crash_f, crash_v1, crash_v2, crash_inv, n_det, n_crash,
@@ -650,7 +662,8 @@ def build_search_step_fn(model: ModelSpec, dims: SearchDims,
                 ovf = ovf | (n_valid > F)
                 merged = jnp.concatenate([frontier, ccfgs], axis=0)
                 mvalid = jnp.concatenate([alive, cvalid])
-                kept, scfgs, perm = prune(merged, mvalid, 2 * F)
+                kept, scfgs, origin = _prune_rows(merged, mvalid, 2 * F,
+                                                  dims, ap_cl)
                 src, new_count = _compact_indices(kept, F)
                 new_frontier = jnp.take(scfgs, src, axis=0)
                 ovf = ovf | (new_count > F)
@@ -661,8 +674,6 @@ def build_search_step_fn(model: ModelSpec, dims: SearchDims,
                 # surviving rows' crash successors were all generated
                 # and merged this round, and dropped rows are covered by
                 # their dominators — the level is closed.
-                origin = (jnp.arange(2 * F, dtype=jnp.int32)
-                          if perm is None else perm)
                 progress = jnp.any(kept & (origin >= F))
                 # configs is NOT bumped here: closure-added rows are
                 # part of this level and the det phase counts the closed
@@ -700,7 +711,8 @@ def build_search_step_fn(model: ModelSpec, dims: SearchDims,
             dcfgs, dvalid, n_valid = succ_block(
                 frontier, dvalidf, cand2, ns2, S)
             ovf = ovf | (n_valid > S)
-            kept, scfgs, _perm = prune(dcfgs, dvalid, S)
+            kept, scfgs, _origin = _prune_rows(dcfgs, dvalid, S, dims,
+                                               ap_det)
             src, new_count = _compact_indices(kept, F)
             new_frontier = jnp.take(scfgs, src, axis=0)
             ovf = ovf | (new_count > F)
@@ -777,6 +789,11 @@ def build_sharded_search_step_fn(model: ModelSpec, dims: SearchDims,
     C_CR = max(64, _round_up(2 * F // D, 32))
 
     pieces = _make_kernel_pieces(model, dims)
+    # prune implementation per merge site, decided at BUILD time; the
+    # D shards run the [m, m] comparison data-parallel, so D is the
+    # effective batch for the memory budget
+    ap_cl = _use_allpairs(F + D * C_CR, D)
+    ap_det = _use_allpairs(D * C_DET, D)
 
     def route(cfgs, valid, cap: int):
         """all_to_all home-routing by pw-hash.  Returns the received
@@ -800,22 +817,25 @@ def build_sharded_search_step_fn(model: ModelSpec, dims: SearchDims,
         rvalid = lane < jnp.repeat(recv_cnt, cap)
         return rcfgs, rvalid, r_ovf
 
-    def merge_dominance(local_cfgs, local_valid, in_cfgs, in_valid):
+    def merge_dominance(local_cfgs, local_valid, in_cfgs, in_valid,
+                        use_ap):
         """Dominance-prune the union of resident + received rows into a
         fresh F-row frontier.  Locality = globality: both inputs are
         pw-home on this shard.  (Exception: the root config starts on
         device 0 whatever its hash — at level 0 it has no siblings, so
-        a missed prune there only wastes a row, never drops one.)"""
+        a missed prune there only wastes a row, never drops one.)
+
+        Per-shard merges are narrow by construction (the global
+        frontier splits D ways); ``use_ap`` is the build-time selector
+        result for this site."""
         merged = jnp.concatenate([local_cfgs, in_cfgs], axis=0)
         mvalid = jnp.concatenate([local_valid, in_valid])
-        m = merged.shape[0]
-        pwh, popc = _pw_parts(merged, dims)
-        kept, scfgs, perm = _sort_dominance(pwh, popc, mvalid, merged,
-                                            m, dims)
+        kept, scfgs, origin = _prune_rows(merged, mvalid,
+                                          merged.shape[0], dims, use_ap)
         src, new_count = _compact_indices(kept, F)
         new_frontier = jnp.take(scfgs, src, axis=0)
         m_ovf = new_count > F
-        progress = jnp.any(kept & (perm >= local_cfgs.shape[0]))
+        progress = jnp.any(kept & (origin >= local_cfgs.shape[0]))
         return new_frontier, jnp.minimum(new_count, F), m_ovf, progress
 
     def step_device(det_f, det_v1, det_v2, det_inv, det_ret, sfx_min,
@@ -866,7 +886,8 @@ def build_sharded_search_step_fn(model: ModelSpec, dims: SearchDims,
                 rcfgs, rvalid, r_ovf = route(ccfgs, cvalid, C_CR)
                 ovf = ovf | r_ovf
                 new_frontier, new_count, m_ovf, progress_loc = \
-                    merge_dominance(frontier, alive, rcfgs, rvalid)
+                    merge_dominance(frontier, alive, rcfgs, rvalid,
+                                    ap_cl)
                 ovf = ovf | m_ovf
                 progress = lax.psum(progress_loc.astype(jnp.int32),
                                     axis) > 0
@@ -895,7 +916,7 @@ def build_sharded_search_step_fn(model: ModelSpec, dims: SearchDims,
             ovf = ovf | r_ovf
             empty = jnp.zeros((0, WORDS), jnp.int32)
             new_frontier, new_count, m_ovf, _pr = merge_dominance(
-                empty, jnp.zeros((0,), bool), rcfgs, rvalid)
+                empty, jnp.zeros((0,), bool), rcfgs, rvalid, ap_det)
             ovf = ovf | m_ovf
 
             found = lax.psum(found_loc.astype(jnp.int32), axis) > 0
@@ -1154,7 +1175,7 @@ def search_opseq_sharded(seq: OpSeq, model: ModelSpec, mesh, *,
         bail = dims.frontier < MAX_FRONTIER
         mesh_key = (tuple(mesh.shape.items()),
                     tuple(d.id for d in mesh.devices.flat))
-        key = (model.name, dims, axis, mesh_key)
+        key = (model.name, dims, axis, mesh_key, _dominance_key())
         fn = _SHARDED_CACHE.get(key)
         if fn is None:
             fn = jax.jit(build_sharded_search_step_fn(

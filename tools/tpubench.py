@@ -101,6 +101,11 @@ def main() -> None:
         for mode in modes:
             lin._DOMINANCE_MODE = mode
             try:
+                # what the selector ACTUALLY chooses per site under
+                # this mode (a forced "allpairs" can still fall back to
+                # sort past the element budget — the row must say so)
+                ap_cl = lin._use_allpairs(2 * F)
+                ap_det = lin._use_allpairs(4 * F)
                 fn = lin.get_kernel(model, dims)
                 carry = tuple(jnp.asarray(c)
                               for c in lin._init_carry(dims, model))
@@ -135,6 +140,7 @@ def main() -> None:
             print(json.dumps({
                 "op": f"kernel-{args.levels}-levels", "F": F, "K": K,
                 "WORDS": WORDS, "dominance": mode,
+                "allpairs_closure": ap_cl, "allpairs_det": ap_det,
                 "ms_per_level": round(min(dts) / lvls_run * 1000, 4),
                 "ms_per_level_mean": round(sum(dts) / len(dts)
                                            / lvls_run * 1000, 4),

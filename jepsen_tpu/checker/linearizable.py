@@ -325,15 +325,58 @@ def _select_enabled(mask, k_out: int):
     return _kth_bit_in_word(w, r) + wi * 32, n
 
 
-def _compact_indices(mask, k_out: int):
+#: compaction implementation: "search" (cumsum + searchsorted),
+#: "matrix" (one-hot reduce), or "auto" — matrix on TPU when the
+#: [k_out, n] one-hot fits the element budget.  searchsorted compiles
+#: to a while loop + ~15 fusions; on TPU that fixed op count floors
+#: narrow levels (the compaction runs 2-3x per level), while the
+#: matrix form is ~5 large VPU ops.
+_COMPACT_MODE = os.environ.get("JEPSEN_TPU_COMPACT", "auto")
+_COMPACT_ELEMS = int(os.environ.get("JEPSEN_TPU_COMPACT_ELEMS",
+                                    str(1 << 24)))
+
+
+def _use_matrix_compact(k_out: int, n: int, batch: int = 1) -> bool:
+    """``batch`` multiplies the [k_out, n] one-hot: a vmapped kernel
+    (batch keys) or a vmap-over-destinations route materializes one
+    instance per lane, exactly like `_use_allpairs`'s budget."""
+    if _COMPACT_MODE == "matrix":
+        return True
+    if _COMPACT_MODE == "search":
+        return False
+    try:
+        backend = jax.default_backend()
+    except Exception:  # noqa: BLE001 — no backend: assume host
+        backend = "cpu"
+    return backend == "tpu" and batch * k_out * n <= _COMPACT_ELEMS
+
+
+def _compact_indices(mask, k_out: int, batch: int = 1):
     """Indices of the first k_out set lanes of a bool mask (stable), plus
-    the total count.  Sort-free stream compaction: cumsum + binary-search
-    gather — O(n + k log n) instead of an argsort (XLA sorts are the
-    bottleneck on both CPU and TPU backends)."""
+    the total count.  Sort-free stream compaction; two forms with
+    identical semantics (out-of-range output rows hold an arbitrary
+    in-bounds index — callers mask on the count):
+
+    * cumsum + binary-search gather — O(n + k log n);
+    * one-hot matrix reduce — O(k*n) FLOPs but a handful of large ops
+      (picked on TPU at narrow widths, where op COUNT is the floor).
+
+    ``batch`` is the memory-budget hint for callers whose instance gets
+    vmapped (the form choice is static per call site)."""
     csum = jnp.cumsum(mask.astype(jnp.int32))
-    targets = jnp.arange(1, k_out + 1, dtype=jnp.int32)
-    idx = jnp.searchsorted(csum, targets, side="left")
     n = mask.shape[0]
+    targets = jnp.arange(1, k_out + 1, dtype=jnp.int32)
+    if _use_matrix_compact(k_out, n, batch):
+        # rank[i] = 1-based rank of lane i among set lanes (0 if unset);
+        # each target rank matches exactly one lane, so the masked
+        # iota-reduce recovers its index (unmatched targets sum to 0 —
+        # in-bounds, masked by the count downstream)
+        rank = jnp.where(mask, csum, 0)
+        onehot = rank[None, :] == targets[:, None]
+        idx = (onehot * jnp.arange(n, dtype=jnp.int32)[None, :]).sum(
+            axis=1)
+        return idx.astype(jnp.int32), csum[-1]
+    idx = jnp.searchsorted(csum, targets, side="left")
     return jnp.minimum(idx, n - 1).astype(jnp.int32), csum[-1]
 
 
@@ -530,11 +573,13 @@ def _level_mask(pieces, op_args, frontier, alive):
     return pieces["expand_mask"](frontier, alive, base, *sargs)
 
 
-def _succ_block(pieces, frontier, validf, cand2, ns2, cap: int, K: int):
+def _succ_block(pieces, frontier, validf, cand2, ns2, cap: int, K: int,
+                batch: int = 1):
     """Compact the [F*K] valid lane mask to ``cap`` survivors and build
-    their packed successor words."""
+    their packed successor words.  ``batch`` is the vmap memory-budget
+    hint for the compaction."""
     F = frontier.shape[0]
-    vsrc, n_valid = _compact_indices(validf, cap)
+    vsrc, n_valid = _compact_indices(validf, cap, batch)
     row = vsrc // K
     src_cfg = jnp.take(frontier, row, axis=0)
     src_lane = jnp.take(cand2.reshape(F * K), vsrc)
@@ -616,7 +661,7 @@ def build_search_step_fn(model: ModelSpec, dims: SearchDims,
 
         def succ_block(frontier, validf, cand2, ns2, cap: int):
             return _succ_block(pieces, frontier, validf, cand2, ns2,
-                               cap, K)
+                               cap, K, batch)
 
         def cond(c):
             _, count, status, configs, _, ovf, lvl = c
@@ -664,7 +709,7 @@ def build_search_step_fn(model: ModelSpec, dims: SearchDims,
                 mvalid = jnp.concatenate([alive, cvalid])
                 kept, scfgs, origin = _prune_rows(merged, mvalid, 2 * F,
                                                   dims, ap_cl)
-                src, new_count = _compact_indices(kept, F)
+                src, new_count = _compact_indices(kept, F, batch)
                 new_frontier = jnp.take(scfgs, src, axis=0)
                 ovf = ovf | (new_count > F)
                 new_count = jnp.minimum(new_count, F)
@@ -713,7 +758,7 @@ def build_search_step_fn(model: ModelSpec, dims: SearchDims,
             ovf = ovf | (n_valid > S)
             kept, scfgs, _origin = _prune_rows(dcfgs, dvalid, S, dims,
                                                ap_det)
-            src, new_count = _compact_indices(kept, F)
+            src, new_count = _compact_indices(kept, F, batch)
             new_frontier = jnp.take(scfgs, src, axis=0)
             ovf = ovf | (new_count > F)
             new_count = jnp.minimum(new_count, F)
@@ -803,7 +848,7 @@ def build_sharded_search_step_fn(model: ModelSpec, dims: SearchDims,
 
         def bucket(d):
             mask = valid & (owner == d)
-            idx, cnt = _compact_indices(mask, cap)
+            idx, cnt = _compact_indices(mask, cap, D)
             return jnp.take(cfgs, idx, axis=0), cnt
 
         send_cfgs, send_cnt = jax.vmap(bucket)(
@@ -1373,14 +1418,15 @@ def _widen_sharded_carry(carry, d: int, old_f: int, new_f: int):
 
 
 def _dominance_key():
-    """Everything `_use_allpairs` depends on — part of the kernel cache
-    key so a mode flip (tests; env overrides) can't reuse a kernel built
-    for the other prune."""
+    """Everything the prune/compaction selectors depend on — part of
+    the kernel cache key so a mode flip (tests; env overrides) can't
+    reuse a kernel built for the other implementation."""
     try:
         backend = jax.default_backend()
     except Exception:  # noqa: BLE001
         backend = "cpu"
-    return (_DOMINANCE_MODE, _ALLPAIRS_MAX, _ALLPAIRS_ELEMS, backend)
+    return (_DOMINANCE_MODE, _ALLPAIRS_MAX, _ALLPAIRS_ELEMS,
+            _COMPACT_MODE, _COMPACT_ELEMS, backend)
 
 
 def get_kernel(model: ModelSpec, dims: SearchDims):

@@ -92,6 +92,27 @@ def test_allpairs_keeps_subset_of_sort_distinct_values(seed):
     assert ap_vals <= s_vals
 
 
+@pytest.mark.parametrize("seed", range(4))
+@pytest.mark.parametrize("k_out,n", [(8, 64), (64, 256), (256, 100)])
+def test_matrix_compact_matches_search_compact(seed, k_out, n,
+                                               monkeypatch):
+    """Both compaction forms return identical in-range rows and the
+    same count (rows past the count are arbitrary in-bounds indices)."""
+    rng = np.random.default_rng(seed)
+    mask = jnp.asarray(rng.random(n) < 0.3)
+    monkeypatch.setattr(lin, "_COMPACT_MODE", "search")
+    idx_s, cnt_s = lin._compact_indices(mask, k_out)
+    monkeypatch.setattr(lin, "_COMPACT_MODE", "matrix")
+    idx_m, cnt_m = lin._compact_indices(mask, k_out)
+    assert int(cnt_s) == int(cnt_m)
+    c = min(int(cnt_s), k_out)
+    np.testing.assert_array_equal(np.asarray(idx_s)[:c],
+                                  np.asarray(idx_m)[:c])
+    # every returned index in-bounds either way (callers gather first,
+    # mask later)
+    assert np.all((np.asarray(idx_m) >= 0) & (np.asarray(idx_m) < n))
+
+
 def _fuzz_history(seed, n_ops=40, n_procs=4, crash_p=0.15):
     import random
 

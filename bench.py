@@ -1006,7 +1006,11 @@ def main():
     # it: the comparators are what turn tier times into speedups, and a
     # ladder that spends _remaining() to the floor would bank a bench
     # with null vs_baseline forever
-    host_reserve = (float(os.environ.get("BENCH_HOST_RESERVE_S", "150"))
+    # default = HOST_S: host_comparators spends share-of-HOST_S per tier
+    # and keeps its own 120s emit slack, so a smaller reserve silently
+    # undecides the 10k comparator (~52s on the r4 bench host)
+    host_reserve = (float(os.environ.get("BENCH_HOST_RESERVE_S",
+                                         str(HOST_S)))
                     if defer_host else 20.0)
     for name, n_ops, n_procs, budget, headline, tier_s in tiers:
         late_probe_check()
@@ -1030,9 +1034,14 @@ def main():
                   "tiers to CPU (probe restarted)", file=sys.stderr)
             force_cpu = True
             restart_probe()
-            if _remaining() > 45:
+            # the retry must leave the deferred host phase its reserve
+            # too, or a wedge on the last tier starves the comparators
+            # and every headline re-records with null speedups
+            retry_cap = _remaining() - (host_reserve if defer_host
+                                        else 15)
+            if retry_cap > 45:
                 res = run_tier(name, budget, tier_s, force_cpu=True,
-                               timeout=min(_remaining() - 15,
+                               timeout=min(retry_cap,
                                            tier_s * 2.2 + 60))
         if res is None:
             continue

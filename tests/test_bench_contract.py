@@ -64,6 +64,27 @@ def test_batch_stats_no_pool():
     assert s["vs_baseline"] is None
 
 
+def test_wide_tier_is_wide_and_near_nominal():
+    # BASELINE config #5's 64-proc worst-case-frontier variant: the
+    # encoding must actually be wide (the tier exists to stress big
+    # levels) and close to its nominal size
+    import jepsen_tpu.checker.linearizable as lin
+
+    seq, model = bench.make_seq("10k64")
+    assert abs(len(seq) - 10_000) <= 16
+    es = lin.encode_search(seq)
+    assert es.concurrency >= 24, es.concurrency
+    assert es.window >= 128, es.window
+
+
+def test_wide_tier_is_last_and_not_headline():
+    # lowest priority: usually undecided; must never displace the 10k
+    # headline or spend earlier tiers' budget
+    names = [t[0] for t in bench.TIERS]
+    assert names[-1] == "10k64"
+    assert bench.TIERS[-1][4] is False
+
+
 def test_batch_tier_runs_before_the_10k():
     # the 10k is the search observed to wedge an open tunnel (r4); it
     # must not be able to cost batch256 its only accelerator window

@@ -1040,7 +1040,11 @@ def main():
         resumed run's rate is cumulative across backends and would
         blend CPU-explored work into the accelerator's numerator."""
         if not (name == "10k64" and res["backend"] not in (None, "cpu")
+                and not res.get("resumed")
                 and _remaining() > host_reserve + tier_s + 60):
+            # resumed runs never get the ratio (blended-backend rate),
+            # so don't spend ~20% of the budget on a sibling whose
+            # comparison would be suppressed anyway
             return
         sib = run_tier(name, budget, tier_s, force_cpu=True,
                        timeout=min(_remaining() - host_reserve - 30,

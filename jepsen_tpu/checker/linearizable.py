@@ -339,9 +339,13 @@ _COMPACT_ELEMS = int(os.environ.get("JEPSEN_TPU_COMPACT_ELEMS",
 def _use_matrix_compact(k_out: int, n: int, batch: int = 1) -> bool:
     """``batch`` multiplies the [k_out, n] one-hot: a vmapped kernel
     (batch keys) or a vmap-over-destinations route materializes one
-    instance per lane, exactly like `_use_allpairs`'s budget."""
+    instance per lane, exactly like `_use_allpairs`'s budget.
+
+    Forced "matrix" still honors the element budget — an escalated
+    frontier (width 256k was reached by the r4 wide-history fuzz)
+    would otherwise ask for a >100 GB one-hot and OOM the process."""
     if _COMPACT_MODE == "matrix":
-        return True
+        return batch * k_out * n <= _COMPACT_ELEMS
     if _COMPACT_MODE == "search":
         return False
     try:

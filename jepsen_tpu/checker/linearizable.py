@@ -2052,8 +2052,10 @@ def _drive_batch_compacting(fn, esps, model: ModelSpec, dims: SearchDims,
     HALF the current lanes, the stacked args/carry are rebuilt at the
     smaller grid size (pad lanes carry status=VALID, count=0: they mask
     out immediately).  The grid steps in multiples of 32 above 32 lanes
-    (pow2 below), and the halving rule bounds re-traces to ~log2(n)
-    batch sizes per drive, all served by the persistent compile cache.
+    (pow2 below); the shrink rule (live set fits HALF the lanes on
+    hosts, a QUARTER on TPU where each re-stack is a costly fresh
+    compile) bounds re-traces to ~log2(n) / ~log4(n) batch sizes per
+    drive, all served by the persistent compile cache.
 
     Returns final (status, count, configs, depth, ovf) arrays over ALL
     keys, in input order.
@@ -2083,6 +2085,16 @@ def _drive_batch_compacting(fn, esps, model: ModelSpec, dims: SearchDims,
                 pad_row = pad_row + VALID  # pad lanes: masked out
             cs.append(jnp.asarray(np.stack(rows + [pad_row] * pad)))
         return args, tuple(cs)
+
+    try:
+        _backend = jax.default_backend()
+    except Exception:  # noqa: BLE001 — no backend: assume host
+        _backend = "cpu"
+    # every re-stack is a fresh vmapped-kernel shape; an uncached
+    # compile through the tunnel costs 10-90 s — far more than the
+    # padded lanes it saves — so the accelerator waits for a QUARTER
+    # fit (~log4(n) sizes) where hosts re-stack at HALF (~log2(n))
+    shrink = 4 if _backend == "tpu" else 2
 
     row0 = tuple(np.asarray(c)[0]
                  for c in _init_batch_carry(1, dims, model))
@@ -2122,10 +2134,7 @@ def _drive_batch_compacting(fn, esps, model: ModelSpec, dims: SearchDims,
         if not first:
             lvl_cap = _adapt_lvl_cap(lvl_cap, dt)
         first = False
-        # re-stack only when the live set fits HALF the current lanes:
-        # bounds shape churn to ~log2(n) stacks per drive even though
-        # the grid itself steps in multiples of 32
-        if grid(len(live)) * 2 <= grid(len(lanes)):
+        if grid(len(live)) * shrink <= grid(len(lanes)):
             rows = [tuple(np.asarray(c)[i] for c in carry) for i in live]
             lanes = [lanes[i] for i in live]
             args, carry = stack(lanes, rows)

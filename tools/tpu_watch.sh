@@ -63,7 +63,7 @@ PY
     stamp=$(date -u +%H%M%S)
     if [ ! -f "$OUT/.batch_done" ]; then
       log "tunnel UP (probe $n); batch256 child -> batch256_tpu_$stamp"
-      BENCH_TIER_S=120 timeout 420 python bench.py \
+      BENCH_TIER_S=180 timeout 420 python bench.py \
         --run-tier batch256 --budget 2000000 \
         > "$OUT/batch256_tpu_$stamp.json" \
         2> "$OUT/batch256_tpu_$stamp.err"

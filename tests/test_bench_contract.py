@@ -64,7 +64,34 @@ def test_batch_stats_no_pool():
     assert s["vs_baseline"] is None
 
 
-def test_wide_tier_is_wide_and_near_nominal():
+def test_checkpoint_resumes_across_prune_modes(tmp_path):
+    """A carry accumulated under one prune implementation resumes under
+    the other (the cross-backend reality: a TPU window checkpoints with
+    the all-pairs kernel, the round-end CPU bench finishes the search
+    with the sort kernel).  Both prunes are sound, so any interleaving
+    must still decide correctly."""
+    import json
+    import subprocess
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+    def run(tier_s, mode):
+        env = {**os.environ, "JAX_PLATFORMS": "cpu",
+               "BENCH_CKPT_DIR": str(tmp_path), "BENCH_TIER_S": tier_s,
+               "JEPSEN_TPU_DOMINANCE": mode}
+        out = subprocess.run(
+            [sys.executable, os.path.join(repo, "bench.py"),
+             "--run-tier", "1k", "--budget", "5000000"],
+            capture_output=True, text=True, env=env, timeout=300)
+        assert out.returncode == 0, out.stderr[-800:]
+        return json.loads(out.stdout.strip().splitlines()[-1])
+
+    r1 = run("3", "allpairs")
+    if r1["valid"] != "unknown":
+        pytest.skip("host too fast to leave a checkpoint")
+    r2 = run("150", "sort")
+    assert r2["resumed"] is True
+    assert r2["valid"] is False  # the 1k history's known verdict
     # BASELINE config #5's 64-proc worst-case-frontier variant: the
     # encoding must actually be wide (the tier exists to stress big
     # levels) and close to its nominal size

@@ -612,6 +612,10 @@ def run_tier_child(name: str, budget: int) -> None:
         "backend": jax.default_backend(),
         "resumed": resumed,
         "elapsed_total": round(prior_elapsed + t_first, 3),
+        # every backend that contributed search time to this verdict
+        # (cumulative results must not let a near-finished CPU carry
+        # masquerade as accelerator work, or vice versa)
+        "backends_contributing": sorted(prior_backends | {backend_now}),
     }), flush=True)
 
 
@@ -966,6 +970,8 @@ def main():
                 "resumed": resumed or None,
                 "device_seconds_cumulative": (round(t_basis, 3)
                                               if resumed else None),
+                "backends_contributing": (res.get("backends_contributing")
+                                          if resumed else None),
                 "device_configs": res["configs"],
                 # the failing det-depth (the obstruction's index) on an
                 # invalid verdict

@@ -64,34 +64,39 @@ def test_batch_stats_no_pool():
     assert s["vs_baseline"] is None
 
 
+def _run_tier_child(tmp_path, tier_s, **extra_env):
+    """Spawn one bench tier child (the shared harness for the
+    checkpoint-contract tests) and parse its JSON line."""
+    import json
+    import subprocess
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env = {**os.environ, "JAX_PLATFORMS": "cpu",
+           "BENCH_CKPT_DIR": str(tmp_path), "BENCH_TIER_S": str(tier_s),
+           **extra_env}
+    out = subprocess.run(
+        [sys.executable, os.path.join(repo, "bench.py"),
+         "--run-tier", "1k", "--budget", "5000000"],
+        capture_output=True, text=True, env=env, timeout=300)
+    assert out.returncode == 0, out.stderr[-800:]
+    return json.loads(out.stdout.strip().splitlines()[-1])
+
+
 def test_checkpoint_resumes_across_prune_modes(tmp_path):
     """A carry accumulated under one prune implementation resumes under
     the other (the cross-backend reality: a TPU window checkpoints with
     the all-pairs kernel, the round-end CPU bench finishes the search
     with the sort kernel).  Both prunes are sound, so any interleaving
     must still decide correctly."""
-    import json
-    import subprocess
-
-    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
-
-    def run(tier_s, mode):
-        env = {**os.environ, "JAX_PLATFORMS": "cpu",
-               "BENCH_CKPT_DIR": str(tmp_path), "BENCH_TIER_S": tier_s,
-               "JEPSEN_TPU_DOMINANCE": mode}
-        out = subprocess.run(
-            [sys.executable, os.path.join(repo, "bench.py"),
-             "--run-tier", "1k", "--budget", "5000000"],
-            capture_output=True, text=True, env=env, timeout=300)
-        assert out.returncode == 0, out.stderr[-800:]
-        return json.loads(out.stdout.strip().splitlines()[-1])
-
-    r1 = run("3", "allpairs")
+    r1 = _run_tier_child(tmp_path, 3, JEPSEN_TPU_DOMINANCE="allpairs")
     if r1["valid"] != "unknown":
         pytest.skip("host too fast to leave a checkpoint")
-    r2 = run("150", "sort")
+    r2 = _run_tier_child(tmp_path, 150, JEPSEN_TPU_DOMINANCE="sort")
     assert r2["resumed"] is True
     assert r2["valid"] is False  # the 1k history's known verdict
+
+
+def test_wide_tier_is_wide_and_near_nominal():
     # BASELINE config #5's 64-proc worst-case-frontier variant: the
     # encoding must actually be wide (the tier exists to stress big
     # levels) and close to its nominal size
@@ -124,21 +129,8 @@ def test_tier_child_checkpoints_and_resumes(tmp_path):
     resumes it (reporting resumed+cumulative time) and a decided run
     deletes it.  This is the cross-tunnel-window accumulation contract
     the r4 wedge motivated."""
-    import json
-    import subprocess
-
-    env = {**os.environ, "JAX_PLATFORMS": "cpu",
-           "BENCH_CKPT_DIR": str(tmp_path), "BENCH_TIER_S": "3"}
-    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
-
     def run(tier_s):
-        env["BENCH_TIER_S"] = tier_s
-        out = subprocess.run(
-            [sys.executable, os.path.join(repo, "bench.py"),
-             "--run-tier", "1k", "--budget", "5000000"],
-            capture_output=True, text=True, env=env, timeout=300)
-        assert out.returncode == 0, out.stderr[-800:]
-        return json.loads(out.stdout.strip().splitlines()[-1])
+        return _run_tier_child(tmp_path, tier_s)
 
     r1 = run("3")  # too short to decide on a cold cpu: must checkpoint
     if r1["valid"] == "unknown":

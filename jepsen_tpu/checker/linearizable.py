@@ -1200,8 +1200,18 @@ _SHARDED_CACHE: dict = {}
 def search_opseq_sharded(seq: OpSeq, model: ModelSpec, mesh, *,
                          axis: str = "shard",
                          budget: int = 20_000_000,
-                         frontier_per_device: int = 1024) -> dict:
-    """Check one history with its frontier sharded over `mesh`."""
+                         frontier_per_device: int = 1024,
+                         deadline: float | None = None,
+                         stop=None, on_slice=None) -> dict:
+    """Check one history with its frontier sharded over `mesh`.
+
+    ``deadline``/``stop``/``on_slice(carry, dims)`` mirror
+    `search_opseq`: the drive ends between slices past the deadline
+    (verdict "unknown"), and every slice's carry reaches the hook.
+    The sharded carry ([D*F, WORDS] frontier, [D] counts, replicated
+    counters + total) is NOT `save_checkpoint`-compatible — that format
+    is the single-device 6-tuple; the escalation loop here resumes
+    from in-memory carries only."""
     es = encode_search(seq)
     if es.n_det == 0 and es.n_crash == 0:
         return {"valid": True, "configs": 0, "max_depth": 0,
@@ -1212,7 +1222,8 @@ def search_opseq_sharded(seq: OpSeq, model: ModelSpec, mesh, *,
     if es.window > MAX_WINDOW or es.n_crash > MAX_CRASH:
         from .linear import check_opseq_linear
 
-        out = check_opseq_linear(seq, model)
+        out = check_opseq_linear(seq, model, deadline=deadline,
+                                 cancel=stop)
         out["engine"] = "host-linear(fallback)"
         return out
 
@@ -1267,16 +1278,23 @@ def search_opseq_sharded(seq: OpSeq, model: ModelSpec, mesh, *,
         def track(carry):
             if not sc(carry, 5):  # clean (pre-overflow) carry
                 prev[0] = carry
+            if on_slice is not None:
+                on_slice(carry, dims)
 
-        carry = _drive_slices(call, carry0, is_active, on_slice=track)
+        carry = _drive_slices(call, carry0, is_active, on_slice=track,
+                              deadline=deadline, stop=stop)
         status = sc(carry, 2)
         configs = sc(carry, 3)
         ovf = bool(sc(carry, 5))
         total = sc(carry, 6)
+        timed_out = ((deadline is not None
+                      and time.perf_counter() > deadline)
+                     or (stop is not None and stop.is_set()))
         if status == -1:
             status = (UNKNOWN if ovf else INVALID) if total <= 0 \
                 else UNKNOWN
-        if status == UNKNOWN and ovf and dims.frontier < MAX_FRONTIER:
+        if (status == UNKNOWN and ovf and not timed_out
+                and dims.frontier < MAX_FRONTIER):
             # escalate, resuming from the last clean carry: each
             # device's frontier block zero-pads from F to F' rows
             new_f = _grid_width(dims.frontier * 4)
@@ -1356,7 +1374,8 @@ def _adapt_lvl_cap(lvl_cap: int, dt: float,
     return lvl_cap
 
 
-def _drive_slices(call, carry, is_active, *, on_slice=None):
+def _drive_slices(call, carry, is_active, *, on_slice=None,
+                  deadline: float | None = None, stop=None):
     """Shared host loop for the batch and sharded kernels.  (The
     single-device path has its own driver inside ``_run_kernel``: it
     re-keys the kernel between slices as the frontier width adapts,
@@ -1364,8 +1383,11 @@ def _drive_slices(call, carry, is_active, *, on_slice=None):
 
     ``call(carry, lvl_cap)`` runs one bounded device slice;
     ``is_active(carry)`` says whether another slice is needed;
-    ``on_slice(carry)`` is the checkpoint hook.  The first slice's wall
-    time includes trace+compile, so it never feeds cap adaptation."""
+    ``on_slice(carry)`` is the checkpoint hook.  ``deadline``
+    (perf_counter clock) / ``stop`` (threading.Event) end the drive
+    between slices with the carry as-is — still-active carries map to
+    an "unknown" verdict in the callers.  The first slice's wall time
+    includes trace+compile, so it never feeds cap adaptation."""
     lvl_cap = _SLICE_LEVELS0
     first = True
     while True:
@@ -1376,6 +1398,10 @@ def _drive_slices(call, carry, is_active, *, on_slice=None):
         if on_slice is not None:
             on_slice(carry)
         if not is_active(carry):
+            return carry
+        if deadline is not None and time.perf_counter() > deadline:
+            return carry
+        if stop is not None and stop.is_set():
             return carry
         if not first:
             lvl_cap = _adapt_lvl_cap(lvl_cap, dt)

@@ -141,3 +141,27 @@ def test_distributed_standalone_degrades():
     with mesh:
         got = lin.search_batch(seqs, model, budget=100_000, sharding=sh)
     assert [r["valid"] for r in got] == want
+
+
+def test_sharded_deadline_and_slice_hook(mesh):
+    """The sharded drive honors a deadline (verdict unknown, not a
+    hang) and delivers every slice's carry + dims to on_slice — the
+    scale-out analog of the single-device checkpoint hook."""
+    import time
+
+    rng = random.Random(99)
+    model = cas_register()
+    h = register_history(rng, n_ops=120, n_procs=8, overlap=6,
+                         crash_p=0.1)
+    h = corrupt_read(rng, h, at=0.9)
+    s = encode_ops(h, model.f_codes)
+    seen = []
+    out = lin.search_opseq_sharded(
+        s, model, mesh, frontier_per_device=64,
+        deadline=time.perf_counter() - 1.0,  # already past: one slice
+        on_slice=lambda carry, dims: seen.append(
+            (np.asarray(carry[0]).shape, dims.frontier)))
+    assert out["valid"] in (True, False, "unknown")
+    assert seen, "on_slice never fired"
+    shape, f = seen[0]
+    assert shape[0] == f * mesh.shape["shard"]

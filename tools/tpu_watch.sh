@@ -33,7 +33,7 @@ export JEPSEN_TPU_TRACE_SLICES=1
 log() { echo "$(date -u +%FT%TZ) $*" >> "$OUT/watch.log"; }
 
 if [ -f "$OUT/.batch_done" ] && [ -f "$OUT/.tenk_done" ] \
-   && [ -f "$OUT/.bench_done" ]; then
+   && [ -f "$OUT/.bench_done" ] && [ -f "$OUT/.prune_done" ]; then
   log "all artifacts already banked; exiting"
   exit 0
 fi
@@ -109,10 +109,27 @@ sys.exit(0 if ok else 1)
 PY
       then
         touch "$OUT/.bench_done"
-        log "tpu-backed full bench banked; exiting"
-        exit 0
+        log "tpu-backed full bench banked"
+        continue
       fi
       log "bench finished without a tpu headline; resuming watch"
+    elif [ ! -f "$OUT/.prune_done" ]; then
+      # the decisive sort-vs-allpairs on-chip comparison: paired kernel
+      # rows + dispatch-amortized loop64 prune rows at the narrow rungs
+      log "tunnel UP (probe $n); prune sweep -> prunebench_$stamp"
+      timeout 900 python tools/tpubench.py \
+        --widths 64,256,1024 --levels 64 --repeat 3 \
+        > "$OUT/prunebench_$stamp.jsonl" \
+        2> "$OUT/prunebench_$stamp.err"
+      if [ "$(grep -c '"dominance": "allpairs"' \
+              "$OUT/prunebench_$stamp.jsonl")" -ge 3 ] \
+         && head -1 "$OUT/prunebench_$stamp.jsonl" \
+            | grep -q '"backend": "tpu"'; then
+        touch "$OUT/.prune_done"
+        log "paired prune sweep banked; exiting"
+        exit 0
+      fi
+      log "prune sweep incomplete; resuming watch"
     else
       exit 0
     fi

@@ -350,12 +350,36 @@ def start_probe() -> subprocess.Popen:
     return proc
 
 
+#: the axon terminal's local TCP endpoint (observed listener in the r4
+#: image; only a diagnostic probe target, never a data path)
+TUNNEL_PORT = int(os.environ.get("BENCH_TUNNEL_PORT", "2024"))
+
+
+def _tunnel_endpoint_state() -> str:
+    """TCP state of the axon terminal's local endpoint (the r4 wedge
+    signature: the port ACCEPTS while the worker session beyond is
+    dead, so 'open' + a hung probe means wedged-worker; 'closed' means
+    no tunnel at all; 'timeout' means a listener that stopped
+    answering — present but unresponsive)."""
+    import socket
+
+    try:
+        with socket.create_connection(("127.0.0.1", TUNNEL_PORT),
+                                      timeout=2):
+            return "open"
+    except (TimeoutError, socket.timeout):
+        return "timeout"
+    except OSError:
+        return "closed"
+
+
 def probe_diag(proc: "subprocess.Popen | None", platform,
                waited_s: float) -> dict:
     """Verbatim probe evidence for the emitted JSON."""
     d = {"platform": platform, "waited_s": round(waited_s, 1),
          "returncode": None if proc is None else proc.poll(),
-         "probe_budget_s": PROBE_S}
+         "probe_budget_s": PROBE_S,
+         "tunnel_endpoint_tcp": _tunnel_endpoint_state()}
     try:
         with open(PROBE_LOG) as f:
             tail = f.read()[-2000:]

@@ -60,6 +60,15 @@ print(d.platform)
 PY
 )
   if [ "$up" = "tpu" ]; then
+    # the driver's end-of-round bench owns the chip when it runs: two
+    # clients sharing the wedge-prone worker (and the same .bench_ckpt)
+    # is how evidence gets corrupted — stand down while any other
+    # bench.py is alive
+    if pgrep -f "python.* bench\.py" > /dev/null 2>&1; then
+      log "tunnel UP but another bench.py is running; standing down"
+      sleep 120
+      continue
+    fi
     stamp=$(date -u +%H%M%S)
     if [ ! -f "$OUT/.batch_done" ]; then
       log "tunnel UP (probe $n); batch256 child -> batch256_tpu_$stamp"

@@ -78,10 +78,21 @@ TIERS = [("1k", 1_000, 32, 5_000_000, False, 90.0),
          # BASELINE config #5's worst-case-frontier variant: 64
          # processes at overlap 32 force genuinely WIDE pruned levels —
          # the regime where the device's lockstep lanes should beat the
-         # host outright.  Last (lowest priority): usually undecided
-         # within its deadline, reported as configs/s vs the host
-         # comparator's rate.
-         ("10k64", 10_000, 64, 200_000_000, False, 120.0)]
+         # host outright.  Last (lowest priority); its search
+         # checkpoints to .bench_ckpt, so undecided runs ACCUMULATE
+         # toward a decided verdict across bench invocations.
+         ("10k64", 10_000, 64, 200_000_000, False, 180.0)]
+
+#: the ONE vs_baseline definition every tier row uses (VERDICT r4
+#: weak #7: two unstated, different extrapolation bases made rows of
+#: the same JSON incomparable).  Each row's vs_baseline_basis states
+#: whether its 16-core model was measured or extrapolated, and how.
+VS_BASELINE_CONVENTION = (
+    "vs_baseline = modeled 16-core-host wall seconds / device wall "
+    "seconds, same tier.  The 16-core model is MEASURED when this host "
+    "has >= 8 cores (process portfolio for single histories, process "
+    "pool for the batch tier); otherwise it is an extrapolation whose "
+    "exact basis is stated in that row's vs_baseline_basis.")
 
 _BEST: dict | None = None
 #: priority of the tier behind _BEST: (headline-tier?, decided?,
@@ -253,6 +264,114 @@ def _remaining() -> float:
     return BUDGET_S - (time.time() - T0)
 
 
+DETAIL_PATH = os.path.join(REPO, "BENCH_detail.json")
+#: hard ceiling on the emitted stdout line: the driver records only a
+#: ~2000-char tail of stdout, and r3+r4 both shipped `parsed: null`
+#: because the full detail blob blew through it (VERDICT r4 weak #1)
+_COMPACT_LIMIT = 1400
+
+
+def _tier_mini(d: dict) -> list:
+    """[backend, verdict, device seconds] from a tier detail dict."""
+    v = d.get("device_verdict")
+    if v is None:
+        v = d.get("valid")
+    return [d.get("backend"), v,
+            d.get("device_seconds") if d.get("device_seconds") is not None
+            else d.get("t_dev")]
+
+
+def _best_banked_tpu() -> dict | None:
+    """Newest banked on-chip evidence under docs/tpu/*/ , compact.
+    Full bench headlines (detail.backend == "tpu") outrank tier-child
+    JSONs; within a kind, newest file wins."""
+    import glob
+
+    best = None  # ((kind_rank, mtime), compact-dict)
+    for p in glob.glob(os.path.join(REPO, "docs", "tpu", "*", "*.json")):
+        try:
+            with open(p) as f:
+                j = json.load(f)
+        except (OSError, ValueError):
+            continue
+        if not isinstance(j, dict):
+            continue
+        rel = os.path.relpath(p, REPO)
+        try:
+            mt = os.path.getmtime(p)
+        except OSError:
+            mt = 0.0
+        if (j.get("detail") or {}).get("backend") == "tpu":
+            c = {"kind": "bench_headline", "source": rel,
+                 **{k: j.get(k) for k in ("metric", "value", "unit",
+                                          "vs_baseline")}}
+            rank = (1, mt)
+        elif j.get("backend") == "tpu" and "valid" in j:
+            c = {"kind": "tier_child", "source": rel,
+                 "valid": j.get("valid"), "t_dev": j.get("t_dev"),
+                 "n_ops": j.get("n_ops"), "configs": j.get("configs")}
+            rank = (0, mt)
+        else:
+            continue
+        if best is None or rank > best[0]:
+            best = (rank, c)
+    return best[1] if best else None
+
+
+def _compact_result(result: dict) -> dict:
+    """Shrink the full result to a <= _COMPACT_LIMIT stdout line: the
+    headline numbers, a per-tier mini-table, the probe diagnosis, a
+    pointer to BENCH_detail.json — and, whenever the live run did not
+    land on the TPU, the best BANKED on-chip artifact (tagged
+    evidence: "banked") so the driver artifact is never blind to chip
+    evidence that exists (VERDICT r5 item 3)."""
+    det = result.get("detail") or {}
+    cd: dict = {}
+    for k in ("backend", "engine", "device_verdict", "valid",
+              "device_seconds", "device_seconds_incl_compile",
+              "n_ops", "n_keys", "keys_per_sec", "resumed",
+              "device_configs", "speedup_vs_host_linear_1core",
+              "speedup_vs_host16", "speedup_vs_host_pool",
+              "speedup_vs_host_pool_per_core", "host_cpus", "error"):
+        if det.get(k) is not None:
+            cd[k] = det[k]
+    basis = det.get("vs_baseline_basis")
+    if basis:
+        cd["vs_baseline_basis"] = (basis if len(basis) <= 80
+                                   else basis[:77] + "...")
+    hl = det.get("host_linear")
+    if isinstance(hl, dict):
+        cd["host_linear"] = {k: hl.get(k) for k in ("valid", "seconds")}
+    pr = det.get("probe")
+    if isinstance(pr, dict):
+        cd["probe"] = {k: pr[k] for k in
+                       ("platform", "waited_s", "tunnel_endpoint_tcp",
+                        "restarts") if pr.get(k) is not None}
+    tiers = {}
+    for k, v in det.items():
+        if (k.startswith("tier_") and isinstance(v, dict)
+                and "see" not in v):
+            tiers[k[5:]] = _tier_mini(v)
+    if isinstance(det.get("batch256"), dict):
+        tiers["batch256"] = _tier_mini(det["batch256"])
+    if tiers:
+        cd["tiers"] = tiers
+    cd["full_detail"] = "BENCH_detail.json"
+    if cd.get("backend") != "tpu":
+        banked = _best_banked_tpu()
+        if banked:
+            banked["evidence"] = "banked"
+            cd["banked_tpu"] = banked
+    compact = {k: result.get(k) for k in ("metric", "value", "unit",
+                                          "vs_baseline")}
+    compact["detail"] = cd
+    # last-resort trims, least precious first (banked_tpu never drops)
+    drop = ["tiers", "probe", "vs_baseline_basis", "host_linear"]
+    while len(json.dumps(compact)) > _COMPACT_LIMIT and drop:
+        cd.pop(drop.pop(0), None)
+    return compact
+
+
 def _emit():
     global _EMITTED
     if _EMITTED:
@@ -265,7 +384,19 @@ def _emit():
     if _EXTRA and "detail" in result:
         result["detail"].update(_EXTRA)
     _EMITTED = True
-    print(json.dumps(result), flush=True)
+    try:
+        with open(DETAIL_PATH, "w") as f:
+            json.dump(result, f, indent=1)
+    except OSError as e:
+        print(f"bench: could not write {DETAIL_PATH}: {e}",
+              file=sys.stderr)
+    try:
+        compact = _compact_result(result)
+    except Exception as e:  # noqa: BLE001 — never lose the emit
+        print(f"bench: compact emit failed ({e!r}); emitting full",
+              file=sys.stderr)
+        compact = result
+    print(json.dumps(compact), flush=True)
 
 
 def _kill_proc(proc) -> None:
@@ -491,16 +622,28 @@ def run_tier_child(name: str, budget: int) -> None:
     prior_slices = 0
     resumed = False
     prior_backends: set = set()
+    decided_pending = False
     if ckpt:
         os.makedirs(ckpt_dir, exist_ok=True)
-        try:
-            with open(ckpt + ".meta.json") as f:
-                m = json.load(f)
-            prior_elapsed = float(m.get("elapsed", 0.0))
-            prior_slices = int(m.get("slices", 0))
-            prior_backends = set(m.get("backends", []))
-        except (OSError, ValueError):
-            pass
+        if not os.path.exists(ckpt):
+            # an orphaned meta (npz removed, meta unlink failed or the
+            # child died between the two removes) must not leak stale
+            # accounting — phantom elapsed/backends — into a run that
+            # can never resume the carry it describes
+            try:
+                os.remove(ckpt + ".meta.json")
+            except OSError:
+                pass
+        else:
+            try:
+                with open(ckpt + ".meta.json") as f:
+                    m = json.load(f)
+                prior_elapsed = float(m.get("elapsed", 0.0))
+                prior_slices = int(m.get("slices", 0))
+                prior_backends = set(m.get("backends", []))
+                decided_pending = bool(m.get("decided_pending_tpu"))
+            except (OSError, ValueError):
+                pass
 
     t0 = time.perf_counter()
     backend_now = jax.default_backend()
@@ -530,6 +673,20 @@ def run_tier_child(name: str, budget: int) -> None:
             os.replace(tmp, ckpt + ".meta.json")
 
     out = None
+    if (ckpt and os.path.exists(ckpt) and decided_pending
+            and backend_now == "cpu"):
+        # this carry is DECIDED (a CPU fallback finished a search that
+        # TPU windows had accumulated) and is banked awaiting one
+        # on-chip confirmation slice.  A CPU child must neither replay
+        # it (ADVICE r4: every later CPU run re-decided and re-reported
+        # the verdict as resumed with ever-growing cumulative elapsed)
+        # nor overwrite it — run fresh, checkpoint-free, with fresh
+        # accounting.
+        print("bench: checkpoint is decided-pending-tpu; CPU child "
+              "runs fresh without touching it", file=sys.stderr)
+        ckpt = None
+        prior_elapsed, prior_slices = 0.0, 0
+        prior_backends = set()
     if ckpt and os.path.exists(ckpt):
         try:
             out = lin.resume_opseq(seq, model, ckpt, on_slice=on_slice,
@@ -573,6 +730,22 @@ def run_tier_child(name: str, budget: int) -> None:
                     os.remove(p)
                 except OSError:
                     pass
+        else:
+            # mark the banked carry DECIDED: later CPU children run
+            # fresh (see decided_pending above) and only a TPU child
+            # resumes it — one near-final slice confirms on-chip and
+            # deletes it via the branch above
+            try:
+                with open(ckpt + ".meta.json") as f:
+                    m = json.load(f)
+            except (OSError, ValueError):
+                m = {}
+            m["decided_pending_tpu"] = True
+            m["verdict_cpu"] = out["valid"]
+            tmp = ckpt + ".meta.tmp"
+            with open(tmp, "w") as f:
+                json.dump(m, f)
+            os.replace(tmp, ckpt + ".meta.json")
     t_dev = t_first  # compile-inclusive, as a floor
     # re-run compile-free when the first run finished well under the
     # deadline (then timing measures the kernel, not the compile).
@@ -759,15 +932,19 @@ def host_comparators(tiers) -> dict:
     cores = os.cpu_count() or 1
     n_procs = min(16, cores)
     out: dict = {"host_cpus": cores}
-    # batch has its own pool comparator below; the wide tier (10k64) is
-    # never compared cross-engine (different config spaces — its
-    # denominator is the pinned-CPU device sibling), so neither gets a
-    # host_linear share — diluting the 10k's cap below its ~52s decide
-    # time would null the headline's vs_baseline
+    # batch has its own pool comparator below.  The wide tier (10k64)
+    # runs LAST with its own env-tunable cap instead of a share — it
+    # must never dilute the 10k's cap below its ~52s decide time, but
+    # it must also never ship comparator-free (VERDICT r4 weak #4: an
+    # unknown verdict with host_linear null is a row with no meaning);
+    # an undecided host run still reports seconds + configs.
     measured = [t for t in tiers
                 if not t[0].startswith("batch") and t[0] != "10k64"]
     share = HOST_S / max(1, len(measured))
-    for name, _n_ops, _p, _b, _h, _t in measured:
+    wide = [t for t in tiers if t[0] == "10k64"]
+    for name, _n_ops, _p, _b, _h, _t in measured + wide:
+        if name == "10k64":
+            share = float(os.environ.get("BENCH_HOST_10K64_S", "150"))
         seq, model = make_seq(name)
         cap = max(10.0, min(share, _remaining() - 120))
         t0 = time.perf_counter()
@@ -848,6 +1025,7 @@ def main():
                                     keep_alive=True)
     cores = host.get("host_cpus", os.cpu_count() or 1)
     _EXTRA["host_cpus"] = cores
+    _EXTRA["vs_baseline_convention"] = VS_BASELINE_CONVENTION
     _EXTRA["probe"] = probe_diag(probe, platform, time.time() - t_probe0)
     force_cpu = platform is None
     if force_cpu:

@@ -838,11 +838,17 @@ def build_sharded_search_step_fn(model: ModelSpec, dims: SearchDims,
     C_CR = max(64, _round_up(2 * F // D, 32))
 
     pieces = _make_kernel_pieces(model, dims)
-    # prune implementation per merge site, decided at BUILD time; the
-    # D shards run the [m, m] comparison data-parallel, so D is the
-    # effective batch for the memory budget
-    ap_cl = _use_allpairs(F + D * C_CR, D)
-    ap_det = _use_allpairs(D * C_DET, D)
+    # prune implementation per merge site, decided at BUILD time.  M
+    # already counts every row a device can hold after routing (local F
+    # + D routing buckets of C rows), and under shard_map each device
+    # materializes exactly ONE [M, M] instance — so batch=1, not D
+    # (ADVICE r4: batch=D made the budget test D^3*C^2 and all-pairs
+    # was never selected on sharded runs even at widths where it fits,
+    # which is the TPU-narrow-rung win the mode exists for).  On a
+    # virtual CPU mesh all D instances share one host's RAM, but the
+    # budget guards TPU HBM — hosts never select all-pairs anyway.
+    ap_cl = _use_allpairs(F + D * C_CR)
+    ap_det = _use_allpairs(D * C_DET)
 
     def route(cfgs, valid, cap: int):
         """all_to_all home-routing by pw-hash.  Returns the received
@@ -2013,12 +2019,24 @@ def batch_dims(ess: list[EncodedSearch], model: ModelSpec, *,
 
 def get_batch_kernel(model: ModelSpec, dims: SearchDims,
                      batch: int = 256):
-    # the batch size reaches the built HLO only through the two prune
-    # selections (closure merge at 2F, det expansion at 4F) — key on
-    # those booleans, not the raw count, so a ladder whose live set
-    # shrinks between rungs keeps sharing compiled kernels
-    sel = (_use_allpairs(2 * dims.frontier, batch),
-           _use_allpairs(4 * dims.frontier, batch))
+    # the batch size reaches the built HLO only through the prune and
+    # compaction SELECTIONS — the two dominance sites (closure merge at
+    # 2F, det expansion at 4F) and the four matrix-compaction sites
+    # (crash/det succ-blocks over F*K lanes; closure-merge and
+    # det-expansion compacts) — so key on those booleans, not the raw
+    # count: a ladder whose live set shrinks between rungs keeps
+    # sharing compiled kernels, while a kernel built under a small
+    # batch can never be reused by a larger batch whose one-hot
+    # [batch, k_out, n] exceeds the element budget (ADVICE r4: that
+    # reuse could OOM the TPU — or pessimize the small batch)
+    F, K = dims.frontier, dims.k
+    S = 4 * F
+    sel = (_use_allpairs(2 * F, batch),
+           _use_allpairs(S, batch),
+           _use_matrix_compact(F, F * K, batch),
+           _use_matrix_compact(S, F * K, batch),
+           _use_matrix_compact(F, 2 * F, batch),
+           _use_matrix_compact(F, S, batch))
     key = ("batch", model.name, dims, sel, _dominance_key())
     fn = _KERNEL_CACHE.get(key)
     if fn is None:

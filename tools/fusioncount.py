@@ -1,0 +1,89 @@
+"""Op-count proxy for the on-chip per-level floor.
+
+docs/perf-notes.md (round 4): the measured ~1.3 ms/level floor at
+narrow widths tracks the COUNT of fused computations in the compiled
+level body (~5-10 us fixed overhead each on the axon TPU), not the
+data volume.  This tool compiles the single-device search kernel at a
+given width on the CPU backend and prints computation counts from the
+optimized HLO — the metric every depth-axis optimization is judged by
+before a tunnel window can time it for real.
+
+Usage: JAX_PLATFORMS=cpu python tools/fusioncount.py [--tier mutex2k]
+       [--widths 16,64,256]
+"""
+
+import argparse
+import collections
+import os
+import re
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+
+def count_hlo(text: str) -> dict:
+    """Computation-kind histogram of an optimized HLO module."""
+    c: collections.Counter = collections.Counter()
+    for m in re.finditer(r"^\s*%?([\w.-]+)\s*=", text, re.M):
+        name = m.group(1)
+        if name.startswith("fused_"):
+            c["fusion"] += 1
+    # fusion *calls* in the entry/while bodies are what execute per
+    # iteration; count op kinds too
+    for kind in ("fusion", "while", "sort", "custom-call", "gather",
+                 "scatter", "dynamic-slice", "dynamic-update-slice",
+                 "all-to-all", "reduce", "iota", "transpose", "copy",
+                 "convert", "broadcast", "concatenate", "dot"):
+        c[f"op:{kind}"] = len(re.findall(rf"=\s*\S+\s+{kind}\(", text))
+    c["computations"] = len(re.findall(r"^%?\S+ \{$", text, re.M))
+    return dict(c)
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--tier", default="mutex2k")
+    ap.add_argument("--widths", default="16,64,256")
+    ap.add_argument("--dump", help="write full HLO text per width here")
+    args = ap.parse_args()
+
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+
+    import bench
+    from jepsen_tpu.checker import linearizable as lin
+
+    seq, model = bench.make_seq(args.tier)
+    es = lin.encode_search(seq)
+    for f in (int(w) for w in args.widths.split(",")):
+        dims = lin.choose_dims(es, model, frontier=f)
+        esp = lin.pad_search(es, dims.n_det_pad, dims.n_crash_pad)
+        fn = jax.jit(lin.build_search_step_fn(model, dims))
+        import jax.numpy as jnp
+        import numpy as np
+
+        carry = lin._init_carry(dims, model)
+        a = (jnp.asarray(esp.det_f), jnp.asarray(esp.det_v1),
+             jnp.asarray(esp.det_v2), jnp.asarray(esp.det_inv),
+             jnp.asarray(esp.det_ret), jnp.asarray(esp.suffix_min_ret),
+             jnp.asarray(esp.crash_f), jnp.asarray(esp.crash_v1),
+             jnp.asarray(esp.crash_v2), jnp.asarray(esp.crash_inv),
+             jnp.int32(es.n_det), jnp.int32(es.n_crash),
+             jnp.int64(10 ** 9), jnp.int32(64), jnp.bool_(True))
+        lowered = fn.lower(*a, *carry)
+        txt = lowered.compile().as_text()
+        counts = count_hlo(txt)
+        top = {k: v for k, v in sorted(counts.items(),
+                                       key=lambda kv: -kv[1]) if v}
+        print(f"F={f}: {top}")
+        if args.dump:
+            os.makedirs(args.dump, exist_ok=True)
+            with open(os.path.join(args.dump,
+                                   f"hlo_{args.tier}_F{f}.txt"),
+                      "w") as fh:
+                fh.write(txt)
+
+
+if __name__ == "__main__":
+    main()

@@ -5,23 +5,39 @@
 # cheaply; the moment the tunnel answers it spends the window on the
 # highest-value MISSING artifact, in order:
 #
+#   0. slice-cap validation: mutex2k child on-chip with per-slice
+#      tracing                             -> slicecap_tpu_*.json
+#      (VERDICT r5 item 7: the watchdog-aware slice caps landed AFTER
+#      the r4 wedges and have never run on a real chip — validate them
+#      on the cheapest decided tier before anything long runs)
 #   1. batch256 tier child on the chip      -> batch256_tpu_*.json
 #   2. the 10k tier child, checkpointed     -> tenk_tpu_*.json
 #      (slices persist to .bench_ckpt; a wedged window RESUMES next
 #      window instead of restarting — the search accumulates until a
 #      window finishes it)
 #   3. one full bench, unpinned             -> bench_tpu_*.json
-#      (bench.py now defers host comparators when the tunnel is open
-#      and resumes tier checkpoints, so this is cheap once 1-2 landed)
+#      (bench.py defers host comparators when the tunnel is open and
+#      resumes tier checkpoints, so this is cheap once 1-2 landed)
+#   4. paired sort-vs-allpairs prune sweep  -> prunebench_*.jsonl
 #
 #   nohup tools/tpu_watch.sh [outdir] &
 #
-# Artifacts land in outdir (default docs/tpu/r4 — inside the repo, so
+# Artifacts land in outdir (default docs/tpu/r5 — inside the repo, so
 # the end-of-round commit picks them up).
+#
+# Wedge-signature backoff (VERDICT r4 weak #6): r4's watcher probed a
+# wedged worker every ~105 s for 11 hours.  The signature is a probe
+# that HANGS while the tunnel's local TCP endpoint stays `open` (a dead
+# worker behind a live listener).  There is no client-side reset for a
+# wedged worker, so once the signature persists the watcher backs off
+# (probe interval 105s -> 300s after 12 consecutive hung-open probes)
+# and snaps back to fast probing the moment a probe either SUCCEEDS or
+# the endpoint's TCP state CHANGES (a closed->open transition is a
+# fresh tunnel).
 set -uo pipefail
 cd "$(dirname "$0")/.."
 
-OUT=${1:-docs/tpu/r4}
+OUT=${1:-docs/tpu/r5}
 mkdir -p "$OUT"
 # persistent XLA compile cache: bench.py's children pin the same dir
 # in-process; this export covers the probe
@@ -32,8 +48,9 @@ export JEPSEN_TPU_TRACE_SLICES=1
 
 log() { echo "$(date -u +%FT%TZ) $*" >> "$OUT/watch.log"; }
 
-if [ -f "$OUT/.batch_done" ] && [ -f "$OUT/.tenk_done" ] \
-   && [ -f "$OUT/.bench_done" ] && [ -f "$OUT/.prune_done" ]; then
+if [ -f "$OUT/.slicecap_done" ] && [ -f "$OUT/.batch_done" ] \
+   && [ -f "$OUT/.tenk_done" ] && [ -f "$OUT/.bench_done" ] \
+   && [ -f "$OUT/.prune_done" ]; then
   log "all artifacts already banked; exiting"
   exit 0
 fi
@@ -48,9 +65,27 @@ except Exception:
 PY
 }
 
+tcp_state() {  # TCP state of the tunnel's local endpoint
+  python - 2>/dev/null <<'PY'
+import os, socket
+port = int(os.environ.get("BENCH_TUNNEL_PORT", "2024"))
+try:
+    with socket.create_connection(("127.0.0.1", port), timeout=2):
+        print("open")
+except (TimeoutError, socket.timeout):
+    print("timeout")
+except OSError:
+    print("closed")
+PY
+}
+
 n=0
+hung_open=0     # consecutive probes that hung while the endpoint was open
+interval=30
+last_tcp=""
 while true; do
   n=$((n + 1))
+  t_probe=$SECONDS
   up=$(timeout 75 python - 2>/dev/null <<'PY'
 import jax
 d = jax.devices()[0]
@@ -59,7 +94,10 @@ x = jnp.ones((128, 128)); (x @ x).block_until_ready()
 print(d.platform)
 PY
 )
+  probe_s=$((SECONDS - t_probe))
+  tcp=$(tcp_state)
   if [ "$up" = "tpu" ]; then
+    hung_open=0; interval=30
     # the driver's end-of-round bench owns the chip when it runs: two
     # clients sharing the wedge-prone worker (and the same .bench_ckpt)
     # is how evidence gets corrupted — stand down while any other
@@ -70,7 +108,22 @@ PY
       continue
     fi
     stamp=$(date -u +%H%M%S)
-    if [ ! -f "$OUT/.batch_done" ]; then
+    if [ ! -f "$OUT/.slicecap_done" ]; then
+      # cheapest decided tier, hard 20s slice cap, full tracing: proves
+      # every single execution stays under the worker watchdog before a
+      # long run risks the window
+      log "tunnel UP (probe $n); slice-cap validation -> slicecap_tpu_$stamp"
+      BENCH_TIER_S=60 JEPSEN_TPU_SLICE_HARD_S=20 timeout 240 python bench.py \
+        --run-tier mutex2k --budget 30000000 \
+        > "$OUT/slicecap_tpu_$stamp.json" \
+        2> "$OUT/slicecap_tpu_$stamp.err"
+      if [ "$(backend_of "$OUT/slicecap_tpu_$stamp.json")" = "tpu" ]; then
+        touch "$OUT/.slicecap_done"
+        log "slice-cap validation banked (mutex2k on-chip)"
+        continue  # same window: go straight to batch256
+      fi
+      log "slice-cap child did not land on tpu; resuming watch"
+    elif [ ! -f "$OUT/.batch_done" ]; then
       log "tunnel UP (probe $n); batch256 child -> batch256_tpu_$stamp"
       BENCH_TIER_S=180 timeout 420 python bench.py \
         --run-tier batch256 --budget 2000000 \
@@ -143,7 +196,28 @@ PY
       exit 0
     fi
   else
-    log "tunnel down (probe $n)"
+    # wedged-worker signature: a probe that actually HUNG (consumed
+    # its 75s timeout) + endpoint still accepting.  A fast-failing
+    # probe behind a live listener is NOT the signature — backing off
+    # on those would cost minutes of a 5-8-min window when the worker
+    # revives (a revival is only detectable by the next probe).
+    if [ "$tcp" = "open" ] && [ "$probe_s" -ge 70 ]; then
+      hung_open=$((hung_open + 1))
+      if [ "$hung_open" -eq 12 ]; then
+        log "wedged-worker signature persists (12 hung-open probes); backing off to 300s"
+        interval=300
+      fi
+      log "tunnel down (probe $n, tcp=$tcp, hung ${probe_s}s, hung_open=$hung_open)"
+    else
+      # endpoint gone or changed: any future open is a fresh tunnel —
+      # probe fast again
+      if [ "$tcp" != "$last_tcp" ] && [ "$interval" -ne 30 ]; then
+        log "endpoint tcp state changed ($last_tcp -> $tcp); fast probing resumes"
+      fi
+      hung_open=0; interval=30
+      log "tunnel down (probe $n, tcp=$tcp)"
+    fi
   fi
-  sleep 30
+  last_tcp="$tcp"
+  sleep "$interval"
 done

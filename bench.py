@@ -663,13 +663,23 @@ def run_tier_child(name: str, budget: int) -> None:
             lin.save_checkpoint(ckpt + ".tmp.npz", carry, dims, model,
                                 budget, seq=seq)
             os.replace(ckpt + ".tmp.npz", ckpt)
+            # read-modify-write: fields other runs own (notably
+            # decided_pending_tpu from a CPU decide) must survive a
+            # TPU child's throttled saves — a wedge after a fresh-dict
+            # write would re-arm the CPU-replay loop this flag stops
+            try:
+                with open(ckpt + ".meta.json") as f:
+                    m = json.load(f)
+            except (OSError, ValueError):
+                m = {}
+            m.update({"elapsed": prior_elapsed
+                      + (time.perf_counter() - t0),
+                      "slices": prior_slices + len(slices),
+                      "backends": sorted(prior_backends
+                                         | {backend_now})})
             tmp = ckpt + ".meta.tmp"
             with open(tmp, "w") as f:
-                json.dump({"elapsed": prior_elapsed
-                           + (time.perf_counter() - t0),
-                           "slices": prior_slices + len(slices),
-                           "backends": sorted(prior_backends
-                                              | {backend_now})}, f)
+                json.dump(m, f)
             os.replace(tmp, ckpt + ".meta.json")
 
     out = None

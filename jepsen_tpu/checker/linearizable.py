@@ -336,6 +336,16 @@ _COMPACT_ELEMS = int(os.environ.get("JEPSEN_TPU_COMPACT_ELEMS",
                                     str(1 << 24)))
 
 
+def _backend() -> str:
+    """The active JAX backend, defaulting to "cpu" when none exists
+    yet (build-time selectors must never fail on an uninitialized
+    backend)."""
+    try:
+        return jax.default_backend()
+    except Exception:  # noqa: BLE001 — no backend: assume host
+        return "cpu"
+
+
 def _use_matrix_compact(k_out: int, n: int, batch: int = 1) -> bool:
     """``batch`` multiplies the [k_out, n] one-hot: a vmapped kernel
     (batch keys) or a vmap-over-destinations route materializes one
@@ -348,10 +358,7 @@ def _use_matrix_compact(k_out: int, n: int, batch: int = 1) -> bool:
         return batch * k_out * n <= _COMPACT_ELEMS
     if _COMPACT_MODE == "search":
         return False
-    try:
-        backend = jax.default_backend()
-    except Exception:  # noqa: BLE001 — no backend: assume host
-        backend = "cpu"
+    backend = _backend()
     return backend == "tpu" and batch * k_out * n <= _COMPACT_ELEMS
 
 
@@ -547,10 +554,7 @@ def _use_allpairs(M: int, batch: int = 1) -> bool:
         return batch * M * M <= _ALLPAIRS_ELEMS
     if _DOMINANCE_MODE == "sort":
         return False
-    try:
-        backend = jax.default_backend()
-    except Exception:  # noqa: BLE001 — no backend: assume host
-        backend = "cpu"
+    backend = _backend()
     return (backend == "tpu" and M <= _ALLPAIRS_MAX
             and batch * M * M <= _ALLPAIRS_ELEMS)
 
@@ -1359,10 +1363,7 @@ def _slice_hard_s() -> float:
         if env:
             _SLICE_HARD_S = float(env)
         else:
-            try:
-                backend = jax.default_backend()
-            except Exception:  # noqa: BLE001 — no backend: assume host
-                backend = "cpu"
+            backend = _backend()
             _SLICE_HARD_S = 20.0 if backend == "tpu" else float("inf")
     return _SLICE_HARD_S
 
@@ -1457,19 +1458,53 @@ def _dominance_key():
     """Everything the prune/compaction selectors depend on — part of
     the kernel cache key so a mode flip (tests; env overrides) can't
     reuse a kernel built for the other implementation."""
-    try:
-        backend = jax.default_backend()
-    except Exception:  # noqa: BLE001
-        backend = "cpu"
+    backend = _backend()
     return (_DOMINANCE_MODE, _ALLPAIRS_MAX, _ALLPAIRS_ELEMS,
             _COMPACT_MODE, _COMPACT_ELEMS, backend)
 
 
+#: level-kernel implementation: "xla" (build_search_step_fn),
+#: "pallas" (pallas_level's fused level-loop kernel), or "auto" —
+#: pallas on TPU whenever the dims/model are eligible (the narrow,
+#: depth-dominated regime where the XLA body's op-count floor costs
+#: ~1.3 ms/level), xla everywhere else
+_ENGINE_MODE = os.environ.get("JEPSEN_TPU_ENGINE", "auto")
+#: sticky fallback: the first Mosaic lowering failure on real hardware
+#: must cost one rebuilt slice, not the bench tier (the pallas path's
+#: first chip contact happens inside a live tunnel window)
+_PALLAS_BROKEN = False
+
+
+def _use_pallas(model: ModelSpec, dims: SearchDims) -> bool:
+    if _ENGINE_MODE == "xla" or _PALLAS_BROKEN:
+        return False
+    from . import pallas_level
+
+    if not pallas_level.eligible(model, dims):
+        return False
+    if _ENGINE_MODE == "pallas":
+        return True
+    backend = _backend()
+    return backend == "tpu"
+
+
 def get_kernel(model: ModelSpec, dims: SearchDims):
-    key = (model.name, dims, _dominance_key())
+    use_p = _use_pallas(model, dims)
+    key = (model.name, dims, _dominance_key(),
+           "pallas" if use_p else "xla")
     fn = _KERNEL_CACHE.get(key)
     if fn is None:
-        fn = jax.jit(build_search_step_fn(model, dims))
+        if use_p:
+            from . import pallas_level
+
+            # off-TPU the pallas kernel runs in interpret mode (tests;
+            # forced-engine differential fuzz) — Mosaic lowering needs
+            # the hardware
+            backend = _backend()
+            fn = jax.jit(pallas_level.build_pallas_step_fn(
+                model, dims, interpret=backend != "tpu"))
+        else:
+            fn = jax.jit(build_search_step_fn(model, dims))
         _KERNEL_CACHE[key] = fn
     return fn
 
@@ -1552,10 +1587,7 @@ def _width_floor() -> int:
         # "0" must mean "no override", not "narrowest possible"
         want = min(v, MAX_FRONTIER) if v >= 8 else 0
     if not want:
-        try:
-            backend = jax.default_backend()
-        except Exception:  # noqa: BLE001 — no backend: assume host
-            backend = "cpu"
+        backend = _backend()
         want = 64 if backend == "tpu" else 16
     # snap onto the power-of-two grid (and under MAX_FRONTIER) so
     # differently-sized histories keep sharing compiled kernels
@@ -1634,9 +1666,30 @@ def _run_kernel(esp: EncodedSearch, es: EncodedSearch, model: ModelSpec,
         _trace(f"run F={F} cap={lvl_cap} first={int(first)} "
                f"depth={prev_depth}")
         t0 = time.perf_counter()
-        carry = fn(*args, jnp.int32(budget), jnp.int32(lvl_cap),
-                   jnp.bool_(bail), *carry)
-        jax.block_until_ready(carry)
+        try:
+            carry = fn(*args, jnp.int32(budget), jnp.int32(lvl_cap),
+                       jnp.bool_(bail), *carry)
+            jax.block_until_ready(carry)
+        except Exception as e:  # noqa: BLE001 — engine fallback
+            global _PALLAS_BROKEN
+            if _use_pallas(model, dims) and not _PALLAS_BROKEN:
+                # the pallas kernel failed to lower/run on this
+                # backend: disable it for the process and redo the
+                # slice on the XLA kernel — the carry is untouched
+                # (the failed call never committed).  Its first real-
+                # hardware contact happens inside a live tunnel
+                # window, and a lowering bug there must cost one
+                # rebuilt slice, not the bench tier.
+                _PALLAS_BROKEN = True
+                _trace(f"pallas kernel failed ({e!r}); falling back "
+                       "to xla engine")
+                fn = get_kernel(model, dims)
+                carry = fn(*args, jnp.int32(budget),
+                           jnp.int32(lvl_cap), jnp.bool_(bail),
+                           *carry)
+                jax.block_until_ready(carry)
+            else:
+                raise
         dt = time.perf_counter() - t0
         if on_slice is not None:
             on_slice(carry, dims)
@@ -2018,7 +2071,7 @@ def batch_dims(ess: list[EncodedSearch], model: ModelSpec, *,
 
 
 def get_batch_kernel(model: ModelSpec, dims: SearchDims,
-                     batch: int = 256):
+                     batch: int = 256, allow_pallas: bool = True):
     # the batch size reaches the built HLO only through the prune and
     # compaction SELECTIONS — the two dominance sites (closure merge at
     # 2F, det expansion at 4F) and the four matrix-compaction sites
@@ -2031,18 +2084,31 @@ def get_batch_kernel(model: ModelSpec, dims: SearchDims,
     # reuse could OOM the TPU — or pessimize the small batch)
     F, K = dims.frontier, dims.k
     S = 4 * F
+    use_p = allow_pallas and _use_pallas(model, dims)
     sel = (_use_allpairs(2 * F, batch),
            _use_allpairs(S, batch),
            _use_matrix_compact(F, F * K, batch),
            _use_matrix_compact(S, F * K, batch),
            _use_matrix_compact(F, 2 * F, batch),
            _use_matrix_compact(F, S, batch))
-    key = ("batch", model.name, dims, sel, _dominance_key())
+    key = ("batch", model.name, dims, sel, _dominance_key(),
+           "pallas" if use_p else "xla")
     fn = _KERNEL_CACHE.get(key)
     if fn is None:
+        if use_p:
+            # vmap of the fused level-loop kernel: the pallas batching
+            # rule runs one grid program per key, each a whole level
+            # loop with zero per-op overhead (verified row-equal to the
+            # vmapped XLA kernel, tests/test_pallas_level.py)
+            from . import pallas_level
+
+            backend = _backend()
+            base = pallas_level.build_pallas_step_fn(
+                model, dims, interpret=backend != "tpu")
+        else:
+            base = build_search_step_fn(model, dims, batch=batch)
         fn = jax.jit(jax.vmap(
-            build_search_step_fn(model, dims, batch=batch),
-            in_axes=(0,) * 12 + (None, None, None) + (0,) * 6))
+            base, in_axes=(0,) * 12 + (None, None, None) + (0,) * 6))
         _KERNEL_CACHE[key] = fn
     return fn
 
@@ -2130,15 +2196,11 @@ def _drive_batch_compacting(fn, esps, model: ModelSpec, dims: SearchDims,
             cs.append(jnp.asarray(np.stack(rows + [pad_row] * pad)))
         return args, tuple(cs)
 
-    try:
-        _backend = jax.default_backend()
-    except Exception:  # noqa: BLE001 — no backend: assume host
-        _backend = "cpu"
     # every re-stack is a fresh vmapped-kernel shape; an uncached
     # compile through the tunnel costs 10-90 s — far more than the
     # padded lanes it saves — so the accelerator waits for a QUARTER
     # fit (~log4(n) sizes) where hosts re-stack at HALF (~log2(n))
-    shrink = 4 if _backend == "tpu" else 2
+    shrink = 4 if _backend() == "tpu" else 2
 
     row0 = tuple(np.asarray(c)[0]
                  for c in _init_batch_carry(1, dims, model))
@@ -2252,7 +2314,11 @@ def search_batch(seqs: list[OpSeq], model: ModelSpec, *,
     pending: list[int] = []
 
     if sharding is not None:
-        fn = get_batch_kernel(model, dims, batch=len(seqs))
+        # mesh-sharded batches stay on the XLA kernel: partitioning a
+        # pallas_call's vmapped grid axis over a mesh is not a path the
+        # batching rule guarantees
+        fn = get_batch_kernel(model, dims, batch=len(seqs),
+                              allow_pallas=False)
         # mesh-sharded batch: fixed size (the key axis must keep
         # covering the mesh), plain slice driver.  Arrays go to the mesh
         # straight from host numpy: in a MULTI-PROCESS job (DCN tier,
@@ -2315,9 +2381,26 @@ def search_batch(seqs: list[OpSeq], model: ModelSpec, *,
         while pending:
             d = _dc_replace(dims, frontier=rung)
             fnr = get_batch_kernel(model, d, batch=len(pending))
-            st, ct, cf, dp, ov = _drive_batch_compacting(
-                fnr, [esps[i] for i in pending], model, d, budget,
-                bail=True)
+            try:
+                st, ct, cf, dp, ov = _drive_batch_compacting(
+                    fnr, [esps[i] for i in pending], model, d, budget,
+                    bail=True)
+            except Exception as e:  # noqa: BLE001 — engine fallback
+                global _PALLAS_BROKEN
+                if _use_pallas(model, d) and not _PALLAS_BROKEN:
+                    # first hardware contact for the pallas batch path
+                    # happens inside a tunnel window; a lowering bug
+                    # must cost one rung rebuild, not the batch tier
+                    _PALLAS_BROKEN = True
+                    _trace(f"pallas batch kernel failed ({e!r}); "
+                           "falling back to xla engine")
+                    fnr = get_batch_kernel(model, d,
+                                           batch=len(pending))
+                    st, ct, cf, dp, ov = _drive_batch_compacting(
+                        fnr, [esps[i] for i in pending], model, d,
+                        budget, bail=True)
+                else:
+                    raise
             nxt = []
             for j, i in enumerate(pending):
                 spent[i] += int(cf[j])

@@ -1,0 +1,516 @@
+"""Pallas TPU level-loop kernel — the whole BFS slice as ONE device op.
+
+Why this exists (VERDICT r4 weak #2 / item 2): the XLA step kernel's
+level body compiles to ~70-140 fused computations, and on the axon TPU
+each one pays a fixed few-microsecond overhead, flooring the per-level
+cost at ~1.3 ms no matter how narrow the live frontier is
+(docs/tpu/r4/tpubench_resweep.jsonl).  Depth-bound searches (mutex2k:
+1,971 sequential levels; 10k: ~9.8k) are therefore op-COUNT-bound, not
+compute-bound.  This module re-expresses the entire slice loop —
+``lvl_cap`` levels of mask/closure/expand/prune/compact — as a single
+``pl.pallas_call`` whose interior is ~dozens of large VPU/MXU
+operations per level with no per-op dispatch overhead.
+
+Design notes (the reference's analog of this engine is knossos's JVM
+search loop, jepsen/src/jepsen/checker.clj:114-139 — redesigned here
+for the TPU's compute model rather than translated):
+
+* The frontier lives UNPACKED inside the kernel: window/crash masks as
+  [F, W]/[F, NC] 0/1 planes instead of packed u32 words.  Packing
+  exists for host/HBM compactness; in VMEM the unpacked planes turn
+  every bit-twiddle (funnel shifts, trailing-ones, kth-set-bit) into
+  plain elementwise/matmul algebra the VPU/MXU like.  Pack/unpack
+  happens once per SLICE at the XLA boundary, amortized over
+  ``lvl_cap`` levels.
+* Every gather is a one-hot CONTRACTION (MXU), never a dynamic gather:
+  table windows are read with one dynamic slice per level, then
+  addressed by `(off + lane == j)` one-hot tensors.  Values that can
+  exceed f32's 2^24 integer-exact range (model-state words, op v1/v2)
+  go through a 12-bit limb split — two exact f32 matmuls, recombined
+  in int32.  Comparison tables (inv/ret/suffix-min) are clamped to
+  CLAMP_INF < 2^24 at the boundary (all real positions are < 2^17, so
+  every comparison is preserved).
+* Stream compaction is hierarchical: per-row counts -> triangular-
+  matmul cumsum -> `[cap, F]` row one-hot -> `[cap, L]` lane one-hot
+  (two small matmuls + compares).  No sorts anywhere.
+* Dominance pruning is the exact all-pairs rule (mirrors
+  `_allpairs_dominance` in linearizable.py): equality via popcount
+  matmul identities, crash-subset via |cr_j| - |cr_i ∩ cr_j| == 0.
+* Control flow is `fori_loop` + `@pl.when` predication only (Mosaic-
+  safe): the level loop runs ``lvl_cap`` rounds gated on a `running`
+  scalar, the crash closure runs ``n_crash+1`` rounds gated on a
+  `progress` scalar — predicated-off rounds skip at runtime.
+
+Semantics contract: bit-for-bit the SAME search as
+`build_search_step_fn` with the all-pairs prune — identical survivor
+order (f-major, lane-ascending), identical configs counts, identical
+overflow/bail/revert behavior — so the slice driver, checkpoints, and
+escalation ladder work unchanged.  Differential tests enforce this
+(tests/test_pallas_level.py).
+
+Eligibility: F <= 64, W <= 64, NC <= 64, state_width <= 4, and a model
+whose ``jstep`` is elementwise (register / cas-register / mutex /
+noop).  Wider rungs fall back to the XLA kernel — the pallas engine
+exists for the narrow, depth-dominated regime that floors on op count.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+from jax.experimental import pallas as pl
+
+try:  # pltpu imports fine off-TPU; only lowering needs the hardware
+    from jax.experimental.pallas import tpu as pltpu
+except ImportError:  # pragma: no cover - ancient jax
+    pltpu = None
+
+#: internal "infinity" for clamped comparison tables — above every real
+#: position (< 2^17) and exactly representable in f32
+CLAMP_INF = np.int32(1 << 23)
+
+#: models whose jstep is elementwise (vmaps to Mosaic-friendly ops)
+SAFE_MODELS = frozenset({"register", "cas-register", "mutex", "noop"})
+
+#: scalar-scratch slots
+(_CNT, _STA, _CFG, _MD, _OVF, _RUN, _FOUND, _CLGO,
+ _CNT0, _CFG0, _MD0, _OVF0) = range(12)
+
+
+def eligible(model, dims) -> bool:
+    return (model.name in SAFE_MODELS
+            and dims.frontier <= 64
+            and dims.window <= 64
+            and dims.n_crash_pad <= 64
+            and dims.state_width <= 4)
+
+
+def _f32(x):
+    return x.astype(jnp.float32)
+
+
+def _mm(a, b):
+    """f32 matmul, always through the MXU contraction path."""
+    return lax.dot_general(_f32(a), _f32(b), (((1,), (0,)), ((), ())),
+                           preferred_element_type=jnp.float32)
+
+
+def _gather_i32(oh, plane):
+    """Exact int32 gather `oh @ plane` for arbitrary int32 values via a
+    12-bit limb split (each oh row has at most one nonzero)."""
+    lo = _f32(jnp.bitwise_and(plane, 0xFFF))
+    hi = _f32(jnp.right_shift(plane, 12))
+    return (_mm(oh, hi).astype(jnp.int32) * 4096
+            + _mm(oh, lo).astype(jnp.int32))
+
+
+def _iota(n, axis, shape):
+    return lax.broadcasted_iota(jnp.int32, shape, axis)
+
+
+def build_pallas_step_fn(model, dims, *, interpret: bool = False):
+    """Build a slice-step function with `build_search_step_fn`'s exact
+    signature, backed by one pallas_call running the whole level loop."""
+    F = dims.frontier
+    W = dims.window
+    NC = dims.n_crash_pad
+    SW = dims.state_width
+    WW = dims.win_words
+    CW = dims.crash_words
+    ND = dims.n_det_pad
+    L = W + NC
+    SCAP = 4 * F
+    W2P = min(-(-(2 * W + NC) // 32) * 32, ND)
+    jstep2 = jax.vmap(jax.vmap(model.jstep))
+
+    # constant unpack/pack index tables (host-side numpy)
+    w_word = np.arange(W) // 32
+    w_bit = np.arange(W) % 32
+    c_word = np.arange(NC) // 32
+    c_bit = np.arange(NC) % 32
+
+    def kernel(scal, tf, tv1, tv2, tinv, tret, sfx, crf, crv1, crv2,
+               crinv, p_in, win_in, crash_in, state_in,
+               p_out, win_out, crash_out, state_out, scal_out,
+               pc, wc, cc, stc, ps, ws, cs, sts,
+               v2r, g2r, nsr, st):
+        n_det = scal[5, 0]
+        n_crash = scal[6, 0]
+        budget = scal[7, 0]
+        lvl_cap = scal[8, 0]
+        bail = scal[9, 0]
+
+        pc[:] = p_in[:]
+        wc[:] = win_in[:]
+        cc[:] = crash_in[:]
+        stc[:] = state_in[:]
+        for i, slot in ((0, _CNT), (1, _STA), (2, _CFG), (3, _MD),
+                        (4, _OVF)):
+            st[slot, 0] = scal[i, 0]
+        st[_RUN, 0] = jnp.where(
+            (scal[1, 0] == -1) & (scal[0, 0] > 0)
+            & (scal[2, 0] < budget)
+            & ~((bail == 1) & (scal[4, 0] == 1)), 1, 0)
+
+        lane_i = _iota(L, 1, (1, L))          # [1, L] candidate lane ids
+        is_det_lane = lane_i < W
+
+        def mask_phase():
+            """Expand the CURRENT planes: valid/goal per candidate lane
+            + successor model states.  Mirrors expand_mask_one
+            (linearizable.py:1054) on unpacked planes, all lanes (no
+            K-cap: the cap was a no-loss bound; S-cap still applies at
+            compaction)."""
+            count = st[_CNT, 0]
+            p = pc[:]                          # [F, 1]
+            win = wc[:]                        # [F, W]
+            crash = cc[:]                      # [F, NC]
+            state = stc[:]                     # [F, SW]
+            aliv = _iota(F, 0, (F, 1)) < count
+            base = jnp.min(jnp.where(aliv, p, CLAMP_INF))
+            base = jnp.clip(base, 0, ND - W2P)
+
+            # 2D reads ([1, n] slices): Mosaic-friendly shapes
+            t_ret = tret[:, pl.ds(base, W2P)].reshape(W2P, 1)
+            t_inv = tinv[:, pl.ds(base, W2P)].reshape(W2P, 1)
+            t_f = tf[:, pl.ds(base, W2P)].reshape(W2P, 1)
+            t_v1 = tv1[:, pl.ds(base, W2P)].reshape(W2P, 1)
+            t_v2 = tv2[:, pl.ds(base, W2P)].reshape(W2P, 1)
+            # the suffix index reaches base + 2W + NC == base + W2P, so
+            # the window needs W2P + 1 entries (base <= ND - W2P keeps
+            # the slice in range: sfx has ND + 1 entries)
+            sfxw = sfx[:, pl.ds(base, W2P + 1)].reshape(W2P + 1, 1)
+
+            off = p - base                     # [F, 1]
+            lw = _iota(W, 1, (1, W))
+            # one-hot [F, W, W2P]: (off + l == j)
+            idx3 = ((off[:, :, None] + lw[:, :, None])
+                    == _iota(W2P, 2, (1, 1, W2P)))
+            oh2 = _f32(idx3).reshape(F * W, W2P)
+
+            def gat(tab):
+                return _mm(oh2, tab).reshape(F, W)
+
+            wret = gat(_f32(t_ret))
+            winv = gat(_f32(t_inv))
+            pos_in = (p + lw) < n_det          # [F, W]
+            no_win = win == 0
+            INF = jnp.float32(CLAMP_INF)
+            wret_eff = jnp.where(pos_in & no_win, wret, INF)
+            m1 = jnp.min(wret_eff, axis=1, keepdims=True)
+            am = jnp.min(jnp.where(wret_eff == m1, lw, W), axis=1,
+                         keepdims=True)
+            m2 = jnp.min(jnp.where(lw == am, INF, wret_eff), axis=1,
+                         keepdims=True)
+            # suffix-min beyond the window
+            sidx = jnp.minimum(p + W, n_det) - base        # [F, 1]
+            soh = _f32(sidx == _iota(W2P + 1, 1, (1, W2P + 1)))
+            sfxv = _mm(soh, _f32(sfxw))                    # [F, 1]
+            m1_tot = jnp.minimum(m1, sfxv)
+            excl_w = jnp.where(lw == am, m2, m1)
+            excl_tot = jnp.minimum(excl_w, sfxv)
+            det_en = pos_in & no_win & (winv < excl_tot)
+
+            cl = _iota(NC, 1, (1, NC))
+            crinv_f = _f32(crinv[:])                     # [1, NC]
+            crash_en = ((cl < n_crash) & (crash == 0)
+                        & (crinv_f < m1_tot))
+
+            # candidate op tables on all L lanes
+            d_f = _gather_i32(oh2, t_f).reshape(F, W)
+            d_v1 = _gather_i32(oh2, t_v1).reshape(F, W)
+            d_v2 = _gather_i32(oh2, t_v2).reshape(F, W)
+            c_f = jnp.broadcast_to(crf[:], (F, NC))
+            c_v1 = jnp.broadcast_to(crv1[:], (F, NC))
+            c_v2 = jnp.broadcast_to(crv2[:], (F, NC))
+            aF = jnp.concatenate([d_f, c_f], axis=1)
+            aV1 = jnp.concatenate([d_v1, c_v1], axis=1)
+            aV2 = jnp.concatenate([d_v2, c_v2], axis=1)
+            enab = jnp.concatenate([det_en, crash_en], axis=1)
+
+            stateB = jnp.broadcast_to(state[:, None, :], (F, L, SW))
+            ns, legal = jstep2(stateB, aF, aV1, aV2)
+            valid = aliv & enab & legal
+
+            wsum = jnp.sum(win, axis=1, keepdims=True)
+            remaining = n_det - (p + wsum)               # [F, 1]
+            goal = valid & jnp.where(is_det_lane, remaining <= 1,
+                                     remaining <= 0)
+            v2r[:] = valid.astype(jnp.int32)
+            g2r[:] = goal.astype(jnp.int32)
+            nsr[:] = ns.astype(jnp.int32)
+
+        def succ_compact(vmask, cap):
+            """Compact the [F, L] valid mask to ``cap`` survivors in
+            (f-major, lane-ascending) order and build their successor
+            planes.  Returns (p2, win2, crash2, state2, svalid, total).
+            Mirrors _succ_block + succ_one."""
+            vf = _f32(vmask)
+            c_row = jnp.sum(vf, axis=1, keepdims=True)   # [F, 1]
+            # trilF[i, j] = (j <= i): cum = trilF @ c_row is the
+            # INCLUSIVE prefix sum cum[i] = sum_{j<=i} c_row[j]
+            trilF = _f32(_iota(F, 1, (F, F)) <= _iota(F, 0, (F, F)))
+            cum = _mm(trilF, c_row)                      # [F, 1]
+            o = cum - c_row                              # exclusive
+            total = jnp.sum(vf).astype(jnp.int32)
+            s_i = _iota(cap, 0, (cap, 1))
+            oT = o.reshape(1, F)
+            cT = c_row.reshape(1, F)
+            row_oh = _f32((oT <= _f32(s_i)) & (_f32(s_i) < oT + cT))
+            q = _f32(s_i) - _mm(row_oh, o)               # [cap, 1]
+            trilL = _f32(_iota(L, 0, (L, L)) <= _iota(L, 1, (L, L)))
+            r = _mm(vf, trilL)                           # [F, L] ranks
+            Rg = _mm(row_oh, r)                          # [cap, L]
+            Vg = _mm(row_oh, vf)
+            lane_oh = (Rg == q + 1) & (Vg > 0.5)         # [cap, L]
+            svalid = s_i < total                         # [cap, 1]
+
+            lane = jnp.sum(jnp.where(lane_oh, _iota(L, 1, (cap, L)), 0),
+                           axis=1, keepdims=True)        # [cap, 1]
+            p_src = _mm(row_oh, _f32(pc[:])).astype(jnp.int32)
+            win_src = (_mm(row_oh, _f32(wc[:])) > 0.5)   # [cap, W] bool
+            crash_src = (_mm(row_oh, _f32(cc[:])) > 0.5)
+            state_src = _gather_i32(row_oh, stc[:])      # [cap, SW]
+
+            lane_f = _f32(lane_oh)
+            ns_cols = []
+            for swi in range(SW):
+                g = _gather_i32(row_oh * 1.0, nsr[:, :, swi])
+                # row-gathered [cap, L] already int; select the lane
+                ns_cols.append(jnp.sum(jnp.where(lane_oh, g, 0),
+                                       axis=1, keepdims=True))
+            ns_sel = jnp.concatenate(ns_cols, axis=1)    # [cap, SW]
+
+            is_d = lane < W                              # [cap, 1]
+            lwc = _iota(W, 1, (cap, W))
+            win1 = win_src | (is_d & (lwc == lane))
+            first_zero = jnp.min(jnp.where(~win1, lwc, W), axis=1,
+                                 keepdims=True)          # = shift
+            shift = first_zero
+            # win2[s, l] = win1[s, l + shift_s]
+            sh3 = (_iota(W, 1, (cap, W, W))              # j axis
+                   == (_iota(W, 2, (cap, W, W)) + shift[:, :, None]))
+            win2 = jnp.einsum("sj,sjl->sl", _f32(win1), _f32(sh3)) > 0.5
+            p2 = jnp.where(is_d, p_src + shift, p_src)
+            w_out = jnp.where(is_d, win2, win_src)
+            cloh = (lane - W) == _iota(NC, 1, (cap, NC))
+            c_out = jnp.where(is_d, crash_src, crash_src | cloh)
+            return (p2, w_out.astype(jnp.int32), c_out.astype(jnp.int32),
+                    ns_sel, svalid, total)
+
+        def prune(pm, winm, crashm, statem, validm, M):
+            """Exact all-pairs dominance over M rows; mirrors
+            _allpairs_dominance (linearizable.py:479) on planes."""
+            eq = pm.reshape(M, 1) == pm.reshape(1, M)
+            wf = _f32(winm)
+            wsum = jnp.sum(wf, axis=1, keepdims=True)
+            wcom = _mm(wf, wf.T)
+            eq &= (wsum + wsum.T - 2.0 * wcom) == 0
+            for swi in range(SW):
+                col = statem[:, swi]
+                eq &= col.reshape(M, 1) == col.reshape(1, M)
+            cf_ = _f32(crashm)
+            csum = jnp.sum(cf_, axis=1, keepdims=True)
+            ccom = _mm(cf_, cf_.T)
+            eq_cr = (csum + csum.T - 2.0 * ccom) == 0
+            # sub[i, j]: cr_j subset of cr_i  <=>  |cr_j| - |inter| == 0
+            sub = (csum.T - ccom) == 0
+            ident = eq & eq_cr
+            strict = eq & sub & ~eq_cr
+            im = _iota(M, 0, (M, M))
+            jm = _iota(M, 1, (M, M))
+            dom = validm.reshape(1, M) & (strict | (ident & (jm < im)))
+            return validm.reshape(M) & ~jnp.any(dom, axis=1)
+
+        def compact_rows(kept, pm, winm, crashm, statem, M):
+            """First-F kept rows, in order; returns planes + kept
+            count."""
+            kf = _f32(kept)[:, None]                     # [M, 1]
+            trilM = _f32(_iota(M, 1, (M, M)) <= _iota(M, 0, (M, M)))
+            rank = _mm(trilM, kf)                        # [M, 1] incl
+            n_kept = jnp.sum(kf).astype(jnp.int32)
+            out_oh = _f32(kept.reshape(1, M)
+                          & (rank.reshape(1, M)
+                             == _f32(_iota(F, 0, (F, 1)) + 1)))
+            p_n = _mm(out_oh, _f32(pm.reshape(M, 1))).astype(jnp.int32)
+            w_n = (_mm(out_oh, _f32(winm)) > 0.5).astype(jnp.int32)
+            c_n = (_mm(out_oh, _f32(crashm)) > 0.5).astype(jnp.int32)
+            s_n = _gather_i32(out_oh, statem)
+            return p_n, w_n, c_n, s_n, n_kept
+
+        def closure_round(_j, carry):
+            @pl.when(st[_CLGO, 0] == 1)
+            def _():
+                cvalid = (v2r[:] == 1) & ~is_det_lane
+                p2, w2, c2, s2, svld, ntot = succ_compact(cvalid, F)
+                st[_OVF, 0] = st[_OVF, 0] | jnp.where(ntot > F, 1, 0)
+                count = st[_CNT, 0]
+                aliv = _iota(F, 0, (F, 1)) < count
+                pm = jnp.concatenate([pc[:], p2], axis=0)
+                wm = jnp.concatenate([wc[:], w2], axis=0)
+                cm = jnp.concatenate([cc[:], c2], axis=0)
+                sm = jnp.concatenate([stc[:], s2], axis=0)
+                vm = jnp.concatenate([aliv, svld], axis=0).reshape(2 * F)
+                kept = prune(pm, wm, cm, sm, vm, 2 * F)
+                p_n, w_n, c_n, s_n, nk = compact_rows(
+                    kept, pm, wm, cm, sm, 2 * F)
+                st[_OVF, 0] = st[_OVF, 0] | jnp.where(nk > F, 1, 0)
+                progress = jnp.any(
+                    kept & (_iota(2 * F, 0, (2 * F, 1)).reshape(2 * F)
+                            >= F))
+                pc[:] = p_n
+                wc[:] = w_n
+                cc[:] = c_n
+                stc[:] = s_n
+                st[_CNT, 0] = jnp.minimum(nk, F)
+                mask_phase()
+                st[_FOUND, 0] = st[_FOUND, 0] | jnp.where(jnp.any(g2r[:] == 1), 1, 0)
+                st[_CLGO, 0] = jnp.where(progress, 1, 0)
+            return carry
+
+        def level(_i, carry):
+            @pl.when(st[_RUN, 0] == 1)
+            def _():
+                # entry snapshot for the uncommitted-overflow revert
+                ps[:] = pc[:]
+                ws[:] = wc[:]
+                cs[:] = cc[:]
+                sts[:] = stc[:]
+                st[_CNT0, 0] = st[_CNT, 0]
+                st[_CFG0, 0] = st[_CFG, 0]
+                st[_MD0, 0] = st[_MD, 0]
+                st[_OVF0, 0] = st[_OVF, 0]
+
+                mask_phase()
+                found0 = jnp.any(g2r[:] == 1)
+                st[_FOUND, 0] = jnp.where(found0, 1, 0)
+                crash_any = jnp.any((v2r[:] == 1) & ~is_det_lane)
+                st[_CLGO, 0] = jnp.where(crash_any, 1, 0)
+                lax.fori_loop(0, n_crash + 1, closure_round, 0)
+                # exit-by-cap while still adding rows: not proven
+                # closed — degrade like an overflow
+                st[_OVF, 0] = st[_OVF, 0] | st[_CLGO, 0]
+
+                # determinate expansion
+                dvalid = (v2r[:] == 1) & is_det_lane
+                p2, w2, c2, s2, svld, ntot = succ_compact(dvalid, SCAP)
+                st[_OVF, 0] = st[_OVF, 0] | jnp.where(ntot > SCAP, 1, 0)
+                kept = prune(p2, w2, c2, s2, svld.reshape(SCAP), SCAP)
+                p_n, w_n, c_n, s_n, nk = compact_rows(
+                    kept, p2, w2, c2, s2, SCAP)
+                st[_OVF, 0] = st[_OVF, 0] | jnp.where(nk > F, 1, 0)
+
+                count = st[_CNT, 0]
+                aliv = _iota(F, 0, (F, 1)) < count
+                st[_CFG, 0] = st[_CFG, 0] + count
+                st[_MD, 0] = jnp.maximum(
+                    st[_MD, 0], jnp.max(jnp.where(aliv, pc[:], 0)))
+                found = st[_FOUND, 0] == 1
+                st[_STA, 0] = jnp.where(found, 2, st[_STA, 0])
+                new_ovf = (st[_OVF, 0] == 1) & (st[_OVF0, 0] == 0)
+                revert = (bail == 1) & new_ovf & ~found
+                pc[:] = jnp.where(revert, ps[:], p_n)
+                wc[:] = jnp.where(revert, ws[:], w_n)
+                cc[:] = jnp.where(revert, cs[:], c_n)
+                stc[:] = jnp.where(revert, sts[:], s_n)
+                st[_CNT, 0] = jnp.where(revert, st[_CNT0, 0],
+                                        jnp.minimum(nk, F))
+                st[_CFG, 0] = jnp.where(revert, st[_CFG0, 0],
+                                        st[_CFG, 0])
+                st[_MD, 0] = jnp.where(revert, st[_MD0, 0], st[_MD, 0])
+                st[_RUN, 0] = jnp.where(
+                    (st[_STA, 0] == -1) & (st[_CNT, 0] > 0)
+                    & (st[_CFG, 0] < budget)
+                    & ~((bail == 1) & (st[_OVF, 0] == 1)), 1, 0)
+            return carry
+
+        lax.fori_loop(0, lvl_cap, level, 0)
+
+        p_out[:] = pc[:]
+        win_out[:] = wc[:]
+        crash_out[:] = cc[:]
+        state_out[:] = stc[:]
+        for i, slot in ((0, _CNT), (1, _STA), (2, _CFG), (3, _MD),
+                        (4, _OVF)):
+            scal_out[i, 0] = st[slot, 0]
+
+    vmem = {} if pltpu is None else {"memory_space": pltpu.VMEM}
+    smem = {} if pltpu is None else {"memory_space": pltpu.SMEM}
+
+    def _scratch(shape, dtype=jnp.int32):
+        if pltpu is None:  # pragma: no cover
+            raise RuntimeError("pallas tpu unavailable")
+        return pltpu.VMEM(shape, dtype)
+
+    call = pl.pallas_call(
+        kernel,
+        in_specs=[pl.BlockSpec(**smem)] + [pl.BlockSpec(**vmem)] * 14,
+        out_specs=[pl.BlockSpec(**vmem)] * 4 + [pl.BlockSpec(**smem)],
+        out_shape=[
+            jax.ShapeDtypeStruct((F, 1), jnp.int32),
+            jax.ShapeDtypeStruct((F, W), jnp.int32),
+            jax.ShapeDtypeStruct((F, NC), jnp.int32),
+            jax.ShapeDtypeStruct((F, SW), jnp.int32),
+            jax.ShapeDtypeStruct((5, 1), jnp.int32),
+        ],
+        scratch_shapes=[
+            _scratch((F, 1)), _scratch((F, W)), _scratch((F, NC)),
+            _scratch((F, SW)),
+            _scratch((F, 1)), _scratch((F, W)), _scratch((F, NC)),
+            _scratch((F, SW)),
+            _scratch((F, L)), _scratch((F, L)), _scratch((F, L, SW)),
+            pltpu.SMEM((16, 1), jnp.int32) if pltpu is not None
+            else None,
+        ],
+        interpret=interpret,
+    )
+
+    def step(det_f, det_v1, det_v2, det_inv, det_ret, sfx_min,
+             crash_f, crash_v1, crash_v2, crash_inv, n_det, n_crash,
+             budget, lvl_cap, bail,
+             frontier, count, status, configs, max_depth, ovf):
+        # ---- XLA boundary: unpack packed words to planes ----------
+        win = ((frontier[:, 1 + w_word] >> w_bit) & 1).astype(jnp.int32)
+        crash = ((frontier[:, 1 + WW + c_word] >> c_bit)
+                 & 1).astype(jnp.int32)
+        p = frontier[:, 0:1]
+        state = frontier[:, 1 + WW + CW:]
+        scal = jnp.stack([
+            count.astype(jnp.int32), status.astype(jnp.int32),
+            configs.astype(jnp.int32), max_depth.astype(jnp.int32),
+            ovf.astype(jnp.int32), n_det, n_crash, budget, lvl_cap,
+            bail.astype(jnp.int32), jnp.int32(0), jnp.int32(0),
+        ]).reshape(12, 1)
+        clamp = functools.partial(jnp.minimum, CLAMP_INF)
+        outs = call(
+            scal,
+            det_f[None, :], det_v1[None, :], det_v2[None, :],
+            clamp(det_inv)[None, :], clamp(det_ret)[None, :],
+            clamp(sfx_min)[None, :],
+            crash_f[None, :], crash_v1[None, :], crash_v2[None, :],
+            clamp(crash_inv)[None, :],
+            p, win, crash, state)
+        p_o, win_o, crash_o, state_o, scal_o = outs
+        # ---- pack planes back to words ----------------------------
+        wshift = jnp.asarray(w_bit, jnp.int32)
+        cshift = jnp.asarray(c_bit, jnp.int32)
+        # disjoint bit values sum to their OR (int32 addition wraps, so
+        # bit 31 round-trips through its negative two's-complement value)
+        win_words = jnp.stack(
+            [(win_o[:, wi * 32:min((wi + 1) * 32, W)]
+              << wshift[wi * 32:min((wi + 1) * 32, W)]).sum(axis=1)
+             for wi in range(WW)], axis=1)
+        crash_words = jnp.stack(
+            [(crash_o[:, wi * 32:min((wi + 1) * 32, NC)]
+              << cshift[wi * 32:min((wi + 1) * 32, NC)]).sum(axis=1)
+             for wi in range(CW)], axis=1)
+        frontier_o = jnp.concatenate(
+            [p_o, win_words, crash_words, state_o], axis=1)
+        return (frontier_o, scal_o[0, 0], scal_o[1, 0], scal_o[2, 0],
+                scal_o[3, 0], scal_o[4, 0].astype(bool))
+
+    return step

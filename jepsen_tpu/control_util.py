@@ -184,6 +184,16 @@ def start_daemon(sess: Session, bin_path: str, *args,
     """Start a daemon via start-stop-daemon, logging to logfile
     (control/util.clj:208-236)."""
     log.info("starting %s", bin_path.rsplit("/", 1)[-1])
+    # stale-pidfile damage control: on hosts with no reaping init (this
+    # image's containers), a kill -9'd daemon stays a ZOMBIE forever —
+    # kill -0 succeeds on it and start-stop-daemon then refuses to
+    # start ("process already running"), so every nemesis restart
+    # silently failed.  Clear the pidfile when its process is a zombie
+    # or gone; a genuinely running daemon (state R/S/D) still blocks.
+    sess.exec_raw(
+        f"pid=$(cat {pidfile} 2>/dev/null); "
+        f"st=$(awk '{{print $3}}' /proc/$pid/stat 2>/dev/null); "
+        f"if [ \"$st\" = Z ] || [ -z \"$st\" ]; then rm -f {pidfile}; fi")
     sess.exec("echo", lit("`date +'%Y-%m-%d %H:%M:%S'`"),
               "Jepsen starting", bin_path, " ".join(map(str, args)),
               lit(">>"), logfile)

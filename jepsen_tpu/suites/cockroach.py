@@ -94,25 +94,38 @@ def db(tarball: str = TARBALL) -> CockroachDB:
     return CockroachDB(tarball)
 
 
+def pg_driver():
+    """The postgres wire driver: psycopg2 when a wheel exists, else the
+    stdlib pg-wire shim (suites/pgwire.py) — same protocol, same DB-API
+    subset, so the txn machinery below executes identically (and runs
+    LIVE in tests/test_clients_live.py against the in-process pg-wire
+    server)."""
+    try:
+        import psycopg2
+
+        return psycopg2
+    except ImportError:
+        from . import pgwire
+
+        return pgwire
+
+
 class SQLClient(client_mod.Client):
-    """Base: a psycopg2 connection to the local gateway node with
+    """Base: a postgres-wire connection to the local gateway node with
     reconnect + retry (cockroach client.clj semantics)."""
+
+    #: test-map override for the SQL port (cockroach's default)
+    PORT = 26257
 
     def __init__(self, node=None):
         self.node = node
         self.conn = None
 
     def open(self, test, node):
-        try:
-            import psycopg2
-        except ImportError as e:
-            raise RuntimeError(
-                "cockroach clients need psycopg2 (postgres wire protocol); "
-                "pip install psycopg2-binary on the control node") from e
         c = type(self)(node)
-        c.conn = psycopg2.connect(host=str(node), port=26257,
-                                  user="root", dbname="jepsen",
-                                  connect_timeout=5)
+        c.conn = pg_driver().connect(
+            host=str(node), port=test.get("sql_port", self.PORT),
+            user="root", dbname="jepsen", connect_timeout=5)
         c.conn.autocommit = False
         return c
 

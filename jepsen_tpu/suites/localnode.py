@@ -66,9 +66,11 @@ class LocalNodeDB(db_mod.DB, db_mod.LogFiles):
         port = node_port(test, node)
         sess.exec("mkdir", "-p", d)
         log.info("%s starting localnode server on :%d", node, port)
+        extra = (["volatile"] if test.get("lock_volatile") else [])
         cu.start_daemon(
             sess, sys.executable,
             "-m", "jepsen_tpu.suites.localnode_server", str(port), d,
+            *extra,
             logfile=os.path.join(d, "server.log"),
             pidfile=os.path.join(d, "server.pid"),
             chdir=REPO_ROOT,          # `-m` resolves against the repo
@@ -229,6 +231,144 @@ class RegisterClient(client_mod.Client):
                 s.close()
             except OSError:
                 pass
+
+
+class LockWireClient(client_mod.Client):
+    """tryLock/unlock over the live text protocol — the executed wire
+    path for BASELINE config #4 (the reference's hazelcast lock
+    workload, hazelcast.clj:260-292 + 379-386).  The op mapping
+    mirrors HzLockClient: grant -> :ok, BUSY -> :fail, wrong-owner
+    release -> :fail not-lock-owner, connection refused (never reached
+    the server) -> :fail, in-flight connection loss -> :info (the op
+    may have applied — the checker's indeterminate case).
+
+    The lock is a single cluster-wide resource (hazelcast's CP
+    subsystem shape), so every client talks to nodes[0]."""
+
+    def __init__(self, timeout: float = 2.0):
+        self.timeout = timeout
+        self.sock = None
+        self.owner = None
+        self.node = None
+
+    def open(self, test, node):
+        c = LockWireClient(self.timeout)
+        c.node = test["nodes"][0]
+        c.owner = f"c{id(c):x}"
+        return c
+
+    class _NeverReached(Exception):
+        """Connect-phase failure: the request provably never reached
+        the server, so the op is a definite :fail — mapping it :info
+        would inject spurious indeterminate ops into the mutex history
+        (an :info release is exactly what lets the checker explain
+        away a real double grant)."""
+
+    def _round_trip(self, test, line: str) -> str:
+        if self.sock is None:
+            try:
+                self.sock = socket.create_connection(
+                    ("127.0.0.1", node_port(test, self.node)),
+                    timeout=self.timeout)
+            except OSError as e:
+                raise self._NeverReached(repr(e)) from e
+        s = self.sock
+        try:
+            s.sendall((line + "\n").encode("ascii"))
+            buf = b""
+            while not buf.endswith(b"\n"):
+                chunk = s.recv(4096)
+                if not chunk:
+                    raise ConnectionResetError("server closed")
+                buf += chunk
+            return buf.decode("ascii").strip()
+        except OSError:
+            self.sock = None
+            try:
+                s.close()
+            except OSError:
+                pass
+            raise
+
+    def invoke(self, test, op):
+        try:
+            if op.f == "acquire":
+                out = self._round_trip(test, f"LOCK {self.owner}")
+                return replace(op, type="ok" if out == "OK" else "fail")
+            if op.f == "release":
+                out = self._round_trip(test, f"UNLOCK {self.owner}")
+                if out == "OK":
+                    return replace(op, type="ok")
+                return replace(op, type="fail", error="not-lock-owner")
+            raise ValueError(f"unknown f {op.f!r}")
+        except self._NeverReached as e:
+            return replace(op, type="fail", error=str(e)[:120])
+        except OSError as e:
+            # in-flight when the connection died: the grant/release may
+            # have been applied (hazelcast.clj:288-291's indeterminate
+            # case)
+            return replace(op, type="info", error=repr(e))
+
+    def close(self, test):
+        if self.sock is not None:
+            try:
+                self.sock.close()
+            except OSError:
+                pass
+
+
+def lock_gen(hold: float = 0.0):
+    """Alternating acquire/release per process (hazelcast.clj:
+    379-383).  ``hold`` sleeps between the two, so the lock spends
+    real wall time held — a nemesis that fires mid-test then lands
+    inside a held window instead of the microsecond grant gap."""
+    cycle = [{"type": "invoke", "f": "acquire", "value": None}]
+    if hold > 0:
+        cycle.append(gen.sleep(hold))
+    cycle.append({"type": "invoke", "f": "release", "value": None})
+    return gen.each(lambda: gen.seq(itertools.cycle(cycle)))
+
+
+def locknode_test(opts: dict) -> dict:
+    """BASELINE config #4, executed live: a real lock-server process,
+    real TCP clients, kill -9 / restart nemesis, mutex-model verdict
+    through the full runner.  With `lock_volatile`, the server forgets
+    the holder on crash and the checker must CATCH the double grant —
+    the reference's hazelcast finding, reproduced end to end."""
+    from ..models import mutex
+
+    kill_every = opts.get("kill_every", 2)
+    # staggered ops keep the in-flight window per process tiny, so a
+    # kill -9 rarely catches a release mid-flight: a volatile server's
+    # forgotten holder then shows up as an ok-acquire pair NO :info
+    # release can explain — the checker's invalid verdict is decisive,
+    # not timing luck
+    rate = opts.get("rate", 100)
+    main_phase = gen.nemesis(
+        gen.seq(itertools.cycle(
+            [gen.sleep(kill_every), {"type": "info", "f": "kill"},
+             gen.sleep(0.5), {"type": "info", "f": "restart"}])),
+        gen.stagger(1.0 / rate, lock_gen(opts.get("hold", 0.0))))
+    phases = [gen.time_limit(opts.get("time_limit", 8), main_phase),
+              gen.log("Healing: restarting all servers"),
+              gen.nemesis(gen.once({"type": "info", "f": "restart"})),
+              gen.sleep(0.5)]
+    nodes = opts.get("nodes") or ["n1"]
+    return fixtures.noop_test() | dict(opts) | {
+        "name": "locknode",
+        "nodes": nodes,
+        "concurrency": opts.get("concurrency", 4),
+        "remote": control.LocalRemote(),
+        "db": db(),
+        "client": LockWireClient(),
+        "nemesis": KillRestartNemesis(),
+        "model": mutex(),
+        "checker": checker_mod.compose({
+            "linear": lin.linearizable(mutex()),
+            "timeline": timeline.timeline(),
+        }),
+        "generator": gen.phases(*phases),
+    }
 
 
 # ---------------------------------------------------------------------------

@@ -6,6 +6,14 @@ A deliberately small but honest linearizable key->int register service:
         R k            -> "OK <v>" | "OK nil"
         W k v          -> "OK"
         CAS k old new  -> "OK" | "FAIL"
+        LOCK owner     -> "OK" | "BUSY"        (global tryLock)
+        UNLOCK owner   -> "OK" | "NOT_OWNER"
+  * the lock mirrors the shape of Hazelcast's tryLock/unlock service
+    (reference hazelcast.clj:260-292) for the BASELINE config #4
+    workload.  In `volatile` mode lock state is NOT logged — a kill -9
+    forgets the holder, exactly the class of bug the reference's
+    hazelcast analysis found under partitions (double grants), so the
+    mutex checker has something real to catch.
   * durability: every state-changing op is appended to an oplog and
     fsync()ed BEFORE the reply is sent, under one global lock — the
     linearization point is inside the lock, and a kill -9 at any moment
@@ -32,9 +40,11 @@ import threading
 
 
 class Store:
-    def __init__(self, data_dir: str):
+    def __init__(self, data_dir: str, volatile_lock: bool = False):
         self.lock = threading.Lock()
         self.state: dict[str, int] = {}
+        self.holder: str | None = None
+        self.volatile_lock = volatile_lock
         os.makedirs(data_dir, exist_ok=True)
         self.path = os.path.join(data_dir, "oplog")
         self._recover()
@@ -51,6 +61,11 @@ class Store:
                 elif len(parts) == 4 and parts[0] == "C":
                     if self.state.get(parts[1]) == int(parts[2]):
                         self.state[parts[1]] = int(parts[3])
+                elif len(parts) == 2 and parts[0] == "L":
+                    self.holder = parts[1]
+                elif len(parts) == 2 and parts[0] == "U":
+                    if self.holder == parts[1]:
+                        self.holder = None
 
     def _durable(self, line: str) -> None:
         self.log.write(line.encode("ascii"))
@@ -72,6 +87,24 @@ class Store:
                 self._durable(f"C {parts[1]} {int(parts[2])} "
                               f"{int(parts[3])}\n")
                 self.state[parts[1]] = int(parts[3])
+                return "OK"
+            if parts[0] == "LOCK" and len(parts) == 2:
+                if self.holder is not None:
+                    return "BUSY"
+                # grant is durable BEFORE the reply (linearization
+                # point inside the log lock) — unless volatile, where a
+                # kill -9 forgets the holder and double grants become
+                # possible, the bug class the mutex checker exists for
+                if not self.volatile_lock:
+                    self._durable(f"L {parts[1]}\n")
+                self.holder = parts[1]
+                return "OK"
+            if parts[0] == "UNLOCK" and len(parts) == 2:
+                if self.holder != parts[1]:
+                    return "NOT_OWNER"
+                if not self.volatile_lock:
+                    self._durable(f"U {parts[1]}\n")
+                self.holder = None
                 return "OK"
             return "ERR bad command"
 
@@ -99,12 +132,14 @@ class Server(socketserver.ThreadingTCPServer):
 
 def main(argv=None) -> None:
     argv = sys.argv[1:] if argv is None else argv
-    if len(argv) != 2:
-        print("usage: localnode_server PORT DATA_DIR", file=sys.stderr)
+    if len(argv) not in (2, 3) or (len(argv) == 3
+                                   and argv[2] != "volatile"):
+        print("usage: localnode_server PORT DATA_DIR [volatile]",
+              file=sys.stderr)
         raise SystemExit(2)
     port, data_dir = int(argv[0]), argv[1]
     srv = Server(("127.0.0.1", port), Handler)
-    srv.store = Store(data_dir)
+    srv.store = Store(data_dir, volatile_lock=len(argv) == 3)
     print(f"localnode_server: listening on 127.0.0.1:{port}", flush=True)
     srv.serve_forever()
 

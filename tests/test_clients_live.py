@@ -302,3 +302,66 @@ def test_etcd_client_down_maps_to_info_or_fail(monkeypatch):
     kv = independent.tuple_
     assert c.invoke({}, invoke_op(0, "read", kv(1, None))).type == "fail"
     assert c.invoke({}, invoke_op(0, "write", kv(1, 2))).type == "info"
+
+
+# ---------------------------------------------------------------------------
+# postgres wire protocol (cockroach SQLClient family)
+# ---------------------------------------------------------------------------
+
+
+def test_cockroach_sql_register_live():
+    """The SQL txn machinery (suites/cockroach.py:101-162) executed
+    LIVE over real pg-wire v3 frames: happy paths, cas hit/miss, a
+    server-reported txn conflict (read -> :fail, write -> :info), and
+    loss of the server mid-session (indeterminate)."""
+    from jepsen_tpu import independent
+    from jepsen_tpu.suites import cockroach, pgwire
+
+    srv, port = pgwire.MiniPGServer.start()
+    t = {"sql_port": port}
+    kv = independent.tuple_
+    try:
+        c = cockroach.RegisterClient().open(t, "127.0.0.1")
+        c.setup(t)  # CREATE TABLE over the wire
+        assert c.invoke(t, invoke_op(0, "write", kv(1, 5))).type == "ok"
+        op = c.invoke(t, invoke_op(0, "read", kv(1, None)))
+        assert op.type == "ok" and op.value.value == 5
+        op = c.invoke(t, invoke_op(0, "read", kv(2, None)))
+        assert op.type == "ok" and op.value.value is None
+        assert c.invoke(t, invoke_op(0, "cas", kv(1, (5, 7)))).type \
+            == "ok"
+        assert c.invoke(t, invoke_op(0, "cas", kv(1, (5, 9)))).type \
+            == "fail"
+        op = c.invoke(t, invoke_op(0, "read", kv(1, None)))
+        assert op.type == "ok" and op.value.value == 7
+        # server-reported conflict: the client's error mapping
+        # (client.clj:retryable semantics) runs live
+        srv.engine.fail_next(1)
+        assert c.invoke(t, invoke_op(0, "read", kv(1, None))).type \
+            == "fail"
+        srv.engine.fail_next(1)
+        assert c.invoke(t, invoke_op(0, "write", kv(1, 8))).type \
+            == "info"
+        # the rollback path left the connection usable
+        op = c.invoke(t, invoke_op(0, "read", kv(1, None)))
+        assert op.type == "ok" and op.value.value == 7
+        # in-flight loss of the connection (server drops mid-statement):
+        # writes indeterminate, reads definite
+        srv.engine.die_next(1)
+        op = c.invoke(t, invoke_op(0, "write", kv(3, 1)))
+        assert op.type == "info"
+        op = c.invoke(t, invoke_op(0, "read", kv(3, None)))
+        assert op.type == "fail"  # connection is dead now
+        c.close(t)
+    finally:
+        srv.shutdown()
+        srv.server_close()
+
+
+def test_pgwire_shim_is_the_fallback_driver():
+    from jepsen_tpu.suites import cockroach, pgwire
+
+    try:
+        import psycopg2  # noqa: F401
+    except ImportError:
+        assert cockroach.pg_driver() is pgwire

@@ -71,6 +71,97 @@ def test_server_survives_kill_minus_9(tmp_path):
         proc.wait(timeout=5)
 
 
+def test_lock_workload_live_durable_valid(tmp_path):
+    """BASELINE config #4 executed end to end: real lock-server
+    process, real TCP acquire/release, kill -9 / restart nemesis,
+    mutex verdict through the full runner.  The durable server fsyncs
+    the holder before granting, so every verdict must be valid."""
+    from jepsen_tpu import core
+    from jepsen_tpu.suites import localnode
+
+    test = localnode.locknode_test({
+        "base_port": 17960,
+        "data_root": str(tmp_path / "nodes"),
+        "store_base": str(tmp_path / "store"),
+        "time_limit": 6,
+        "kill_every": 2,
+        "concurrency": 4,
+    })
+    test = core.run(test)
+    res = test["results"]
+    assert res.get("valid") is True, res
+    hist = test["history"]
+    assert any(op.process == "nemesis" and op.f == "kill"
+               for op in hist), "nemesis never killed the lock server"
+    oks = [op for op in hist if isinstance(op.process, int)
+           and op.type == "ok"]
+    assert len(oks) > 10, f"too few completed lock ops: {len(oks)}"
+
+
+def test_lock_volatile_double_grant_detected(tmp_path):
+    """The reference's hazelcast finding reproduced live: a lock
+    server that forgets its holder on kill -9 double-grants, and the
+    mutex checker must CATCH it (hazelcast.clj analysis; the checker
+    path is BASELINE config #4's whole point)."""
+    from jepsen_tpu import core
+    from jepsen_tpu.suites import localnode
+
+    # the construction must leave the checker NO :info release to
+    # explain the gap with (a symmetric acquire/release workload always
+    # has one: the dead holder's own release discovers the kill on its
+    # send and records :info, which legally linearizes as the unlock).
+    # So: one HOLDER process (acquire, hold 2 s, release) and one
+    # acquire-ONLY process that never releases.  The kill lands inside
+    # the hold; the restarted volatile server forgets the holder and
+    # grants the acquirer while the holder still sleeps; the holder's
+    # release is then INVOKED strictly after that grant returned, so
+    # real-time order pins its linearization point after both grants —
+    # two ok acquires with no possible unlock between.  hazelcast.clj's
+    # double-grant finding, reproduced live through the full runner.
+    import itertools
+
+    from jepsen_tpu import generator as gen
+    from jepsen_tpu.suites.localnode import lock_gen
+
+    # hold must outlast kill + restart latency (the restart's daemon
+    # start + readiness poll takes ~2 s on a loaded host): the second
+    # grant has to COMPLETE while the holder still sleeps, or the
+    # holder's pending release alone explains the gap
+    for attempt in range(3):
+        test = localnode.locknode_test({
+            "base_port": 17970 + attempt,
+            "data_root": str(tmp_path / f"nodes{attempt}"),
+            "store_base": str(tmp_path / f"store{attempt}"),
+            "time_limit": 10,
+            "concurrency": 2,
+            "lock_volatile": True,
+        })
+        holder = gen.stagger(0.01, lock_gen(hold=5.0))
+        acquirer = gen.stagger(0.05, gen.each(
+            lambda: gen.seq(itertools.cycle(
+                [{"type": "invoke", "f": "acquire", "value": None}]))))
+        nem = gen.seq(itertools.cycle(
+            [gen.sleep(1.5), {"type": "info", "f": "kill"},
+             gen.sleep(0.3), {"type": "info", "f": "restart"}]))
+        test["generator"] = gen.phases(
+            gen.time_limit(10, gen.nemesis(
+                nem, gen.reserve(1, holder, acquirer))),
+            gen.nemesis(gen.once({"type": "info", "f": "restart"})),
+            gen.sleep(0.5))
+        test = core.run(test)
+        res = test["results"]
+        assert res.get("valid") in (True, False)
+        if res.get("valid") is False:
+            # the double grant was real and the checker caught it —
+            # through real sockets, a real kill -9, the full runner
+            return
+        # unlucky timing (kill missed every hold window): the verdict
+        # is then honestly valid; try again
+    pytest.fail("no double grant detected in 3 runs with 2s holds and "
+                "mid-hold kills — the volatile lock server or checker "
+                "path regressed")
+
+
 def test_full_stack_real_processes(tmp_path):
     """core.run end to end: real server daemons per node, a kill -9 /
     restart nemesis, linearizable verdict, store artifacts."""

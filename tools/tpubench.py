@@ -247,6 +247,79 @@ def main() -> None:
                   lambda c: (jnp.all(c[1:] == c[:-1], axis=1)).sum(),
                   cfgs, repeat=rep)
 
+    # --- engine-paired rows: pallas level-loop vs XLA step -----------
+    # The pallas kernel (checker/pallas_level.py) fuses the whole level
+    # loop into one device op to beat the ~1.3 ms/level op-count floor
+    # (docs/perf-notes.md r4).  mutex2k is the eligibility-friendly
+    # history (window 32); these rows are the decisive on-chip A/B.
+    # A Mosaic lowering failure must emit a diagnostic row, not kill
+    # the sweep — it would be the first hardware contact for the path.
+    from jepsen_tpu.checker import pallas_level as plev
+
+    seqm, modelm = hbench.make_seq("mutex2k")
+    esm = lin.encode_search(seqm)
+    for F in (16, 64):
+        dimsm = lin.choose_dims(esm, modelm, frontier=F)
+        if not plev.eligible(modelm, dimsm):
+            print(json.dumps({"op": "engine-pair", "F": F,
+                              "skipped": "ineligible dims",
+                              "dims": str(dimsm)}), flush=True)
+            continue
+        espm = lin.pad_search(esm, dimsm.n_det_pad, dimsm.n_crash_pad)
+        kargsm = (jnp.asarray(espm.det_f), jnp.asarray(espm.det_v1),
+                  jnp.asarray(espm.det_v2), jnp.asarray(espm.det_inv),
+                  jnp.asarray(espm.det_ret),
+                  jnp.asarray(espm.suffix_min_ret),
+                  jnp.asarray(espm.crash_f), jnp.asarray(espm.crash_v1),
+                  jnp.asarray(espm.crash_v2),
+                  jnp.asarray(espm.crash_inv),
+                  jnp.int32(esm.n_det), jnp.int32(esm.n_crash))
+        mode0 = lin._DOMINANCE_MODE
+        for engine in ("xla", "pallas"):
+            try:
+                lin._DOMINANCE_MODE = "allpairs"
+                if engine == "pallas":
+                    step = jax.jit(plev.build_pallas_step_fn(
+                        modelm, dimsm,
+                        interpret=jax.default_backend() != "tpu"))
+                else:
+                    step = jax.jit(lin.build_search_step_fn(modelm,
+                                                            dimsm))
+                carry = tuple(jnp.asarray(c)
+                              for c in lin._init_carry(dimsm, modelm))
+                t0 = time.perf_counter()
+                out = step(*kargsm, jnp.int32(10**9),
+                           jnp.int32(args.levels), jnp.bool_(False),
+                           *carry)
+                jax.block_until_ready(out)
+                t_compile = time.perf_counter() - t0
+                dts = []
+                for _ in range(rep):
+                    t0 = time.perf_counter()
+                    out = step(*kargsm, jnp.int32(10**9),
+                               jnp.int32(args.levels), jnp.bool_(False),
+                               *carry)
+                    jax.block_until_ready(out)
+                    dts.append(time.perf_counter() - t0)
+                lvls_run = int(out[4]) + 1
+                print(json.dumps({
+                    "op": f"engine-{args.levels}-levels", "F": F,
+                    "engine": engine, "history": "mutex2k",
+                    "ms_per_level": round(min(dts) / lvls_run * 1000,
+                                          4),
+                    "levels_run": lvls_run,
+                    "carry": {"count": int(out[1]),
+                              "status": int(out[2]),
+                              "configs": int(out[3]),
+                              "ovf": bool(out[5])},
+                    "compile_s": round(t_compile, 2)}), flush=True)
+            except Exception as e:  # noqa: BLE001 — diagnostic row
+                print(json.dumps({"op": f"engine-{args.levels}-levels",
+                                  "F": F, "engine": engine,
+                                  "error": repr(e)[:500]}), flush=True)
+            finally:
+                lin._DOMINANCE_MODE = mode0
+
 
 if __name__ == "__main__":
     main()

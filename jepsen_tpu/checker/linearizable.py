@@ -1627,7 +1627,9 @@ def _run_kernel(esp: EncodedSearch, es: EncodedSearch, model: ModelSpec,
       narrow valleys with rare wide bursts; without the downshift one
       burst taxes every later level at the burst's width.
 
-    Returns (status, configs, max_depth, dims): status is finalized
+    Returns (status, configs, max_depth, dims, used_pallas):
+    ``used_pallas`` is True iff any slice executed on the pallas
+    level-loop engine (label evidence); status is finalized
     (-1 never escapes), dims reflects the final width.  ``on_slice(carry,
     dims)`` fires after every device call (the checkpoint hook);
     ``resume`` accepts a previously captured carry at ``dims.frontier``
@@ -1660,8 +1662,11 @@ def _run_kernel(esp: EncodedSearch, es: EncodedSearch, model: ModelSpec,
             return max(8, min(cap, int(hard_s / per_lvl)))
         return cap
 
+    used_pallas = False  # any slice ran on the pallas engine (evidence
+    #                      for the emitted engine label)
     while True:
         bail = escalate and F < MAX_FRONTIER
+        want_pallas = _use_pallas(model, dims)
         fn = get_kernel(model, dims)
         _trace(f"run F={F} cap={lvl_cap} first={int(first)} "
                f"depth={prev_depth}")
@@ -1690,6 +1695,10 @@ def _run_kernel(esp: EncodedSearch, es: EncodedSearch, model: ModelSpec,
                 jax.block_until_ready(carry)
             else:
                 raise
+        # only a slice that actually EXECUTED on pallas counts (a
+        # fallback flips _PALLAS_BROKEN before the redo)
+        used_pallas = used_pallas or (want_pallas
+                                      and not _PALLAS_BROKEN)
         dt = time.perf_counter() - t0
         if on_slice is not None:
             on_slice(carry, dims)
@@ -1789,7 +1798,7 @@ def _run_kernel(esp: EncodedSearch, es: EncodedSearch, model: ModelSpec,
             status = UNKNOWN
         else:
             status = UNKNOWN if ovf else INVALID
-    return status, configs, int(carry[4]), dims
+    return status, configs, int(carry[4]), dims, used_pallas
 
 
 def greedy_witness(seq: OpSeq, model: ModelSpec) -> bool:
@@ -1846,11 +1855,13 @@ def search_opseq(seq: OpSeq, model: ModelSpec, *,
 
     dims = dims or choose_dims(es, model)
     esp = pad_search(es, dims.n_det_pad, dims.n_crash_pad)
-    status, configs, max_depth, dims = _run_kernel(
+    status, configs, max_depth, dims, used_pallas = _run_kernel(
         esp, es, model, dims, budget, on_slice=on_slice,
         deadline=deadline, stop=stop)
     return {"valid": _STATUS[status], "configs": configs,
-            "max_depth": max_depth, "engine": "device-bfs",
+            "max_depth": max_depth,
+            "engine": ("device-bfs(pallas)" if used_pallas
+                       else "device-bfs"),
             "frontier": dims.frontier,
             "window": es.window, "concurrency": es.concurrency}
 
@@ -2032,11 +2043,13 @@ def resume_opseq(seq: OpSeq, model: ModelSpec, path: str, *,
             "checkpoint was taken on a different history (digest mismatch)")
     es = encode_search(seq)
     esp = pad_search(es, dims.n_det_pad, dims.n_crash_pad)
-    status, configs, max_depth, dims = _run_kernel(
+    status, configs, max_depth, dims, used_pallas = _run_kernel(
         esp, es, model, dims, budget, on_slice=on_slice, resume=carry,
         deadline=deadline, stop=stop)
     return {"valid": _STATUS[status], "configs": configs,
-            "max_depth": max_depth, "engine": "device-bfs(resumed)",
+            "max_depth": max_depth,
+            "engine": ("device-bfs(pallas,resumed)" if used_pallas
+                       else "device-bfs(resumed)"),
             "frontier": dims.frontier,
             "window": es.window, "concurrency": es.concurrency}
 
@@ -2378,8 +2391,10 @@ def search_batch(seqs: list[OpSeq], model: ModelSpec, *,
         pending = list(range(n))
         spent = np.zeros(n, np.int64)  # configs across ALL rungs
         rung = dims.frontier
+        used_pallas = False  # any rung executed on the pallas engine
         while pending:
             d = _dc_replace(dims, frontier=rung)
+            want_pallas = _use_pallas(model, d)
             fnr = get_batch_kernel(model, d, batch=len(pending))
             try:
                 st, ct, cf, dp, ov = _drive_batch_compacting(
@@ -2401,6 +2416,8 @@ def search_batch(seqs: list[OpSeq], model: ModelSpec, *,
                         budget, bail=True)
                 else:
                     raise
+            used_pallas = used_pallas or (want_pallas
+                                          and not _PALLAS_BROKEN)
             nxt = []
             for j, i in enumerate(pending):
                 spent[i] += int(cf[j])
@@ -2426,6 +2443,8 @@ def search_batch(seqs: list[OpSeq], model: ModelSpec, *,
         status)
     out = []
     ladder = sharding is None
+    batch_engine = ("device-batch(pallas)"
+                    if ladder and used_pallas else "device-batch")
     solo = set(pending) if ladder else set()
     for i in range(len(seqs)):
         needs_solo = i in solo or (int(status[i]) == UNKNOWN
@@ -2436,7 +2455,7 @@ def search_batch(seqs: list[OpSeq], model: ModelSpec, *,
             # UNKNOWN stands, with the true cumulative count.
             out.append({"valid": "unknown", "configs": int(spent[i]),
                         "max_depth": int(depth[i]),
-                        "engine": "device-batch"})
+                        "engine": batch_engine})
         elif needs_solo:
             # overflowed every shared rung: redo solo with the adaptive
             # ladder, on the REMAINING budget, reporting cumulative
@@ -2450,7 +2469,7 @@ def search_batch(seqs: list[OpSeq], model: ModelSpec, *,
             out.append({"valid": _STATUS[int(status[i])],
                         "configs": int(configs[i]),
                         "max_depth": int(depth[i]),
-                        "engine": "device-batch"})
+                        "engine": batch_engine})
     return out
 
 

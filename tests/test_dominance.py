@@ -142,5 +142,6 @@ def test_engine_verdicts_match_across_prunes(seed, monkeypatch):
     assert out_sort["valid"] == out_ap["valid"], (
         f"seed {seed}: sort={out_sort} allpairs={out_ap}")
     # the exact prune can only explore the same or fewer configs
-    if out_sort.get("engine") == out_ap.get("engine") == "device-bfs":
+    if (str(out_sort.get("engine", "")).startswith("device-bfs")
+            and str(out_ap.get("engine", "")).startswith("device-bfs")):
         assert out_ap["configs"] <= out_sort["configs"]

@@ -441,7 +441,9 @@ def test_search_batch_mixed_difficulty_compaction():
     got = lin.search_batch(seqs, model, budget=500_000)
     assert [r["valid"] for r in got] == want
     assert all(r["engine"] in
-               ("device-batch", "greedy-witness", "device-bfs", "trivial")
+               ("device-batch", "device-batch(pallas)",
+                "greedy-witness", "device-bfs", "device-bfs(pallas)",
+                "trivial")
                for r in got)
     # at least the corrupted keys must have ridden the device
     assert sum(r["engine"] == "device-batch" for r in got) >= 6

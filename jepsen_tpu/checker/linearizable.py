@@ -1860,8 +1860,7 @@ def search_opseq(seq: OpSeq, model: ModelSpec, *,
         deadline=deadline, stop=stop)
     return {"valid": _STATUS[status], "configs": configs,
             "max_depth": max_depth,
-            "engine": ("device-bfs(pallas)" if used_pallas
-                       else "device-bfs"),
+            "engine": _engine_label(used_pallas),
             "frontier": dims.frontier,
             "window": es.window, "concurrency": es.concurrency}
 
@@ -1990,6 +1989,14 @@ def history_digest(seq: OpSeq, model: ModelSpec) -> str:
     return h.hexdigest()
 
 
+def _engine_label(used_pallas: bool, resumed: bool = False,
+                  base: str = "device-bfs") -> str:
+    """One place assembles the engine strings (three emit sites)."""
+    tags = [t for t, on in (("pallas", used_pallas),
+                            ("resumed", resumed)) if on]
+    return base + (f"({','.join(tags)})" if tags else "")
+
+
 def save_checkpoint(path: str, carry, dims: SearchDims, model: ModelSpec,
                     budget: int, seq: OpSeq | None = None) -> None:
     """Persist a live search carry (as delivered to ``on_slice``).
@@ -1998,21 +2005,38 @@ def save_checkpoint(path: str, carry, dims: SearchDims, model: ModelSpec,
     progress counters — so a checkpoint is one npz.  The reference's
     knossos search has no analog: a killed -Xmx32g JVM search restarts
     from scratch (jepsen/project.clj:25).  Pass ``seq`` to bind the
-    checkpoint to its history so `resume_opseq` can refuse a mismatch."""
+    checkpoint to its history so `resume_opseq` can refuse a mismatch.
+
+    The checkpoint also carries ``used_pallas`` — whether any slice of
+    the SEARCH SO FAR executed on the pallas engine — ORed across
+    saves of the same history (the engine label of a cross-window
+    accumulated verdict must not forget a window that ran on-chip
+    pallas just because a later CPU window saved last)."""
     c = [np.asarray(x) for x in carry]
     digest = history_digest(seq, model) if seq is not None else ""
+    used_p = _use_pallas(model, dims)
+    if not used_p and os.path.exists(path):
+        try:
+            z = np.load(path)
+            if ("used_pallas" in z and bool(z["used_pallas"][()])
+                    and "digest" in z
+                    and bytes(z["digest"][()]).decode() == digest):
+                used_p = True
+        except Exception:  # noqa: BLE001 — corrupt prior file
+            pass
     np.savez_compressed(
         path, frontier=c[0], count=c[1], status=c[2], configs=c[3],
         max_depth=c[4], ovf=c[5], budget=np.int64(budget),
         model=np.bytes_(model.name.encode()),
         digest=np.bytes_(digest.encode()),
+        used_pallas=np.bool_(used_p),
         dims=np.asarray([dims.n_det_pad, dims.n_crash_pad, dims.window,
                          dims.k, dims.state_width, dims.frontier],
                         np.int64))
 
 
 def load_checkpoint(path: str):
-    """Returns (carry, dims, model_name, budget, digest)."""
+    """Returns (carry, dims, model_name, budget, digest, used_pallas)."""
     z = np.load(path)
     d = z["dims"]
     dims = SearchDims(n_det_pad=int(d[0]), n_crash_pad=int(d[1]),
@@ -2021,8 +2045,9 @@ def load_checkpoint(path: str):
     carry = (z["frontier"], z["count"][()], z["status"][()],
              z["configs"][()], z["max_depth"][()], z["ovf"][()])
     digest = bytes(z["digest"][()]).decode() if "digest" in z else ""
+    used_p = bool(z["used_pallas"][()]) if "used_pallas" in z else False
     return (carry, dims, bytes(z["model"][()]).decode(), int(z["budget"]),
-            digest)
+            digest, used_p)
 
 
 def resume_opseq(seq: OpSeq, model: ModelSpec, path: str, *,
@@ -2034,7 +2059,8 @@ def resume_opseq(seq: OpSeq, model: ModelSpec, path: str, *,
     `search_opseq` — a resumed search interrupted AGAIN is still a
     checkpoint (the bench's cross-tunnel-window accumulation relies on
     this)."""
-    carry, dims, model_name, budget, digest = load_checkpoint(path)
+    carry, dims, model_name, budget, digest, prior_pallas = \
+        load_checkpoint(path)
     if model_name != model.name:
         raise ValueError(
             f"checkpoint is for model {model_name!r}, got {model.name!r}")
@@ -2048,8 +2074,8 @@ def resume_opseq(seq: OpSeq, model: ModelSpec, path: str, *,
         deadline=deadline, stop=stop)
     return {"valid": _STATUS[status], "configs": configs,
             "max_depth": max_depth,
-            "engine": ("device-bfs(pallas,resumed)" if used_pallas
-                       else "device-bfs(resumed)"),
+            "engine": _engine_label(prior_pallas or used_pallas,
+                                    resumed=True),
             "frontier": dims.frontier,
             "window": es.window, "concurrency": es.concurrency}
 
@@ -2443,8 +2469,8 @@ def search_batch(seqs: list[OpSeq], model: ModelSpec, *,
         status)
     out = []
     ladder = sharding is None
-    batch_engine = ("device-batch(pallas)"
-                    if ladder and used_pallas else "device-batch")
+    batch_engine = _engine_label(ladder and used_pallas,
+                                 base="device-batch")
     solo = set(pending) if ladder else set()
     for i in range(len(seqs)):
         needs_solo = i in solo or (int(status[i]) == UNKNOWN

@@ -233,7 +233,8 @@ def test_checkpoint_resume(tmp_path, monkeypatch):
         lin.search_opseq(s, model, dims=DIMS, on_slice=save_then_stop)
     except Stop:
         pass
-    carry, dims2, name, budget, digest = lin.load_checkpoint(ckpt)
+    carry, dims2, name, budget, digest, _pallas = \
+        lin.load_checkpoint(ckpt)
     # the adaptive driver may have moved frontier width along the grid;
     # everything else must round-trip exactly
     assert {**dims2.__dict__, "frontier": 0} == \
@@ -446,7 +447,8 @@ def test_search_batch_mixed_difficulty_compaction():
                 "trivial")
                for r in got)
     # at least the corrupted keys must have ridden the device
-    assert sum(r["engine"] == "device-batch" for r in got) >= 6
+    assert sum(r["engine"].startswith("device-batch")
+               for r in got) >= 6
 
 
 @pytest.mark.parametrize("seed", range(8))

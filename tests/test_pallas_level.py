@@ -181,6 +181,45 @@ def test_search_batch_pallas_matches_oracle():
         assert r["valid"] == oracle["valid"], k
 
 
+def test_checkpoint_resume_under_pallas(tmp_path):
+    """The cross-tunnel-window accumulation path on the pallas engine:
+    a deadline-killed pallas search checkpoints; resume_opseq (also on
+    pallas) finishes it and labels the engine honestly.  This is
+    exactly what a wedged window followed by a fresh one executes."""
+    import time
+
+    rng = random.Random(71)
+    model = cas_register()
+    h = register_history(rng, n_ops=80, n_procs=4, overlap=3,
+                         crash_p=0.05, max_crashes=3, n_values=3)
+    h = corrupt_read(rng, h, at=0.9)
+    seq = encode_ops(h, model.f_codes)
+    path = str(tmp_path / "ck.npz")
+    old = lin._ENGINE_MODE
+    lin._ENGINE_MODE = "pallas"
+    try:
+        saved = []
+
+        def on_slice(carry, dims):
+            lin.save_checkpoint(path, carry, dims, model, 10**7,
+                                seq=seq)
+            saved.append(1)
+
+        out = lin.search_opseq(
+            seq, model, budget=10**7, on_slice=on_slice,
+            deadline=time.perf_counter())  # expire immediately
+        if out["valid"] != "unknown" or not saved:
+            pytest.skip("search decided before the deadline could cut "
+                        "it (host too fast)")
+        res = lin.resume_opseq(seq, model, path)
+        assert res["valid"] is False
+        assert res["engine"] == "device-bfs(pallas,resumed)"
+        oracle = check_opseq(seq, model)
+        assert res["valid"] == oracle["valid"]
+    finally:
+        lin._ENGINE_MODE = old
+
+
 def test_eligibility_gates():
     model = cas_register()
     es_like = lin.SearchDims(n_det_pad=64, n_crash_pad=32, window=32,

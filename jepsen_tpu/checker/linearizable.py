@@ -69,6 +69,7 @@ from __future__ import annotations
 
 import os
 import sys
+import threading
 import time
 from dataclasses import dataclass, replace as _dc_replace
 
@@ -1370,8 +1371,16 @@ def _slice_hard_s() -> float:
 
 def _adapt_lvl_cap(lvl_cap: int, dt: float,
                    target_s: float | None = None) -> int:
-    """Grow/shrink the per-call level cap toward the target slice time."""
+    """Grow/shrink the per-call level cap toward the target slice time.
+
+    The x16 rung matters on the axon TPU with the pallas engine:
+    per-level cost drops to the µs scale, and a x4-only ramp from 32
+    levels pays ~6 dispatches (x ~14 ms tunnel floor each) before the
+    cap covers a deep search — enough overhead to lose a ~0.1 s-scale
+    verdict race on dispatch alone."""
     t = _SLICE_TARGET_S if target_s is None else target_s
+    if dt < t / 16:
+        return min(lvl_cap * 16, _SLICE_MAX)
     if dt < t / 4:
         return min(lvl_cap * 4, _SLICE_MAX)
     if dt < t / 2:
@@ -1473,6 +1482,13 @@ _ENGINE_MODE = os.environ.get("JEPSEN_TPU_ENGINE", "auto")
 #: must cost one rebuilt slice, not the bench tier (the pallas path's
 #: first chip contact happens inside a live tunnel window)
 _PALLAS_BROKEN = False
+
+#: the ACTIVE single-device slice driver's cumulative "any slice
+#: executed on pallas" flag (thread-local: the competition checker
+#: races the device leg in a thread).  save_checkpoint reads it so a
+#: checkpoint written mid-run records the search's real engine
+#: history; None outside a driver.
+_RUN_PALLAS = threading.local()
 
 
 def _use_pallas(model: ModelSpec, dims: SearchDims) -> bool:
@@ -1610,7 +1626,8 @@ def _grid_width(f: int) -> int:
 def _run_kernel(esp: EncodedSearch, es: EncodedSearch, model: ModelSpec,
                 dims: SearchDims, budget: int, *,
                 escalate: bool = True, on_slice=None, resume=None,
-                deadline: float | None = None, stop=None):
+                deadline: float | None = None, stop=None,
+                used_pallas0: bool = False):
     """Drive the sliced kernel to completion with an adaptive width.
 
     The frontier width moves both ways on the power-of-two grid
@@ -1629,7 +1646,10 @@ def _run_kernel(esp: EncodedSearch, es: EncodedSearch, model: ModelSpec,
 
     Returns (status, configs, max_depth, dims, used_pallas):
     ``used_pallas`` is True iff any slice executed on the pallas
-    level-loop engine (label evidence); status is finalized
+    level-loop engine, OR ``used_pallas0`` was passed (the resumed
+    checkpoint's accumulated flag — label evidence); the live value is
+    mirrored into the `_RUN_PALLAS` thread-local around each on_slice
+    call so checkpoint saves record it; status is finalized
     (-1 never escapes), dims reflects the final width.  ``on_slice(carry,
     dims)`` fires after every device call (the checkpoint hook);
     ``resume`` accepts a previously captured carry at ``dims.frontier``
@@ -1662,8 +1682,8 @@ def _run_kernel(esp: EncodedSearch, es: EncodedSearch, model: ModelSpec,
             return max(8, min(cap, int(hard_s / per_lvl)))
         return cap
 
-    used_pallas = False  # any slice ran on the pallas engine (evidence
-    #                      for the emitted engine label)
+    used_pallas = used_pallas0  # any slice (incl. resumed-from runs)
+    #                             ran on the pallas engine
     while True:
         bail = escalate and F < MAX_FRONTIER
         want_pallas = _use_pallas(model, dims)
@@ -1701,7 +1721,11 @@ def _run_kernel(esp: EncodedSearch, es: EncodedSearch, model: ModelSpec,
                                       and not _PALLAS_BROKEN)
         dt = time.perf_counter() - t0
         if on_slice is not None:
-            on_slice(carry, dims)
+            _RUN_PALLAS.flag = used_pallas
+            try:
+                on_slice(carry, dims)
+            finally:
+                _RUN_PALLAS.flag = None
         status = int(carry[2])
         count = int(carry[1])
         configs = int(carry[3])
@@ -2008,22 +2032,21 @@ def save_checkpoint(path: str, carry, dims: SearchDims, model: ModelSpec,
     checkpoint to its history so `resume_opseq` can refuse a mismatch.
 
     The checkpoint also carries ``used_pallas`` — whether any slice of
-    the SEARCH SO FAR executed on the pallas engine — ORed across
-    saves of the same history (the engine label of a cross-window
-    accumulated verdict must not forget a window that ran on-chip
-    pallas just because a later CPU window saved last)."""
+    the SEARCH SO FAR executed on the pallas engine (the engine label
+    of a cross-window accumulated verdict must not forget a window
+    that ran on-chip pallas just because a later CPU window saved
+    last).  The truth comes from the ACTIVE slice driver via a
+    thread-local (`_run_kernel` maintains it, seeded with the resumed
+    checkpoint's flag) — never from re-reading the target file, which
+    callers like bench.py write through a tmp-path + rename and which
+    would therefore never show the prior state."""
     c = [np.asarray(x) for x in carry]
     digest = history_digest(seq, model) if seq is not None else ""
-    used_p = _use_pallas(model, dims)
-    if not used_p and os.path.exists(path):
-        try:
-            z = np.load(path)
-            if ("used_pallas" in z and bool(z["used_pallas"][()])
-                    and "digest" in z
-                    and bytes(z["digest"][()]).decode() == digest):
-                used_p = True
-        except Exception:  # noqa: BLE001 — corrupt prior file
-            pass
+    used_p = getattr(_RUN_PALLAS, "flag", None)
+    if used_p is None:
+        # called outside a live driver (tests, tools): fall back to
+        # the current eligibility decision
+        used_p = _use_pallas(model, dims)
     np.savez_compressed(
         path, frontier=c[0], count=c[1], status=c[2], configs=c[3],
         max_depth=c[4], ovf=c[5], budget=np.int64(budget),
@@ -2071,11 +2094,10 @@ def resume_opseq(seq: OpSeq, model: ModelSpec, path: str, *,
     esp = pad_search(es, dims.n_det_pad, dims.n_crash_pad)
     status, configs, max_depth, dims, used_pallas = _run_kernel(
         esp, es, model, dims, budget, on_slice=on_slice, resume=carry,
-        deadline=deadline, stop=stop)
+        deadline=deadline, stop=stop, used_pallas0=prior_pallas)
     return {"valid": _STATUS[status], "configs": configs,
             "max_depth": max_depth,
-            "engine": _engine_label(prior_pallas or used_pallas,
-                                    resumed=True),
+            "engine": _engine_label(used_pallas, resumed=True),
             "frontier": dims.frontier,
             "window": es.window, "concurrency": es.concurrency}
 

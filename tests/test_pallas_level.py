@@ -220,6 +220,50 @@ def test_checkpoint_resume_under_pallas(tmp_path):
         lin._ENGINE_MODE = old
 
 
+def test_cross_backend_resume_keeps_pallas_evidence(tmp_path):
+    """A TPU window runs pallas slices and checkpoints; the next window
+    resumes on a host where pallas is off.  The accumulated verdict's
+    engine label must still carry the pallas evidence (the checkpoint
+    persists the driver's actual-execution flag — through bench.py's
+    tmp-path + rename save pattern too)."""
+    import os
+    import time
+
+    rng = random.Random(72)
+    model = cas_register()
+    h = register_history(rng, n_ops=80, n_procs=4, overlap=3,
+                         crash_p=0.05, max_crashes=3, n_values=3)
+    h = corrupt_read(rng, h, at=0.9)
+    seq = encode_ops(h, model.f_codes)
+    path = str(tmp_path / "ck.npz")
+    old = lin._ENGINE_MODE
+    lin._ENGINE_MODE = "pallas"
+    try:
+        saved = []
+
+        def on_slice(carry, dims):
+            # bench.py's atomic save pattern: tmp path then rename
+            # (np.savez appends .npz when the suffix is missing, so
+            # the tmp name must keep it — same as bench.py's)
+            lin.save_checkpoint(path + ".tmp.npz", carry, dims, model,
+                                10**7, seq=seq)
+            os.replace(path + ".tmp.npz", path)
+            saved.append(1)
+
+        out = lin.search_opseq(
+            seq, model, budget=10**7, on_slice=on_slice,
+            deadline=time.perf_counter())
+        if out["valid"] != "unknown" or not saved:
+            pytest.skip("search decided before the deadline could cut "
+                        "it (host too fast)")
+        lin._ENGINE_MODE = "xla"
+        res = lin.resume_opseq(seq, model, path)
+        assert res["valid"] is False
+        assert res["engine"] == "device-bfs(pallas,resumed)"
+    finally:
+        lin._ENGINE_MODE = old
+
+
 def test_eligibility_gates():
     model = cas_register()
     es_like = lin.SearchDims(n_det_pad=64, n_crash_pad=32, window=32,

@@ -124,7 +124,11 @@ def build_pallas_step_fn(model, dims, *, interpret: bool = False):
     ND = dims.n_det_pad
     L = W + NC
     SCAP = 4 * F
-    W2P = min(-(-(2 * W + NC) // 32) * 32, ND)
+    # +32: the table-window base is rounded DOWN to a 32-multiple so
+    # every dynamic slice offset is aligned (Mosaic handles aligned
+    # lane offsets far more reliably than arbitrary ones); the window
+    # grows by one granule to keep covering [min_p, min_p + 2W + NC]
+    W2P = min(-(-(2 * W + NC + 32) // 32) * 32, ND)
     jstep2 = jax.vmap(jax.vmap(model.jstep))
 
     # constant unpack/pack index tables (host-side numpy)
@@ -172,7 +176,9 @@ def build_pallas_step_fn(model, dims, *, interpret: bool = False):
             state = stc[:]                     # [F, SW]
             aliv = _iota(F, 0, (F, 1)) < count
             base = jnp.min(jnp.where(aliv, p, CLAMP_INF))
-            base = jnp.clip(base, 0, ND - W2P)
+            # 32-aligned so pl.ds offsets lower cleanly (see W2P)
+            base = (jnp.clip(base, 0, ND - W2P) // 32) * 32
+            base = pl.multiple_of(base, 32)
 
             # 2D reads ([1, n] slices): Mosaic-friendly shapes
             t_ret = tret[:, pl.ds(base, W2P)].reshape(W2P, 1)
@@ -180,9 +186,13 @@ def build_pallas_step_fn(model, dims, *, interpret: bool = False):
             t_f = tf[:, pl.ds(base, W2P)].reshape(W2P, 1)
             t_v1 = tv1[:, pl.ds(base, W2P)].reshape(W2P, 1)
             t_v2 = tv2[:, pl.ds(base, W2P)].reshape(W2P, 1)
-            # the suffix index reaches base + 2W + NC == base + W2P, so
-            # the window needs W2P + 1 entries (base <= ND - W2P keeps
-            # the slice in range: sfx has ND + 1 entries)
+            # max suffix index = (min_p - base) + 2W + NC, and the
+            # 32-aligned-down base leaves min_p - base <= 31, so with
+            # W2P >= 2W + NC + 32 the index is <= W2P - 1; the slice
+            # still takes W2P + 1 entries (base <= ND - W2P keeps it
+            # in range: sfx has ND + 1 entries).  Do NOT tighten this
+            # to 2W + NC + 1 or drop the +32 from W2P without removing
+            # the base down-rounding.
             sfxw = sfx[:, pl.ds(base, W2P + 1)].reshape(W2P + 1, 1)
 
             off = p - base                     # [F, 1]
@@ -291,10 +301,21 @@ def build_pallas_step_fn(model, dims, *, interpret: bool = False):
             first_zero = jnp.min(jnp.where(~win1, lwc, W), axis=1,
                                  keepdims=True)          # = shift
             shift = first_zero
-            # win2[s, l] = win1[s, l + shift_s]
-            sh3 = (_iota(W, 1, (cap, W, W))              # j axis
-                   == (_iota(W, 2, (cap, W, W)) + shift[:, :, None]))
-            win2 = jnp.einsum("sj,sjl->sl", _f32(win1), _f32(sh3)) > 0.5
+            # win2[s, l] = win1[s, l + shift_s]: per-row dynamic shift
+            # as a STATIC correlation loop — W+1 predicated adds of 2D
+            # planes (tiny compute, no batched 3D dot_general for
+            # Mosaic to choke on; the shift values are 1..W)
+            win1i = win1.astype(jnp.int32)
+            # v = 0 (bit 0 unset, p does not advance) is the common
+            # case and must map win2 = win1 unchanged
+            win2acc = (shift == 0).astype(jnp.int32) * win1i
+            for v in range(1, W + 1):
+                sel = (shift == v).astype(jnp.int32)     # [cap, 1]
+                shifted = jnp.concatenate(
+                    [win1i[:, v:], jnp.zeros((cap, v), jnp.int32)],
+                    axis=1)
+                win2acc = win2acc + sel * shifted
+            win2 = win2acc > 0
             p2 = jnp.where(is_d, p_src + shift, p_src)
             w_out = jnp.where(is_d, win2, win_src)
             cloh = (lane - W) == _iota(NC, 1, (cap, NC))

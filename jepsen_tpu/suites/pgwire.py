@@ -296,63 +296,153 @@ _READY = _msg(b"Z", b"I")
 
 
 class RegisterEngine:
-    """The statements suites/cockroach.py's RegisterClient issues, each
-    linearized under one lock.  `fail_next(n)` arms injected errors so
-    the client's error->:fail/:info mapping executes live."""
+    """The statements suites/cockroach.py's Register and Bank clients
+    issue, with REAL transaction semantics: BEGIN takes the engine
+    lock until COMMIT/ROLLBACK (strict serialization — cockroach's
+    SERIALIZABLE, degenerately), writes keep an undo log so ROLLBACK
+    (or a dead connection mid-txn) restores state.  `fail_next(n)`
+    arms injected errors so the client's error->:fail/:info mapping
+    executes live."""
 
     def __init__(self):
-        self.lock = threading.Lock()
-        self.rows: dict[int, int] = {}
+        self.lock = threading.RLock()
+        self.rows: dict[int, int] = {}          # registers
+        self.accounts: dict[int, int] = {}      # bank balances
         self._fail = 0
         self._die = 0
+        self._txn_owner: int | None = None      # thread id holding BEGIN
+        self._undo: list = []                   # (table, key, old|None)
 
     def fail_next(self, n: int = 1) -> None:
         with self.lock:
             self._fail = n
 
     def die_next(self, n: int = 1) -> None:
+        """Arm a connection kill on the n-th DML/SELECT statement from
+        now (n=1: the very next one).  Counting — rather than killing
+        the next n — lets a test land the death AFTER a transaction
+        already applied writes, so the undo log is non-empty when the
+        abort hook replays it."""
         with self.lock:
             self._die = n
 
+    # -- txn plumbing -----------------------------------------------------
+    def _table(self, name: str) -> dict[int, int]:
+        return self.rows if name == "registers" else self.accounts
+
+    def _write(self, table: str, k: int, v: int) -> None:
+        t = self._table(table)
+        if self._txn_owner is not None:
+            self._undo.append((table, k, t.get(k)))
+        t[k] = v
+
+    def _rollback_undo(self) -> None:
+        """Replay the undo log newest-first (a key written twice in
+        one txn restores its pre-txn value last)."""
+        for table, k, old in reversed(self._undo):
+            t = self._table(table)
+            if old is None:
+                t.pop(k, None)
+            else:
+                t[k] = old
+
+    def _release(self) -> None:
+        self._txn_owner = None
+        self._undo.clear()
+        self.lock.release()
+
+    def abort_connection(self) -> None:
+        """Handler hook: a connection died — roll back its open txn so
+        a half-applied transfer can never leak (and release the lock
+        other connections are blocked on)."""
+        if self._txn_owner == threading.get_ident():
+            self._rollback_undo()
+            self._release()
+
     def execute(self, sql: str) -> tuple[list[tuple], list[str], str]:
         s = sql.strip().rstrip(";")
+        me = threading.get_ident()
+        if re.fullmatch(r"BEGIN", s, re.I):
+            if self._txn_owner != me:
+                self.lock.acquire()          # blocks on other txns
+                self._txn_owner = me
+                self._undo.clear()
+            return [], [], "BEGIN"
+        if re.fullmatch(r"(COMMIT|ROLLBACK)", s, re.I):
+            kind = s.upper()
+            if self._txn_owner == me:
+                if kind == "ROLLBACK":
+                    self._rollback_undo()
+                self._release()
+            return [], [], kind
         with self.lock:
-            if re.fullmatch(r"(BEGIN|COMMIT|ROLLBACK)", s, re.I):
-                return [], [], s.split()[0].upper()
-            if re.match(r"CREATE TABLE", s, re.I):
-                return [], [], "CREATE TABLE"
-            # injected failures hit DML/SELECT only — never the txn
-            # control statements the client's rollback path issues
-            if self._die > 0:
-                self._die -= 1
+            # inside a txn this re-enters (RLock); autocommit
+            # statements serialize against open txns
+            return self._stmt(s)
+
+    def _stmt(self, s: str) -> tuple[list[tuple], list[str], str]:
+        if re.match(r"CREATE TABLE", s, re.I):
+            return [], [], "CREATE TABLE"
+        # injected failures hit DML/SELECT only — never the txn
+        # control statements the client's rollback path issues
+        if self._die > 0:
+            self._die -= 1
+            if self._die == 0:
                 raise _Die()
-            if self._fail > 0:
-                self._fail -= 1
-                raise Error("restart transaction: injected conflict")
-            m = re.fullmatch(
-                r"SELECT value FROM registers WHERE id=(-?\d+)", s,
-                re.I)
-            if m:
-                k = int(m.group(1))
-                rows = ([(self.rows[k],)] if k in self.rows else [])
-                return rows, ["value"], f"SELECT {len(rows)}"
-            m = re.fullmatch(
-                r"UPSERT INTO registers \(id, value\) "
-                r"VALUES \((-?\d+), (-?\d+)\)", s, re.I)
-            if m:
-                self.rows[int(m.group(1))] = int(m.group(2))
-                return [], [], "INSERT 0 1"
-            m = re.fullmatch(
-                r"UPDATE registers SET value=(-?\d+) "
-                r"WHERE id=(-?\d+) AND value=(-?\d+)", s, re.I)
-            if m:
-                new, k, old = (int(m.group(1)), int(m.group(2)),
-                               int(m.group(3)))
-                if self.rows.get(k) == old:
-                    self.rows[k] = new
-                    return [], [], "UPDATE 1"
+        if self._fail > 0:
+            self._fail -= 1
+            raise Error("restart transaction: injected conflict")
+        m = re.fullmatch(
+            r"SELECT value FROM registers WHERE id=(-?\d+)", s, re.I)
+        if m:
+            k = int(m.group(1))
+            rows = ([(self.rows[k],)] if k in self.rows else [])
+            return rows, ["value"], f"SELECT {len(rows)}"
+        m = re.fullmatch(
+            r"UPSERT INTO registers \(id, value\) "
+            r"VALUES \((-?\d+), (-?\d+)\)", s, re.I)
+        if m:
+            self._write("registers", int(m.group(1)), int(m.group(2)))
+            return [], [], "INSERT 0 1"
+        m = re.fullmatch(
+            r"UPDATE registers SET value=(-?\d+) "
+            r"WHERE id=(-?\d+) AND value=(-?\d+)", s, re.I)
+        if m:
+            new, k, old = (int(m.group(1)), int(m.group(2)),
+                           int(m.group(3)))
+            if self.rows.get(k) == old:
+                self._write("registers", k, new)
+                return [], [], "UPDATE 1"
+            return [], [], "UPDATE 0"
+        # --- bank workload (suites/cockroach.py BankClient) -----------
+        m = re.fullmatch(
+            r"UPSERT INTO accounts \(id, balance\) "
+            r"VALUES \((-?\d+), (-?\d+)\)", s, re.I)
+        if m:
+            self._write("accounts", int(m.group(1)), int(m.group(2)))
+            return [], [], "INSERT 0 1"
+        if re.fullmatch(r"SELECT id, balance FROM accounts", s, re.I):
+            rows = sorted(self.accounts.items())
+            return rows, ["id", "balance"], f"SELECT {len(rows)}"
+        m = re.fullmatch(
+            r"SELECT balance FROM accounts WHERE id=(-?\d+)", s, re.I)
+        if m:
+            k = int(m.group(1))
+            rows = ([(self.accounts[k],)] if k in self.accounts
+                    else [])
+            return rows, ["balance"], f"SELECT {len(rows)}"
+        m = re.fullmatch(
+            r"UPDATE accounts SET balance=balance([+-])(\d+) "
+            r"WHERE id=(-?\d+)", s, re.I)
+        if m:
+            sign, amt, k = (m.group(1), int(m.group(2)),
+                            int(m.group(3)))
+            if k not in self.accounts:
                 return [], [], "UPDATE 0"
-            raise Error(f"unsupported statement: {s[:80]}")
+            delta = amt if sign == "+" else -amt
+            self._write("accounts", k, self.accounts[k] + delta)
+            return [], [], "UPDATE 1"
+        raise Error(f"unsupported statement: {s[:80]}")
 
 
 class _Handler(socketserver.BaseRequestHandler):
@@ -405,6 +495,14 @@ class _Handler(socketserver.BaseRequestHandler):
                     sock.sendall(_error(str(e)) + _READY)
         except OSError:
             return
+        finally:
+            # a dying connection rolls back its open transaction (and
+            # releases the engine lock other connections block on) —
+            # half-applied transfers must never leak
+            abort = getattr(self.server.engine, "abort_connection",
+                            None)
+            if abort is not None:
+                abort()
 
 
 class MiniPGServer(socketserver.ThreadingTCPServer):

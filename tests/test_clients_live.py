@@ -365,3 +365,70 @@ def test_pgwire_shim_is_the_fallback_driver():
         import psycopg2  # noqa: F401
     except ImportError:
         assert cockroach.pg_driver() is pgwire
+
+
+def test_cockroach_bank_live_concurrent_transfers():
+    """The bank workload (tests/bank.clj shape) LIVE over pg-wire:
+    multi-statement transactions (implicit BEGIN -> SELECT + two
+    UPDATEs -> COMMIT) from concurrent clients against the serializing
+    engine.  Total preservation is the workload's invariant; a dying
+    connection mid-transaction must roll back, never leak a
+    half-applied transfer."""
+    import random as rnd
+
+    from jepsen_tpu.suites import cockroach, pgwire
+
+    srv, port = pgwire.MiniPGServer.start()
+    t = {"sql_port": port, "accounts": list(range(4)),
+         "total_amount": 100}
+    try:
+        c0 = cockroach.BankClient().open(t, "127.0.0.1")
+        c0.setup(t)
+
+        def worker(seed, n_ops, results):
+            c = cockroach.BankClient().open(t, "127.0.0.1")
+            r = rnd.Random(seed)
+            for _ in range(n_ops):
+                a, b = r.sample(t["accounts"], 2)
+                op = invoke_op(0, "transfer",
+                               {"from": a, "to": b,
+                                "amount": 1 + r.randrange(5)})
+                results.append(c.invoke(t, op).type)
+            c.close(t)
+
+        results: list = []
+        ts = [threading.Thread(target=worker, args=(s, 25, results))
+              for s in range(4)]
+        for th in ts:
+            th.start()
+        for th in ts:
+            th.join(timeout=60)
+        assert all(not th.is_alive() for th in ts)
+        assert results and set(results) <= {"ok", "fail"}
+        # the invariant the bank checker exists for: total preserved
+        op = c0.invoke(t, invoke_op(0, "read", None))
+        assert op.type == "ok"
+        assert sum(op.value.values()) == 100, op.value
+        # insufficient funds -> :fail (the SELECT-then-check txn path)
+        op = c0.invoke(t, invoke_op(0, "transfer",
+                                    {"from": 0, "to": 1,
+                                     "amount": 10**6}))
+        assert op.type == "fail"
+        # a connection dying MID-TRANSACTION with a WRITE ALREADY
+        # APPLIED: the transfer runs SELECT (1), the debit UPDATE (2,
+        # applied — the undo log now holds the old balance), and dies
+        # on the credit UPDATE (3).  The engine's abort hook must
+        # replay the undo log — restoring the debited account — and
+        # release the txn lock.
+        before = c0.invoke(t, invoke_op(0, "read", None)).value
+        cdie = cockroach.BankClient().open(t, "127.0.0.1")
+        srv.engine.die_next(3)
+        op = cdie.invoke(t, invoke_op(0, "transfer",
+                                      {"from": 0, "to": 1,
+                                       "amount": 1}))
+        assert op.type == "info"  # indeterminate to the client...
+        after = c0.invoke(t, invoke_op(0, "read", None)).value
+        assert after == before  # ...but rolled back on the server
+    finally:
+        srv.shutdown()
+        srv.server_close()

@@ -82,7 +82,9 @@ def check_opseq_linear(seq: OpSeq, model: ModelSpec, *,
                        witness_cap: int = 0,
                        checkpoint_path: str | None = None,
                        checkpoint_every: int = 0,
-                       resume_from: str | None = None) -> dict:
+                       resume_from: str | None = None,
+                       decompose: bool = False,
+                       decompose_cache=None) -> dict:
     """Exact linearizability check.  Returns a knossos-style map
     {"valid": True|False|"unknown", "configs": n, "max_depth": d, ...};
     on invalid, ``final_ops`` holds the un-linearizable candidate rows at
@@ -101,7 +103,36 @@ def check_opseq_linear(seq: OpSeq, model: ModelSpec, *,
     continues a run from such a snapshot after verifying it binds to
     this exact (history, model) — the level set IS the whole search
     state, so nothing else needs saving.  Resumed runs report verdicts
-    only (no witness: the parent table is not serialized)."""
+    only (no witness: the parent table is not serialized).
+
+    ``decompose`` routes through the P-compositional decomposition
+    layer (jepsen_tpu/decompose/) with this sweep as the sub-engine —
+    verdict-identical, default off; ``decompose_cache`` is its
+    VerdictCache or jsonl path."""
+    if decompose:
+        if checkpoint_path or resume_from:
+            # the decomposed funnel has no serialized level-set to
+            # snapshot; dropping the contract silently would cost a
+            # crashed multi-hour run its resume point
+            raise ValueError(
+                "decompose=True does not support checkpoint_path/"
+                "resume_from (sub-searches are independent; use the "
+                "verdict cache for cross-run reuse instead)")
+        from ..decompose.engine import check_opseq_decomposed
+
+        def _direct(s):
+            return check_opseq_linear(s, model, max_configs=max_configs,
+                                      deadline=deadline, cancel=cancel,
+                                      witness_cap=witness_cap)
+
+        def _sub(s, m, *, max_configs=max_configs, deadline=deadline):
+            return check_opseq_linear(s, m, max_configs=max_configs,
+                                      deadline=deadline, cancel=cancel)
+
+        return check_opseq_decomposed(seq, model, cache=decompose_cache,
+                                      direct=_direct, sub_check=_sub,
+                                      sub_max_configs=max_configs,
+                                      deadline=deadline)
     es = encode_search(seq)
     n_det, n_crash, W = es.n_det, es.n_crash, es.window
     if n_det == 0 and n_crash == 0:

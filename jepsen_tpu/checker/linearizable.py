@@ -2044,9 +2044,11 @@ def save_checkpoint(path: str, carry, dims: SearchDims, model: ModelSpec,
     digest = history_digest(seq, model) if seq is not None else ""
     used_p = getattr(_RUN_PALLAS, "flag", None)
     if used_p is None:
-        # called outside a live driver (tests, tools): fall back to
-        # the current eligibility decision
-        used_p = _use_pallas(model, dims)
+        # called outside a live slice driver (tests, tools): nothing
+        # has executed, so nothing ran on pallas — recording mere
+        # *eligibility* here would make a verdict resumed from this
+        # checkpoint claim pallas execution that never happened
+        used_p = False
     np.savez_compressed(
         path, frontier=c[0], count=c[1], status=c[2], configs=c[3],
         max_depth=c[4], ovf=c[5], budget=np.int64(budget),
@@ -2318,7 +2320,9 @@ def _drive_batch_compacting(fn, esps, model: ModelSpec, dims: SearchDims,
 def search_batch(seqs: list[OpSeq], model: ModelSpec, *,
                  budget: int = 2_000_000,
                  dims: SearchDims | None = None,
-                 sharding=None) -> list[dict]:
+                 sharding=None,
+                 decompose: bool = False,
+                 decompose_cache=None) -> list[dict]:
     """Check a batch of independent per-key histories in one device call.
 
     This is the TPU analog of jepsen.independent's bounded-pmap over
@@ -2327,9 +2331,21 @@ def search_batch(seqs: list[OpSeq], model: ModelSpec, *,
     ``jax.sharding.NamedSharding`` (key axis) to spread the batch over a
     mesh — searches are embarrassingly parallel, so XLA partitions them
     with no communication beyond the verdict gather.
+
+    ``decompose=True`` puts the canonical-hash verdict cache
+    (jepsen_tpu/decompose/) in front of the batch: keys are
+    canonicalized (process renaming, event-rank erasure, value
+    renaming) and hashed; cached shapes return instantly, duplicate
+    shapes within the batch run once, and only the remaining distinct
+    shapes ride to the device.  ``decompose_cache`` is a VerdictCache,
+    a jsonl path, or None for an in-memory cache (dedup only).
     """
     if not seqs:
         return []
+    if decompose:
+        return _search_batch_decomposed(seqs, model, budget=budget,
+                                        dims=dims, sharding=sharding,
+                                        cache=decompose_cache)
     # greedy completion-order witnesses dispose of well-behaved keys
     # host-side in O(n); only contentious keys ride to the device
     results_by_idx: dict = {}
@@ -2521,6 +2537,86 @@ def search_batch(seqs: list[OpSeq], model: ModelSpec, *,
     return out
 
 
+def _search_batch_decomposed(seqs: list[OpSeq], model: ModelSpec, *,
+                             budget: int, dims, sharding,
+                             cache) -> list[dict]:
+    """Cache + dedup front-end for `search_batch` (decompose=True).
+
+    Exact by construction: a canonical-hash collision means the two
+    histories are the *same search problem* (same rows, same precedence
+    ranks, value-bijective), so one verdict serves both.  Undecided
+    results are never cached and never deduplicated onto other keys."""
+    from ..decompose.cache import VerdictCache
+    from ..decompose.canonical import canonical_key
+
+    if isinstance(cache, str):
+        cache = VerdictCache(cache)
+    elif cache is None:
+        cache = VerdictCache()  # in-memory: within-batch dedup only
+    cache.reset_stats()
+    keys = [canonical_key(s, model) for s in seqs]
+    results: dict[int, dict] = {}
+    rep: dict[str, int] = {}  # canonical key -> representative index
+    todo: list[int] = []
+    for i, k in enumerate(keys):
+        e = cache.get(k)
+        if e is not None and "v" in e:
+            results[i] = {"valid": e["v"], "configs": 0,
+                          "engine": "decompose-cache"}
+        elif k in rep:
+            pass  # filled from the representative's verdict below
+        else:
+            rep[k] = i
+            todo.append(i)
+    if todo:
+        sub = search_batch([seqs[i] for i in todo], model, budget=budget,
+                           dims=dims, sharding=sharding)
+        for i, r in zip(todo, sub):
+            results[i] = r
+            if r.get("valid") in (True, False):
+                cache.put_verdict(keys[i], r["valid"])
+    n_dup = 0
+    solo: dict[str, dict] = {}
+    for i, k in enumerate(keys):
+        if i in results:
+            continue
+        r = results[rep[k]]
+        if r.get("valid") in (True, False):
+            n_dup += 1
+            results[i] = {"valid": r["valid"], "configs": 0,
+                          "engine": "decompose-dedup"}
+            continue
+        # the representative was undecided in the batch: retry solo —
+        # ONCE per canonical shape (copies are isomorphic problems, so
+        # a decided retry serves all of them, and sharing an undecided
+        # one asserts nothing)
+        r2 = solo.get(k)
+        if r2 is None:
+            r2 = solo[k] = search_opseq(seqs[i], model, budget=budget)
+            if r2.get("valid") in (True, False):
+                cache.put_verdict(k, r2["valid"])
+                # the decided retry serves the representative too: one
+                # canonical shape must not report two verdicts in one
+                # result list (its batch-spent configs stay billed)
+                ri = results[rep[k]]
+                ri["valid"] = r2["valid"]
+                ri["engine"] = (ri.get("engine") or
+                                "device-batch") + "+decompose-retry"
+            results[i] = r2
+        else:
+            n_dup += 1
+            results[i] = {"valid": r2.get("valid"), "configs": 0,
+                          "engine": "decompose-dedup"}
+    out = [results[i] for i in range(len(seqs))]
+    stats = {"n_keys": len(seqs), "cache_hits": cache.hits,
+             "cache_misses": cache.misses, "deduped": n_dup,
+             "searched": len(todo),
+             "hit_rate": round(cache.hits / max(1, len(seqs)), 4)}
+    for r in out:
+        r.setdefault("decompose_batch", stats)
+    return out
+
+
 def truncate_to_failure(seq: OpSeq, depth: int, window: int
                         ) -> OpSeq | None:
     """Cut the history just past the failure region, at a point where
@@ -2598,11 +2694,26 @@ class Linearizable:
                  budget: int = 20_000_000,
                  host_threshold: int = 48,
                  witness_threshold: int = 3000,
-                 algorithm: str = "auto"):
+                 algorithm: str = "auto",
+                 decompose: bool = False,
+                 verdict_cache=None):
         self.model = model
         self.budget = budget
         self.host_threshold = host_threshold
         self.witness_threshold = witness_threshold
+        # ``decompose=True`` runs the P-compositional decomposition
+        # layer (jepsen_tpu/decompose/) in front of whichever engine
+        # ``algorithm`` selects; verdict-identical, default off.
+        # ``verdict_cache``: a decompose.VerdictCache, a jsonl path, or
+        # True for the store-persisted default location.  The env knob
+        # (set by the CLI's --lin-decompose) reaches suite-constructed
+        # checkers the same way JEPSEN_TPU_LIN_ALGORITHM does.
+        if not decompose:
+            decompose = os.environ.get(
+                "JEPSEN_TPU_LIN_DECOMPOSE", "").lower() in ("1", "true",
+                                                            "on", "yes")
+        self.decompose = decompose
+        self.verdict_cache = verdict_cache
         src = "algorithm"
         if algorithm == "auto":
             # fleet-wide experiment knob: suites construct their own
@@ -2618,13 +2729,44 @@ class Linearizable:
                 f"{sorted(self.ALGORITHMS)}") from None
 
     def check(self, test, history, opts=None):
-        from . import seq as seqmod
-
         model = self.model or test.get("model")
         if model is None:
             raise ValueError("linearizable checker needs a model")
         seq = history if isinstance(history, OpSeq) else \
             encode_ops(history, model.f_codes)
+        if self.decompose:
+            from ..decompose.cache import default_cache_path
+            from ..decompose.engine import check_opseq_decomposed
+
+            cache = self.verdict_cache
+            if cache is True:
+                cache = default_cache_path()
+            sub_check = None
+            if self.algorithm == "host":
+                # honor the selected host engine for sub-searches too;
+                # the other selections (device/competition/linear/auto)
+                # keep the default host `linear` sub-engine — cells and
+                # segments are small, where device dispatch only loses
+                from . import seq as seqmod
+
+                def sub_check(s, m, *, max_configs, deadline):
+                    return seqmod.check_opseq(s, m,
+                                              max_configs=max_configs,
+                                              deadline=deadline)
+            out = check_opseq_decomposed(
+                seq, model, cache=cache,
+                sub_max_configs=self.budget,  # the user's sizing knob
+                sub_check=sub_check,
+                direct=lambda s: self._check_direct(test, s, model, opts))
+            if out["valid"] is False and "report_file" not in out:
+                # the direct fallback renders its own report; a verdict
+                # decided by decomposition alone still gets one
+                self._render_failure(test, seq, out, opts)
+            return out
+        return self._check_direct(test, seq, model, opts)
+
+    def _check_direct(self, test, seq, model, opts):
+        from . import seq as seqmod
 
         if (self.algorithm == "host"
                 or (self.algorithm == "auto"

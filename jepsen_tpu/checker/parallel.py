@@ -54,7 +54,7 @@ def _await_ready(procs, readies, *, timeout: float):
 
 
 def _portfolio_worker(builder, builder_args, algo, seed, max_configs,
-                      ready, go, q):
+                      decompose, ready, go, q):
     os.environ.setdefault("JAX_PLATFORMS", "cpu")  # never touch a TPU
     try:
         seq, model = builder(*builder_args)
@@ -64,12 +64,13 @@ def _portfolio_worker(builder, builder_args, algo, seed, max_configs,
         if algo == "linear":
             from .linear import check_opseq_linear
 
-            r = check_opseq_linear(seq, model, max_configs=max_configs)
+            r = check_opseq_linear(seq, model, max_configs=max_configs,
+                                   decompose=decompose)
         else:
             from . import seq as seqmod
 
             r = seqmod.check_opseq(seq, model, max_configs=max_configs,
-                                   order_seed=seed)
+                                   order_seed=seed, decompose=decompose)
         r["worker_seconds"] = time.perf_counter() - t0
         q.put((algo, seed, r))
     except Exception as e:  # noqa: BLE001 — a crashed leg must not hang the pool
@@ -78,7 +79,8 @@ def _portfolio_worker(builder, builder_args, algo, seed, max_configs,
 
 def portfolio_check(builder, builder_args=(), *, n_procs: int = 16,
                     deadline_s: float | None = None,
-                    max_configs: int = 500_000_000) -> dict:
+                    max_configs: int = 500_000_000,
+                    decompose: bool = False) -> dict:
     """Race ``n_procs`` host algorithm variants on one history.
 
     ``builder(*builder_args) -> (OpSeq, ModelSpec)`` must be a
@@ -86,7 +88,9 @@ def portfolio_check(builder, builder_args=(), *, n_procs: int = 16,
     Returns the winning verdict plus {"engine", "n_procs", "seconds"};
     "unknown" if every leg was inconclusive or the deadline passed.
     The clock starts only after every worker has built its history and
-    signalled ready — startup is not billed.
+    signalled ready — startup is not billed.  ``decompose`` runs every
+    leg behind the P-compositional decomposition layer (verdict-
+    identical; the legs still diverge inside undecomposable parts).
     """
     ctx = mp.get_context("spawn")
     q = ctx.Queue()
@@ -99,7 +103,8 @@ def portfolio_check(builder, builder_args=(), *, n_procs: int = 16,
         ready = ctx.Event()
         p = ctx.Process(target=_portfolio_worker,
                         args=(builder, builder_args, algo, seed,
-                              max_configs, ready, go, q), daemon=True)
+                              max_configs, decompose, ready, go, q),
+                        daemon=True)
         p.start()
         procs.append(p)
         readies.append(ready)
@@ -150,11 +155,18 @@ def portfolio_check(builder, builder_args=(), *, n_procs: int = 16,
     return r
 
 
-def _batch_worker(builder, n_keys, wid, n_procs, ready, go, q):
+def _batch_worker(builder, n_keys, wid, n_procs, decompose, cache_path,
+                  ready, go, q):
     os.environ.setdefault("JAX_PLATFORMS", "cpu")
     try:
         from .linear import check_opseq_linear
 
+        cache = None
+        if decompose and cache_path:
+            # open the shared cache once per worker, not once per key
+            from ..decompose.cache import VerdictCache
+
+            cache = VerdictCache(cache_path)
         work = []
         for k in range(wid, n_keys, n_procs):
             work.append((k,) + tuple(builder(k)))
@@ -163,7 +175,8 @@ def _batch_worker(builder, n_keys, wid, n_procs, ready, go, q):
         for k, seq, model in work:
             # a per-key failure must not kill this worker's other keys
             try:
-                r = check_opseq_linear(seq, model)
+                r = check_opseq_linear(seq, model, decompose=decompose,
+                                       decompose_cache=cache)
                 q.put((k, r.get("valid"), r.get("configs", 0)))
             except Exception:  # noqa: BLE001
                 q.put((k, "unknown", 0))
@@ -172,14 +185,19 @@ def _batch_worker(builder, n_keys, wid, n_procs, ready, go, q):
 
 
 def batch_check_pool(builder, n_keys: int, *, n_procs: int = 16,
-                     deadline_s: float | None = None) -> dict:
+                     deadline_s: float | None = None,
+                     decompose: bool = False,
+                     cache_path: str | None = None) -> dict:
     """Check ``n_keys`` independent histories over a process pool.
 
     ``builder(k) -> (OpSeq, ModelSpec)`` must be module-level.  Returns
     {"verdicts": {k: valid}, "seconds", "configs", "keys_done"} — the
     per-key-parallel host baseline for the batch tiers (the reference's
     bounded-pmap, independent.clj:247-298).  History construction
-    happens before the clock starts.
+    happens before the clock starts.  ``decompose`` checks every key
+    behind the decomposition layer; with ``cache_path`` the workers
+    share one on-disk canonical-hash verdict cache (appends are
+    line-atomic, and duplicate entries are only ever equal).
     """
     ctx = mp.get_context("spawn")
     q = ctx.Queue()
@@ -189,8 +207,8 @@ def batch_check_pool(builder, n_keys: int, *, n_procs: int = 16,
     for wid in range(n_procs):
         ready = ctx.Event()
         p = ctx.Process(target=_batch_worker,
-                        args=(builder, n_keys, wid, n_procs, ready, go,
-                              q), daemon=True)
+                        args=(builder, n_keys, wid, n_procs, decompose,
+                              cache_path, ready, go, q), daemon=True)
         p.start()
         procs.append(p)
         readies.append(ready)

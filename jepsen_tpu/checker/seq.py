@@ -59,7 +59,9 @@ def check_opseq(seq: OpSeq, model: ModelSpec, *,
                 max_configs: int = 5_000_000,
                 deadline: float | None = None,
                 cancel=None,
-                order_seed: int | None = None) -> dict:
+                order_seed: int | None = None,
+                decompose: bool = False,
+                decompose_cache=None) -> dict:
     """Run the DFS over a columnar OpSeq.  Returns a knossos-style map:
 
     valid        True | False | "unknown"
@@ -77,8 +79,28 @@ def check_opseq(seq: OpSeq, model: ModelSpec, *,
     ``linearizable.check_competition``).  ``order_seed`` randomizes the
     DFS candidate-push order: the verdict is unchanged, but different
     seeds dive different subtrees first — the diversity knob for the
-    portfolio comparator (checker/parallel.py).
+    portfolio comparator (checker/parallel.py).  ``decompose`` routes
+    through the P-compositional decomposition layer (jepsen_tpu/
+    decompose/) with this DFS as the sub-engine — verdict-identical,
+    default off; ``decompose_cache`` is its VerdictCache or jsonl path.
     """
+    if decompose:
+        from ..decompose.engine import check_opseq_decomposed
+
+        def _direct(s):
+            return check_opseq(s, model, max_configs=max_configs,
+                               deadline=deadline, cancel=cancel,
+                               order_seed=order_seed)
+
+        def _sub(s, m, *, max_configs=max_configs, deadline=deadline):
+            return check_opseq(s, m, max_configs=max_configs,
+                               deadline=deadline, cancel=cancel,
+                               order_seed=order_seed)
+
+        return check_opseq_decomposed(seq, model, cache=decompose_cache,
+                                      direct=_direct, sub_check=_sub,
+                                      sub_max_configs=max_configs,
+                                      deadline=deadline)
     import random as _random
     import time
     n = len(seq)

@@ -20,6 +20,7 @@ from __future__ import annotations
 
 import argparse
 import logging
+import os
 import re
 import sys
 import traceback
@@ -63,6 +64,14 @@ def add_test_opts(p: argparse.ArgumentParser) -> None:
                    help="Test duration excluding setup/teardown, seconds")
     p.add_argument("--dummy", action="store_true", default=False,
                    help="Use the dummy remote (no SSH; harness testing)")
+    p.add_argument("--lin-decompose", action="store_true", default=False,
+                   help="Run linearizability checks through the "
+                        "P-compositional decomposition layer "
+                        "(jepsen_tpu/decompose/): per-key/per-value "
+                        "splits, quiescence cuts, and the persisted "
+                        "canonical-hash verdict cache.  Verdict-"
+                        "identical; sets JEPSEN_TPU_LIN_DECOMPOSE so "
+                        "every suite-constructed checker honors it.")
 
 
 def add_tarball_opt(p: argparse.ArgumentParser, default: str | None = None,
@@ -114,6 +123,12 @@ def test_opt_fn(parsed: argparse.Namespace) -> dict:
     opts = parse_nodes(opts)
     opts = parse_concurrency(opts)
     opts = rename_ssh_options(opts)
+    if opts.pop("lin_decompose", False):
+        # suites construct their own Linearizable checkers, so the
+        # opt-in travels the same fleet-wide channel as the algorithm
+        # selector (JEPSEN_TPU_LIN_ALGORITHM)
+        os.environ["JEPSEN_TPU_LIN_DECOMPOSE"] = "1"
+        opts["lin_decompose"] = True
     return opts
 
 

@@ -190,9 +190,14 @@ def start_daemon(sess: Session, bin_path: str, *args,
     # start ("process already running"), so every nemesis restart
     # silently failed.  Clear the pidfile when its process is a zombie
     # or gone; a genuinely running daemon (state R/S/D) still blocks.
+    # the state field sits after the comm field, and comm may contain
+    # spaces ("tmux: server") — naive $3 then reads a comm fragment,
+    # mis-detects a RUNNING daemon as not-Z/not-empty... or worse, a
+    # zombie as alive.  /proc(5): parse after the LAST ')' instead.
     sess.exec_raw(
         f"pid=$(cat {pidfile} 2>/dev/null); "
-        f"st=$(awk '{{print $3}}' /proc/$pid/stat 2>/dev/null); "
+        f"st=$(sed -e 's/^.*) //' /proc/$pid/stat 2>/dev/null "
+        f"| cut -d' ' -f1); "
         f"if [ \"$st\" = Z ] || [ -z \"$st\" ]; then rm -f {pidfile}; fi")
     sess.exec("echo", lit("`date +'%Y-%m-%d %H:%M:%S'`"),
               "Jepsen starting", bin_path, " ".join(map(str, args)),

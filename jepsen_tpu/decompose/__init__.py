@@ -1,0 +1,48 @@
+"""P-compositional history decomposition (Horn & Kroening, PAPERS.md
+arXiv:1504.00204) — the layer between history ingestion and every search
+engine.
+
+A linearizability search is exponential in history size; this subsystem
+splits one history into sub-histories that are exponentially cheaper to
+check separately, without ever changing the verdict:
+
+  * :mod:`partition` — per-key locality splits (Herlihy–Wing locality:
+    a multi-register history is linearizable iff each key's projection
+    is), the exact per-value block decomposition for unique-write
+    register histories (the P-compositionality instance the paper names),
+    and quiescence cutting (split where no op is pending; segments
+    compose sequentially through reachable-state sets);
+  * :mod:`canonical` — sub-histories canonicalized (process renaming,
+    event-rank erasure, value renaming) and hashed, so identical shapes
+    are recognized across keys, nemesis cycles, and runs;
+  * :mod:`cache` — the canonical-hash verdict cache, persisted under
+    ``store/`` (store.py's results tree) so repeated runs start warm;
+  * :mod:`engine` — the decomposed checker: cache -> partition ->
+    sub-search, with a ``direct`` fallback so a history nothing can
+    split costs one ordinary search, never two;
+  * :mod:`schedule` — the shard scheduler feeding independent cells to
+    a multiprocess host pool or the batched device engine,
+    largest-first.
+
+Every search-engine entry point exposes it as a ``decompose=`` opt-in
+(default off): checker/seq.py, checker/linear.py, the Linearizable
+checker and search_batch in checker/linearizable.py, and the pool in
+checker/parallel.py.
+"""
+
+from .cache import VerdictCache, default_cache_path
+from .canonical import canonical_key
+from .engine import check_opseq_decomposed
+from .partition import (partition_by_key, quiescence_segments, subseq,
+                        value_block_verdict)
+
+__all__ = [
+    "VerdictCache",
+    "default_cache_path",
+    "canonical_key",
+    "check_opseq_decomposed",
+    "partition_by_key",
+    "quiescence_segments",
+    "subseq",
+    "value_block_verdict",
+]

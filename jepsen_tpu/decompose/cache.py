@@ -1,0 +1,103 @@
+"""Canonical-hash verdict cache, persisted through the store tree.
+
+The cache maps :func:`canonical.canonical_key` hashes to either a
+decided verdict (``{"v": true|false}`` for a whole cell) or a reachable
+final-state set (``{"out": [[..], ..]}`` for a quiescence segment under
+a given input-state set).  Undecided ("unknown") results are never
+cached — a budget miss is not a property of the history.
+
+Persistence rides store.py's results tree (store.clj's store/ layout):
+the default file lives at ``store/verdict_cache/verdicts.jsonl`` under
+:data:`jepsen_tpu.store.BASE`, one JSON object per line, append-only.
+Appends are small single-``write`` lines, so concurrent writers (the
+multiprocess pool) interleave whole lines; a torn final line (crash
+mid-write) is skipped on load.  Rewrites never happen — the newest
+entry for a key wins, and duplicate entries are only ever equal (the
+engines are deterministic on a canonical shape).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+
+
+def default_cache_path(base: str | None = None) -> str:
+    """store/<BASE>/verdict_cache/verdicts.jsonl (store.py layout)."""
+    from .. import store
+
+    return os.path.join(base if base is not None else store.BASE,
+                        "verdict_cache", "verdicts.jsonl")
+
+
+class VerdictCache:
+    """In-memory dict with append-through jsonl persistence.
+
+    ``path=None`` keeps the cache purely in-memory (tests, one-shot
+    runs).  ``hits``/``misses`` count :meth:`get` outcomes since the
+    last :meth:`reset_stats` — the bench's hit-rate evidence."""
+
+    def __init__(self, path: str | None = None):
+        self.path = path
+        self._d: dict[str, dict] = {}
+        self.hits = 0
+        self.misses = 0
+        self._fh = None
+        if path is not None:
+            self._load(path)
+
+    def _load(self, path: str) -> None:
+        try:
+            with open(path) as f:
+                for line in f:
+                    line = line.strip()
+                    if not line:
+                        continue
+                    try:
+                        e = json.loads(line)
+                        self._d[e["k"]] = e
+                    except (ValueError, KeyError):
+                        continue  # torn tail line from a crashed writer
+        except OSError:
+            pass
+
+    def __len__(self) -> int:
+        return len(self._d)
+
+    def reset_stats(self) -> None:
+        self.hits = 0
+        self.misses = 0
+
+    def get(self, key: str) -> dict | None:
+        e = self._d.get(key)
+        if e is None:
+            self.misses += 1
+            return None
+        self.hits += 1
+        return e
+
+    def _append(self, e: dict) -> None:
+        if self.path is None:
+            return
+        if self._fh is None:
+            os.makedirs(os.path.dirname(self.path) or ".", exist_ok=True)
+            self._fh = open(self.path, "a")
+        self._fh.write(json.dumps(e, separators=(",", ":")) + "\n")
+        self._fh.flush()
+
+    def put_verdict(self, key: str, valid) -> None:
+        if valid not in (True, False):
+            return  # "unknown" is a budget artifact, not a verdict
+        e = {"k": key, "v": bool(valid)}
+        self._d[key] = e
+        self._append(e)
+
+    def put_states(self, key: str, out_states: list[list[int]]) -> None:
+        e = {"k": key, "out": [list(s) for s in out_states]}
+        self._d[key] = e
+        self._append(e)
+
+    def close(self) -> None:
+        if self._fh is not None:
+            self._fh.close()
+            self._fh = None

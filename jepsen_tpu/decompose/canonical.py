@@ -1,0 +1,138 @@
+"""Canonical forms for (sub-)histories — the verdict cache's key space.
+
+Two histories that differ only in ways no search engine can observe must
+hash identically, so one cached verdict covers both.  The engines
+(checker/seq.py, checker/linear.py, the device BFS) consume only
+``(f, v1, v2, inv, ret, ok)`` per row and compare ``inv``/``ret`` by
+order, never by magnitude; ``process`` and wall-clock times never reach
+a search at all.  Canonicalization therefore:
+
+  * drops the process column (process renaming, for free);
+  * erases timestamps/event indices down to dense event *ranks* (the
+    order is the only thing the precedence tests ``ret[i] < inv[j]``
+    read), with crashed returns staying at +inf;
+  * renames values by first appearance for the single-register family,
+    where model semantics depend only on the equality pattern among
+    values plus which of them is the initial value (a value bijection
+    fixing NIL commutes with read/write/cas legality) — so register
+    histories over different value sets share shapes.
+
+The model's identity (name, init, state_width) is folded into the hash
+exactly as checker/linearizable.history_digest does: register(0) and
+register(7) share a name but give different verdicts.  For segment
+entries the *input state set* is part of the key too — the same segment
+reached with different carry-in states is a different question.
+"""
+
+from __future__ import annotations
+
+import hashlib
+
+import numpy as np
+
+from ..history import INF_RET, NIL
+from ..models import ModelSpec
+
+#: models whose semantics see values only through equality with each
+#: other and with the initial value — the value-renaming family
+RENAME_FAMILY = ("register", "cas-register")
+
+#: canonical id for "the initial value" under renaming (NIL keeps NIL)
+_INIT_ID = -2
+
+
+class _Renamer:
+    """First-appearance value interning; identity when disabled."""
+
+    def __init__(self, model: ModelSpec, enabled: bool):
+        self.enabled = enabled
+        self._map: dict[int, int] = {}
+        self._next = 0
+        if enabled:
+            # NIL ("unknown value", always-legal reads) must stay
+            # distinct from "the initial value": an init of NIL is NOT
+            # a value reads can be constrained against
+            self._map[NIL] = NIL
+            init = int(model.init[0])
+            if init != NIL:
+                self._map[init] = _INIT_ID
+
+    def rename(self, v: int) -> int:
+        if not self.enabled:
+            return v
+        r = self._map.get(v)
+        if r is None:
+            # fresh ids count up from 0 in appearance order
+            r = self._next
+            self._next += 1
+            self._map[v] = r
+        return r
+
+    def decode_states(self, states) -> list[tuple]:
+        """Map canonical state tuples back to real values (cache hits
+        return canonically-encoded reachable states)."""
+        if not self.enabled:
+            return [tuple(s) for s in states]
+        inv = {r: v for v, r in self._map.items()}
+        return [tuple(inv[int(x)] for x in s) for s in states]
+
+    def encode_states(self, states) -> list[list[int]]:
+        """Canonicalize state tuples for cache storage.  Every lane of a
+        reachable state is the init value, NIL, or a value some row
+        wrote — all already interned by the row scan."""
+        if not self.enabled:
+            return [list(s) for s in sorted(states)]
+        return sorted([self._map[int(x)] for x in s] for s in states)
+
+
+def event_ranks(inv, ret) -> tuple[list[int], list[int]]:
+    """Dense ranks of a (sub-)history's own events; INF stays INF.
+
+    The single home of the rank-erasure invariant ("order is the only
+    observable; +inf returns stay +inf") — canonical keys hash these
+    ranks and partition.subseq re-bases cells with them, so the two
+    must never diverge."""
+    inv = [int(x) for x in inv]
+    ret = [int(x) for x in ret]
+    events = sorted(set(inv) | {r for r in ret if r != INF_RET})
+    rank = {e: i for i, e in enumerate(events)}
+    return ([rank[i] for i in inv],
+            [rank[r] if r != INF_RET else INF_RET for r in ret])
+
+
+def canonical_payload(seq, model: ModelSpec,
+                      instates=None) -> tuple[bytes, _Renamer]:
+    """Canonical byte serialization of (sub-history, model, instates).
+
+    Returns the payload plus the renamer, so segment callers can encode
+    output states (and decode cached ones) under the same value map.
+    ``instates`` are interned *before* the rows: the map must be a
+    function of the cache key, not of which copy computed it.
+    """
+    ren = _Renamer(model, model.name in RENAME_FAMILY)
+    parts: list = [model.name, model.state_width]
+    if ren.enabled:
+        # init is abstracted into the renaming, but "unset" (NIL) stays
+        # a distinct model from "starts at some value"
+        parts.append("I" if int(model.init[0]) != NIL else "I=NIL")
+    else:
+        parts.append(tuple(model.init))
+    if instates is not None:
+        parts.append(tuple(
+            tuple(ren.rename(int(x)) for x in s) for s in sorted(instates)))
+    inv_r, ret_r = event_ranks(seq.inv, seq.ret)
+    f = np.asarray(seq.f)
+    v1 = np.asarray(seq.v1)
+    v2 = np.asarray(seq.v2)
+    ok = np.asarray(seq.ok)
+    for i in range(len(seq)):
+        parts.append((int(f[i]), ren.rename(int(v1[i])),
+                      ren.rename(int(v2[i])), inv_r[i], ret_r[i],
+                      bool(ok[i])))
+    return repr(parts).encode(), ren
+
+
+def canonical_key(seq, model: ModelSpec, instates=None) -> str:
+    """sha256 hex of the canonical form — the verdict-cache key."""
+    payload, _ = canonical_payload(seq, model, instates)
+    return hashlib.sha256(payload).hexdigest()

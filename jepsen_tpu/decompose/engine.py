@@ -1,0 +1,362 @@
+"""The decomposed linearizability checker.
+
+``check_opseq_decomposed`` runs the full funnel, each stage exact:
+
+    canonical-hash cache  ->  per-key cells  ->  per-cell:
+        cache -> value blocks -> quiescence segments -> sub-search
+
+Quiescence segments compose sequentially: every op in segment i returns
+before every op in segment i+1 invokes, so any linearization of the
+cell is a linearization of segment 1, then 2, ... — the only coupling
+is the model state carried across each cut.  Non-final segments are
+crash-free (a crashed op's +inf return suppresses all later cuts), so
+each is swept level-synchronously to the *complete set* of reachable
+final states, which seeds the next segment; the final segment (crashes
+and all) is checked from each carried-in state with the ordinary host
+engine.  Sub-results are cached by canonical hash — for segments, the
+input-state set is part of the key and the reachable output states are
+the cached value.
+
+Anything inconclusive (sub-search budget, sweep budget) falls back to
+the ``direct`` engine on the whole history: decomposition may only ever
+*add* decided verdicts, never change one.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import time
+from dataclasses import replace as _dc_replace
+
+from ..history import OpSeq
+from ..models import ModelSpec
+from .cache import VerdictCache
+from .canonical import canonical_key, canonical_payload
+from .partition import (partition_by_key, quiescence_segments, subseq,
+                        value_block_verdict)
+
+
+class _Inconclusive(Exception):
+    """A sub-search ran out of budget/deadline: fall back to direct."""
+
+
+class _DirectUndecided(Exception):
+    """The direct engine itself came back undecided — there is nothing
+    left to fall back to; surface its result as-is."""
+
+    def __init__(self, result: dict):
+        super().__init__(result.get("info", "undecided"))
+        self.result = result
+
+
+def _default_sub_check(sseq, smodel, *, max_configs, deadline):
+    from ..checker.linear import check_opseq_linear
+
+    return check_opseq_linear(sseq, smodel, max_configs=max_configs,
+                              deadline=deadline)
+
+
+def segment_states(sseq: OpSeq, model: ModelSpec, init_states, *,
+                   max_configs: int = 50_000_000,
+                   deadline: float | None = None) -> set:
+    """All model states reachable by fully linearizing a crash-free
+    segment, starting from any state in ``init_states``.  Empty set
+    means no linearization exists (the segment — hence its cell — is
+    invalid).  The sweep is checker/linear.py's level-synchronous
+    engine minus the crash machinery (segments before the last cut
+    carry no :info rows by construction)."""
+    from ..checker.linear import _advance
+    from ..checker.linearizable import INF32, encode_search
+
+    es = encode_search(sseq)
+    if es.n_crash:
+        raise ValueError("segment_states requires a crash-free segment")
+    n_det, W = es.n_det, es.window
+    states0 = {tuple(int(x) for x in s) for s in init_states}
+    if n_det == 0:
+        return states0
+
+    det_inv = [int(x) for x in es.det_inv]
+    det_ret = [int(x) for x in es.det_ret]
+    det_f = [int(x) for x in es.det_f]
+    det_v1 = [int(x) for x in es.det_v1]
+    det_v2 = [int(x) for x in es.det_v2]
+    sfx = [int(x) for x in es.suffix_min_ret]
+    pystep = model.pystep
+    INF = int(INF32)
+
+    frames: dict[tuple, list] = {}
+
+    def frame(p: int, win: int) -> list:
+        fr = frames.get((p, win))
+        if fr is not None:
+            return fr
+        if len(frames) > 1_000_000:
+            frames.clear()
+        hi = min(p + W, n_det)
+        w_ret = [INF if (win >> (j - p)) & 1 else det_ret[j]
+                 for j in range(p, hi)]
+        tail = sfx[hi] if hi < len(sfx) else INF
+        m1, m2, m1_at = tail, INF + 1, -1
+        for i, r in enumerate(w_ret):
+            if r < m1:
+                m2, m1, m1_at = m1, r, i
+            elif r < m2:
+                m2 = r
+        fr = []
+        for i in range(hi - p):
+            if (win >> i) & 1:
+                continue
+            j = p + i
+            excl = m2 if i == m1_at else m1
+            if det_inv[j] < excl:
+                fr.append((i, det_f[j], det_v1[j], det_v2[j]))
+        frames[(p, win)] = fr
+        return fr
+
+    level = {(0, 0, s) for s in states0}
+    configs = 0
+    for _depth in range(n_det):
+        if deadline is not None and time.perf_counter() > deadline:
+            raise _Inconclusive("segment sweep exceeded deadline")
+        nxt = set()
+        for p, win, state in level:
+            for i, f, v1, v2 in frame(p, win):
+                ns = pystep(state, f, v1, v2)
+                if ns is None:
+                    continue
+                configs += 1
+                if configs > max_configs:
+                    raise _Inconclusive("segment sweep exceeded budget")
+                p2, win2 = _advance(p, win, i, n_det)
+                nxt.add((p2, win2, ns))
+        level = nxt
+        if not level:
+            return set()
+    return {state for _p, _w, state in level}
+
+
+def _skey(payload: bytes) -> str:
+    return hashlib.sha256(payload).hexdigest()
+
+
+def check_opseq_decomposed(seq: OpSeq, model: ModelSpec, *,
+                           cache: VerdictCache | str | None = None,
+                           direct=None, sub_check=None,
+                           sub_max_configs: int = 50_000_000,
+                           deadline: float | None = None,
+                           scheduler: str | None = None,
+                           n_procs: int | None = None) -> dict:
+    """Check ``seq`` via decomposition; verdict-identical to ``direct``.
+
+    cache       VerdictCache, a jsonl path, or None (no caching)
+    direct      fn(seq) -> result dict; runs the whole history when
+                nothing decomposes or a sub-search is inconclusive
+                (defaults to the host `linear` engine)
+    sub_check   fn(sub_seq, sub_model, max_configs=, deadline=) -> dict;
+                the engine for final segments / unsplit cells
+    scheduler   None (in-process, largest-first), "pool" (multiprocess
+                host pool over independent cells), or "device" (batched
+                device engine over independent cells)
+
+    The result carries a ``decompose`` dict: cells, segments,
+    cache_hits/misses, configs_searched, and the methods that fired.
+    """
+    if isinstance(cache, str):
+        cache = VerdictCache(cache)
+    if sub_check is None:
+        sub_check = _default_sub_check
+    stats = {"cells": 0, "segments": 0, "cache_hits": 0,
+             "cache_misses": 0, "configs_searched": 0, "methods": []}
+    methods: set = set()
+
+    def done(valid, extra: dict | None = None) -> dict:
+        if cache is not None:
+            stats["cache_hits"] = cache.hits
+            stats["cache_misses"] = cache.misses
+        stats["methods"] = sorted(methods)
+        out = {"valid": valid, "configs": stats["configs_searched"],
+               "engine": "decompose(%s)" % ",".join(
+                   stats["methods"]) if methods else "decompose",
+               "decompose": stats}
+        if extra:
+            out = {**extra, **out, "engine": out["engine"],
+                   "decompose": stats}
+        return out
+
+    wkey = None
+    if cache is not None:
+        cache.reset_stats()
+        # the whole-history canonicalization is O(n) pure Python; a
+        # cache-less check (portfolio legs, bench probes) skips it
+        wkey = canonical_key(seq, model)
+        e = cache.get(wkey)
+        if e is not None and "v" in e:
+            methods.add("cache")
+            return done(e["v"])
+
+    cells, cell_model, early = partition_by_key(seq, model)
+    if early is False:
+        methods.add("key-partition")
+        stats["cells"] = 1
+        if cache is not None:
+            cache.put_verdict(wkey, False)
+        return done(False)
+    if cells is None:
+        cells, cell_model = {0: seq}, model
+    elif len(cells) > 1:
+        methods.add("key-partition")
+    stats["cells"] = len(cells)
+    order = sorted(cells, key=lambda k: -len(cells[k]))  # largest first
+
+    def check_cell(cseq: OpSeq, is_whole: bool):
+        """-> (verdict True/False, direct-result dict or None)."""
+        ckey = None
+        if cache is not None:
+            ckey = wkey if is_whole else canonical_key(cseq, cell_model)
+            if not is_whole:
+                e = cache.get(ckey)
+                if e is not None and "v" in e:
+                    methods.add("cache")
+                    return e["v"], None
+        vb = value_block_verdict(cseq, cell_model)
+        if vb is not None:
+            methods.add("value-blocks")
+            if cache is not None:
+                cache.put_verdict(ckey, vb)
+            return vb, None
+        segs = quiescence_segments(cseq)
+        stats["segments"] += len(segs)
+        if len(segs) <= 1:
+            if is_whole and direct is not None:
+                r = direct(cseq)
+                methods.add("direct")
+            else:
+                r = sub_check(cseq, cell_model,
+                              max_configs=sub_max_configs,
+                              deadline=deadline)
+                methods.add("sub-search")
+            stats["configs_searched"] += int(r.get("configs", 0) or 0)
+            v = r.get("valid")
+            if v not in (True, False):
+                if is_whole and direct is not None:
+                    raise _DirectUndecided(r)  # nothing left to try
+                raise _Inconclusive(r.get("info", "sub-search undecided"))
+            if cache is not None:
+                cache.put_verdict(ckey, v)
+            # a CELL's result rows (final_ops, linearization) index the
+            # cell's own projection, not the parent history — merging
+            # them into the whole-history result would make the failure
+            # report highlight unrelated ops; only whole-history results
+            # carry their row-level evidence out
+            return v, (r if is_whole else None)
+        methods.add("quiescence")
+        states = {tuple(cell_model.init)}
+        for rows in segs[:-1]:
+            sseq = subseq(cseq, rows)
+            e = ren = skey = None
+            if cache is not None:
+                payload, ren = canonical_payload(sseq, cell_model,
+                                                 instates=states)
+                skey = _skey(payload)
+                e = cache.get(skey)
+            if e is not None and "out" in e:
+                states = set(ren.decode_states(e["out"]))
+            else:
+                states = segment_states(sseq, cell_model, states,
+                                        max_configs=sub_max_configs,
+                                        deadline=deadline)
+                if cache is not None:
+                    cache.put_states(skey, ren.encode_states(states))
+            if not states:
+                if cache is not None:
+                    cache.put_verdict(ckey, False)
+                return False, None
+        fseq = subseq(cseq, segs[-1])
+        e = fkey = None
+        if cache is not None:
+            payload, _ren = canonical_payload(fseq, cell_model,
+                                              instates=states)
+            fkey = _skey(payload)
+            e = cache.get(fkey)
+        if e is not None and "v" in e:
+            v = e["v"]
+        else:
+            v = False
+            for s in sorted(states):
+                r = sub_check(fseq, _dc_replace(cell_model, init=tuple(s)),
+                              max_configs=sub_max_configs,
+                              deadline=deadline)
+                stats["configs_searched"] += int(r.get("configs", 0) or 0)
+                rv = r.get("valid")
+                if rv is True:
+                    v = True
+                    break
+                if rv is not False:
+                    raise _Inconclusive(
+                        r.get("info", "final segment undecided"))
+            if cache is not None:
+                cache.put_verdict(fkey, v)
+        if cache is not None:
+            cache.put_verdict(ckey, v)
+        return v, None
+
+    try:
+        verdict = True
+        last_direct = None
+        pending = order
+        if scheduler in ("pool", "device") and len(pending) > 1:
+            from . import schedule
+
+            cell_list = [cells[k] for k in pending]
+            # the caller's budget bounds both schedulers; the wall-clock
+            # deadline bounds the pool (per-cell workers poll it), while
+            # the batched device engine is budget-bounded only — an
+            # in-flight XLA dispatch has no wall-clock cancel, so the
+            # best the device branch can do is refuse to launch late
+            left = (max(0.1, deadline - time.perf_counter())
+                    if deadline is not None else None)
+            if scheduler == "pool":
+                verdicts = schedule.pool_check_cells(
+                    cell_list, cell_model, n_procs=n_procs,
+                    cache_path=getattr(cache, "path", None),
+                    max_configs=sub_max_configs, deadline_s=left)
+            else:
+                if deadline is not None and \
+                        time.perf_counter() >= deadline:
+                    raise _Inconclusive("deadline before device batch")
+                verdicts = schedule.device_batch_cells(
+                    cell_list, cell_model, budget=sub_max_configs)
+            methods.add(scheduler)
+            # one invalid cell decides the whole history (locality) —
+            # a decided False must win over an undecided sibling, not
+            # be discarded for a full direct re-search
+            if False in verdicts:
+                verdict = False
+            else:
+                for v in verdicts:
+                    if v is not True:
+                        raise _Inconclusive("scheduled cell undecided")
+        else:
+            for k in pending:
+                v, r = check_cell(cells[k], cells[k] is seq)
+                if r is not None:
+                    last_direct = r
+                if v is False:
+                    verdict = False
+                    break
+    except _DirectUndecided as e:
+        return done("unknown", extra=e.result)
+    except _Inconclusive:
+        if direct is None:
+            return done("unknown")
+        r = direct(seq)
+        methods.add("direct")
+        stats["configs_searched"] += int(r.get("configs", 0) or 0)
+        if cache is not None and r.get("valid") in (True, False):
+            cache.put_verdict(wkey, r["valid"])
+        return done(r.get("valid", "unknown"), extra=r)
+
+    if cache is not None:
+        cache.put_verdict(wkey, verdict)
+    return done(verdict, extra=last_direct)

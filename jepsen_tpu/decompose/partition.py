@@ -1,0 +1,213 @@
+"""History partitioners — every split here is verdict-exact.
+
+Three decompositions, in decreasing order of power:
+
+* :func:`partition_by_key` — Herlihy–Wing locality: a multi-register
+  history is linearizable iff each key's projection is linearizable as a
+  single register.  Locality holds with pending (:info) ops — they are
+  the incomplete ops the original proof already completes — so cells
+  keep their crashed rows.
+
+* :func:`value_block_verdict` — the P-compositionality instance for
+  registers (PAPERS.md arXiv:1504.00204), exact on the *unique-writes*
+  class: every linearization of such a history is a concatenation of
+  per-value blocks (the write of v, then the reads of v — a value
+  written once is "current" in one contiguous stretch), so the whole
+  search collapses to per-block interval checks plus an acyclicity test
+  on the forced block order.  Naive per-value projection is NOT sound —
+  two per-value sub-histories can each linearize while their blocks
+  interleave irreconcilably — which is why the cross-block DAG is part
+  of the decomposition, and why histories outside the gated class
+  (duplicate writes, CAS ops, crashed ops) fall through to the next
+  cutter instead.
+
+* :func:`quiescence_segments` — cut wherever no op is pending: every op
+  before the cut returns before every op after it invokes, so any
+  linearization is segment-1 then segment-2, and segments compose
+  through the set of reachable final states (engine.py threads them).
+  Crashed ops never return, so no cut can follow one — crash rows
+  always land in the final segment.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from ..history import NIL, OpSeq
+from ..models import R_READ, R_WRITE, ModelSpec, register
+
+
+def subseq(seq: OpSeq, rows) -> OpSeq:
+    """Project an OpSeq onto a row subset, re-ranking events densely.
+
+    The engines compare ``inv``/``ret`` by order only, so dense ranks
+    over the cell's own events preserve every verdict while making the
+    projection canonical-form-friendly (two cells with the same shape
+    get the same ranks regardless of where they sat in the parent)."""
+    from .canonical import event_ranks
+
+    rows = np.asarray(rows, dtype=np.int64)
+    inv_r, ret_r = event_ranks(np.asarray(seq.inv, dtype=np.int64)[rows],
+                               np.asarray(seq.ret, dtype=np.int64)[rows])
+    return OpSeq(
+        process=np.asarray(seq.process)[rows],
+        f=np.asarray(seq.f)[rows],
+        v1=np.asarray(seq.v1)[rows],
+        v2=np.asarray(seq.v2)[rows],
+        inv=np.array(inv_r, dtype=np.int64),
+        ret=np.array(ret_r, dtype=np.int64),
+        ok=np.asarray(seq.ok)[rows],
+        ops=[seq.ops[i] for i in rows.tolist()] if seq.ops else [],
+        encoder=seq.encoder,
+    )
+
+
+def quiescence_segments(seq: OpSeq) -> list[np.ndarray]:
+    """Row-index segments split at quiescent points.
+
+    Rows are sorted by invocation; a cut lands between row i and i+1
+    when every earlier op has returned before row i+1 invokes
+    (``max(ret[..i]) < inv[i+1]``).  A crashed row's +inf return
+    suppresses every later cut."""
+    n = len(seq)
+    if n <= 1:
+        return [np.arange(n)]
+    inv = np.asarray(seq.inv, dtype=np.int64)
+    ret = np.asarray(seq.ret, dtype=np.int64)
+    run_max = np.maximum.accumulate(ret)
+    cuts = np.nonzero(run_max[:-1] < inv[1:])[0] + 1  # segment starts
+    bounds = [0, *cuts.tolist(), n]
+    return [np.arange(bounds[i], bounds[i + 1])
+            for i in range(len(bounds) - 1)]
+
+
+def partition_by_key(seq: OpSeq, model: ModelSpec):
+    """Split a multi-register history into per-key register cells.
+
+    Returns ``(cells, cell_model, early_verdict)`` where ``cells`` maps
+    key -> register-shaped OpSeq (value moved from the v2 lane to v1),
+    or ``(None, None, None)`` when the model isn't multi-register.
+    ``early_verdict`` is False when an :ok op can never legally step
+    (NIL or out-of-range key — pystep rejects it in every state), which
+    decides the whole history without any search.  A crashed op with
+    such a key can never linearize either, but is never *required* to —
+    dropping it is exact."""
+    if model.name != "multi-register":
+        return None, None, None
+    width = model.state_width
+    initial = int(model.init[0])
+    v1 = np.asarray(seq.v1)
+    ok = np.asarray(seq.ok)
+    by_key: dict[int, list[int]] = {}
+    for i in range(len(seq)):
+        k = int(v1[i])
+        if k == NIL or not 0 <= k < width:
+            if bool(ok[i]):
+                return {}, None, False
+            continue  # un-linearizable crashed op: droppable
+        by_key.setdefault(k, []).append(i)
+    cell_model = register(initial)
+    cells = {}
+    for k, rows in by_key.items():
+        sub = subseq(seq, rows)
+        sub.v1 = np.asarray(sub.v2).copy()  # value lane becomes v1
+        sub.v2 = np.full(len(sub.v1), NIL, dtype=sub.v1.dtype)
+        cells[k] = sub
+    return cells, cell_model, None
+
+
+# ---------------------------------------------------------------------------
+# Per-value blocks (unique-writes registers)
+# ---------------------------------------------------------------------------
+
+
+def _blocks_conflict(m: np.ndarray, M: np.ndarray) -> bool:
+    """Is the forced block order cyclic?
+
+    Block A must precede B iff some A-op returns before some B-op
+    invokes, i.e. ``minret(A) < maxinv(B)``.  This threshold digraph is
+    a Ferrers digraph: any cycle contains a 2-cycle (telescoping the
+    edge/non-edge inequalities around a longer cycle contradicts
+    itself), so acyclicity reduces to "no pair with m_A < M_B and
+    m_B < M_A" — checked pairwise, chunked to bound memory."""
+    k = len(m)
+    step = max(1, 4_000_000 // max(1, k))
+    for lo in range(0, k, step):
+        hi = min(k, lo + step)
+        # strict upper triangle of the pairwise test, one chunk of rows
+        cross = (m[lo:hi, None] < M[None, :]) & (m[None, :] < M[lo:hi, None])
+        cross &= ~np.tri(hi - lo, k, k=lo, dtype=bool)
+        if cross.any():
+            return True
+    return False
+
+
+def value_block_verdict(seq: OpSeq, model: ModelSpec):
+    """Exact verdict via per-value blocks, or None when ineligible.
+
+    Eligible class: single-register model (register / cas-register),
+    every row :ok, only read/write ops, every written value distinct
+    and distinct from the initial value.  Within it:
+
+      * reads of NIL constrain nothing (always legal, state unchanged)
+        and are dropped;
+      * a read of a never-written, non-initial value can never step —
+        the history is invalid outright;
+      * otherwise ops group into per-value blocks (pseudo-block for
+        initial-value reads, pinned first via a [-1,-1] pseudo-write);
+        invalid iff some read returns before its value's write invokes,
+        or the forced block order has a cycle.
+    """
+    if model.name not in ("register", "cas-register"):
+        return None
+    if not bool(np.asarray(seq.ok).all()):
+        return None
+    n = len(seq)
+    if n == 0:
+        return True
+    f = np.asarray(seq.f)
+    if not bool(np.isin(f, (R_READ, R_WRITE)).all()):
+        return None  # CAS (or foreign codes): not this decomposition
+    v1 = [int(x) for x in seq.v1]
+    inv = [int(x) for x in seq.inv]
+    ret = [int(x) for x in seq.ret]
+    init = int(model.init[0])
+
+    writes: dict[int, int] = {}  # value -> row
+    for i in range(n):
+        if int(f[i]) == R_WRITE:
+            v = v1[i]
+            if v == NIL or v == init or v in writes:
+                return None  # NIL/init/duplicate write: ineligible
+            writes[v] = i
+
+    # blocks: value -> (minret, maxinv); the init pseudo-block's write
+    # has interval [-1,-1] so it is forced before everything
+    m: dict[int, int] = {v: ret[i] for v, i in writes.items()}
+    M: dict[int, int] = {v: inv[i] for v, i in writes.items()}
+    have_init_block = False
+    for i in range(n):
+        if int(f[i]) != R_READ:
+            continue
+        v = v1[i]
+        if v == NIL:
+            continue  # unknown-value read: always legal, drop
+        if v == init and init != NIL:
+            if not have_init_block:
+                have_init_block = True
+                m[NIL], M[NIL] = -1, -1  # NIL key = the init pseudo-block
+            m[NIL] = min(m[NIL], ret[i])
+            M[NIL] = max(M[NIL], inv[i])
+            continue
+        wi = writes.get(v)
+        if wi is None:
+            return False  # read of a value nothing wrote: never legal
+        if ret[i] < inv[wi]:
+            return False  # read forced before its own write
+        m[v] = min(m[v], ret[i])
+        M[v] = max(M[v], inv[i])
+
+    vals = list(m)
+    return not _blocks_conflict(
+        np.array([m[v] for v in vals], dtype=np.int64),
+        np.array([M[v] for v in vals], dtype=np.int64))

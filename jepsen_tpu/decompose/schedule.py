@@ -1,0 +1,175 @@
+"""Shard scheduler — spread independent cells over hosts or the device.
+
+Cells produced by the partitioners are independent histories (per-key
+projections, deduplicated batch keys), so they schedule like
+jepsen.independent's bounded-pmap (independent.clj:247-298): largest
+first (the straggler bound is the biggest cell — starting it last adds
+its whole runtime to the tail), over either
+
+* :func:`pool_check_cells` — a spawn-context process pool; cells ship
+  as plain int columns and the model ships as a *descriptor* (ModelSpec
+  closures don't pickle), workers rebuild both and run the decomposed
+  checker with the shared on-disk verdict cache; or
+* :func:`device_batch_cells` — the batched device engine
+  (checker/linearizable.search_batch), which vmaps the cells over the
+  key axis in one compiled search.
+
+Quiescence segments are NOT scheduler units: they compose sequentially
+through carried state sets, so they run inside their cell's worker.
+"""
+
+from __future__ import annotations
+
+import multiprocessing as mp
+import os
+import queue as _queue
+import time
+
+import numpy as np
+
+from ..history import OpSeq
+from ..models import ModelSpec
+
+
+def model_descriptor(model: ModelSpec) -> tuple:
+    """(name, init, state_width) — enough to rebuild every built-in
+    model family in a spawned worker (the same identity history_digest
+    binds checkpoints to)."""
+    return (model.name, tuple(int(x) for x in model.init),
+            int(model.state_width))
+
+
+def model_from_descriptor(desc: tuple) -> ModelSpec:
+    from .. import models
+
+    name, init, width = desc
+    if name == "register":
+        return models.register(init[0])
+    if name == "cas-register":
+        return models.cas_register(init[0])
+    if name == "mutex":
+        return models.mutex()
+    if name == "noop":
+        return models.noop()
+    if name == "multi-register":
+        return models.multi_register(width, init[0])
+    if name.startswith("unordered-queue-"):
+        return models.unordered_queue(int(name.rsplit("-", 1)[1]))
+    if name.startswith("fifo-queue-"):
+        return models.fifo_queue(int(name.rsplit("-", 1)[1]))
+    raise ValueError(f"no factory for model {name!r}")
+
+
+def _pack_cell(seq: OpSeq) -> tuple:
+    """Columns as plain lists — row data only; ops/encoder stay behind
+    (workers return verdicts, not reports)."""
+    return ([int(x) for x in seq.process], [int(x) for x in seq.f],
+            [int(x) for x in seq.v1], [int(x) for x in seq.v2],
+            [int(x) for x in seq.inv], [int(x) for x in seq.ret],
+            [bool(x) for x in seq.ok])
+
+
+def _unpack_cell(cols: tuple) -> OpSeq:
+    process, f, v1, v2, inv, ret, ok = cols
+    n = len(f)
+    return OpSeq(process=np.array(process, np.int32).reshape(n),
+                 f=np.array(f, np.int32).reshape(n),
+                 v1=np.array(v1, np.int32).reshape(n),
+                 v2=np.array(v2, np.int32).reshape(n),
+                 inv=np.array(inv, np.int64).reshape(n),
+                 ret=np.array(ret, np.int64).reshape(n),
+                 ok=np.array(ok, bool).reshape(n))
+
+
+def _pool_worker(desc, packed, idxs, cache_path, max_configs, q):
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")  # never touch a TPU
+    try:
+        from .engine import check_opseq_decomposed
+
+        model = model_from_descriptor(desc)
+        for i in idxs:
+            try:
+                r = check_opseq_decomposed(
+                    _unpack_cell(packed[i]), model, cache=cache_path,
+                    sub_max_configs=max_configs)
+                q.put((i, r.get("valid"), int(r.get("configs", 0))))
+            except Exception:  # noqa: BLE001 — one cell, not the pool
+                q.put((i, "unknown", 0))
+    except Exception:  # noqa: BLE001 — startup failure
+        for i in idxs:
+            q.put((i, "unknown", 0))
+
+
+def pool_check_cells(cells: list[OpSeq], model: ModelSpec, *,
+                     n_procs: int | None = None,
+                     cache_path: str | None = None,
+                     max_configs: int = 50_000_000,
+                     deadline_s: float | None = None) -> list:
+    """Verdict per cell via a process pool, largest-first striping.
+
+    Workers run the decomposed checker themselves (value blocks and
+    quiescence cuts apply within each cell) against the shared on-disk
+    cache; appends are line-atomic, so concurrent writers only ever
+    duplicate equal entries."""
+    n = len(cells)
+    if n == 0:
+        return []
+    n_procs = max(1, min(n_procs or min(16, os.cpu_count() or 1), n))
+    order = sorted(range(n), key=lambda i: -len(cells[i]))
+    packed = {i: _pack_cell(cells[i]) for i in range(n)}
+    # largest-first striping: worker w takes order[w], order[w+P], ...
+    shards = [order[w::n_procs] for w in range(n_procs)]
+    ctx = mp.get_context("spawn")
+    q = ctx.Queue()
+    desc = model_descriptor(model)
+    procs = []
+    for shard in shards:
+        # ship each worker only its own cells (packed rows pickle per
+        # process; the whole batch would be copied n_procs times)
+        mine = {i: packed[i] for i in shard}
+        p = ctx.Process(target=_pool_worker,
+                        args=(desc, mine, shard, cache_path,
+                              max_configs, q), daemon=True)
+        p.start()
+        procs.append(p)
+    out: dict = {}
+    t_end = None if deadline_s is None else time.monotonic() + deadline_s
+    while len(out) < n:
+        if t_end is not None and time.monotonic() >= t_end:
+            break
+        try:
+            i, v, _c = q.get(timeout=1.0)
+            out[i] = v
+        except _queue.Empty:
+            if not any(p.is_alive() for p in procs):
+                # drain anything that raced the liveness check
+                try:
+                    while True:
+                        i, v, _c = q.get_nowait()
+                        out[i] = v
+                except _queue.Empty:
+                    break
+    for p in procs:
+        p.terminate()
+    for p in procs:
+        p.join(timeout=5.0)
+    return [out.get(i, "unknown") for i in range(n)]
+
+
+def device_batch_cells(cells: list[OpSeq], model: ModelSpec, *,
+                       budget: int = 2_000_000) -> list:
+    """Verdict per cell via the batched device engine, largest-first
+    (the batch pads every key to the widest dims, so the order is about
+    the escalation ladder retiring big keys early, not padding)."""
+    from ..checker.linearizable import search_batch
+
+    n = len(cells)
+    if n == 0:
+        return []
+    order = sorted(range(n), key=lambda i: -len(cells[i]))
+    results = search_batch([cells[i] for i in order], model,
+                           budget=budget)
+    out = [None] * n
+    for pos, i in enumerate(order):
+        out[i] = results[pos].get("valid")
+    return out

@@ -27,6 +27,7 @@ closes that:
 
 from __future__ import annotations
 
+import math
 import re
 import socket
 import socketserver
@@ -41,6 +42,74 @@ class Error(Exception):
 class _Die(Exception):
     """Test control: the handler drops the connection without a reply
     (simulates the server dying with the statement in flight)."""
+
+
+def _quote_param(v) -> str:
+    """Render one parameter as a SQL literal, psycopg2-style.
+
+    Strings are quoted with ``''`` doubling; Decimal passes through as
+    its exact text form; anything the shim cannot adapt raises a CLEAR
+    error instead of emitting broken SQL (the psycopg2 behavior —
+    ProgrammingError: can't adapt)."""
+    from decimal import Decimal
+
+    if v is None:
+        return "NULL"
+    if isinstance(v, bool):  # bool before int: bool IS an int
+        return "TRUE" if v else "FALSE"
+    if isinstance(v, int):
+        return str(v)
+    if isinstance(v, float):
+        if not math.isfinite(v):
+            raise Error(f"pgwire shim can't adapt non-finite float "
+                        f"{v!r} (str() would emit invalid SQL)")
+        return str(v)
+    if isinstance(v, Decimal):
+        if not v.is_finite():
+            raise Error(f"pgwire shim can't adapt non-finite Decimal "
+                        f"{v!r} (str() would emit invalid SQL)")
+        return str(v)
+    if isinstance(v, str):
+        return "'" + v.replace("'", "''") + "'"
+    raise Error(
+        f"pgwire shim can't adapt parameter of type "
+        f"{type(v).__name__!r}; supported: None/bool/int/float/"
+        f"Decimal/str")
+
+
+def _interpolate(sql: str, params) -> str:
+    """psycopg2 %-format semantics: ``%s`` consumes a parameter, ``%%``
+    is a literal ``%``, anything else after ``%`` (and a placeholder/
+    parameter count mismatch) is an error."""
+    it = iter(params)
+    out: list[str] = []
+    i, n = 0, len(sql)
+    while i < n:
+        ch = sql[i]
+        if ch != "%":
+            out.append(ch)
+            i += 1
+            continue
+        if i + 1 >= n:
+            raise Error("pgwire shim: lone '%' at end of statement")
+        nxt = sql[i + 1]
+        if nxt == "s":
+            try:
+                out.append(_quote_param(next(it)))
+            except StopIteration:
+                raise Error("pgwire shim: not enough parameters for "
+                            "query placeholders") from None
+            i += 2
+        elif nxt == "%":
+            out.append("%")
+            i += 2
+        else:
+            raise Error(f"pgwire shim: unsupported format character "
+                        f"{nxt!r} (only %s and %% are supported)")
+    if sum(1 for _ in it):
+        raise Error("pgwire shim: more parameters than query "
+                    "placeholders")
+    return "".join(out)
 
 
 # ---------------------------------------------------------------------------
@@ -63,13 +132,8 @@ class Cursor:
         self._i = 0
 
     def execute(self, sql: str, params: tuple | None = None) -> None:
-        if params:
-            def sub(m):
-                nonlocal it
-                v = next(it)
-                return "NULL" if v is None else str(int(v))
-            it = iter(params)
-            sql = re.sub(r"%s", sub, sql)
+        if params is not None:
+            sql = _interpolate(sql, params)
         self.conn._maybe_begin()
         rows, tag = self.conn._query(sql)
         self._rows, self._i = rows, 0
@@ -310,12 +374,18 @@ class RegisterEngine:
         self.accounts: dict[int, int] = {}      # bank balances
         self._fail = 0
         self._die = 0
+        # injected counters are scoped to the FIRST connection that
+        # consumes them: a counter armed for one client's transaction
+        # must not fire mid-statement on a concurrent connection
+        self._fail_owner: int | None = None
+        self._die_owner: int | None = None
         self._txn_owner: int | None = None      # thread id holding BEGIN
         self._undo: list = []                   # (table, key, old|None)
 
     def fail_next(self, n: int = 1) -> None:
         with self.lock:
             self._fail = n
+            self._fail_owner = None
 
     def die_next(self, n: int = 1) -> None:
         """Arm a connection kill on the n-th DML/SELECT statement from
@@ -325,6 +395,15 @@ class RegisterEngine:
         abort hook replays it."""
         with self.lock:
             self._die = n
+            self._die_owner = None
+
+    def disarm(self) -> None:
+        """Clear any armed (or partially-consumed) injection counters —
+        a test whose scenario bailed early must not leak a live counter
+        into later statements."""
+        with self.lock:
+            self._fail = self._die = 0
+            self._fail_owner = self._die_owner = None
 
     # -- txn plumbing -----------------------------------------------------
     def _table(self, name: str) -> dict[int, int]:
@@ -354,22 +433,41 @@ class RegisterEngine:
     def abort_connection(self) -> None:
         """Handler hook: a connection died — roll back its open txn so
         a half-applied transfer can never leak (and release the lock
-        other connections are blocked on)."""
-        if self._txn_owner == threading.get_ident():
+        other connections are blocked on).  Injection counters this
+        connection had claimed die with it."""
+        me = threading.get_ident()
+        # reading _txn_owner unlocked is safe here: it can only equal
+        # `me` if this thread set it (and then still holds the lock)
+        if self._txn_owner == me:
             self._rollback_undo()
             self._release()
+        with self.lock:
+            if self._die_owner == me:
+                self._die, self._die_owner = 0, None
+            if self._fail_owner == me:
+                self._fail, self._fail_owner = 0, None
 
     def execute(self, sql: str) -> tuple[list[tuple], list[str], str]:
         s = sql.strip().rstrip(";")
         me = threading.get_ident()
         if re.fullmatch(r"BEGIN", s, re.I):
-            if self._txn_owner != me:
-                self.lock.acquire()          # blocks on other txns
+            # _txn_owner transitions happen only while HOLDING the
+            # lock: the old unlocked `owner != me` test read the field
+            # mid-transition against a releasing thread.  Acquire
+            # first (re-entrant when we already own the txn), then
+            # decide — `owner == me` is stable under the lock.
+            self.lock.acquire()              # blocks on other txns
+            if self._txn_owner == me:
+                self.lock.release()          # re-entrant BEGIN: no-op
+            else:
                 self._txn_owner = me
                 self._undo.clear()
             return [], [], "BEGIN"
         if re.fullmatch(r"(COMMIT|ROLLBACK)", s, re.I):
             kind = s.upper()
+            # `owner == me` implies this thread holds the lock (set
+            # under it at BEGIN and cleared only by us), so the
+            # transition below is already guarded
             if self._txn_owner == me:
                 if kind == "ROLLBACK":
                     self._rollback_undo()
@@ -384,13 +482,21 @@ class RegisterEngine:
         if re.match(r"CREATE TABLE", s, re.I):
             return [], [], "CREATE TABLE"
         # injected failures hit DML/SELECT only — never the txn
-        # control statements the client's rollback path issues
-        if self._die > 0:
+        # control statements the client's rollback path issues.  The
+        # first connection to consume a counter claims it; concurrent
+        # connections' statements pass through untouched.
+        me = threading.get_ident()
+        if self._die > 0 and self._die_owner in (None, me):
+            self._die_owner = me
             self._die -= 1
             if self._die == 0:
+                self._die_owner = None
                 raise _Die()
-        if self._fail > 0:
+        if self._fail > 0 and self._fail_owner in (None, me):
+            self._fail_owner = me
             self._fail -= 1
+            if self._fail == 0:
+                self._fail_owner = None
             raise Error("restart transaction: injected conflict")
         m = re.fullmatch(
             r"SELECT value FROM registers WHERE id=(-?\d+)", s, re.I)

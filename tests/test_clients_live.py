@@ -420,15 +420,95 @@ def test_cockroach_bank_live_concurrent_transfers():
         # on the credit UPDATE (3).  The engine's abort hook must
         # replay the undo log — restoring the debited account — and
         # release the txn lock.
+        #
+        # The concurrent transfers above may have DRAINED account 0;
+        # an insufficient-funds transfer bails after the SELECT (one
+        # statement, not three), comes back :fail, and leaves the die
+        # counter partially consumed — the ~40% flake.  Seed account 0
+        # with a known positive balance BEFORE arming the counter.
+        balances = c0.invoke(t, invoke_op(0, "read", None)).value
+        if balances[0] < 1:
+            rich = max(balances, key=balances.get)
+            op = c0.invoke(t, invoke_op(0, "transfer",
+                                        {"from": rich, "to": 0,
+                                         "amount": 1}))
+            assert op.type == "ok", op
         before = c0.invoke(t, invoke_op(0, "read", None)).value
+        assert before[0] >= 1
         cdie = cockroach.BankClient().open(t, "127.0.0.1")
         srv.engine.die_next(3)
-        op = cdie.invoke(t, invoke_op(0, "transfer",
-                                      {"from": 0, "to": 1,
-                                       "amount": 1}))
-        assert op.type == "info"  # indeterminate to the client...
-        after = c0.invoke(t, invoke_op(0, "read", None)).value
-        assert after == before  # ...but rolled back on the server
+        try:
+            op = cdie.invoke(t, invoke_op(0, "transfer",
+                                          {"from": 0, "to": 1,
+                                           "amount": 1}))
+            assert op.type == "info"  # indeterminate to the client...
+            after = c0.invoke(t, invoke_op(0, "read", None)).value
+            assert after == before  # ...but rolled back on the server
+        finally:
+            # a partially-consumed counter (e.g. an assertion above
+            # fired) must not leak into the teardown's statements
+            srv.engine.disarm()
     finally:
         srv.shutdown()
         srv.server_close()
+
+
+# ---------------------------------------------------------------------------
+# pg-wire shim unit behavior (param quoting, injection-counter scoping)
+# ---------------------------------------------------------------------------
+
+
+def test_pgwire_param_interpolation_quotes_and_escapes():
+    from decimal import Decimal
+
+    from jepsen_tpu.suites import pgwire
+
+    f = pgwire._interpolate
+    assert f("SELECT %s", (7,)) == "SELECT 7"
+    assert f("SELECT %s", (None,)) == "SELECT NULL"
+    assert f("SELECT %s", ("it's",)) == "SELECT 'it''s'"
+    assert f("SELECT %s", (Decimal("1.50"),)) == "SELECT 1.50"
+    assert f("SELECT %s", (True,)) == "SELECT TRUE"
+    # psycopg2's %% -> literal %
+    assert f("LIKE 'a%%' AND x=%s", (1,)) == "LIKE 'a%' AND x=1"
+    with pytest.raises(pgwire.Error, match="unsupported format"):
+        f("SELECT %d", (1,))
+    with pytest.raises(pgwire.Error, match="not enough parameters"):
+        f("%s %s", (1,))
+    with pytest.raises(pgwire.Error, match="more parameters"):
+        f("%s", (1, 2))
+    with pytest.raises(pgwire.Error, match="can't adapt"):
+        f("%s", (object(),))
+
+
+def test_pgwire_injection_counters_scope_to_consuming_connection():
+    """A die counter partially consumed by one connection's statements
+    must neither fire on another connection nor survive the consumer's
+    death; fail counters scope the same way."""
+    from jepsen_tpu.suites import pgwire
+
+    eng = pgwire.RegisterEngine()
+    eng.execute("UPSERT INTO registers (id, value) VALUES (1, 5)")
+    eng.die_next(3)
+    results: list = []
+
+    def other_conn():
+        # a different thread = a different connection in this engine:
+        # its statement must pass through the armed counter untouched
+        results.append(
+            eng.execute("SELECT value FROM registers WHERE id=1"))
+
+    # this thread claims the counter with its first statement
+    eng.execute("SELECT value FROM registers WHERE id=1")
+    th = threading.Thread(target=other_conn)
+    th.start()
+    th.join(timeout=10)
+    assert results and results[0][0] == [(5,)]
+    # the claimant consumed 1 of 3; its death must clear the rest
+    eng.abort_connection()
+    for _ in range(4):  # would have died on the 3rd statement
+        eng.execute("SELECT value FROM registers WHERE id=1")
+    # disarm() clears a freshly-armed counter too
+    eng.fail_next(2)
+    eng.disarm()
+    eng.execute("SELECT value FROM registers WHERE id=1")

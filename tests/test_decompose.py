@@ -1,0 +1,444 @@
+"""P-compositional decomposition engine (jepsen_tpu/decompose/).
+
+The subsystem's contract is absolute: ``decompose=True`` must be
+verdict-identical to the direct engines on every history — valid,
+invalid, crashed-op-laden, multi-key — while doing exponentially less
+work where a split applies and ZERO search work on a canonical-hash
+cache hit.  The differential fuzz here (>= 300 histories, :info ops
+included) is the enforcement; the targeted tests pin the individual
+decomposition theorems (value-block exactness incl. the naive-
+projection counterexample, quiescence threading, locality) and the
+cache/scheduler plumbing.
+"""
+
+from __future__ import annotations
+
+import os
+import random
+from dataclasses import replace
+
+import numpy as np
+import pytest
+
+from jepsen_tpu.history import (encode_ops, info_op, invoke_op, ok_op)
+from jepsen_tpu.models import (cas_register, multi_register, mutex,
+                               register)
+from jepsen_tpu.synth import (flip_read, register_history,
+                              sim_mutex_history, sim_register_history)
+
+
+def _direct(seq, model):
+    from jepsen_tpu.checker.seq import check_opseq
+
+    return check_opseq(seq, model)
+
+
+def _decomposed(seq, model, **kw):
+    from jepsen_tpu.decompose.engine import check_opseq_decomposed
+
+    return check_opseq_decomposed(
+        seq, model, direct=lambda s: _direct(s, model), **kw)
+
+
+def sim_multireg_history(rng, width=3, n_procs=4, n_ops=30,
+                         crash_p=0.05):
+    """Valid-by-construction multi-register history ((key, value) ops);
+    crashed writes apply with probability .5."""
+    state = {k: 0 for k in range(width)}
+    h, pending, crashed = [], {}, set()
+    done = 0
+    while done < n_ops or pending:
+        live = [p for p in range(n_procs) if p not in crashed]
+        if not live:
+            break
+        p = rng.choice(live)
+        if p in pending:
+            f, k, v = pending.pop(p)
+            if crash_p and rng.random() < crash_p:
+                if rng.random() < 0.5 and f == "write":
+                    state[k] = v
+                crashed.add(p)
+                h.append(info_op(p, f, (k, v if f == "write" else None)))
+                continue
+            if f == "read":
+                h.append(ok_op(p, f, (k, state[k])))
+            else:
+                state[k] = v
+                h.append(ok_op(p, f, (k, v)))
+        elif done < n_ops:
+            f = rng.choice(["read", "write"])
+            k = rng.randrange(width)
+            v = None if f == "read" else rng.randrange(5)
+            h.append(invoke_op(p, f, (k, v)))
+            pending[p] = (f, k, v)
+            done += 1
+    return h
+
+
+def _flip_mr_read(rng, h):
+    idx = [i for i, op in enumerate(h)
+           if op.type == "ok" and op.f == "read"]
+    if not idx:
+        return h
+    h = list(h)
+    i = rng.choice(idx)
+    k, v = h[i].value
+    h[i] = replace(h[i], value=(k, (v or 0) + 7))
+    return h
+
+
+# ---------------------------------------------------------------------------
+# differential fuzz: >= 300 histories, zero verdict divergences
+# ---------------------------------------------------------------------------
+
+
+def _fuzz_cases():
+    """(label, model, seq) for 320 histories: cas-register with :info
+    ops and corruptions, unique-write registers (the value-block class),
+    low-overlap registers (the quiescence class), mutex with crashes,
+    and multi-register (the locality class)."""
+    cases = []
+    for i in range(110):  # cas-register, crashes, 1/3 corrupted
+        rng = random.Random(i)
+        m = cas_register()
+        h = sim_register_history(rng, n_procs=4, n_ops=24, crash_p=0.1,
+                                 cas=(i % 2 == 0))
+        if i % 3 == 0:
+            h = flip_read(rng, h)
+        cases.append(("cas", m, encode_ops(h, m.f_codes)))
+    for i in range(70):  # unique writes: the value-block fast path
+        rng = random.Random(1000 + i)
+        m = register(0)
+        h = register_history(rng, n_ops=36, n_procs=6, overlap=5,
+                             crash_p=0.0, n_values=10**6, cas=False)
+        if i % 2 == 0:
+            h = flip_read(rng, h)
+        cases.append(("uniq", m, encode_ops(h, m.f_codes)))
+    for i in range(40):  # low overlap: the quiescence-cut path
+        rng = random.Random(2000 + i)
+        m = cas_register()
+        h = register_history(rng, n_ops=40, n_procs=3, overlap=1,
+                             crash_p=0.02, max_crashes=2, n_values=4)
+        if i % 2 == 0:
+            h = flip_read(rng, h)
+        cases.append(("quiesce", m, encode_ops(h, m.f_codes)))
+    for i in range(50):  # mutex with crashed acquires/releases
+        rng = random.Random(3000 + i)
+        m = mutex()
+        h = sim_mutex_history(rng, n_ops=26, n_procs=4, crash_p=0.06)
+        cases.append(("mutex", m, encode_ops(h, m.f_codes)))
+    for i in range(50):  # multi-register: the locality path
+        rng = random.Random(4000 + i)
+        m = multi_register(3)
+        h = sim_multireg_history(rng)
+        if i % 3 == 0:
+            h = _flip_mr_read(rng, h)
+        cases.append(("multireg", m, encode_ops(h, m.f_codes)))
+    assert len(cases) >= 300
+    return cases
+
+
+def test_differential_fuzz_decomposed_vs_direct():
+    divergences = []
+    used_methods = set()
+    for label, m, seq in _fuzz_cases():
+        d = _direct(seq, m)["valid"]
+        r = _decomposed(seq, m)
+        used_methods.update(r["decompose"]["methods"])
+        if r["valid"] != d:
+            divergences.append((label, d, r["valid"], r["decompose"]))
+    assert not divergences, divergences[:5]
+    # the fuzz must actually exercise every decomposition, or the
+    # parity claim is vacuous
+    assert {"value-blocks", "quiescence",
+            "key-partition"} <= used_methods, used_methods
+
+
+def test_wired_entry_points_are_verdict_identical():
+    from jepsen_tpu.checker.linear import check_opseq_linear
+    from jepsen_tpu.checker.seq import check_opseq
+
+    m = cas_register()
+    for i in range(25):
+        rng = random.Random(50 + i)
+        h = sim_register_history(rng, n_procs=4, n_ops=24, crash_p=0.08)
+        if i % 3 == 0:
+            h = flip_read(rng, h)
+        seq = encode_ops(h, m.f_codes)
+        a = check_opseq(seq, m)["valid"]
+        assert check_opseq(seq, m, decompose=True)["valid"] == a
+        assert check_opseq_linear(seq, m, decompose=True)["valid"] == a
+
+
+def test_linearizable_checker_decompose_option():
+    from jepsen_tpu.checker.linearizable import Linearizable
+
+    m = cas_register()
+    rng = random.Random(9)
+    h = sim_register_history(rng, n_procs=4, n_ops=60, crash_p=0.05)
+    plain = Linearizable(m, algorithm="linear").check({"name": ""}, h)
+    dec = Linearizable(m, algorithm="linear",
+                       decompose=True).check({"name": ""}, h)
+    assert dec["valid"] == plain["valid"]
+    assert dec["engine"].startswith("decompose(")
+    assert dec["decompose"]["cells"] >= 1
+
+
+# ---------------------------------------------------------------------------
+# value blocks: exactness and the naive-projection counterexample
+# ---------------------------------------------------------------------------
+
+
+def test_value_blocks_reject_naive_projection_counterexample():
+    """w(1)[0,10] w(2)[0,10] r->1[1,2] r->2[3,4] r->1[5,6]: each
+    per-value projection is linearizable on its own, but the value
+    sequence 1,2,1 needs two writes of 1 — the cross-block cycle test
+    is what makes the decomposition exact."""
+    from jepsen_tpu.decompose.partition import value_block_verdict
+
+    h = [invoke_op(0, "write", 1), invoke_op(1, "write", 2),
+         invoke_op(2, "read", None), ok_op(2, "read", 1),
+         invoke_op(3, "read", None), ok_op(3, "read", 2),
+         invoke_op(4, "read", None), ok_op(4, "read", 1),
+         ok_op(0, "write", 1), ok_op(1, "write", 2)]
+    m = register(0)
+    seq = encode_ops(h, m.f_codes)
+    assert _direct(seq, m)["valid"] is False
+    assert value_block_verdict(seq, m) is False
+    assert _decomposed(seq, m)["valid"] is False
+
+
+def test_value_blocks_gate_ineligible_histories():
+    from jepsen_tpu.decompose.partition import value_block_verdict
+
+    m = cas_register(0)
+    # CAS ops: not this decomposition
+    h = [invoke_op(0, "cas", (0, 1)), ok_op(0, "cas", (0, 1))]
+    assert value_block_verdict(encode_ops(h, m.f_codes), m) is None
+    # duplicate writes of one value: ineligible
+    h = [invoke_op(0, "write", 3), ok_op(0, "write", 3),
+         invoke_op(0, "write", 3), ok_op(0, "write", 3)]
+    assert value_block_verdict(encode_ops(h, m.f_codes), m) is None
+    # crashed ops: ineligible
+    h = [invoke_op(0, "write", 3), info_op(0, "write", 3)]
+    assert value_block_verdict(encode_ops(h, m.f_codes), m) is None
+    # read of a value nothing wrote: immediately invalid
+    h = [invoke_op(0, "read", None), ok_op(0, "read", 42)]
+    assert value_block_verdict(encode_ops(h, m.f_codes), m) is False
+    # reads of the initial value are fine (pinned-first pseudo-block)
+    h = [invoke_op(0, "read", None), ok_op(0, "read", 0),
+         invoke_op(0, "write", 5), ok_op(0, "write", 5),
+         invoke_op(0, "read", None), ok_op(0, "read", 5)]
+    assert value_block_verdict(encode_ops(h, m.f_codes), m) is True
+
+
+# ---------------------------------------------------------------------------
+# quiescence cutting
+# ---------------------------------------------------------------------------
+
+
+def test_quiescence_segments_partition_and_crash_placement():
+    from jepsen_tpu.decompose.partition import quiescence_segments
+
+    m = cas_register()
+    rng = random.Random(11)
+    h = register_history(rng, n_ops=50, n_procs=3, overlap=1,
+                         crash_p=0.05, max_crashes=3, n_values=4)
+    seq = encode_ops(h, m.f_codes)
+    segs = quiescence_segments(seq)
+    # segments partition the rows in order
+    assert np.array_equal(np.concatenate(segs), np.arange(len(seq)))
+    # crash rows (ret = +inf) may appear in the FINAL segment only
+    ok = np.asarray(seq.ok)
+    for s in segs[:-1]:
+        assert ok[s].all(), "crash row escaped a non-final segment"
+    # an actually-quiescent generator must actually split
+    assert len(segs) > 1
+
+
+def test_quiescence_threading_runs_and_agrees():
+    """Histories that split must go through the state-set composition
+    path (methods includes 'quiescence') and still agree exactly."""
+    m = cas_register()
+    hit = 0
+    for i in range(30):
+        rng = random.Random(600 + i)
+        h = register_history(rng, n_ops=44, n_procs=3, overlap=1,
+                             crash_p=0.03, max_crashes=2, n_values=3)
+        if i % 2 == 0:
+            h = flip_read(rng, h)
+        seq = encode_ops(h, m.f_codes)
+        r = _decomposed(seq, m)
+        if "quiescence" in r["decompose"]["methods"]:
+            hit += 1
+        assert r["valid"] == _direct(seq, m)["valid"]
+    assert hit > 0
+
+
+# ---------------------------------------------------------------------------
+# canonicalization + verdict cache
+# ---------------------------------------------------------------------------
+
+
+def test_canonical_key_invariances():
+    from jepsen_tpu.decompose.canonical import canonical_key
+
+    m = cas_register()
+    rng = random.Random(21)
+    h = sim_register_history(rng, n_procs=4, n_ops=24, crash_p=0.1)
+    seq = encode_ops(h, m.f_codes)
+    k0 = canonical_key(seq, m)
+    # process renaming: invisible
+    h2 = [replace(op, process=op.process + 100) for op in h]
+    assert canonical_key(encode_ops(h2, m.f_codes), m) == k0
+    # event-index erasure: a dropped :fail op at the front shifts
+    # every raw event index but not the ranks
+    h3 = [invoke_op(99, "write", 7),
+          replace(ok_op(99, "write", 7), type="fail"), *h]
+    assert canonical_key(encode_ops(h3, m.f_codes), m) == k0
+
+    # value renaming (register family): a value bijection is invisible
+    def shift(v):
+        if isinstance(v, int):
+            return v + 50
+        if isinstance(v, (tuple, list)):  # cas (expected, new)
+            return tuple(shift(x) for x in v)
+        return v
+
+    h4 = [replace(op, value=shift(op.value)) for op in h]
+    assert canonical_key(encode_ops(h4, m.f_codes), m) == k0
+    # ...but the model's identity is not
+    assert canonical_key(seq, cas_register(7)) != k0
+    assert canonical_key(seq, register(0)) != k0
+
+
+def test_cache_hit_does_zero_search_work(tmp_path):
+    from jepsen_tpu.decompose.cache import VerdictCache
+
+    m = cas_register()
+    rng = random.Random(42)
+    h = sim_register_history(rng, n_procs=4, n_ops=30, crash_p=0.1)
+    seq = encode_ops(h, m.f_codes)
+    path = str(tmp_path / "verdicts.jsonl")
+    cache = VerdictCache(path)
+    r1 = _decomposed(seq, m, cache=cache)
+    assert r1["configs"] > 0
+    # the same canonical shape — processes renamed — from a COLD cache
+    # object (disk round-trip): zero search work
+    h2 = [replace(op, process=op.process + 10) for op in h]
+    seq2 = encode_ops(h2, m.f_codes)
+    r2 = _decomposed(seq2, m, cache=VerdictCache(path))
+    assert r2["valid"] == r1["valid"]
+    assert r2["configs"] == 0
+    assert r2["decompose"]["cache_hits"] >= 1
+    assert r2["decompose"]["methods"] == ["cache"]
+
+
+def test_cache_never_stores_unknown(tmp_path):
+    from jepsen_tpu.decompose.cache import VerdictCache
+
+    c = VerdictCache(str(tmp_path / "v.jsonl"))
+    c.put_verdict("k1", "unknown")
+    c.put_verdict("k2", True)
+    assert len(VerdictCache(str(tmp_path / "v.jsonl"))) == 1
+
+
+def test_segment_cache_reuses_state_sets(tmp_path):
+    """A multi-segment cell checked twice: the second pass must hit the
+    per-segment entries (input-state set in the key, reachable states
+    as the value) and do no sweep work."""
+    from jepsen_tpu.decompose.cache import VerdictCache
+
+    m = cas_register()
+    rng = random.Random(77)
+    h = register_history(rng, n_ops=44, n_procs=3, overlap=1,
+                         crash_p=0.0, n_values=3)
+    seq = encode_ops(h, m.f_codes)
+    path = str(tmp_path / "v.jsonl")
+    r1 = _decomposed(seq, m, cache=VerdictCache(path))
+    assert "quiescence" in r1["decompose"]["methods"]
+    r2 = _decomposed(seq, m, cache=VerdictCache(path))
+    assert r2["configs"] == 0 and r2["valid"] == r1["valid"]
+
+
+# ---------------------------------------------------------------------------
+# batch + scheduler integration
+# ---------------------------------------------------------------------------
+
+
+def test_search_batch_decompose_dedup_and_parity():
+    from jepsen_tpu.checker.linearizable import search_batch
+
+    m = cas_register()
+    seqs = []
+    for k in range(12):  # 4 distinct shapes, 3 copies each
+        rng = random.Random(k % 4)
+        h = sim_register_history(rng, n_procs=4, n_ops=24, crash_p=0.0)
+        seqs.append(encode_ops(h, m.f_codes))
+    direct = search_batch(seqs, m, budget=200_000)
+    dec = search_batch(seqs, m, budget=200_000, decompose=True)
+    assert [r["valid"] for r in dec] == [r["valid"] for r in direct]
+    stats = dec[0]["decompose_batch"]
+    assert stats["searched"] == 4 and stats["deduped"] == 8
+    # dedup'd keys report zero configs — no search happened for them
+    assert sum(1 for r in dec if r["configs"] == 0) == 8
+
+
+def test_pool_scheduler_parity():
+    from jepsen_tpu.decompose.engine import check_opseq_decomposed
+
+    rng = random.Random(5)
+    m = multi_register(4)
+    h = sim_multireg_history(rng, width=4, n_ops=50, n_procs=6)
+    seq = encode_ops(h, m.f_codes)
+    r = check_opseq_decomposed(seq, m, scheduler="pool", n_procs=2)
+    assert r["valid"] == _direct(seq, m)["valid"]
+    assert r["decompose"]["cells"] > 1
+    assert "pool" in r["decompose"]["methods"]
+
+
+def test_model_descriptor_roundtrip():
+    from jepsen_tpu.decompose.schedule import (model_descriptor,
+                                               model_from_descriptor)
+    from jepsen_tpu.models import fifo_queue, noop, unordered_queue
+
+    for m in (register(3), cas_register(), mutex(), noop(),
+              multi_register(5, 2), unordered_queue(8), fifo_queue(4)):
+        m2 = model_from_descriptor(model_descriptor(m))
+        assert m2.name == m.name
+        assert m2.init == m.init
+        assert m2.state_width == m.state_width
+
+
+def test_env_knob_reaches_suite_constructed_checkers(monkeypatch):
+    """--lin-decompose travels via JEPSEN_TPU_LIN_DECOMPOSE, the same
+    fleet-wide channel as the algorithm selector, because suites build
+    their own Linearizable checkers."""
+    from jepsen_tpu.checker.linearizable import Linearizable
+
+    monkeypatch.delenv("JEPSEN_TPU_LIN_DECOMPOSE", raising=False)
+    assert Linearizable(cas_register()).decompose is False
+    monkeypatch.setenv("JEPSEN_TPU_LIN_DECOMPOSE", "1")
+    assert Linearizable(cas_register()).decompose is True
+    m = cas_register()
+    rng = random.Random(4)
+    h = sim_register_history(rng, n_procs=3, n_ops=20)
+    r = Linearizable(m, algorithm="linear").check({"name": ""}, h)
+    assert r["engine"].startswith("decompose")
+
+
+def test_cli_flag_sets_env_knob(monkeypatch):
+    import argparse
+
+    from jepsen_tpu import cli
+
+    # setenv-then-delenv (not bare delenv of an absent var, which
+    # records nothing): the cli sets the var OUTSIDE monkeypatch, so
+    # teardown must know to remove it or it leaks into later tests
+    monkeypatch.setenv("JEPSEN_TPU_LIN_DECOMPOSE", "placeholder")
+    monkeypatch.delenv("JEPSEN_TPU_LIN_DECOMPOSE")
+    p = argparse.ArgumentParser()
+    cli.add_test_opts(p)
+    opts = cli.test_opt_fn(p.parse_args(["--lin-decompose", "--dummy"]))
+    assert opts["lin_decompose"] is True
+    assert os.environ.get("JEPSEN_TPU_LIN_DECOMPOSE") == "1"

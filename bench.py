@@ -75,6 +75,16 @@ TIERS = [("1k", 1_000, 32, 5_000_000, False, 90.0),
          ("mutex2k", 2_000, 16, 30_000_000, False, 90.0),
          ("batch256", 128, 8, 2_000_000, False, 120.0),
          ("10k", 10_000, 32, 100_000_000, True, 420.0),
+         # the ROADMAP's unique-writes wide tier: 10k ops, every write
+         # a DISTINCT value, overlap kept permanently in flight (no
+         # quiescent point) — the per-value block decomposition's
+         # class at device-relevant scale, so config 5's
+         # `applies: false` stops being the only decomposition data
+         # point.  Corrupted by swapping two distant reads' values:
+         # the block-ORDER invalidity mode the cross-block acyclicity
+         # test exists for (a never-written value would be rejected
+         # before any order reasoning).
+         ("10kuniq", 10_000, 32, 100_000_000, False, 180.0),
          # BASELINE config #5's worst-case-frontier variant: 64
          # processes at overlap 32 force genuinely WIDE pruned levels —
          # the regime where the device's lockstep lanes should beat the
@@ -175,12 +185,31 @@ def make_seq(name: str):
     if name in _SEQ_CACHE:
         return _SEQ_CACHE[name]
     from jepsen_tpu.history import encode_ops
-    from jepsen_tpu.models import cas_register, mutex
+    from jepsen_tpu.models import cas_register, mutex, register
     from jepsen_tpu.synth import (corrupt_read, register_history,
-                                  sim_mutex_history)
+                                  sim_mutex_history, swap_read_values)
 
     spec = {t[0]: t for t in TIERS}[name]
     _, n_ops, n_procs, _, _, _ = spec
+    if name == "10kuniq":
+        # unique-writes wide tier: no crashes/:fail ops and cas=False,
+        # so the encoded count equals the invoke count exactly; the
+        # distant-read swap makes the history (almost surely) invalid
+        # through the forced block ORDER, the deep invalidity mode
+        model = register(0)
+
+        def gen(n):
+            rng = random.Random(f"bench-{name}")
+            h = register_history(rng, n_ops=n, n_procs=n_procs,
+                                 overlap=8, crash_p=0.0, cas=False,
+                                 unique_writes=True)
+            return swap_read_values(rng, h)
+
+        _, seq = _resolve_nominal(name, gen,
+                                  lambda h: encode_ops(h, model.f_codes),
+                                  n_ops, lo_guess=n_ops)
+        _SEQ_CACHE[name] = (seq, model)
+        return seq, model
     if name.startswith("mutex"):
         # BASELINE config #4: lock workload with nemesis-induced :info
         # (crashed) ops — the indeterminate-op stressor.  An acquire
@@ -605,6 +634,77 @@ def _batch_decomposed(lin, seqs, model, budget, direct_results,
     }
 
 
+def _wide_outlier_key():
+    """One deliberately WIDE key (512 ops, overlap 16, corrupted so it
+    must ride the device): appended to the config-3 batch it forces
+    the single fused batch to pad all other keys to its dims — the
+    mixed-size shape the bucketed scheduler (checker/bucket.py)
+    exists for.  Corrupted EARLY (at=0.35): padding efficiency is a
+    function of dims alone, while verdict-search cost scales with the
+    obstruction depth — a late corruption made the probe's two passes
+    cost minutes of pure search on a cold CPU."""
+    from jepsen_tpu.history import encode_ops
+    from jepsen_tpu.models import cas_register
+    from jepsen_tpu.synth import corrupt_read, register_history
+
+    model = cas_register()
+    rng = random.Random("bench-batch-wide")
+    h = register_history(rng, n_ops=512, n_procs=16, overlap=16,
+                         crash_p=0.01, max_crashes=2, n_values=6)
+    return encode_ops(corrupt_read(rng, h, at=0.35), model.f_codes)
+
+
+def _batch_bucketed(lin, seqs, model, budget, direct_results,
+                    left_s: float | None = None) -> dict:
+    """ISSUE 2 acceptance evidence: the mixed-size batch (config 3
+    shape plus one wide outlier key), bucketed vs single-fused —
+    verdict parity, padding efficiency both ways (useful_ops /
+    padded_ops), per-bucket detail, and kernel-cache hit counts.
+
+    Cost containment (the probe must never eat the batch tier): it
+    runs on a config-3 SUBSET (BENCH_BUCKET_KEYS, default 16), with
+    its own config-budget cap (search_batch has no wall-clock cancel,
+    so the budget is the bound — exhausted keys report "unknown" in
+    BOTH passes, parity intact), and it is skipped outright when the
+    tier has under ~30s left (``left_s``)."""
+    if left_s is not None and left_s < 30.0:
+        return {"skipped": f"tier budget exhausted ({left_s:.0f}s left)"}
+    n_sub = int(os.environ.get("BENCH_BUCKET_KEYS", "16"))
+    seqs = seqs[:n_sub]
+    direct_results = direct_results[:n_sub]
+    budget = min(budget, 500_000)
+    mixed = seqs + [_wide_outlier_key()]
+    t0 = time.perf_counter()
+    r_fused = lin.search_batch(mixed, model, budget=budget,
+                               bucket=False)
+    t_fused = time.perf_counter() - t0
+    t0 = time.perf_counter()
+    r_buck = lin.search_batch(mixed, model, budget=budget, bucket=True)
+    t_buck = time.perf_counter() - t0
+    st = r_buck[0].get("bucket_batch") or {}
+    return {
+        "n_keys": len(mixed),
+        "t_fused": round(t_fused, 3),
+        "t_bucketed": round(t_buck, 3),
+        "verdicts_agree_fused": all(
+            a["valid"] == b["valid"] for a, b in zip(r_fused, r_buck)),
+        # the plain config-3 results (no outlier) must agree too —
+        # bucketing may only relabel work, never flip a verdict.
+        # Judged on keys the probe DECIDED (its budget is capped below
+        # the direct pass's; an unknown is a budget artifact, not a
+        # flip — same convention as the decomposed comparison)
+        "verdicts_agree_direct": all(
+            a["valid"] == d["valid"] for a, d in
+            zip(r_buck[:len(direct_results)], direct_results)
+            if a["valid"] in (True, False)),
+        "n_buckets": st.get("n_buckets"),
+        "padding_efficiency_bucketed": st.get("padding_efficiency"),
+        "padding_efficiency_fused": st.get("fused_padding_efficiency"),
+        "per_bucket": st.get("buckets"),
+        "kernel_cache": st.get("kernel_cache"),
+    }
+
+
 def _single_decomposed(seq, model, budget, direct_valid,
                        t_direct) -> dict:
     """Config 5 decomposed-vs-direct: value partitioning + quiescence
@@ -666,9 +766,12 @@ def _child_platform_pin():
         jax.config.update("jax_platforms", "cpu")
     try:
         # persistent XLA compile cache: repeated bench runs (and the
-        # CPU-retry child) skip recompilation
+        # CPU-retry child) skip recompilation.  The env knob shares
+        # one cache dir with the CLI's --compile-cache-dir so every
+        # process family warms the same store.
         jax.config.update("jax_compilation_cache_dir",
-                          os.path.join(REPO, ".jax_cache"))
+                          os.environ.get("JEPSEN_TPU_COMPILE_CACHE_DIR")
+                          or os.path.join(REPO, ".jax_cache"))
     except Exception:
         pass
     return jax
@@ -683,6 +786,7 @@ def run_tier_child(name: str, budget: int) -> None:
 
     if name == "batch256":
         seqs, model = make_batch()
+        t_tier0 = time.perf_counter()
         t0 = time.perf_counter()
         results = lin.search_batch(seqs, model, budget=budget)
         t_first = t_dev = time.perf_counter() - t0
@@ -699,6 +803,12 @@ def run_tier_child(name: str, budget: int) -> None:
                                  t_dev)
                if os.environ.get("BENCH_DECOMPOSE", "1") != "0"
                else None)
+        buck = (_batch_bucketed(
+                    lin, seqs, model, budget, results,
+                    left_s=tier_deadline - (time.perf_counter()
+                                            - t_tier0))
+                if os.environ.get("BENCH_BUCKETS", "1") != "0"
+                else None)
         print(json.dumps({
             "configs": sum(r["configs"] for r in results),
             "t_dev": t_dev, "t_first": t_first,
@@ -710,6 +820,7 @@ def run_tier_child(name: str, budget: int) -> None:
             "n_ops": n_ops, "n_keys": len(seqs),
             "backend": jax.default_backend(),
             "decomposed": dec,
+            "bucketed": buck,
         }), flush=True)
         return
 
@@ -920,7 +1031,7 @@ def run_tier_child(name: str, budget: int) -> None:
     dec = (_single_decomposed(seq, model, budget, out["valid"],
                               prior_elapsed + t_dev
                               if resumed else t_dev)
-           if (name in ("10k", "10k64")
+           if (name in ("10k", "10k64", "10kuniq")
                and os.environ.get("BENCH_DECOMPOSE", "1") != "0")
            else None)
     print(json.dumps({
@@ -1026,6 +1137,7 @@ def batch_detail(res: dict, host: dict, t_dev: float) -> dict:
         "device_seconds_incl_compile": round(res["t_first"], 3),
         "keys_per_sec": round(res["n_keys"] / t_dev, 1),
         "decomposed": res.get("decomposed"),
+        "bucketed": res.get("bucketed"),
         **batch_stats(res, host, t_dev),
     }
 
@@ -1062,19 +1174,22 @@ def host_comparators(tiers) -> dict:
     cores = os.cpu_count() or 1
     n_procs = min(16, cores)
     out: dict = {"host_cpus": cores}
-    # batch has its own pool comparator below.  The wide tier (10k64)
-    # runs LAST with its own env-tunable cap instead of a share — it
-    # must never dilute the 10k's cap below its ~52s decide time, but
-    # it must also never ship comparator-free (VERDICT r4 weak #4: an
-    # unknown verdict with host_linear null is a row with no meaning);
-    # an undecided host run still reports seconds + configs.
+    # batch has its own pool comparator below.  The wide tiers (10k64,
+    # 10kuniq) run LAST with their own env-tunable caps instead of a
+    # share — they must never dilute the 10k's cap below its ~52s
+    # decide time, but must also never ship comparator-free (VERDICT
+    # r4 weak #4: an unknown verdict with host_linear null is a row
+    # with no meaning); an undecided host run still reports seconds +
+    # configs.
+    late = ("10k64", "10kuniq")
     measured = [t for t in tiers
-                if not t[0].startswith("batch") and t[0] != "10k64"]
+                if not t[0].startswith("batch") and t[0] not in late]
     share = HOST_S / max(1, len(measured))
-    wide = [t for t in tiers if t[0] == "10k64"]
+    wide = [t for t in tiers if t[0] in late]
     for name, _n_ops, _p, _b, _h, _t in measured + wide:
-        if name == "10k64":
-            share = float(os.environ.get("BENCH_HOST_10K64_S", "150"))
+        if name in late:
+            share = float(os.environ.get(
+                f"BENCH_HOST_{name.upper()}_S", "150"))
         seq, model = make_seq(name)
         cap = max(10.0, min(share, _remaining() - 120))
         t0 = time.perf_counter()

@@ -1248,6 +1248,7 @@ def search_opseq_sharded(seq: OpSeq, model: ModelSpec, mesh, *,
                     tuple(d.id for d in mesh.devices.flat))
         key = (model.name, dims, axis, mesh_key, _dominance_key())
         fn = _SHARDED_CACHE.get(key)
+        KERNEL_CACHE_STATS["hits" if fn is not None else "misses"] += 1
         if fn is None:
             fn = jax.jit(build_sharded_search_step_fn(
                 model, dims, mesh, axis))
@@ -1326,6 +1327,17 @@ def search_opseq_sharded(seq: OpSeq, model: ModelSpec, mesh, *,
 # ---------------------------------------------------------------------------
 
 _KERNEL_CACHE: dict = {}
+
+#: compiled-kernel cache accounting across get_kernel/get_batch_kernel/
+#: the sharded cache — the bucketed batch scheduler's bench evidence
+#: that steady-state runs never retrace (a memoized kernel costs a dict
+#: lookup; a miss costs a trace + XLA compile)
+KERNEL_CACHE_STATS = {"hits": 0, "misses": 0}
+
+
+def kernel_cache_stats() -> dict:
+    """Snapshot of the process-lifetime kernel-cache counters."""
+    return dict(KERNEL_CACHE_STATS)
 
 #: initial BFS levels per device call; the driver adapts from here so
 #: each call lands near _SLICE_TARGET_S seconds of device time (axon
@@ -1509,6 +1521,7 @@ def get_kernel(model: ModelSpec, dims: SearchDims):
     key = (model.name, dims, _dominance_key(),
            "pallas" if use_p else "xla")
     fn = _KERNEL_CACHE.get(key)
+    KERNEL_CACHE_STATS["hits" if fn is not None else "misses"] += 1
     if fn is None:
         if use_p:
             from . import pallas_level
@@ -2157,6 +2170,7 @@ def get_batch_kernel(model: ModelSpec, dims: SearchDims,
     key = ("batch", model.name, dims, sel, _dominance_key(),
            "pallas" if use_p else "xla")
     fn = _KERNEL_CACHE.get(key)
+    KERNEL_CACHE_STATS["hits" if fn is not None else "misses"] += 1
     if fn is None:
         if use_p:
             # vmap of the fused level-loop kernel: the pallas batching
@@ -2322,7 +2336,8 @@ def search_batch(seqs: list[OpSeq], model: ModelSpec, *,
                  dims: SearchDims | None = None,
                  sharding=None,
                  decompose: bool = False,
-                 decompose_cache=None) -> list[dict]:
+                 decompose_cache=None,
+                 bucket: bool | None = None) -> list[dict]:
     """Check a batch of independent per-key histories in one device call.
 
     This is the TPU analog of jepsen.independent's bounded-pmap over
@@ -2339,13 +2354,31 @@ def search_batch(seqs: list[OpSeq], model: ModelSpec, *,
     shapes within the batch run once, and only the remaining distinct
     shapes ride to the device.  ``decompose_cache`` is a VerdictCache,
     a jsonl path, or None for an in-memory cache (dedup only).
+
+    ``bucket`` selects the shape-bucketed scheduler (checker/bucket.py):
+    keys group by their power-of-two-rounded SearchDims bucket and each
+    bucket runs at its own tight dims with pipelined host prep, instead
+    of every key padding to the batch-wide max.  ``None`` follows the
+    JEPSEN_TPU_BATCH_BUCKETS env knob (default on); bucketing is
+    verdict-identical either way and applies only to the ladder path
+    (explicit ``dims`` or a mesh ``sharding`` pin the fused shape).
     """
     if not seqs:
         return []
     if decompose:
         return _search_batch_decomposed(seqs, model, budget=budget,
                                         dims=dims, sharding=sharding,
-                                        cache=decompose_cache)
+                                        cache=decompose_cache,
+                                        bucket=bucket)
+    if bucket is None and sharding is None and dims is None \
+            and len(seqs) > 1:
+        from .bucket import bucketing_enabled
+
+        bucket = bucketing_enabled()
+    if bucket and sharding is None and dims is None:
+        from .bucket import search_batch_bucketed
+
+        return search_batch_bucketed(seqs, model, budget=budget)
     # greedy completion-order witnesses dispose of well-behaved keys
     # host-side in O(n); only contentious keys ride to the device
     results_by_idx: dict = {}
@@ -2361,7 +2394,7 @@ def search_batch(seqs: list[OpSeq], model: ModelSpec, *,
         return [results_by_idx[i] for i in range(len(seqs))]
     if results_by_idx:
         sub = search_batch([seqs[i] for i in rest], model, budget=budget,
-                           dims=dims, sharding=sharding)
+                           dims=dims, sharding=sharding, bucket=False)
         for i, r in zip(rest, sub):
             results_by_idx[i] = r
         return [results_by_idx[i] for i in range(len(seqs))]
@@ -2388,7 +2421,6 @@ def search_batch(seqs: list[OpSeq], model: ModelSpec, *,
     # frontier; the ladder path starts narrow and escalates in batches
     dims = dims or batch_dims(
         ess, model, frontier=64 if sharding is not None else 32)
-    pending: list[int] = []
 
     if sharding is not None:
         # mesh-sharded batches stay on the XLA kernel: partitioning a
@@ -2436,84 +2468,113 @@ def search_batch(seqs: list[OpSeq], model: ModelSpec, *,
         configs = gather(carry[3])
         depth = gather(carry[4])
         ovf = gather(carry[5])
-    else:
-        # batched escalation ladder: every pending key runs at the
-        # current frontier rung; keys that overflow it re-run TOGETHER
-        # at 4x width (one kernel call per rung, not one solo search
-        # per overflowing key — solo re-runs each pay dispatch/compile,
-        # which is exactly what hurts on a real accelerator).  Keys
-        # still overflowing past the rung cap fall back to the solo
-        # adaptive ladder.
-        esps = [pad_search(e, dims.n_det_pad, dims.n_crash_pad)
-                for e in ess]
-        n = len(seqs)
-        status = np.full(n, UNKNOWN, np.int32)
-        count = np.zeros(n, np.int32)
-        configs = np.zeros(n, np.int64)
-        depth = np.zeros(n, np.int32)
-        ovf = np.zeros(n, bool)
-        pending = list(range(n))
-        spent = np.zeros(n, np.int64)  # configs across ALL rungs
-        rung = dims.frontier
-        used_pallas = False  # any rung executed on the pallas engine
-        while pending:
-            d = _dc_replace(dims, frontier=rung)
-            want_pallas = _use_pallas(model, d)
-            fnr = get_batch_kernel(model, d, batch=len(pending))
-            try:
-                st, ct, cf, dp, ov = _drive_batch_compacting(
-                    fnr, [esps[i] for i in pending], model, d, budget,
-                    bail=True)
-            except Exception as e:  # noqa: BLE001 — engine fallback
-                global _PALLAS_BROKEN
-                if _use_pallas(model, d) and not _PALLAS_BROKEN:
-                    # first hardware contact for the pallas batch path
-                    # happens inside a tunnel window; a lowering bug
-                    # must cost one rung rebuild, not the batch tier
-                    _PALLAS_BROKEN = True
-                    _trace(f"pallas batch kernel failed ({e!r}); "
-                           "falling back to xla engine")
-                    fnr = get_batch_kernel(model, d,
-                                           batch=len(pending))
-                    st, ct, cf, dp, ov = _drive_batch_compacting(
-                        fnr, [esps[i] for i in pending], model, d,
-                        budget, bail=True)
-                else:
-                    raise
-            used_pallas = used_pallas or (want_pallas
-                                          and not _PALLAS_BROKEN)
-            nxt = []
-            for j, i in enumerate(pending):
-                spent[i] += int(cf[j])
-                if st[j] == -1 and bool(ov[j]) and spent[i] < budget:
-                    nxt.append(i)  # overflowed this rung: escalate
-                else:
-                    # configs reports cumulative exploration across
-                    # rungs, and the per-key budget bounds the total —
-                    # a key never escalates once its cumulative spend
-                    # crosses it (worst case: budget + one rung)
-                    status[i], count[i] = st[j], ct[j]
-                    configs[i] = spent[i]
-                    depth[i], ovf[i] = dp[j], ov[j]
-            pending = nxt
-            if pending and rung >= BATCH_FRONTIER_CAP:
-                break  # stragglers go solo below
-            rung = min(rung * 4, BATCH_FRONTIER_CAP)
-    # host-side finalization of still -1 statuses (dead frontier or
-    # exhausted budget), mirroring _run_kernel
-    status = np.where(
+        status = _finalize_batch_status(status, count, ovf)
+        out = []
+        for i in range(len(seqs)):
+            if int(status[i]) == UNKNOWN and bool(ovf[i]):
+                # overflowed the fixed mesh shape: redo solo with the
+                # adaptive ladder
+                out.append(search_opseq(seqs[i], model, budget=budget))
+            else:
+                out.append({"valid": _STATUS[int(status[i])],
+                            "configs": int(configs[i]),
+                            "max_depth": int(depth[i]),
+                            "engine": "device-batch"})
+        return out
+    esps = [pad_search(e, dims.n_det_pad, dims.n_crash_pad)
+            for e in ess]
+    return _search_batch_ladder(seqs, esps, model, dims, budget)
+
+
+def _finalize_batch_status(status, count, ovf):
+    """Host-side finalization of still -1 statuses (dead frontier or
+    exhausted budget), mirroring _run_kernel — the ONE rule both the
+    sharded and ladder batch paths apply."""
+    return np.where(
         status == -1,
         np.where(count <= 0, np.where(ovf, UNKNOWN, INVALID), UNKNOWN),
         status)
+
+
+def _search_batch_ladder(seqs: list[OpSeq], esps: list[EncodedSearch],
+                         model: ModelSpec, dims: SearchDims,
+                         budget: int) -> list[dict]:
+    """The batched escalation ladder — `search_batch`'s device path for
+    un-meshed batches, taking PRE-PADDED EncodedSearches at ``dims``.
+
+    This is also the entry point the bucketed scheduler
+    (checker/bucket.py) feeds directly: per-bucket host prep (greedy
+    witnesses, encoding, padding) happens in its pipeline thread, and
+    this function only pays the device work.
+
+    Every pending key runs at the current frontier rung; keys that
+    overflow it re-run TOGETHER at 4x width (one kernel call per rung,
+    not one solo search per overflowing key — solo re-runs each pay
+    dispatch/compile, which is exactly what hurts on a real
+    accelerator).  Keys still overflowing past the rung cap fall back
+    to the solo adaptive ladder.
+    """
+    global _PALLAS_BROKEN
+    n = len(seqs)
+    status = np.full(n, UNKNOWN, np.int32)
+    count = np.zeros(n, np.int32)
+    configs = np.zeros(n, np.int64)
+    depth = np.zeros(n, np.int32)
+    ovf = np.zeros(n, bool)
+    pending = list(range(n))
+    spent = np.zeros(n, np.int64)  # configs across ALL rungs
+    rung = dims.frontier
+    used_pallas = False  # any rung executed on the pallas engine
+    while pending:
+        d = _dc_replace(dims, frontier=rung)
+        want_pallas = _use_pallas(model, d)
+        fnr = get_batch_kernel(model, d, batch=len(pending))
+        try:
+            st, ct, cf, dp, ov = _drive_batch_compacting(
+                fnr, [esps[i] for i in pending], model, d, budget,
+                bail=True)
+        except Exception as e:  # noqa: BLE001 — engine fallback
+            if _use_pallas(model, d) and not _PALLAS_BROKEN:
+                # first hardware contact for the pallas batch path
+                # happens inside a tunnel window; a lowering bug
+                # must cost one rung rebuild, not the batch tier
+                _PALLAS_BROKEN = True
+                _trace(f"pallas batch kernel failed ({e!r}); "
+                       "falling back to xla engine")
+                fnr = get_batch_kernel(model, d,
+                                       batch=len(pending))
+                st, ct, cf, dp, ov = _drive_batch_compacting(
+                    fnr, [esps[i] for i in pending], model, d,
+                    budget, bail=True)
+            else:
+                raise
+        used_pallas = used_pallas or (want_pallas
+                                      and not _PALLAS_BROKEN)
+        nxt = []
+        for j, i in enumerate(pending):
+            spent[i] += int(cf[j])
+            if st[j] == -1 and bool(ov[j]) and spent[i] < budget:
+                nxt.append(i)  # overflowed this rung: escalate
+            else:
+                # configs reports cumulative exploration across
+                # rungs, and the per-key budget bounds the total —
+                # a key never escalates once its cumulative spend
+                # crosses it (worst case: budget + one rung)
+                status[i], count[i] = st[j], ct[j]
+                configs[i] = spent[i]
+                depth[i], ovf[i] = dp[j], ov[j]
+        pending = nxt
+        if pending and rung >= BATCH_FRONTIER_CAP:
+            break  # stragglers go solo below
+        rung = min(rung * 4, BATCH_FRONTIER_CAP)
+    status = _finalize_batch_status(status, count, ovf)
     out = []
-    ladder = sharding is None
-    batch_engine = _engine_label(ladder and used_pallas,
-                                 base="device-batch")
-    solo = set(pending) if ladder else set()
-    for i in range(len(seqs)):
+    batch_engine = _engine_label(used_pallas, base="device-batch")
+    solo = set(pending)
+    for i in range(n):
         needs_solo = i in solo or (int(status[i]) == UNKNOWN
                                    and bool(ovf[i]))
-        if needs_solo and ladder and spent[i] >= budget:
+        if needs_solo and spent[i] >= budget:
             # cumulative ladder spend already exhausted this key's
             # budget: a solo re-run would amplify work past the cap.
             # UNKNOWN stands, with the true cumulative count.
@@ -2524,10 +2585,9 @@ def search_batch(seqs: list[OpSeq], model: ModelSpec, *,
             # overflowed every shared rung: redo solo with the adaptive
             # ladder, on the REMAINING budget, reporting cumulative
             # configs (ladder spend + solo spend)
-            rem = budget - int(spent[i]) if ladder else budget
+            rem = budget - int(spent[i])
             r = search_opseq(seqs[i], model, budget=max(1000, rem))
-            if ladder:
-                r["configs"] = int(r.get("configs", 0)) + int(spent[i])
+            r["configs"] = int(r.get("configs", 0)) + int(spent[i])
             out.append(r)
         else:
             out.append({"valid": _STATUS[int(status[i])],
@@ -2539,7 +2599,7 @@ def search_batch(seqs: list[OpSeq], model: ModelSpec, *,
 
 def _search_batch_decomposed(seqs: list[OpSeq], model: ModelSpec, *,
                              budget: int, dims, sharding,
-                             cache) -> list[dict]:
+                             cache, bucket=None) -> list[dict]:
     """Cache + dedup front-end for `search_batch` (decompose=True).
 
     Exact by construction: a canonical-hash collision means the two
@@ -2570,7 +2630,7 @@ def _search_batch_decomposed(seqs: list[OpSeq], model: ModelSpec, *,
             todo.append(i)
     if todo:
         sub = search_batch([seqs[i] for i in todo], model, budget=budget,
-                           dims=dims, sharding=sharding)
+                           dims=dims, sharding=sharding, bucket=bucket)
         for i, r in zip(todo, sub):
             results[i] = r
             if r.get("valid") in (True, False):
@@ -2612,8 +2672,11 @@ def _search_batch_decomposed(seqs: list[OpSeq], model: ModelSpec, *,
              "cache_misses": cache.misses, "deduped": n_dup,
              "searched": len(todo),
              "hit_rate": round(cache.hits / max(1, len(seqs)), 4)}
-    for r in out:
-        r.setdefault("decompose_batch", stats)
+    # first result only — attaching one shared mutable dict to every
+    # key invites spooky cross-key mutation and serializes the stats
+    # N times through per-key stores (same convention as bucket_batch)
+    if out:
+        out[0].setdefault("decompose_batch", stats)
     return out
 
 
@@ -2735,12 +2798,20 @@ class Linearizable:
         seq = history if isinstance(history, OpSeq) else \
             encode_ops(history, model.f_codes)
         if self.decompose:
-            from ..decompose.cache import default_cache_path
+            from ..decompose.cache import VerdictCache, default_cache_path
             from ..decompose.engine import check_opseq_decomposed
 
             cache = self.verdict_cache
             if cache is True:
                 cache = default_cache_path()
+            if isinstance(cache, str):
+                # construct the cache ONCE per checker, not per check():
+                # each construction re-parses the whole append-only
+                # jsonl, which grows with every decided verdict
+                if getattr(self, "_cache_obj", None) is None or \
+                        self._cache_obj.path != cache:
+                    self._cache_obj = VerdictCache(cache)
+                cache = self._cache_obj
             sub_check = None
             if self.algorithm == "host":
                 # honor the selected host engine for sub-searches too;

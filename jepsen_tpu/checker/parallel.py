@@ -10,9 +10,10 @@ Two shapes, mirroring how the reference actually parallelizes
 (SURVEY.md §2.3):
 
 * :func:`portfolio_check` — ONE history, ``n_procs`` processes racing
-  algorithm variants (the `linear` sweep plus WGL DFS under different
-  exploration orders); first conclusive verdict wins and the rest are
-  killed.  This is knossos `competition` scaled to a process pool: a
+  algorithm variants (the `linear` sweep, a P-compositional decomposed
+  leg, plus WGL DFS under different exploration orders); first
+  conclusive verdict wins and the rest are killed.  This is knossos
+  `competition` scaled to a process pool: a
   single history's search does not data-parallelize (the reference's
   answer is the same — it shards *keys*, not one search,
   independent.clj:66-111), so extra cores buy portfolio diversity, not
@@ -66,6 +67,27 @@ def _portfolio_worker(builder, builder_args, algo, seed, max_configs,
 
             r = check_opseq_linear(seq, model, max_configs=max_configs,
                                    decompose=decompose)
+        elif algo == "decompose":
+            from ..decompose.engine import check_opseq_decomposed
+            from ..decompose.partition import (partition_by_key,
+                                               quiescence_segments,
+                                               value_block_verdict)
+
+            # the dedicated decomposed leg: P-compositional splits +
+            # the canonical-hash verdict cache, racing the DIRECT legs
+            # (which ARE the fallback — direct=None here).  When NO
+            # cutter applies, the whole-history sub-search would be
+            # byte-for-byte the sibling `linear` leg's sweep, so the
+            # leg concedes "unknown" immediately instead of burning a
+            # core on duplicate work.
+            cells, _cm, early = partition_by_key(seq, model)
+            if (early is None and cells is None
+                    and value_block_verdict(seq, model) is None
+                    and len(quiescence_segments(seq)) <= 1):
+                r = {"valid": "unknown", "info": "nothing decomposes"}
+            else:
+                r = check_opseq_decomposed(seq, model,
+                                           sub_max_configs=max_configs)
         else:
             from . import seq as seqmod
 
@@ -96,7 +118,14 @@ def portfolio_check(builder, builder_args=(), *, n_procs: int = 16,
     q = ctx.Queue()
     go = ctx.Event()
     legs = [("linear", 0)]
-    legs += [("wgl", s) for s in range(n_procs - 1)]
+    if n_procs >= 3 and not decompose:
+        # a dedicated decomposed leg races the direct legs (first
+        # conclusive verdict wins): cells/value-blocks/quiescence cuts
+        # win exactly the structured histories that strand a direct
+        # sweep.  Redundant when ``decompose`` already wraps every leg;
+        # at n_procs == 2 the classic linear+wgl pairing stands.
+        legs.append(("decompose", 0))
+    legs += [("wgl", s) for s in range(max(0, n_procs - len(legs)))]
     procs = []
     readies = []
     for algo, seed in legs[:n_procs]:

@@ -72,6 +72,13 @@ def add_test_opts(p: argparse.ArgumentParser) -> None:
                         "canonical-hash verdict cache.  Verdict-"
                         "identical; sets JEPSEN_TPU_LIN_DECOMPOSE so "
                         "every suite-constructed checker honors it.")
+    p.add_argument("--compile-cache-dir", metavar="DIR", default=None,
+                   help="Persistent JAX compilation-cache directory "
+                        "(jax_compilation_cache_dir): compiled search "
+                        "kernels survive across processes, so repeat "
+                        "runs and the bucketed batch scheduler's "
+                        "steady-state buckets never retrace.  Also "
+                        "honored from JEPSEN_TPU_COMPILE_CACHE_DIR.")
 
 
 def add_tarball_opt(p: argparse.ArgumentParser, default: str | None = None,
@@ -129,6 +136,15 @@ def test_opt_fn(parsed: argparse.Namespace) -> dict:
         # selector (JEPSEN_TPU_LIN_ALGORITHM)
         os.environ["JEPSEN_TPU_LIN_DECOMPOSE"] = "1"
         opts["lin_decompose"] = True
+    ccd = opts.get("compile_cache_dir")
+    if ccd:
+        # the env var carries the setting into spawned workers/children;
+        # the config update applies it to THIS process (deferred import:
+        # the CLI must not pay backend init for --help)
+        os.environ["JEPSEN_TPU_COMPILE_CACHE_DIR"] = ccd
+        from .util import enable_compilation_cache
+
+        enable_compilation_cache(ccd)
     return opts
 
 

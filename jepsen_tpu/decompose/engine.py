@@ -317,16 +317,29 @@ def check_opseq_decomposed(seq: OpSeq, model: ModelSpec, *,
             left = (max(0.1, deadline - time.perf_counter())
                     if deadline is not None else None)
             if scheduler == "pool":
-                verdicts = schedule.pool_check_cells(
+                verdicts, pool_configs = schedule.pool_check_cells(
                     cell_list, cell_model, n_procs=n_procs,
                     cache_path=getattr(cache, "path", None),
                     max_configs=sub_max_configs, deadline_s=left)
+                # workers report their explored configs; billing them
+                # keeps pool-scheduled accounting as honest as the
+                # device branch's
+                stats["configs_searched"] += int(pool_configs)
             else:
                 if deadline is not None and \
                         time.perf_counter() >= deadline:
                     raise _Inconclusive("deadline before device batch")
-                verdicts = schedule.device_batch_cells(
+                cell_results = schedule.device_batch_cells(
                     cell_list, cell_model, budget=sub_max_configs)
+                verdicts = [r.get("valid") for r in cell_results]
+                # the device engine's full per-cell dicts keep the
+                # accounting honest through the decomposed path:
+                # explored configs are billed, and the engines that
+                # actually ran are named
+                stats["configs_searched"] += sum(
+                    int(r.get("configs", 0) or 0) for r in cell_results)
+                stats["cell_engines"] = sorted(
+                    {str(r.get("engine")) for r in cell_results})
             methods.add(scheduler)
             # one invalid cell decides the whole history (locality) —
             # a decided False must win over an undecided sibling, not

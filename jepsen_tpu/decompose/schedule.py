@@ -12,7 +12,9 @@ its whole runtime to the tail), over either
   checker with the shared on-disk verdict cache; or
 * :func:`device_batch_cells` — the batched device engine
   (checker/linearizable.search_batch), which vmaps the cells over the
-  key axis in one compiled search.
+  key axis; `search_batch` routes through the shape-bucketed scheduler
+  (checker/bucket.py) by default, so cells of different sizes run at
+  their own tight dims instead of all padding to the widest cell.
 
 Quiescence segments are NOT scheduler units: they compose sequentially
 through carried state sets, so they run inside their cell's worker.
@@ -84,13 +86,18 @@ def _unpack_cell(cols: tuple) -> OpSeq:
 def _pool_worker(desc, packed, idxs, cache_path, max_configs, q):
     os.environ.setdefault("JAX_PLATFORMS", "cpu")  # never touch a TPU
     try:
+        from .cache import VerdictCache
         from .engine import check_opseq_decomposed
 
         model = model_from_descriptor(desc)
+        # open the shared cache once per WORKER, not once per cell —
+        # passing the raw path would make every cell re-parse the whole
+        # append-only jsonl and hold its own append fd
+        cache = VerdictCache(cache_path) if cache_path else None
         for i in idxs:
             try:
                 r = check_opseq_decomposed(
-                    _unpack_cell(packed[i]), model, cache=cache_path,
+                    _unpack_cell(packed[i]), model, cache=cache,
                     sub_max_configs=max_configs)
                 q.put((i, r.get("valid"), int(r.get("configs", 0))))
             except Exception:  # noqa: BLE001 — one cell, not the pool
@@ -104,16 +111,20 @@ def pool_check_cells(cells: list[OpSeq], model: ModelSpec, *,
                      n_procs: int | None = None,
                      cache_path: str | None = None,
                      max_configs: int = 50_000_000,
-                     deadline_s: float | None = None) -> list:
-    """Verdict per cell via a process pool, largest-first striping.
+                     deadline_s: float | None = None
+                     ) -> tuple[list, int]:
+    """(verdict per cell, total explored configs) via a process pool,
+    largest-first striping.
 
     Workers run the decomposed checker themselves (value blocks and
     quiescence cuts apply within each cell) against the shared on-disk
     cache; appends are line-atomic, so concurrent writers only ever
-    duplicate equal entries."""
+    duplicate equal entries.  The configs total is what the workers
+    actually reported — the caller's accounting must not claim zero
+    search after millions of explored configs."""
     n = len(cells)
     if n == 0:
-        return []
+        return [], 0
     n_procs = max(1, min(n_procs or min(16, os.cpu_count() or 1), n))
     order = sorted(range(n), key=lambda i: -len(cells[i]))
     packed = {i: _pack_cell(cells[i]) for i in range(n)}
@@ -138,29 +149,49 @@ def pool_check_cells(cells: list[OpSeq], model: ModelSpec, *,
         if t_end is not None and time.monotonic() >= t_end:
             break
         try:
-            i, v, _c = q.get(timeout=1.0)
-            out[i] = v
+            i, v, c = q.get(timeout=1.0)
+            out[i] = (v, c)
         except _queue.Empty:
             if not any(p.is_alive() for p in procs):
-                # drain anything that raced the liveness check
-                try:
-                    while True:
-                        i, v, _c = q.get_nowait()
-                        out[i] = v
-                except _queue.Empty:
-                    break
+                break
+    # completed verdicts that raced the deadline or the liveness check
+    # must not be reported "unknown": one final non-blocking drain
+    # before the workers are terminated
+    _drain_queue(q, out)
     for p in procs:
         p.terminate()
     for p in procs:
         p.join(timeout=5.0)
-    return [out.get(i, "unknown") for i in range(n)]
+    return ([out.get(i, ("unknown", 0))[0] for i in range(n)],
+            sum(int(c) for _v, c in out.values()))
+
+
+def _drain_queue(q, out: dict) -> None:
+    """Collect every already-enqueued (idx, verdict, configs) triple
+    without blocking."""
+    try:
+        while True:
+            i, v, c = q.get_nowait()
+            out[i] = (v, c)
+    except _queue.Empty:
+        pass
 
 
 def device_batch_cells(cells: list[OpSeq], model: ModelSpec, *,
-                       budget: int = 2_000_000) -> list:
-    """Verdict per cell via the batched device engine, largest-first
-    (the batch pads every key to the widest dims, so the order is about
-    the escalation ladder retiring big keys early, not padding)."""
+                       budget: int = 2_000_000) -> list[dict]:
+    """FULL result dict per cell via the batched device engine.
+
+    `search_batch` routes through the shape-bucketed scheduler
+    (checker/bucket.py) by default, and cells are exactly the
+    small-uniform shapes bucketing rewards: each bucket runs at its
+    own tight dims instead of every cell padding to the widest one.
+    The largest-first order is about the escalation ladder retiring
+    big cells early within a bucket.
+
+    Returns the per-cell dicts as the engines produced them (valid,
+    configs, engine, max_depth; bucket_batch stats on the first) so
+    the caller's bench accounting stays honest through the decomposed
+    path."""
     from ..checker.linearizable import search_batch
 
     n = len(cells)
@@ -169,7 +200,13 @@ def device_batch_cells(cells: list[OpSeq], model: ModelSpec, *,
     order = sorted(range(n), key=lambda i: -len(cells[i]))
     results = search_batch([cells[i] for i in order], model,
                            budget=budget)
-    out = [None] * n
+    out: list = [None] * n
     for pos, i in enumerate(order):
-        out[i] = results[pos].get("valid")
+        out[i] = results[pos]
+    # bucket_batch stats ride the first result of the REORDERED batch
+    # (the largest cell); move them to output slot 0 so callers can
+    # find them without knowing the schedule order
+    st = results[0].pop("bucket_batch", None)
+    if st is not None:
+        out[0].setdefault("bucket_batch", st)
     return out

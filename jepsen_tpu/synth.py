@@ -28,13 +28,21 @@ from .history import Op, fail_op, info_op, invoke_op, ok_op
 def register_history(rng: random.Random, *, n_ops: int, n_procs: int,
                      overlap: int = 4, crash_p: float = 0.0,
                      max_crashes: int = 16, n_values: int = 5,
-                     cas: bool = True) -> list[Op]:
-    """Concurrent CAS-register history, valid by construction."""
+                     cas: bool = True,
+                     unique_writes: bool = False) -> list[Op]:
+    """Concurrent CAS-register history, valid by construction.
+
+    ``unique_writes`` draws every write value from a fresh counter
+    (starting at 1, so it never collides with a register's initial 0)
+    instead of ``[0, n_values)`` — the unique-writes register class the
+    per-value block decomposition (decompose/partition.py) is exact
+    on."""
     state = None
     h: list[Op] = []
     pending: dict[int, tuple] = {}
     n_crashed = 0
     done = 0
+    next_v = 1  # unique_writes counter
     crashed_procs: set[int] = set()
     while done < n_ops or pending:
         free = [p for p in range(n_procs)
@@ -45,9 +53,16 @@ def register_history(rng: random.Random, *, n_ops: int, n_procs: int,
             p = rng.choice(free)
             fs = ["read", "write"] + (["cas"] if cas else [])
             f = rng.choice(fs)
-            v = (None if f == "read"
-                 else rng.randrange(n_values) if f == "write"
-                 else (rng.randrange(n_values), rng.randrange(n_values)))
+            if f == "read":
+                v = None
+            elif f == "write":
+                if unique_writes:
+                    v = next_v
+                    next_v += 1
+                else:
+                    v = rng.randrange(n_values)
+            else:
+                v = (rng.randrange(n_values), rng.randrange(n_values))
             h.append(invoke_op(p, f, v))
             pending[p] = (f, v)
             done += 1
@@ -77,6 +92,31 @@ def register_history(rng: random.Random, *, n_ops: int, n_procs: int,
                 h.append(ok_op(p, f, v))
             else:
                 h.append(fail_op(p, f, v))
+    return h
+
+
+def swap_read_values(rng: random.Random, h: list[Op], *,
+                     min_gap: int | None = None) -> list[Op]:
+    """Swap the values of two ok reads of DIFFERENT values at least
+    ``min_gap`` events apart (default: a quarter of the history).
+
+    On a unique-writes history this forces block-order conflicts — a
+    value current in two separated stretches would need two writes —
+    which is the invalidity mode the per-value block decomposition's
+    cross-block acyclicity test exists to catch.  (`corrupt_read`'s
+    never-written value is rejected before any order reasoning.)"""
+    idx = [i for i, op in enumerate(h)
+           if op.type == "ok" and op.f == "read" and op.value is not None]
+    if len(idx) < 2:
+        return h
+    gap = len(h) // 4 if min_gap is None else min_gap
+    for _ in range(200):
+        i, j = sorted(rng.sample(idx, 2))
+        if j - i >= gap and h[i].value != h[j].value:
+            h = list(h)
+            h[i], h[j] = (replace(h[i], value=h[j].value),
+                          replace(h[j], value=h[i].value))
+            return h
     return h
 
 

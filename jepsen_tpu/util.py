@@ -24,6 +24,33 @@ def majority(n: int) -> int:
     return n // 2 + 1
 
 
+def enable_compilation_cache(path: str | None = None) -> str | None:
+    """Point JAX's persistent compilation cache at ``path``.
+
+    ``None`` falls back to the JEPSEN_TPU_COMPILE_CACHE_DIR env var.
+    With the cache set, compiled search kernels persist ACROSS
+    processes: the in-process kernel cache (checker/linearizable
+    ``_KERNEL_CACHE``) and the bucketed batch scheduler's per-(model,
+    dims, size-class) memoization already stop retracing within a run,
+    and this is what makes a restarted run (bench children, CLI test
+    repeats, tunnel-window retries) start warm too.  Safe before or
+    after backend init; returns the applied path, or None when no path
+    was given or the jax build lacks the knob."""
+    import os
+
+    if path is None:
+        path = os.environ.get("JEPSEN_TPU_COMPILE_CACHE_DIR") or None
+    if not path:
+        return None
+    try:
+        import jax
+
+        jax.config.update("jax_compilation_cache_dir", path)
+    except Exception:  # noqa: BLE001 — an old jax without the knob
+        return None
+    return path
+
+
 def real_pmap(f: Callable, xs: Iterable) -> list:
     """Map f over xs, one real thread per element (util.clj:45-51).
 

@@ -117,6 +117,31 @@ def test_wide_tier_is_last_and_not_headline():
     assert bench.TIERS[-1][4] is False
 
 
+def test_uniq_tier_exercises_value_blocks():
+    """ISSUE 2 satellite: the unique-writes wide tier must be exactly
+    10k encoded ops, quiescence-free, and ELIGIBLE for the per-value
+    block decomposition — so config 5's `applies: false` stops being
+    the only decomposition data point at device scale."""
+    from jepsen_tpu.decompose.partition import (quiescence_segments,
+                                                value_block_verdict)
+
+    seq, model = bench.make_seq("10kuniq")
+    assert len(seq) == 10_000
+    assert len(quiescence_segments(seq)) == 1  # no quiescent point
+    vb = value_block_verdict(seq, model)
+    assert vb in (True, False)  # the decomposition APPLIES
+    d = bench._single_decomposed(seq, model, 1_000_000, vb, 1.0)
+    assert d["applies"] is True
+    assert d["valid"] == vb
+    assert "value-blocks" in (d.get("methods") or [])
+    # not the headline, and ordered before the 10k64 straggler
+    names = [t[0] for t in bench.TIERS]
+    assert names.index("10k") < names.index("10kuniq") \
+        < names.index("10k64")
+    spec = {t[0]: t for t in bench.TIERS}["10kuniq"]
+    assert spec[4] is False
+
+
 def test_batch_tier_runs_before_the_10k():
     # the 10k is the search observed to wedge an open tunnel (r4); it
     # must not be able to cost batch256 its only accelerator window

@@ -1,0 +1,74 @@
+"""Static analysis over histories, search plans, and suites.
+
+Three passes, one O(n) substrate (the GPUexplore lesson, arXiv:1801.05857:
+validate on the cheap host before paying for accelerated search):
+
+  * :mod:`jepsen_tpu.analyze.lint` — well-formedness linter.  A single
+    O(n) scan over an event history (or an encoded OpSeq) producing
+    structured diagnostics with stable codes (H001 double-invoke, H002
+    orphan completion, ... M001 op unknown to model).  Wired on by
+    default into ``check_opseq``, ``check_opseq_linear``,
+    ``Linearizable.check``, ``search_batch`` and the decompose engine:
+    errors are fatal (:class:`HistoryLintError`), warnings ride the
+    result dict.  ``JEPSEN_TPU_LINT=0`` (or ``lint=False`` per call)
+    restores the old silent tolerance.
+
+  * :mod:`jepsen_tpu.analyze.plan` — search-plan explainer.
+    :func:`explain` predicts, without running anything, exactly what the
+    live engines would do: concurrency width, window, crash words,
+    ``SearchDims``, the shape bucket, which decompositions apply
+    (key-partition / value-blocks / quiescence), the engine route, and a
+    state-space upper bound.  The decomposition applicability gates LIVE
+    here and are consumed by ``decompose/partition.py`` — predictor and
+    engine cannot drift.
+
+  * :mod:`jepsen_tpu.analyze.suites` — suite protocol lint.  AST checks
+    over ``jepsen_tpu/suites/*`` (S-codes: invoke must return a typed
+    completion, no broad except converting crashes to determinate
+    verdicts, setup/teardown pairing, nemesis completions are :info).
+    ``tools/lint_suites.py`` is the standalone CLI;
+    ``tests/test_suite_lint.py`` gates the bundled suites in tier-1.
+
+``analyze(history, model)`` runs lint + plan in one call;
+``python -m jepsen_tpu.analyze history.jsonl --model cas-register
+--explain`` does the same from a stored history.
+"""
+
+from __future__ import annotations
+
+from .lint import (  # noqa: F401
+    Diagnostic,
+    HistoryLintError,
+    HistoryScan,
+    lint_enabled,
+    lint_history,
+    lint_opseq,
+    scan_events,
+)
+from .plan import explain, explain_batch  # noqa: F401
+
+
+def analyze(history, model=None) -> dict:
+    """Lint + plan in one call.
+
+    ``history`` is an event-level list of :class:`~jepsen_tpu.history.Op`
+    or an encoded :class:`~jepsen_tpu.history.OpSeq`.  Returns::
+
+        {"diagnostics": [Diagnostic...], "errors": n, "warnings": n,
+         "plan": {...} | None}
+
+    The plan is computed only when the history is well-formed enough to
+    encode (no error diagnostics) and a model is given.
+    """
+    from ..history import OpSeq
+
+    if isinstance(history, OpSeq):
+        diags = lint_opseq(history, model)
+    else:
+        diags = lint_history(history, model)
+    errors = [d for d in diags if d.severity == "error"]
+    plan = None
+    if model is not None and not errors:
+        plan = explain(history, model)
+    return {"diagnostics": diags, "errors": len(errors),
+            "warnings": len(diags) - len(errors), "plan": plan}

@@ -1,0 +1,102 @@
+"""``python -m jepsen_tpu.analyze`` — lint/explain a stored history.
+
+Reads a ``history.jsonl`` (store.write_history's format: one op per
+line), lints it, and with ``--explain`` prints the static search plan::
+
+    python -m jepsen_tpu.analyze store/t/latest/history.jsonl \\
+        --model cas-register --explain
+    python -m jepsen_tpu.analyze history.jsonl --json
+
+Exit codes follow cli.py's contract: 0 clean, 1 lint errors found,
+254 bad arguments.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+#: model factories reachable by name; parameterized ones take their
+#: knob from --model-arg
+MODELS = ("register", "cas-register", "mutex", "noop", "multi-register",
+          "unordered-queue", "fifo-queue")
+
+
+def _model(name: str, arg: int | None):
+    from .. import models
+
+    if name == "register":
+        return models.register(arg if arg is not None else 0)
+    if name == "cas-register":
+        return models.cas_register()
+    if name == "mutex":
+        return models.mutex()
+    if name == "noop":
+        return models.noop()
+    if name == "multi-register":
+        return models.multi_register(arg if arg is not None else 8)
+    if name == "unordered-queue":
+        return models.unordered_queue(arg if arg is not None else 16)
+    if name == "fifo-queue":
+        return models.fifo_queue(arg if arg is not None else 16)
+    raise ValueError(f"unknown model {name!r}; one of {MODELS}")
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(
+        prog="python -m jepsen_tpu.analyze",
+        description="Lint a stored history; --explain adds the static "
+                    "search plan (dims, bucket, engine route, "
+                    "decompositions).")
+    p.add_argument("history", help="history.jsonl path (one op/line)")
+    p.add_argument("--model", choices=MODELS, default=None,
+                   help="Model for the model-facing checks + plan")
+    p.add_argument("--model-arg", type=int, default=None,
+                   help="Model parameter (initial value / width / "
+                        "capacity)")
+    p.add_argument("--explain", action="store_true",
+                   help="Print the static search plan (needs --model)")
+    p.add_argument("--json", action="store_true", dest="as_json",
+                   help="Machine-readable output")
+    try:
+        opts = p.parse_args(argv)
+    except SystemExit as e:
+        return 0 if e.code in (0, None) else 254
+
+    from .. import store
+    from . import analyze
+    from .plan import render_plan
+
+    try:
+        history = store.read_history(opts.history)
+    except OSError as e:
+        print(f"cannot read {opts.history}: {e}", file=sys.stderr)
+        return 254
+    model = _model(opts.model, opts.model_arg) if opts.model else None
+    if opts.explain and model is None:
+        print("--explain needs --model", file=sys.stderr)
+        return 254
+
+    rep = analyze(history, model)
+    diags = rep["diagnostics"]
+    if opts.as_json:
+        out = {"errors": rep["errors"], "warnings": rep["warnings"],
+               "diagnostics": [d.to_dict() for d in diags]}
+        if opts.explain:
+            out["plan"] = rep["plan"]
+        print(json.dumps(out, indent=2, default=str))
+    else:
+        for d in diags:
+            print(f"{d.severity.upper()} {d}")
+        print(f"{rep['errors']} error(s), {rep['warnings']} warning(s) "
+              f"over {len(history)} events")
+        if opts.explain and rep["plan"] is not None:
+            print(render_plan(rep["plan"]))
+        elif opts.explain:
+            print("plan skipped: history has lint errors")
+    return 1 if rep["errors"] else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

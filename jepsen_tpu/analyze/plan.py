@@ -1,0 +1,327 @@
+"""Search-plan explainer — predict the search without paying for it.
+
+Everything the live engines decide host-side before (or instead of)
+dispatching the exponential search is derivable from one cheap scan:
+concurrency width, the real-time window, crash-word count, the quantized
+``SearchDims``, the shape bucket, the engine route, and which
+P-compositional decompositions (arXiv:1504.00204) apply.  :func:`explain`
+computes all of it statically — the dry-run cost model the ROADMAP's
+"measure bucketing on a real TPU window" item needs — and
+:func:`explain_batch` does the same for a batch, mirroring the bucketed
+scheduler's plan.
+
+Prediction = implementation: this module calls the engines' OWN
+primitives (``encode_search``, ``choose_dims``, ``batch_dims``,
+``bucket_key``, ``plan_buckets``, ``greedy_witness``) rather than
+re-deriving them, and the decomposition applicability gates
+(:func:`key_partition_applies`, :func:`value_block_gate`,
+:func:`quiescence_cuts`) live HERE and are consumed by
+``decompose/partition.py`` — so the plan a user reads is by construction
+the plan the engines execute (verified against recorded run stats in
+tests/test_analyze.py).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from ..history import NIL, OpSeq, encode_ops
+from ..models import R_READ, R_WRITE, ModelSpec
+
+# ---------------------------------------------------------------------------
+# Decomposition applicability gates — the ONE home (partition.py consumes)
+# ---------------------------------------------------------------------------
+
+
+def key_partition_applies(model: ModelSpec) -> bool:
+    """Herlihy–Wing locality applies to the multi-register model: each
+    key's projection checks independently as a single register."""
+    return model.name == "multi-register"
+
+
+def value_block_gate(seq: OpSeq, model: ModelSpec):
+    """Eligibility gate for the per-value block decomposition.
+
+    Returns ``(applies, reason, writes)``: ``reason`` names the first
+    disqualifier when ``applies`` is False; ``writes`` maps value ->
+    writing row (the scan's byproduct, reused by
+    ``partition.value_block_verdict`` so gate and verdict cannot
+    diverge).
+
+    Eligible class (partition.py's docstring, the P-compositionality
+    instance for registers): single-register model, every row :ok, only
+    read/write ops, every written value distinct and distinct from the
+    initial value.
+    """
+    if model.name not in ("register", "cas-register"):
+        return False, f"model {model.name!r} is not a single register", None
+    if not bool(np.asarray(seq.ok).all()):
+        return False, "crashed (:info) rows present", None
+    n = len(seq)
+    if n == 0:
+        return True, None, {}
+    f = np.asarray(seq.f)
+    if not bool(np.isin(f, (R_READ, R_WRITE)).all()):
+        return False, "non-read/write ops (cas or foreign codes)", None
+    v1 = np.asarray(seq.v1)
+    init = int(model.init[0])
+    writes: dict[int, int] = {}  # value -> row
+    for i in np.nonzero(f == R_WRITE)[0]:
+        v = int(v1[i])
+        if v == NIL:
+            return False, "write of NIL", None
+        if v == init:
+            return False, "write of the initial value", None
+        if v in writes:
+            return False, f"duplicate write of value {v}", None
+        writes[v] = int(i)
+    return True, None, writes
+
+
+def quiescence_cuts(seq: OpSeq) -> np.ndarray:
+    """Row indices where a quiescence cut lands (segment STARTS, 0
+    excluded): every op before row i returned before row i invokes
+    (``max(ret[..i-1]) < inv[i]``).  A crashed row's +inf return
+    suppresses every later cut.  Consumed by
+    ``partition.quiescence_segments`` (row ranges) and the plan."""
+    n = len(seq)
+    if n <= 1:
+        return np.zeros(0, dtype=np.int64)
+    inv = np.asarray(seq.inv, dtype=np.int64)
+    ret = np.asarray(seq.ret, dtype=np.int64)
+    run_max = np.maximum.accumulate(ret)
+    return np.nonzero(run_max[:-1] < inv[1:])[0] + 1
+
+
+def schedule_weight(seq: OpSeq) -> int:
+    """The cell schedulers' cost proxy (largest-first ordering in
+    decompose/schedule.py's host pool and device batch).
+
+    Row count — finer-grained than the bucket quantization's padded
+    rows (``bucket_key`` rounds n_det to a power of two, so many cells
+    tie) while strictly monotone with it; one home so the schedulers
+    and the plan explainer rank cells identically."""
+    return len(seq)
+
+
+# ---------------------------------------------------------------------------
+# The plan
+# ---------------------------------------------------------------------------
+
+
+def _dims_dict(dims) -> dict:
+    return {"n_det_pad": dims.n_det_pad, "n_crash_pad": dims.n_crash_pad,
+            "window": dims.window, "k": dims.k,
+            "state_width": dims.state_width, "frontier": dims.frontier}
+
+
+def _decompositions(seq: OpSeq, model: ModelSpec) -> dict:
+    """Which decompositions the engine (decompose/engine.py's funnel)
+    would apply, in funnel order: key partition -> per cell: value
+    blocks -> quiescence cuts."""
+    from ..decompose.partition import partition_by_key
+
+    out: dict = {}
+    cells_map = None
+    cell_model = model
+    if key_partition_applies(model):
+        cells_map, cell_model, early = partition_by_key(seq, model)
+        out["key_partition"] = {
+            "applies": True,
+            "cells": len(cells_map) if cells_map else 0,
+            "early_verdict": early,
+        }
+        if early is False or not cells_map:
+            out["value_blocks"] = {"applies": False,
+                                   "reason": "decided by key partition"}
+            out["quiescence"] = {"applies": False, "segments": 1}
+            return out
+    else:
+        out["key_partition"] = {"applies": False,
+                                "reason": f"model {model.name!r} is not "
+                                          f"multi-register"}
+    cells = list(cells_map.values()) if cells_map else [seq]
+
+    vb_cells = 0
+    vb_reason = None
+    segs_total = 0
+    cut_cells = 0
+    for cseq in cells:
+        applies, reason, _writes = value_block_gate(cseq, cell_model)
+        if applies:
+            vb_cells += 1
+        elif vb_reason is None:
+            vb_reason = reason
+        nsegs = len(quiescence_cuts(cseq)) + 1
+        segs_total += nsegs
+        if nsegs > 1:
+            cut_cells += 1
+    out["value_blocks"] = {"applies": vb_cells > 0,
+                           "eligible_cells": vb_cells}
+    if vb_reason is not None:
+        out["value_blocks"]["reason"] = vb_reason
+    out["quiescence"] = {"applies": segs_total > len(cells),
+                         "segments": segs_total,
+                         "cells_with_cuts": cut_cells}
+    return out
+
+
+def explain(history, model: ModelSpec, *,
+            frontier: int | None = None,
+            host_threshold: int = 48) -> dict:
+    """The static plan for ONE history: what the live engines would do.
+
+    ``history`` is an event-level Op list or an encoded OpSeq.
+    ``host_threshold`` mirrors ``Linearizable``'s small-history host
+    routing; ``frontier`` pins the initial frontier as
+    ``choose_dims`` would accept it.
+    """
+    from ..checker import linearizable as lin
+    from ..checker.bucket import bucket_key
+
+    seq = history if isinstance(history, OpSeq) else \
+        encode_ops(history, model.f_codes)
+    es = lin.encode_search(seq)
+    dims = lin.choose_dims(es, model, frontier=frontier)
+
+    greedy = lin.greedy_witness(seq, model)
+    device_ok = (es.window <= lin.MAX_WINDOW
+                 and es.n_crash <= lin.MAX_CRASH)
+    if es.n_det == 0 and es.n_crash == 0:
+        engine = "trivial"
+    elif greedy:
+        engine = "greedy-witness"
+    elif not device_ok:
+        engine = "host-linear(fallback)"
+    else:
+        engine = "device-bfs"
+
+    # distinct reachable configs, model state EXCLUDED: det prefix
+    # position x window mask (the first window bit is the prefix
+    # boundary itself) x crash mask — the count the frontier + budget
+    # must cover in the worst case
+    ub_log2 = (max(0, es.window - 1) + es.n_crash)
+    upper = (es.n_det + 1) << ub_log2
+
+    return {
+        "model": model.name,
+        "n_rows": len(seq),
+        "n_det": es.n_det,
+        "n_crash": es.n_crash,
+        "window": es.window,
+        "concurrency": es.concurrency,
+        "crash_words": dims.crash_words,
+        "config_words": dims.words,
+        "search_dims": _dims_dict(dims),
+        "bucket": list(bucket_key(es)),
+        "greedy_witness": greedy,
+        "device_eligible": device_ok,
+        "host_threshold_route": len(seq) <= host_threshold,
+        "engine": engine,
+        "config_upper_bound": upper,
+        "config_upper_bound_log2": round(
+            ub_log2 + float(np.log2(max(1, es.n_det + 1))), 2),
+        "decompositions": _decompositions(seq, model),
+    }
+
+
+def explain_batch(seqs: list[OpSeq], model: ModelSpec) -> dict:
+    """The static plan for a BATCH: per-key routing plus the bucketed
+    scheduler's exact bucket assignment (checker/bucket.py's
+    ``plan_buckets`` over the same keys, merged to the same cap).
+
+    Mirrors ``search_batch_bucketed``: greedy witnesses dispose keys
+    host-side, window/crash outliers fall back to the host sweep, and
+    the rest group into power-of-two dims buckets, each searched at its
+    own tight dims.
+    """
+    from ..checker import linearizable as lin
+    from ..checker.bucket import _bucket_mode, bucket_key, plan_buckets
+
+    ess = [lin.encode_search(s) for s in seqs]
+    hard, fit = [], []
+    for i, e in enumerate(ess):
+        (hard if e.window > lin.MAX_WINDOW
+         or e.n_crash > lin.MAX_CRASH else fit).append(i)
+    _enabled, max_buckets = _bucket_mode()
+    plans = plan_buckets([bucket_key(ess[i]) for i in fit], max_buckets)
+    plans = [[fit[p] for p in grp] for grp in plans]
+
+    greedy = [i for i in range(len(seqs))
+              if lin.greedy_witness(seqs[i], model)]
+    greedy_set = set(greedy)
+    buckets = []
+    for idxs in plans:
+        run = [i for i in idxs if i not in greedy_set]
+        dims = (lin.batch_dims([ess[i] for i in run], model, frontier=32)
+                if run else None)
+        useful = sum(ess[i].n_det + ess[i].n_crash for i in run)
+        padded = (len(run) * (dims.n_det_pad + dims.n_crash_pad)
+                  if run else 0)
+        buckets.append({
+            "keys": idxs,
+            "n_keys": len(idxs),
+            "searched": len(run),
+            "dims": ([dims.n_det_pad, dims.window, dims.n_crash_pad]
+                     if run else None),
+            "useful_ops": useful,
+            "padded_ops": padded,
+            "padding_efficiency": (round(useful / padded, 4)
+                                   if padded else None),
+        })
+    return {
+        "n_keys": len(seqs),
+        "n_buckets": len(plans),
+        "bucketing": _enabled,
+        "greedy": len(greedy),
+        "hard": len(hard),
+        "hard_keys": hard,
+        "buckets": buckets,
+    }
+
+
+def render_plan(plan: dict, *, batch: bool = False) -> str:
+    """Human-readable plan (the CLI --explain output)."""
+    lines = []
+    if batch or "buckets" in plan:
+        lines.append(f"batch plan: {plan['n_keys']} keys -> "
+                     f"{plan['n_buckets']} bucket(s), "
+                     f"{plan['greedy']} greedy-disposed, "
+                     f"{plan['hard']} host-fallback")
+        for b, bk in enumerate(plan["buckets"]):
+            dims = bk["dims"]
+            eff = bk["padding_efficiency"]
+            lines.append(
+                f"  bucket {b}: {bk['n_keys']} keys, {bk['searched']} "
+                f"searched, dims={dims}, padding_efficiency={eff}")
+        return "\n".join(lines)
+    d = plan["search_dims"]
+    lines += [
+        f"plan: {plan['n_rows']} rows ({plan['n_det']} det, "
+        f"{plan['n_crash']} crashed) under model {plan['model']!r}",
+        f"  window={plan['window']} concurrency={plan['concurrency']} "
+        f"crash_words={plan['crash_words']} "
+        f"config_words={plan['config_words']}",
+        f"  SearchDims: n_det_pad={d['n_det_pad']} "
+        f"n_crash_pad={d['n_crash_pad']} window={d['window']} "
+        f"k={d['k']} frontier={d['frontier']}",
+        f"  bucket={tuple(plan['bucket'])} engine={plan['engine']}"
+        + (" (greedy witness exists)" if plan["greedy_witness"] else ""),
+        f"  config upper bound ~2^"
+        f"{plan['config_upper_bound_log2']}",
+    ]
+    dec = plan["decompositions"]
+    kp = dec["key_partition"]
+    vb = dec["value_blocks"]
+    qc = dec["quiescence"]
+    lines.append(
+        "  decompositions: key-partition "
+        + (f"applies ({kp.get('cells')} cells)" if kp["applies"]
+           else "n/a")
+        + "; value-blocks "
+        + ("applies" if vb["applies"]
+           else f"n/a ({vb.get('reason', '')})")
+        + "; quiescence "
+        + (f"applies ({qc['segments']} segments)" if qc["applies"]
+           else "n/a"))
+    return "\n".join(lines)

@@ -1,0 +1,325 @@
+"""Suite protocol lint — AST checks over ``jepsen_tpu/suites/*``.
+
+The worker loop guarantees half of the client protocol at runtime
+(``invoke_op`` asserts completion types, converts crashes to :info —
+core.clj:248-281), but a suite bug can still poison a history in ways no
+runtime assert sees: an ``except Exception`` that converts an
+indeterminate crash into a determinate ``:ok``/``:fail`` teaches the
+checker a lie it can never detect (a write that "failed" but actually
+applied makes a LINEARIZABLE system look broken, and vice versa).  This
+module lints the suite SOURCE for those patterns before any test runs.
+
+S-codes (stable; documented in docs/analyze.md):
+
+==== ======== ==========================================================
+code severity meaning
+==== ======== ==========================================================
+S001 error    ``invoke`` can return None / fall off the end / return
+              the invocation unchanged (must return a typed completion)
+S002 error    broad/bare ``except`` in ``invoke`` converts a crash to
+              ``:ok`` (a crash is indeterminate: must become ``:info``)
+S003 error    broad/bare ``except`` in ``invoke`` unconditionally
+              converts a crash to ``:fail`` (only sound when the op
+              provably did not happen — guard the return with a test of
+              the exception or ``op.f``, or complete as ``:info``)
+S004 warning  ``setup``/``teardown`` (or ``open``/``close``) defined
+              without its pair
+S005 error    a Nemesis ``invoke`` returns a completion whose type is
+              not ``info`` (core.py asserts this at runtime)
+==== ======== ==========================================================
+
+False-positive escape hatch: a line containing ``suite-lint: ok``
+suppresses findings anchored on it (use sparingly, with a comment saying
+why the pattern is sound).
+"""
+
+from __future__ import annotations
+
+import ast
+from pathlib import Path
+from typing import Sequence
+
+from .lint import Diagnostic
+
+#: exception names whose handlers catch crashes indiscriminately
+BROAD_EXCEPTS = {"Exception", "BaseException"}
+
+SUITE_CODES = {
+    "S001": "invoke must return a typed completion on every path",
+    "S002": "broad except converting a crash to :ok",
+    "S003": "broad except unconditionally converting a crash to :fail",
+    "S004": "setup/teardown (open/close) pairing",
+    "S005": "nemesis completions must be :info",
+}
+
+
+def _base_names(cls: ast.ClassDef) -> list[str]:
+    out = []
+    for b in cls.bases:
+        try:
+            out.append(ast.unparse(b))
+        except Exception:  # noqa: BLE001 — exotic base exprs: skip
+            pass
+    return out
+
+
+def _is_broad(handler: ast.ExceptHandler) -> bool:
+    t = handler.type
+    if t is None:
+        return True  # bare except
+    elts = t.elts if isinstance(t, ast.Tuple) else [t]
+    for e in elts:
+        name = getattr(e, "id", getattr(e, "attr", None))
+        if name in BROAD_EXCEPTS:
+            return True
+    return False
+
+
+def _return_type_consts(ret: ast.Return) -> set:
+    """Constant values passed as ``type=`` anywhere in the returned
+    expression (IfExp alternatives all collected)."""
+    out: set = set()
+    if ret.value is None:
+        return out
+    for node in ast.walk(ret.value):
+        if isinstance(node, ast.keyword) and node.arg == "type":
+            for c in ast.walk(node.value):
+                if isinstance(c, ast.Constant):
+                    out.add(c.value)
+    return out
+
+
+def _always_exits(body: Sequence[ast.stmt]) -> bool:
+    """Conservative: does this statement list definitely end in a
+    return/raise on every path?  Uncertain constructs answer False at
+    the leaf but callers only flag when the WHOLE body is certain to
+    fall through — so uncertainty never produces a finding, only
+    misses one."""
+    if not body:
+        return False
+    last = body[-1]
+    if isinstance(last, (ast.Return, ast.Raise)):
+        return True
+    if isinstance(last, ast.If):
+        return bool(last.orelse) and _always_exits(last.body) \
+            and _always_exits(last.orelse)
+    if isinstance(last, ast.Try):
+        handlers_exit = all(_always_exits(h.body)
+                            for h in last.handlers) if last.handlers \
+            else True
+        body_exit = _always_exits(last.orelse) if last.orelse \
+            else _always_exits(last.body)
+        final_exit = _always_exits(last.finalbody) if last.finalbody \
+            else False
+        return final_exit or (body_exit and handlers_exit)
+    if isinstance(last, ast.With):
+        return _always_exits(last.body)
+    if isinstance(last, ast.While):
+        # while True with no top-level break never falls through
+        is_true = isinstance(last.test, ast.Constant) and \
+            bool(last.test.value)
+        has_break = any(isinstance(n, ast.Break)
+                        for n in ast.walk(last)
+                        if not isinstance(n, (ast.FunctionDef,
+                                              ast.AsyncFunctionDef)))
+        return is_true and not has_break
+    return False
+
+
+def _own_returns(fn: ast.FunctionDef) -> list[ast.Return]:
+    """Return statements belonging to ``fn`` itself (nested defs
+    excluded — suites wrap invoke bodies in closures)."""
+    out: list[ast.Return] = []
+
+    def prune_walk(node):
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                  ast.ClassDef, ast.Lambda)):
+                continue
+            if isinstance(child, ast.Return):
+                out.append(child)
+            prune_walk(child)
+
+    prune_walk(fn)
+    return out
+
+
+def _assigned_names(fn: ast.FunctionDef) -> set:
+    names: set = set()
+    for node in ast.walk(fn):
+        if isinstance(node, ast.Assign):
+            for t in node.targets:
+                for n in ast.walk(t):
+                    if isinstance(n, ast.Name):
+                        names.add(n.id)
+        elif isinstance(node, (ast.AugAssign, ast.AnnAssign)):
+            if isinstance(node.target, ast.Name):
+                names.add(node.target.id)
+    return names
+
+
+def _handler_unguarded_returns(handler: ast.ExceptHandler
+                               ) -> list[ast.Return]:
+    """Returns sitting at the handler body's top level (not nested under
+    an If/Try that could be testing the exception or the op)."""
+    return [s for s in handler.body if isinstance(s, ast.Return)]
+
+
+def _handler_raises(handler: ast.ExceptHandler) -> bool:
+    return any(isinstance(n, ast.Raise) for n in ast.walk(handler))
+
+
+def lint_source(src: str, filename: str = "<string>"
+                ) -> list[Diagnostic]:
+    """Lint one suite module's source.  Returns Diagnostics whose
+    ``index`` is the 1-based source LINE."""
+    diags: list[Diagnostic] = []
+    try:
+        tree = ast.parse(src, filename=filename)
+    except SyntaxError as e:
+        return [Diagnostic("S001", "error",
+                           f"{filename}: does not parse: {e}",
+                           index=e.lineno)]
+    lines = src.splitlines()
+
+    def suppressed(lineno: int | None) -> bool:
+        if lineno is None or not 1 <= lineno <= len(lines):
+            return False
+        return "suite-lint: ok" in lines[lineno - 1]
+
+    def add(code, sev, msg, lineno, **kw):
+        if not suppressed(lineno):
+            diags.append(Diagnostic(code, sev, f"{filename}:{lineno}: "
+                                    f"{msg}", index=lineno, **kw))
+
+    for cls in [n for n in ast.walk(tree)
+                if isinstance(n, ast.ClassDef)]:
+        bases = _base_names(cls)
+        is_client = any(b.endswith("Client") for b in bases) or (
+            cls.name.endswith("Client") and not bases)
+        is_nemesis = any(b.endswith("Nemesis") for b in bases)
+        is_db = any(b.endswith("DB") or b.endswith("db_mod.DB")
+                    for b in bases)
+        methods = {m.name: m for m in cls.body
+                   if isinstance(m, ast.FunctionDef)}
+
+        # --- S004: lifecycle pairing ------------------------------------
+        # DB classes own node state: a setup without a teardown leaks it
+        # across runs.  CLIENT setup-without-teardown is idiomatic here
+        # (logical state is wiped by the DB teardown), so clients are
+        # only checked for the connection pair (open without close).
+        if is_db:
+            for a, b in (("setup", "teardown"),):
+                if (a in methods) != (b in methods):
+                    have, miss = (a, b) if a in methods else (b, a)
+                    add("S004", "warning",
+                        f"{cls.name} defines {have}() without {miss}() "
+                        f"(lifecycle pairing — state made in one phase "
+                        f"should be unmade in its pair)",
+                        methods[have].lineno)
+        elif is_client and "open" in methods and "close" not in methods:
+            # only flag when open() plausibly acquires a resource (it
+            # does more than construct-and-return)
+            opens = methods["open"]
+            if len(opens.body) > 1:
+                add("S004", "warning",
+                    f"{cls.name} defines open() that builds client "
+                    f"state but no close() — if open() acquires a "
+                    f"connection or server-side session it leaks on "
+                    f"every crash/reopen cycle", opens.lineno)
+
+        if not (is_client or is_nemesis) or "invoke" not in methods:
+            continue
+        fn = methods["invoke"]
+        args = [a.arg for a in fn.args.args]
+        op_name = args[2] if len(args) > 2 else "op"
+        reassigned = _assigned_names(fn)
+        returns = _own_returns(fn)
+
+        # --- S001: every return is a typed completion -------------------
+        for ret in returns:
+            if ret.value is None or (isinstance(ret.value, ast.Constant)
+                                     and ret.value.value is None):
+                add("S001", "error",
+                    f"{cls.name}.invoke returns None — it must return "
+                    f"a completion Op with type ok/fail/info",
+                    ret.lineno)
+            elif isinstance(ret.value, ast.Name) and \
+                    ret.value.id == op_name and op_name not in reassigned:
+                add("S001", "error",
+                    f"{cls.name}.invoke returns the invocation "
+                    f"unchanged — complete it with an explicit type",
+                    ret.lineno)
+        if not _always_exits(fn.body):
+            add("S001", "error",
+                f"{cls.name}.invoke can fall off the end (implicit "
+                f"None) — every path must return a typed completion "
+                f"or raise", fn.lineno)
+
+        # --- S005: nemesis completions are :info ------------------------
+        if is_nemesis:
+            for ret in returns:
+                consts = _return_type_consts(ret)
+                bad = consts - {"info"}
+                if bad:
+                    add("S005", "error",
+                        f"{cls.name}.invoke returns type={sorted(bad)!r}"
+                        f" — nemesis completions must be :info "
+                        f"(core.py asserts this at runtime)",
+                        ret.lineno)
+            continue  # S002/S003 are about client determinism
+
+        # --- S002/S003: crash-to-determinate conversion -----------------
+        for handler in [n for n in ast.walk(fn)
+                        if isinstance(n, ast.ExceptHandler)]:
+            if not _is_broad(handler):
+                continue
+            for ret in [r for r in returns
+                        if handler.lineno <= r.lineno <=
+                        (handler.end_lineno or r.lineno)]:
+                consts = _return_type_consts(ret)
+                if "ok" in consts:
+                    add("S002", "error",
+                        f"{cls.name}.invoke converts a broad-except "
+                        f"crash to :ok — a crash is indeterminate and "
+                        f"must complete as :info",
+                        ret.lineno)
+            if _handler_raises(handler):
+                continue  # narrow cases re-raised: the rest is vetted
+            for ret in _handler_unguarded_returns(handler):
+                consts = _return_type_consts(ret)
+                if consts == {"fail"}:
+                    add("S003", "error",
+                        f"{cls.name}.invoke unconditionally converts a "
+                        f"broad-except crash to :fail — :fail asserts "
+                        f"the op definitely did NOT happen; guard on "
+                        f"the exception/op.f or complete as :info",
+                        ret.lineno)
+    return diags
+
+
+def lint_file(path: str | Path) -> list[Diagnostic]:
+    p = Path(path)
+    return lint_source(p.read_text(), filename=str(p))
+
+
+def lint_paths(paths: Sequence[str | Path] | None = None
+               ) -> dict[str, list[Diagnostic]]:
+    """Lint suite files.  ``paths`` may mix files and directories;
+    default: the bundled ``jepsen_tpu/suites``.  Returns
+    {filename: diagnostics} for files with findings only."""
+    if not paths:
+        paths = [Path(__file__).resolve().parent.parent / "suites"]
+    files: list[Path] = []
+    for p in paths:
+        p = Path(p)
+        if p.is_dir():
+            files.extend(sorted(p.glob("*.py")))
+        else:
+            files.append(p)
+    out: dict[str, list[Diagnostic]] = {}
+    for f in files:
+        diags = lint_file(f)
+        if diags:
+            out[str(f)] = diags
+    return out

@@ -84,7 +84,8 @@ def check_opseq_linear(seq: OpSeq, model: ModelSpec, *,
                        checkpoint_every: int = 0,
                        resume_from: str | None = None,
                        decompose: bool = False,
-                       decompose_cache=None) -> dict:
+                       decompose_cache=None,
+                       lint: bool | None = None) -> dict:
     """Exact linearizability check.  Returns a knossos-style map
     {"valid": True|False|"unknown", "configs": n, "max_depth": d, ...};
     on invalid, ``final_ops`` holds the un-linearizable candidate rows at
@@ -108,7 +109,14 @@ def check_opseq_linear(seq: OpSeq, model: ModelSpec, *,
     ``decompose`` routes through the P-compositional decomposition
     layer (jepsen_tpu/decompose/) with this sweep as the sub-engine —
     verdict-identical, default off; ``decompose_cache`` is its
-    VerdictCache or jsonl path."""
+    VerdictCache or jsonl path.
+
+    ``lint`` runs the O(n) well-formedness linter (analyze/lint.py)
+    over the OpSeq first — on by default (None follows JEPSEN_TPU_LINT);
+    errors raise :class:`~jepsen_tpu.analyze.HistoryLintError`."""
+    from ..analyze.lint import maybe_lint
+
+    maybe_lint(seq, model, lint)
     if decompose:
         if checkpoint_path or resume_from:
             # the decomposed funnel has no serialized level-set to
@@ -123,16 +131,18 @@ def check_opseq_linear(seq: OpSeq, model: ModelSpec, *,
         def _direct(s):
             return check_opseq_linear(s, model, max_configs=max_configs,
                                       deadline=deadline, cancel=cancel,
-                                      witness_cap=witness_cap)
+                                      witness_cap=witness_cap,
+                                      lint=False)
 
         def _sub(s, m, *, max_configs=max_configs, deadline=deadline):
             return check_opseq_linear(s, m, max_configs=max_configs,
-                                      deadline=deadline, cancel=cancel)
+                                      deadline=deadline, cancel=cancel,
+                                      lint=False)
 
         return check_opseq_decomposed(seq, model, cache=decompose_cache,
                                       direct=_direct, sub_check=_sub,
                                       sub_max_configs=max_configs,
-                                      deadline=deadline)
+                                      deadline=deadline, lint=False)
     es = encode_search(seq)
     n_det, n_crash, W = es.n_det, es.n_crash, es.window
     if n_det == 0 and n_crash == 0:

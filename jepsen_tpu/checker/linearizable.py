@@ -1861,7 +1861,7 @@ def search_opseq(seq: OpSeq, model: ModelSpec, *,
                  budget: int = 20_000_000,
                  dims: SearchDims | None = None,
                  on_slice=None, deadline: float | None = None,
-                 stop=None) -> dict:
+                 stop=None, lint: bool | None = None) -> dict:
     """Check one columnar history on device.  Returns a knossos-style map
     {"valid": True|False|"unknown", "configs": n, "max_depth": d}.
 
@@ -1871,7 +1871,12 @@ def search_opseq(seq: OpSeq, model: ModelSpec, *,
     ``deadline`` (perf_counter clock) bounds wall time; an unexhausted
     search past it returns "unknown" with throughput still reported.
     ``stop`` (a ``threading.Event``) aborts between slices — the
-    competition hook."""
+    competition hook.  ``lint`` runs the O(n) well-formedness linter
+    first (None follows JEPSEN_TPU_LINT; errors raise
+    HistoryLintError)."""
+    from ..analyze.lint import maybe_lint
+
+    maybe_lint(seq, model, lint)
     es = encode_search(seq)
     if es.n_det == 0 and es.n_crash == 0:
         return {"valid": True, "configs": 0, "max_depth": 0,
@@ -1886,7 +1891,7 @@ def search_opseq(seq: OpSeq, model: ModelSpec, *,
         from .linear import check_opseq_linear
 
         out = check_opseq_linear(seq, model, deadline=deadline,
-                                 cancel=stop)
+                                 cancel=stop, lint=False)
         out["engine"] = "host-linear(fallback)"
         return out
 
@@ -1904,7 +1909,8 @@ def search_opseq(seq: OpSeq, model: ModelSpec, *,
 
 def check_competition(seq: OpSeq, model: ModelSpec, *,
                       budget: int = 20_000_000,
-                      max_configs: int = 50_000_000) -> dict:
+                      max_configs: int = 50_000_000,
+                      lint: bool | None = None) -> dict:
     """Race the exact host checkers against the device BFS search; the
     first conclusive verdict wins and retires the losers.
 
@@ -1923,6 +1929,13 @@ def check_competition(seq: OpSeq, model: ModelSpec, *,
 
     from . import seq as seqmod
     from .linear import check_opseq_linear
+
+    # one lint at the race's boundary; the legs run lint-free (they
+    # share the seq, and a loser leg raising HistoryLintError inside a
+    # daemon thread would be swallowed as a leg error)
+    from ..analyze.lint import maybe_lint
+
+    maybe_lint(seq, model, lint)
 
     # the host DFS memoizes each config TWICE (visited + parent_of) as a
     # (bigint linearized-set, state tuple) pair: ~n/8 bytes of mask plus
@@ -1953,7 +1966,7 @@ def check_competition(seq: OpSeq, model: ModelSpec, *,
     def wgl_leg():
         try:
             r = seqmod.check_opseq(seq, model, max_configs=max_configs,
-                                   cancel=done)
+                                   cancel=done, lint=False)
         except Exception:  # noqa: BLE001 — loser errors must not win
             return
         submit(r, "competition(host-wgl)")
@@ -1961,7 +1974,7 @@ def check_competition(seq: OpSeq, model: ModelSpec, *,
     def linear_leg():
         try:
             r = check_opseq_linear(seq, model, max_configs=max_configs,
-                                   cancel=done)
+                                   cancel=done, lint=False)
         except Exception:  # noqa: BLE001
             return
         submit(r, "competition(host-linear)")
@@ -1987,7 +2000,8 @@ def check_competition(seq: OpSeq, model: ModelSpec, *,
         return {"valid": "unknown", "configs": 0,
                 "engine": "competition(exhausted; device encoding limits)"}
 
-    dev = search_opseq(seq, model, budget=budget, stop=done)
+    dev = search_opseq(seq, model, budget=budget, stop=done,
+                       lint=False)
     submit(dev, "competition(device)")
     if not result:
         # device inconclusive: the race is only over when the hosts' own
@@ -2337,7 +2351,8 @@ def search_batch(seqs: list[OpSeq], model: ModelSpec, *,
                  sharding=None,
                  decompose: bool = False,
                  decompose_cache=None,
-                 bucket: bool | None = None) -> list[dict]:
+                 bucket: bool | None = None,
+                 lint: bool | None = None) -> list[dict]:
     """Check a batch of independent per-key histories in one device call.
 
     This is the TPU analog of jepsen.independent's bounded-pmap over
@@ -2365,6 +2380,22 @@ def search_batch(seqs: list[OpSeq], model: ModelSpec, *,
     """
     if not seqs:
         return []
+    from ..analyze.lint import (Diagnostic, HistoryLintError,
+                                lint_enabled, lint_opseq)
+
+    if lint if lint is not None else lint_enabled():
+        # lint every key up front (O(total rows) numpy): errors raise
+        # naming the offending key instead of shipping a malformed
+        # encoding to the device
+        bad: list = []
+        for k, s in enumerate(seqs):
+            for d in lint_opseq(s, model):
+                bad.append(Diagnostic(d.code, d.severity,
+                                      f"batch key {k}: {d.message}",
+                                      index=d.index, process=d.process,
+                                      f=d.f))
+        if any(d.severity == "error" for d in bad):
+            raise HistoryLintError(bad)
     if decompose:
         return _search_batch_decomposed(seqs, model, budget=budget,
                                         dims=dims, sharding=sharding,
@@ -2394,7 +2425,8 @@ def search_batch(seqs: list[OpSeq], model: ModelSpec, *,
         return [results_by_idx[i] for i in range(len(seqs))]
     if results_by_idx:
         sub = search_batch([seqs[i] for i in rest], model, budget=budget,
-                           dims=dims, sharding=sharding, bucket=False)
+                           dims=dims, sharding=sharding, bucket=False,
+                           lint=False)
         for i, r in zip(rest, sub):
             results_by_idx[i] = r
         return [results_by_idx[i] for i in range(len(seqs))]
@@ -2409,11 +2441,12 @@ def search_batch(seqs: list[OpSeq], model: ModelSpec, *,
         out = []
         for i, s in enumerate(seqs):
             if i in hard:
-                r = check_opseq_linear(s, model)
+                r = check_opseq_linear(s, model, lint=False)
                 r["engine"] = "host-linear(fallback)"
                 out.append(r)
             else:
-                out.append(search_opseq(s, model, budget=budget))
+                out.append(search_opseq(s, model, budget=budget,
+                                        lint=False))
         return out
 
     # the sharded path has no escalation ladder (the key axis must keep
@@ -2474,7 +2507,8 @@ def search_batch(seqs: list[OpSeq], model: ModelSpec, *,
             if int(status[i]) == UNKNOWN and bool(ovf[i]):
                 # overflowed the fixed mesh shape: redo solo with the
                 # adaptive ladder
-                out.append(search_opseq(seqs[i], model, budget=budget))
+                out.append(search_opseq(seqs[i], model,
+                                        budget=budget, lint=False))
             else:
                 out.append({"valid": _STATUS[int(status[i])],
                             "configs": int(configs[i]),
@@ -2586,7 +2620,8 @@ def _search_batch_ladder(seqs: list[OpSeq], esps: list[EncodedSearch],
             # ladder, on the REMAINING budget, reporting cumulative
             # configs (ladder spend + solo spend)
             rem = budget - int(spent[i])
-            r = search_opseq(seqs[i], model, budget=max(1000, rem))
+            r = search_opseq(seqs[i], model, budget=max(1000, rem),
+                             lint=False)
             r["configs"] = int(r.get("configs", 0)) + int(spent[i])
             out.append(r)
         else:
@@ -2630,7 +2665,8 @@ def _search_batch_decomposed(seqs: list[OpSeq], model: ModelSpec, *,
             todo.append(i)
     if todo:
         sub = search_batch([seqs[i] for i in todo], model, budget=budget,
-                           dims=dims, sharding=sharding, bucket=bucket)
+                           dims=dims, sharding=sharding, bucket=bucket,
+                           lint=False)
         for i, r in zip(todo, sub):
             results[i] = r
             if r.get("valid") in (True, False):
@@ -2652,7 +2688,8 @@ def _search_batch_decomposed(seqs: list[OpSeq], model: ModelSpec, *,
         # one asserts nothing)
         r2 = solo.get(k)
         if r2 is None:
-            r2 = solo[k] = search_opseq(seqs[i], model, budget=budget)
+            r2 = solo[k] = search_opseq(seqs[i], model, budget=budget,
+                                        lint=False)
             if r2.get("valid") in (True, False):
                 cache.put_verdict(k, r2["valid"])
                 # the decided retry serves the representative too: one
@@ -2759,11 +2796,26 @@ class Linearizable:
                  witness_threshold: int = 3000,
                  algorithm: str = "auto",
                  decompose: bool = False,
-                 verdict_cache=None):
+                 verdict_cache=None,
+                 lint: bool | None = None,
+                 explain: bool | None = None):
         self.model = model
         self.budget = budget
         self.host_threshold = host_threshold
         self.witness_threshold = witness_threshold
+        # ``lint`` runs the well-formedness linter (analyze/lint.py)
+        # over the history before any search: errors are fatal
+        # (HistoryLintError), warnings ride the result dict as
+        # ``lint_warnings``.  None follows the JEPSEN_TPU_LINT knob
+        # (default on).  ``explain`` (or JEPSEN_TPU_EXPLAIN, set by the
+        # CLI's --explain) reports the static search PLAN
+        # (analyze/plan.py) without running any search.
+        self.lint = lint
+        if explain is None:
+            explain = os.environ.get(
+                "JEPSEN_TPU_EXPLAIN", "").lower() in ("1", "true", "on",
+                                                      "yes")
+        self.explain = explain
         # ``decompose=True`` runs the P-compositional decomposition
         # layer (jepsen_tpu/decompose/) in front of whichever engine
         # ``algorithm`` selects; verdict-identical, default off.
@@ -2795,8 +2847,46 @@ class Linearizable:
         model = self.model or test.get("model")
         if model is None:
             raise ValueError("linearizable checker needs a model")
+        from ..analyze.lint import (check_history, check_opseq_lint,
+                                    lint_enabled)
+
+        lint_warnings: list = []
+        do_lint = self.lint if self.lint is not None else lint_enabled()
+        if do_lint:
+            # event-level lint sees defects encoding erases (double
+            # invokes, orphan completions, type drift); an OpSeq input
+            # gets the columnar checks.  Errors raise HERE — before
+            # encode_ops can silently mis-pair the malformed events —
+            # and check_safe turns that into an "unknown" verdict
+            # carrying the diagnostic, never a wrong True/False.
+            if isinstance(history, OpSeq):
+                lint_warnings = check_opseq_lint(history, model)
+            else:
+                lint_warnings = check_history(history, model)
         seq = history if isinstance(history, OpSeq) else \
             encode_ops(history, model.f_codes)
+        if self.explain:
+            # plan-only mode (--explain): report what the search WOULD
+            # do — dims, bucket, route, decompositions — and stop
+            from ..analyze.plan import explain as explain_plan
+            from ..analyze.plan import render_plan
+
+            plan = explain_plan(seq, model,
+                                host_threshold=self.host_threshold)
+            print(render_plan(plan))
+            out = {"valid": "unknown", "engine": "explain(plan-only)",
+                   "explain": plan, "configs": 0}
+            if lint_warnings:
+                out["lint_warnings"] = [d.to_dict()
+                                        for d in lint_warnings]
+            return out
+        out = self._checked(test, seq, model, opts)
+        if lint_warnings and isinstance(out, dict):
+            out.setdefault("lint_warnings",
+                           [d.to_dict() for d in lint_warnings])
+        return out
+
+    def _checked(self, test, seq, model, opts):
         if self.decompose:
             from ..decompose.cache import VerdictCache, default_cache_path
             from ..decompose.engine import check_opseq_decomposed
@@ -2823,11 +2913,14 @@ class Linearizable:
                 def sub_check(s, m, *, max_configs, deadline):
                     return seqmod.check_opseq(s, m,
                                               max_configs=max_configs,
-                                              deadline=deadline)
+                                              deadline=deadline,
+                                              lint=False)
+            # lint=False: this checker already linted (or deliberately
+            # skipped) at its own boundary in check()
             out = check_opseq_decomposed(
                 seq, model, cache=cache,
                 sub_max_configs=self.budget,  # the user's sizing knob
-                sub_check=sub_check,
+                sub_check=sub_check, lint=False,
                 direct=lambda s: self._check_direct(test, s, model, opts))
             if out["valid"] is False and "report_file" not in out:
                 # the direct fallback renders its own report; a verdict
@@ -2842,7 +2935,9 @@ class Linearizable:
         if (self.algorithm == "host"
                 or (self.algorithm == "auto"
                     and len(seq) <= self.host_threshold)):
-            out = seqmod.check_opseq(seq, model)
+            # lint=False throughout _check_direct: check() linted (or
+            # deliberately skipped) at the checker boundary already
+            out = seqmod.check_opseq(seq, model, lint=False)
             out["engine"] = "host-oracle"
             if out["valid"] is False:
                 self._render_failure(test, seq, out, opts)
@@ -2854,7 +2949,8 @@ class Linearizable:
             # user-facing path: track the valid-verdict witness (the
             # verdict-only callers — competition legs, portfolio,
             # fuzzers — leave it off and keep level-local memory)
-            out = check_opseq_linear(seq, model, witness_cap=2_000_000)
+            out = check_opseq_linear(seq, model, witness_cap=2_000_000,
+                                     lint=False)
             out["engine"] = "host-linear"
             if out["valid"] is False:
                 self._render_failure(test, seq, out, opts)
@@ -2866,9 +2962,11 @@ class Linearizable:
             # device search; whichever concludes first wins.  The host
             # thread costs one core and wins exactly the histories a DFS
             # lucky-dives (deep valid ones); the device wins sweeps.
-            out = check_competition(seq, model, budget=self.budget)
+            out = check_competition(seq, model, budget=self.budget,
+                                    lint=False)
         else:
-            out = search_opseq(seq, model, budget=self.budget)
+            out = search_opseq(seq, model, budget=self.budget,
+                               lint=False)
         if out["valid"] is False:
             eng = out.get("engine", "")
             if "host-oracle" in eng or "host-linear" in eng:
@@ -2885,7 +2983,7 @@ class Linearizable:
             if trunc is not None:
                 target = trunc
             if len(target) <= self.witness_threshold:
-                confirm = seqmod.check_opseq(target, model)
+                confirm = seqmod.check_opseq(target, model, lint=False)
                 if confirm["valid"] is False:
                     confirm["engine"] = out["engine"] + "+host-witness"
                     confirm["device_configs"] = out["configs"]

@@ -61,7 +61,8 @@ def check_opseq(seq: OpSeq, model: ModelSpec, *,
                 cancel=None,
                 order_seed: int | None = None,
                 decompose: bool = False,
-                decompose_cache=None) -> dict:
+                decompose_cache=None,
+                lint: bool | None = None) -> dict:
     """Run the DFS over a columnar OpSeq.  Returns a knossos-style map:
 
     valid        True | False | "unknown"
@@ -83,24 +84,36 @@ def check_opseq(seq: OpSeq, model: ModelSpec, *,
     through the P-compositional decomposition layer (jepsen_tpu/
     decompose/) with this DFS as the sub-engine — verdict-identical,
     default off; ``decompose_cache`` is its VerdictCache or jsonl path.
+    ``lint`` runs the O(n) well-formedness linter (analyze/lint.py)
+    over the OpSeq before searching — on by default (None follows the
+    JEPSEN_TPU_LINT knob); errors raise
+    :class:`~jepsen_tpu.analyze.HistoryLintError` instead of feeding a
+    malformed history to the search.  Verdict-identical on well-formed
+    histories (tests/test_analyze.py's differential fuzz).
     """
+    from ..analyze.lint import maybe_lint
+
+    maybe_lint(seq, model, lint)
     if decompose:
         from ..decompose.engine import check_opseq_decomposed
 
         def _direct(s):
             return check_opseq(s, model, max_configs=max_configs,
                                deadline=deadline, cancel=cancel,
-                               order_seed=order_seed)
+                               order_seed=order_seed, lint=False)
 
         def _sub(s, m, *, max_configs=max_configs, deadline=deadline):
             return check_opseq(s, m, max_configs=max_configs,
                                deadline=deadline, cancel=cancel,
-                               order_seed=order_seed)
+                               order_seed=order_seed, lint=False)
 
+        # the entry seq was linted above (when enabled); cells/segments
+        # are engine-derived projections, so re-linting them would only
+        # re-prove invariants subseq preserves by construction
         return check_opseq_decomposed(seq, model, cache=decompose_cache,
                                       direct=_direct, sub_check=_sub,
                                       sub_max_configs=max_configs,
-                                      deadline=deadline)
+                                      deadline=deadline, lint=False)
     import random as _random
     import time
     n = len(seq)

@@ -72,6 +72,20 @@ def add_test_opts(p: argparse.ArgumentParser) -> None:
                         "canonical-hash verdict cache.  Verdict-"
                         "identical; sets JEPSEN_TPU_LIN_DECOMPOSE so "
                         "every suite-constructed checker honors it.")
+    p.add_argument("--explain", action="store_true", default=False,
+                   help="Print the static search PLAN instead of "
+                        "running the linearizability search: SearchDims"
+                        ", shape bucket, engine route, and which "
+                        "decompositions apply "
+                        "(jepsen_tpu.analyze.explain).  Sets "
+                        "JEPSEN_TPU_EXPLAIN so every suite-constructed "
+                        "Linearizable checker honors it; the verdict "
+                        "reports as \"unknown\" with the plan attached.")
+    p.add_argument("--no-lint", action="store_true", default=False,
+                   help="Disable the history well-formedness linter "
+                        "(jepsen_tpu.analyze) that runs in front of "
+                        "every linearizability check.  Sets "
+                        "JEPSEN_TPU_LINT=0 fleet-wide.")
     p.add_argument("--compile-cache-dir", metavar="DIR", default=None,
                    help="Persistent JAX compilation-cache directory "
                         "(jax_compilation_cache_dir): compiled search "
@@ -136,6 +150,14 @@ def test_opt_fn(parsed: argparse.Namespace) -> dict:
         # selector (JEPSEN_TPU_LIN_ALGORITHM)
         os.environ["JEPSEN_TPU_LIN_DECOMPOSE"] = "1"
         opts["lin_decompose"] = True
+    if opts.pop("explain", False):
+        # like --lin-decompose: suites construct their own checkers, so
+        # the plan-only mode travels by env var
+        os.environ["JEPSEN_TPU_EXPLAIN"] = "1"
+        opts["explain"] = True
+    if opts.pop("no_lint", False):
+        os.environ["JEPSEN_TPU_LINT"] = "0"
+        opts["no_lint"] = True
     ccd = opts.get("compile_cache_dir")
     if ccd:
         # the env var carries the setting into spawned workers/children;

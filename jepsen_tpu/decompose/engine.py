@@ -52,8 +52,11 @@ class _DirectUndecided(Exception):
 def _default_sub_check(sseq, smodel, *, max_configs, deadline):
     from ..checker.linear import check_opseq_linear
 
+    # lint=False: cells/segments are engine-derived projections whose
+    # invariants subseq preserves by construction (the entry seq was
+    # linted at the decomposed checker's own boundary)
     return check_opseq_linear(sseq, smodel, max_configs=max_configs,
-                              deadline=deadline)
+                              deadline=deadline, lint=False)
 
 
 def segment_states(sseq: OpSeq, model: ModelSpec, init_states, *,
@@ -146,7 +149,8 @@ def check_opseq_decomposed(seq: OpSeq, model: ModelSpec, *,
                            sub_max_configs: int = 50_000_000,
                            deadline: float | None = None,
                            scheduler: str | None = None,
-                           n_procs: int | None = None) -> dict:
+                           n_procs: int | None = None,
+                           lint: bool | None = None) -> dict:
     """Check ``seq`` via decomposition; verdict-identical to ``direct``.
 
     cache       VerdictCache, a jsonl path, or None (no caching)
@@ -161,7 +165,16 @@ def check_opseq_decomposed(seq: OpSeq, model: ModelSpec, *,
 
     The result carries a ``decompose`` dict: cells, segments,
     cache_hits/misses, configs_searched, and the methods that fired.
+
+    ``lint`` runs the O(n) well-formedness linter (analyze/lint.py)
+    over the entry seq — on by default (None follows JEPSEN_TPU_LINT);
+    errors raise before any partitioning or cache write (a malformed
+    history must not poison the persisted verdict cache).  Engine
+    entry points that already linted pass ``lint=False``.
     """
+    from ..analyze.lint import maybe_lint
+
+    maybe_lint(seq, model, lint)
     if isinstance(cache, str):
         cache = VerdictCache(cache)
     if sub_check is None:

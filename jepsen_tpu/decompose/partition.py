@@ -33,8 +33,9 @@ from __future__ import annotations
 
 import numpy as np
 
+from ..analyze.plan import quiescence_cuts, value_block_gate
 from ..history import NIL, OpSeq
-from ..models import R_READ, R_WRITE, ModelSpec, register
+from ..models import R_READ, ModelSpec, register
 
 
 def subseq(seq: OpSeq, rows) -> OpSeq:
@@ -65,17 +66,16 @@ def subseq(seq: OpSeq, rows) -> OpSeq:
 def quiescence_segments(seq: OpSeq) -> list[np.ndarray]:
     """Row-index segments split at quiescent points.
 
-    Rows are sorted by invocation; a cut lands between row i and i+1
-    when every earlier op has returned before row i+1 invokes
-    (``max(ret[..i]) < inv[i+1]``).  A crashed row's +inf return
-    suppresses every later cut."""
+    The cut-point math lives in ``analyze.plan.quiescence_cuts`` (the
+    plan explainer predicts these same segments without running the
+    engine, so the two must share one implementation): a cut lands
+    between row i and i+1 when every earlier op has returned before row
+    i+1 invokes; a crashed row's +inf return suppresses every later
+    cut."""
     n = len(seq)
     if n <= 1:
         return [np.arange(n)]
-    inv = np.asarray(seq.inv, dtype=np.int64)
-    ret = np.asarray(seq.ret, dtype=np.int64)
-    run_max = np.maximum.accumulate(ret)
-    cuts = np.nonzero(run_max[:-1] < inv[1:])[0] + 1  # segment starts
+    cuts = quiescence_cuts(seq)
     bounds = [0, *cuts.tolist(), n]
     return [np.arange(bounds[i], bounds[i + 1])
             for i in range(len(bounds) - 1)]
@@ -147,7 +147,9 @@ def value_block_verdict(seq: OpSeq, model: ModelSpec):
 
     Eligible class: single-register model (register / cas-register),
     every row :ok, only read/write ops, every written value distinct
-    and distinct from the initial value.  Within it:
+    and distinct from the initial value — gated by
+    ``analyze.plan.value_block_gate`` (the ONE home of the
+    applicability rule, shared with the plan explainer).  Within it:
 
       * reads of NIL constrain nothing (always legal, state unchanged)
         and are dropped;
@@ -158,28 +160,17 @@ def value_block_verdict(seq: OpSeq, model: ModelSpec):
         invalid iff some read returns before its value's write invokes,
         or the forced block order has a cycle.
     """
-    if model.name not in ("register", "cas-register"):
-        return None
-    if not bool(np.asarray(seq.ok).all()):
+    applies, _reason, writes = value_block_gate(seq, model)
+    if not applies:
         return None
     n = len(seq)
     if n == 0:
         return True
     f = np.asarray(seq.f)
-    if not bool(np.isin(f, (R_READ, R_WRITE)).all()):
-        return None  # CAS (or foreign codes): not this decomposition
     v1 = [int(x) for x in seq.v1]
     inv = [int(x) for x in seq.inv]
     ret = [int(x) for x in seq.ret]
     init = int(model.init[0])
-
-    writes: dict[int, int] = {}  # value -> row
-    for i in range(n):
-        if int(f[i]) == R_WRITE:
-            v = v1[i]
-            if v == NIL or v == init or v in writes:
-                return None  # NIL/init/duplicate write: ineligible
-            writes[v] = i
 
     # blocks: value -> (minret, maxinv); the init pseudo-block's write
     # has interval [-1,-1] so it is forced before everything

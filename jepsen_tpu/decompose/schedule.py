@@ -29,6 +29,7 @@ import time
 
 import numpy as np
 
+from ..analyze.plan import schedule_weight
 from ..history import OpSeq
 from ..models import ModelSpec
 
@@ -98,7 +99,7 @@ def _pool_worker(desc, packed, idxs, cache_path, max_configs, q):
             try:
                 r = check_opseq_decomposed(
                     _unpack_cell(packed[i]), model, cache=cache,
-                    sub_max_configs=max_configs)
+                    sub_max_configs=max_configs, lint=False)
                 q.put((i, r.get("valid"), int(r.get("configs", 0))))
             except Exception:  # noqa: BLE001 — one cell, not the pool
                 q.put((i, "unknown", 0))
@@ -126,7 +127,8 @@ def pool_check_cells(cells: list[OpSeq], model: ModelSpec, *,
     if n == 0:
         return [], 0
     n_procs = max(1, min(n_procs or min(16, os.cpu_count() or 1), n))
-    order = sorted(range(n), key=lambda i: -len(cells[i]))
+    order = sorted(range(n),
+                   key=lambda i: -schedule_weight(cells[i]))
     packed = {i: _pack_cell(cells[i]) for i in range(n)}
     # largest-first striping: worker w takes order[w], order[w+P], ...
     shards = [order[w::n_procs] for w in range(n_procs)]
@@ -197,9 +199,12 @@ def device_batch_cells(cells: list[OpSeq], model: ModelSpec, *,
     n = len(cells)
     if n == 0:
         return []
-    order = sorted(range(n), key=lambda i: -len(cells[i]))
+    order = sorted(range(n),
+                   key=lambda i: -schedule_weight(cells[i]))
+    # lint=False: cells are engine-derived projections, linted (when
+    # enabled) at the decomposed checker's own entry
     results = search_batch([cells[i] for i in order], model,
-                           budget=budget)
+                           budget=budget, lint=False)
     out: list = [None] * n
     for pos, i in enumerate(order):
         out[i] = results[pos]

@@ -82,15 +82,31 @@ class AtomClient(client_mod.Client):
         return self
 
     def invoke(self, test, op):
+        # keyed (independent) workloads travel values as [key value]
+        # tuples; the completion must carry the SAME keyed shape, or
+        # every other key's subhistory inherits this op's completion as
+        # an orphan (un-keyed ops pass the key filter) — the silent
+        # mis-pairing the history linter (analyze/lint.py, H002) flags.
+        # Real clients do exactly this re-wrap (e.g. etcdemo's reads).
+        from . import independent
+
+        v = op.value
+        key = None
+        if independent.is_tuple(v):
+            key, v = v.key, v.value
         if op.f == "write":
-            self.state.write(op.value)
+            self.state.write(v)
             return replace(op, type="ok")
         if op.f == "cas":
-            cur, new = op.value
+            cur, new = v
             return replace(op, type="ok" if self.state.cas(cur, new)
                            else "fail")
         if op.f == "read":
-            return replace(op, type="ok", value=self.state.read())
+            val = self.state.read()
+            if key is not None:
+                return replace(op, type="ok",
+                               value=independent.tuple_(key, val))
+            return replace(op, type="ok", value=val)
         raise ValueError(f"unknown op {op.f!r}")
 
 

@@ -122,13 +122,35 @@ def index(history: Iterable[Op]) -> list[Op]:
     return [replace(op, index=i) for i, op in enumerate(history)]
 
 
-def pair_index(history: Sequence[Op]) -> dict[int, int]:
+def _strict_pairing(history: Sequence[Op]) -> None:
+    """Raise analyze.HistoryLintError when the pairing scan would have
+    to tolerate a malformed event (H001 double-invoke / H002 orphan
+    completion / H003 unknown type) — the strict mode of
+    :func:`pair_index`/:func:`complete`."""
+    from .analyze.lint import HistoryLintError, scan_events
+
+    sc = scan_events(history, codes=("H001", "H002", "H003"))
+    if sc.errors:
+        raise HistoryLintError(sc.diagnostics)
+
+
+def pair_index(history: Sequence[Op], *,
+               strict: bool = False) -> dict[int, int]:
     """Map each event's index -> its partner's index (invoke<->completion).
 
     A process has at most one outstanding op (the single-threaded-process
     invariant, core.clj:387-404), so pairing is a per-process scan.
     Crashed invokes (no completion) are absent from the map.
+
+    Default behavior is PERMISSIVE, matching knossos: a double-invoke
+    silently overwrites the open invoke (the first invoke becomes an
+    orphan) and an orphan completion is dropped.  ``strict=True`` runs
+    the well-formedness linter's scan first (analyze/lint.py) and
+    raises :class:`~jepsen_tpu.analyze.HistoryLintError` carrying the
+    H001/H002/H003 diagnostics instead of tolerating them.
     """
+    if strict:
+        _strict_pairing(history)
     pairs: dict[int, int] = {}
     open_by_process: dict[Any, int] = {}
     for i, op in enumerate(history):
@@ -142,14 +164,19 @@ def pair_index(history: Sequence[Op]) -> dict[int, int]:
     return pairs
 
 
-def complete(history: Sequence[Op]) -> list[Op]:
+def complete(history: Sequence[Op], *, strict: bool = False) -> list[Op]:
     """Fill in invoke values from ok completions (knossos.history/complete).
 
     An ok'd read's invocation has value nil (or a compound value with nil
     lanes, e.g. multi-register's ``(key, nil)``); the model must check the
     value the read actually returned, so the completion's value is copied
     back onto the invocation whenever the completion carries one.
+
+    ``strict=True`` raises on malformed pairing exactly as
+    :func:`pair_index` does.
     """
+    if strict:
+        _strict_pairing(history)
     out = list(history)
     open_by_process: dict[Any, int] = {}
     for i, op in enumerate(out):

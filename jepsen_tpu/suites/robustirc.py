@@ -149,6 +149,13 @@ class IRCSession:
             raw = r.read()
         return json.loads(raw) if raw else {}
 
+    def quit(self, message: str = "jepsen client closing") -> None:
+        """DELETE the server-side session — an undeleted session holds
+        server state until it times out, and the worker reopens clients
+        after every crash (suite lint S004)."""
+        self._req("DELETE", f"/robustirc/v1/{self.session_id}",
+                  {"Quitmessage": message}, auth=True)
+
     def post(self, ircmessage: str) -> None:
         """robustirc.clj:110-121."""
         self._req("POST",
@@ -235,6 +242,17 @@ class SetClient(client_mod.Client):
         except (urllib.error.URLError, OSError) as e:
             return replace(op, type="fail" if op.f == "read" else "info",
                            error=str(e))
+
+    def close(self, test):
+        # delete the server-side session open() created; the worker
+        # reopens crashed clients, so leaked sessions would otherwise
+        # accumulate on the server for the whole run
+        if self.session is not None:
+            try:
+                self.session.quit()
+            except Exception:  # noqa: BLE001 — best-effort cleanup
+                pass
+            self.session = None
 
 
 # ---------------------------------------------------------------------------

@@ -1,0 +1,314 @@
+"""Suite protocol lint (jepsen_tpu/analyze/suites.py) — the CI gate.
+
+``test_bundled_suites_have_no_protocol_errors`` is the tier-1 guard: a
+new suite cannot merge with an ERROR-severity protocol violation (broad
+except converting crashes to determinate completions, invoke paths that
+return None, nemesis completions that aren't :info).  The rest pins the
+rules themselves on fixture sources, and regression-tests the defects
+the lint actually found in the bundled suites.
+"""
+
+import json
+import os
+import subprocess
+import sys
+import urllib.error
+
+import pytest
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+from jepsen_tpu.analyze.suites import (  # noqa: E402
+    SUITE_CODES,
+    lint_paths,
+    lint_source,
+)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def codes(diags, severity=None):
+    return {d.code for d in diags
+            if severity is None or d.severity == severity}
+
+
+# ---------------------------------------------------------------------------
+# the CI gate: bundled suites must be protocol-clean
+# ---------------------------------------------------------------------------
+
+
+def test_bundled_suites_have_no_protocol_errors():
+    findings = lint_paths()
+    errors = [(f, d) for f, ds in findings.items() for d in ds
+              if d.severity == "error"]
+    assert errors == [], "suite protocol errors:\n" + "\n".join(
+        f"  {d.message}" for _f, d in errors)
+
+
+def test_lint_suites_cli_json():
+    out = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "lint_suites.py"),
+         "--json"],
+        capture_output=True, text=True, cwd=REPO)
+    assert out.returncode == 0, out.stdout + out.stderr
+    payload = json.loads(out.stdout)
+    assert payload["errors"] == 0
+    assert set(payload) == {"errors", "warnings", "files"}
+
+
+def test_lint_suites_cli_flags_errors(tmp_path):
+    bad = tmp_path / "bad_suite.py"
+    bad.write_text(
+        "class FooClient(Client):\n"
+        "    def invoke(self, test, op):\n"
+        "        try:\n"
+        "            return replace(op, type='ok')\n"
+        "        except Exception:\n"
+        "            return replace(op, type='ok')\n")
+    out = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "lint_suites.py"),
+         str(bad)],
+        capture_output=True, text=True, cwd=REPO)
+    assert out.returncode == 1
+    assert "S002" in out.stdout
+
+
+# ---------------------------------------------------------------------------
+# the rules, on fixture sources
+# ---------------------------------------------------------------------------
+
+
+def test_s001_invoke_returns_none_and_falls_off():
+    src = (
+        "class AClient(Client):\n"
+        "    def invoke(self, test, op):\n"
+        "        if op.f == 'read':\n"
+        "            return None\n")
+    diags = lint_source(src, "fix.py")
+    assert codes(diags, "error") == {"S001"}
+    assert len(diags) == 2  # the None return AND the fall-through
+
+
+def test_s001_return_op_unchanged():
+    src = (
+        "class AClient(Client):\n"
+        "    def invoke(self, test, op):\n"
+        "        return op\n")
+    assert "S001" in codes(lint_source(src, "f.py"), "error")
+    # reassigned op is a completion — not flagged
+    src_ok = (
+        "class AClient(Client):\n"
+        "    def invoke(self, test, op):\n"
+        "        op = replace(op, type='ok')\n"
+        "        return op\n")
+    assert lint_source(src_ok, "f.py") == []
+
+
+def test_s001_clean_shapes_accepted():
+    src = (
+        "class AClient(Client):\n"
+        "    def invoke(self, test, op):\n"
+        "        try:\n"
+        "            if op.f == 'read':\n"
+        "                return replace(op, type='ok', value=1)\n"
+        "            raise ValueError(op.f)\n"
+        "        except OSError as e:\n"
+        "            return replace(op, type='info', error=str(e))\n")
+    assert lint_source(src, "f.py") == []
+
+
+def test_s002_broad_except_to_ok():
+    src = (
+        "class AClient(Client):\n"
+        "    def invoke(self, test, op):\n"
+        "        try:\n"
+        "            return replace(op, type='ok')\n"
+        "        except Exception:\n"
+        "            return replace(op, type='ok')\n")
+    assert "S002" in codes(lint_source(src, "f.py"), "error")
+
+
+def test_s003_broad_except_unconditional_fail():
+    src = (
+        "class AClient(Client):\n"
+        "    def invoke(self, test, op):\n"
+        "        try:\n"
+        "            return replace(op, type='ok')\n"
+        "        except Exception as e:\n"
+        "            return replace(op, type='fail', error=str(e))\n")
+    assert "S003" in codes(lint_source(src, "f.py"), "error")
+
+
+def test_s003_guarded_or_conditional_fail_is_clean():
+    # the idiomatic forms stay clean: a type conditioned on op.f, a
+    # fail return guarded by an exception test with re-raise
+    src = (
+        "class AClient(Client):\n"
+        "    def invoke(self, test, op):\n"
+        "        try:\n"
+        "            return replace(op, type='ok')\n"
+        "        except Exception as e:\n"
+        "            if 'conflict' in str(e):\n"
+        "                return replace(op, type='fail')\n"
+        "            raise\n")
+    assert lint_source(src, "f.py") == []
+    src2 = (
+        "class AClient(Client):\n"
+        "    def invoke(self, test, op):\n"
+        "        try:\n"
+        "            return replace(op, type='ok')\n"
+        "        except Exception as e:\n"
+        "            return replace(op, type='fail' if op.f == 'read'"
+        " else 'info', error=str(e))\n")
+    assert lint_source(src2, "f.py") == []
+
+
+def test_s004_db_pairing():
+    src = (
+        "class FooDB(db_mod.DB):\n"
+        "    def setup(self, test, node):\n"
+        "        pass\n")
+    diags = lint_source(src, "f.py")
+    assert codes(diags) == {"S004"}
+    assert all(d.severity == "warning" for d in diags)
+
+
+def test_s005_nemesis_completion_type():
+    src = (
+        "class FooNemesis(nemesis_mod.Nemesis):\n"
+        "    def invoke(self, test, op):\n"
+        "        return replace(op, type='ok')\n")
+    assert "S005" in codes(lint_source(src, "f.py"), "error")
+    src_ok = src.replace("'ok'", "'info'")
+    assert lint_source(src_ok, "f.py") == []
+
+
+def test_suppression_comment():
+    src = (
+        "class AClient(Client):\n"
+        "    def invoke(self, test, op):\n"
+        "        try:\n"
+        "            return replace(op, type='ok')\n"
+        "        except Exception as e:\n"
+        "            return replace(op, type='fail')  # suite-lint: ok\n")
+    assert lint_source(src, "f.py") == []
+
+
+def test_codes_documented():
+    for code in ("S001", "S002", "S003", "S004", "S005"):
+        assert code in SUITE_CODES
+
+
+# ---------------------------------------------------------------------------
+# regression tests for the defects the lint found (satellite 2)
+# ---------------------------------------------------------------------------
+
+
+def test_chronos_crashed_addjob_is_indeterminate(monkeypatch):
+    """chronos.py used to convert EVERY invoke crash to :fail — but a
+    crashed add-job POST may have been applied, and a silently-scheduled
+    job would then run without the checker expecting it.  Crashed
+    add-jobs must complete :info; crashed reads (effect-free) stay
+    :fail."""
+    from jepsen_tpu.history import Op
+    from jepsen_tpu.suites import chronos
+
+    def boom(*a, **kw):
+        raise urllib.error.URLError("connection refused")
+
+    monkeypatch.setattr(chronos.urllib.request, "urlopen", boom)
+    client = chronos.ChronosClient(node="n1")
+    job = {"name": "j1", "start": 10.0, "count": 5, "interval": 60,
+           "epsilon": 15, "duration": 5}
+    out = client.invoke({}, Op(process=0, type="invoke", f="add-job",
+                               value=job))
+    assert out.type == "info"
+
+    def read_boom(_test):
+        raise OSError("ssh down")
+
+    monkeypatch.setattr(chronos, "read_runs", read_boom)
+    out = client.invoke({}, Op(process=0, type="invoke", f="read",
+                               value=None))
+    assert out.type == "fail"
+
+
+def test_robustirc_close_deletes_server_session():
+    """robustirc's SetClient opened a server-side session per open()
+    and never deleted it — the worker reopens clients after every
+    crash, so sessions accumulated on the server for the whole run.
+    close() must issue the DELETE (and survive a dead server)."""
+    from jepsen_tpu.suites import robustirc
+
+    calls = []
+
+    class FakeSession:
+        def quit(self, message="x"):
+            calls.append("quit")
+
+    c = robustirc.SetClient("n1")
+    c.session = FakeSession()
+    c.close({})
+    assert calls == ["quit"]
+    assert c.session is None
+
+    class DeadSession:
+        def quit(self, message="x"):
+            raise OSError("server gone")
+
+    c2 = robustirc.SetClient("n1")
+    c2.session = DeadSession()
+    c2.close({})  # must not raise
+    assert c2.session is None
+
+
+def test_robustirc_session_quit_issues_delete(monkeypatch):
+    from jepsen_tpu.suites import robustirc
+
+    reqs = []
+
+    class R:
+        def __init__(self):
+            self.fp = None
+
+        def read(self):
+            return b"{}"
+
+        def __enter__(self):
+            return self
+
+        def __exit__(self, *a):
+            return False
+
+        def close(self):
+            pass
+
+    def fake_urlopen(req, timeout=None, context=None):
+        reqs.append((req.get_method(), req.full_url))
+        return R()
+
+    monkeypatch.setattr(robustirc.urllib.request, "urlopen",
+                        fake_urlopen)
+    monkeypatch.setattr(
+        robustirc.IRCSession, "__init__",
+        lambda self, node, timeout=10.0: (
+            setattr(self, "node", str(node)),
+            setattr(self, "timeout", timeout),
+            setattr(self, "ctx", None),
+            setattr(self, "session_id", "sess42"),
+            setattr(self, "session_auth", "auth"),
+        ) and None)
+    s = robustirc.IRCSession("n1")
+    s.quit()
+    assert reqs and reqs[-1][0] == "DELETE"
+    assert "/robustirc/v1/sess42" in reqs[-1][1]
+
+
+@pytest.mark.parametrize("fname", ["chronos.py", "robustirc.py"])
+def test_fixed_suites_stay_clean(fname):
+    findings = lint_paths([os.path.join(
+        REPO, "jepsen_tpu", "suites", fname)])
+    errors = [d for ds in findings.values() for d in ds
+              if d.severity == "error"]
+    assert errors == []

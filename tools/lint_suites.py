@@ -1,0 +1,65 @@
+#!/usr/bin/env python
+"""Standalone suite protocol lint — jepsen_tpu.analyze.suites as a CLI.
+
+    python tools/lint_suites.py                  # lint bundled suites
+    python tools/lint_suites.py path/to/suite.py another_dir/
+    python tools/lint_suites.py --json           # machine-readable
+
+Exit code 0 when no ERROR-severity findings (warnings don't fail the
+run), 1 otherwise.  The same check gates CI through
+tests/test_suite_lint.py, so a new suite cannot merge with protocol
+violations.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+from jepsen_tpu.analyze.suites import SUITE_CODES, lint_paths  # noqa: E402
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(
+        description="AST protocol lint over jepsen suites "
+                    "(S-codes; see docs/analyze.md)")
+    p.add_argument("paths", nargs="*",
+                   help="suite files or directories "
+                        "(default: jepsen_tpu/suites)")
+    p.add_argument("--json", action="store_true", dest="as_json",
+                   help="machine-readable output")
+    p.add_argument("--codes", action="store_true",
+                   help="list the S-codes and exit")
+    opts = p.parse_args(argv)
+    if opts.codes:
+        for code, desc in sorted(SUITE_CODES.items()):
+            print(f"{code}  {desc}")
+        return 0
+
+    findings = lint_paths(opts.paths)
+    n_err = sum(1 for ds in findings.values()
+                for d in ds if d.severity == "error")
+    n_warn = sum(1 for ds in findings.values()
+                 for d in ds if d.severity == "warning")
+    if opts.as_json:
+        print(json.dumps({
+            "errors": n_err,
+            "warnings": n_warn,
+            "files": {f: [d.to_dict() for d in ds]
+                      for f, ds in findings.items()},
+        }, indent=2))
+    else:
+        for _f, ds in sorted(findings.items()):
+            for d in ds:
+                print(f"{d.severity.upper()} {d.code} {d.message}")
+        print(f"{n_err} error(s), {n_warn} warning(s)")
+    return 1 if n_err else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -29,13 +29,38 @@ validate on the cheap host before paying for accelerated search):
     ``tools/lint_suites.py`` is the standalone CLI;
     ``tests/test_suite_lint.py`` gates the bundled suites in tier-1.
 
+Two further passes close the loop on the *output* side (ISSUE 4 —
+proof-carrying verdicts):
+
+  * :mod:`jepsen_tpu.analyze.audit` — independent certificate audit.
+    Every engine verdict now carries a certificate (``linearization``
+    or ``witness_dropped`` on valid; ``final_ops`` or
+    ``frontier_dropped`` on invalid); :func:`audit` replays it against
+    the model in pure Python (W001-W005), sharing no code with the
+    engines.  Opt-in via ``audit=True`` per call, ``JEPSEN_TPU_AUDIT=1``
+    fleet-wide, or the CLI ``--audit``; on by default in the
+    differential-fuzz tests.
+
+  * :mod:`jepsen_tpu.analyze.shrink` — counterexample minimization.
+    :func:`shrink_invalid` delta-debugs an invalid history to a
+    1-minimal failing subhistory, independently confirmed by a naive
+    brute-force permutation checker; failure reports (linear_report /
+    web UI) render the minimal core as the failure story.
+
 ``analyze(history, model)`` runs lint + plan in one call;
 ``python -m jepsen_tpu.analyze history.jsonl --model cas-register
---explain`` does the same from a stored history.
+--explain`` does the same from a stored history, and ``--audit
+result.json`` replays a stored result's certificate against it.
 """
 
 from __future__ import annotations
 
+from .audit import (  # noqa: F401
+    AUDIT_CODES,
+    AuditError,
+    audit,
+    audit_enabled,
+)
 from .lint import (  # noqa: F401
     Diagnostic,
     HistoryLintError,
@@ -46,6 +71,7 @@ from .lint import (  # noqa: F401
     scan_events,
 )
 from .plan import explain, explain_batch  # noqa: F401
+from .shrink import brute_force_check, shrink_invalid  # noqa: F401
 
 
 def analyze(history, model=None) -> dict:

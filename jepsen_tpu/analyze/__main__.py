@@ -1,14 +1,19 @@
-"""``python -m jepsen_tpu.analyze`` — lint/explain a stored history.
+"""``python -m jepsen_tpu.analyze`` — lint/explain/audit a stored history.
 
 Reads a ``history.jsonl`` (store.write_history's format: one op per
-line), lints it, and with ``--explain`` prints the static search plan::
+line), lints it; ``--explain`` prints the static search plan;
+``--audit RESULT.json`` replays a stored result's certificate
+(``linearization``/``final_ops``) against the history and model — the
+standalone certificate checker::
 
     python -m jepsen_tpu.analyze store/t/latest/history.jsonl \\
         --model cas-register --explain
     python -m jepsen_tpu.analyze history.jsonl --json
+    python -m jepsen_tpu.analyze history.jsonl --model cas-register \\
+        --audit result.json
 
-Exit codes follow cli.py's contract: 0 clean, 1 lint errors found,
-254 bad arguments.
+Exit codes follow cli.py's contract: 0 clean, 1 lint errors or audit
+W-codes found, 254 bad arguments.
 """
 
 from __future__ import annotations
@@ -57,6 +62,10 @@ def main(argv=None) -> int:
                         "capacity)")
     p.add_argument("--explain", action="store_true",
                    help="Print the static search plan (needs --model)")
+    p.add_argument("--audit", metavar="RESULT_JSON", default=None,
+                   help="Audit a stored result's certificate against "
+                        "this history (needs --model); exits 1 on any "
+                        "W-code")
     p.add_argument("--json", action="store_true", dest="as_json",
                    help="Machine-readable output")
     try:
@@ -77,6 +86,22 @@ def main(argv=None) -> int:
     if opts.explain and model is None:
         print("--explain needs --model", file=sys.stderr)
         return 254
+    if opts.audit and model is None:
+        print("--audit needs --model", file=sys.stderr)
+        return 254
+
+    audit_rep = None
+    if opts.audit:
+        from .audit import audit as run_audit
+
+        try:
+            with open(opts.audit) as f:
+                result = json.load(f)
+        except (OSError, ValueError) as e:
+            print(f"cannot read result {opts.audit}: {e}",
+                  file=sys.stderr)
+            return 254
+        audit_rep = run_audit(history, model, result)
 
     rep = analyze(history, model)
     diags = rep["diagnostics"]
@@ -85,6 +110,12 @@ def main(argv=None) -> int:
                "diagnostics": [d.to_dict() for d in diags]}
         if opts.explain:
             out["plan"] = rep["plan"]
+        if audit_rep is not None:
+            out["audit"] = {
+                "ok": audit_rep["ok"], "checked": audit_rep["checked"],
+                "codes": audit_rep["codes"],
+                "diagnostics": [d.to_dict()
+                                for d in audit_rep["diagnostics"]]}
         print(json.dumps(out, indent=2, default=str))
     else:
         for d in diags:
@@ -95,6 +126,14 @@ def main(argv=None) -> int:
             print(render_plan(rep["plan"]))
         elif opts.explain:
             print("plan skipped: history has lint errors")
+        if audit_rep is not None:
+            for d in audit_rep["diagnostics"]:
+                print(f"AUDIT {d}")
+            print(f"audit: {'ok' if audit_rep['ok'] else 'FAILED'} "
+                  f"(checked {audit_rep['checked']}, "
+                  f"{len(audit_rep['diagnostics'])} finding(s))")
+    if audit_rep is not None and not audit_rep["ok"]:
+        return 1
     return 1 if rep["errors"] else 0
 
 

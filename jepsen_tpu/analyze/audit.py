@@ -1,0 +1,265 @@
+"""Certificate audit — independent replay of proof-carrying verdicts.
+
+Every engine route now emits a *certificate* alongside its verdict
+(docs/analyze.md "Certificate format"):
+
+  * a ``valid`` result carries ``linearization`` — row indices of the
+    checked OpSeq in linearization order — or an explicit
+    ``witness_dropped: <reason>`` when the route cannot produce one
+    (device BFS keeps no parent chains, cache hits store verdicts only,
+    a witness table hit its cap, ...);
+  * an ``invalid`` result carries ``final_ops`` — the blocking frontier
+    the search exhausted — or an explicit ``frontier_dropped: <reason>``.
+
+This module is the *independent* half of that contract: a pure-Python,
+JAX-free O(n) replay of the certificate against the model, sharing no
+code with the search engines (the GPUexplore pattern, arXiv:1801.05857:
+the accelerated search earns trust by pairing with a cheap host-side
+validation of its answer).  A certificate that fails audit means an
+engine bug — a kernel miscompile, a bad bucket pad, a wrong cell stitch
+in decompose/engine.py — that the verdict alone could never reveal.
+
+W-codes (stable; documented in docs/analyze.md):
+
+==== =================================================================
+W001 certificate references an op not in the history (row out of range)
+W002 duplicate or missing op (an :ok row absent from the witness, a row
+     linearized twice, or a decided verdict with no certificate AND no
+     explicit drop reason)
+W003 witness violates real-time order (an op linearized before another
+     op that returned before it invoked)
+W004 model step rejects a witness transition (the linearization is not
+     a legal run of the model)
+W005 stitched witness violates cross-cell precedence (the decomposed
+     merge interleaved two cells against the parent history's real-time
+     order)
+==== =================================================================
+
+``audit(history, model, result)`` never raises on a bad certificate —
+it *reports*; :func:`maybe_audit` applies the wiring policy (attach the
+audit to the result, raise :class:`AuditError` on any W-code) behind the
+``audit=True`` / ``JEPSEN_TPU_AUDIT=1`` / CLI ``--audit`` opt-in.
+"""
+
+from __future__ import annotations
+
+import os
+
+from ..history import OpSeq, encode_ops
+from .lint import Diagnostic
+
+AUDIT_CODES = {
+    "W001": "certificate references an op not in the history",
+    "W002": "duplicate or missing op in the certificate",
+    "W003": "witness violates real-time order",
+    "W004": "model step rejects a witness transition",
+    "W005": "stitched witness violates cross-cell precedence",
+}
+
+
+class AuditError(ValueError):
+    """A certificate failed its independent audit.  ``diagnostics``
+    carries every W-code finding; ``audit`` the full audit dict."""
+
+    def __init__(self, audit: dict):
+        self.audit = audit
+        self.diagnostics = list(audit.get("diagnostics", ()))
+        head = "; ".join(str(d) for d in self.diagnostics[:5])
+        more = (f" (+{len(self.diagnostics) - 5} more)"
+                if len(self.diagnostics) > 5 else "")
+        super().__init__(f"certificate failed audit: {head}{more}")
+
+
+def audit_enabled() -> bool:
+    """The opt-in knob: JEPSEN_TPU_AUDIT=1/true/on/yes turns the
+    certificate audit on fleet-wide (engines also take ``audit=``)."""
+    return os.environ.get("JEPSEN_TPU_AUDIT", "").strip().lower() in (
+        "1", "true", "on", "yes")
+
+
+def _as_seq(history, model) -> OpSeq:
+    if isinstance(history, OpSeq):
+        return history
+    return encode_ops(history, model.f_codes)
+
+
+def _audit_witness(seq: OpSeq, model, result: dict, diags: list) -> None:
+    """Replay a ``linearization`` certificate: coverage (W001/W002),
+    real-time order (W003/W005), model legality (W004)."""
+    lin = result["linearization"]
+    n = len(seq)
+    # W005 needs a row -> cell map; for the key-partitioned (stitched)
+    # route the cell IS the key lane, so it is derivable from the
+    # history itself — the result does not have to ship a row map
+    stitched = bool((result.get("decompose") or {}).get("stitched"))
+    cell_of = None
+    if stitched and getattr(model, "name", "") == "multi-register":
+        cell_of = [int(x) for x in seq.v1]
+
+    seen: set[int] = set()
+    rows: list[int] = []
+    for pos, r in enumerate(lin):
+        if not isinstance(r, int) or isinstance(r, bool) \
+                or not 0 <= r < n:
+            diags.append(Diagnostic(
+                "W001", "error",
+                f"witness position {pos} references row {r!r}, not a "
+                f"row of this {n}-op history", index=pos))
+            continue
+        if r in seen:
+            diags.append(Diagnostic(
+                "W002", "error",
+                f"row {r} appears more than once in the witness "
+                f"(position {pos})", index=r))
+            continue
+        seen.add(r)
+        rows.append(r)
+
+    ok = seq.ok
+    missing = [i for i in range(n) if bool(ok[i]) and i not in seen]
+    for i in missing[:8]:
+        diags.append(Diagnostic(
+            "W002", "error",
+            f":ok row {i} is missing from the witness (every ok op "
+            f"must linearize)", index=i))
+    if len(missing) > 8:
+        diags.append(Diagnostic(
+            "W002", "error",
+            f"...and {len(missing) - 8} more :ok rows missing"))
+
+    # real-time: no witness op may precede an op that returned before
+    # it invoked.  One pass tracking the running max invocation rank
+    # (and which row holds it): a later row returning below that max
+    # was really ordered after its own return.
+    inv = [int(x) for x in seq.inv]
+    ret = [int(x) for x in seq.ret]
+    max_inv = -1
+    max_inv_row = -1
+    for r in rows:
+        if ret[r] < max_inv:
+            code, extra = "W003", ""
+            if cell_of is not None and cell_of[r] != cell_of[max_inv_row]:
+                code = "W005"
+                extra = (f" (cells {cell_of[max_inv_row]} vs "
+                         f"{cell_of[r]}: the stitch broke cross-cell "
+                         f"precedence)")
+            diags.append(Diagnostic(
+                code, "error",
+                f"row {r} (returns at rank {ret[r]}) is linearized "
+                f"after row {max_inv_row} (invokes at rank "
+                f"{inv[max_inv_row]}) although it returned first"
+                f"{extra}", index=r))
+        if inv[r] > max_inv:
+            max_inv, max_inv_row = inv[r], r
+
+    # model replay — the independent legality check (plain pystep; no
+    # engine encodings, no JAX)
+    pystep = model.pystep
+    state = model.init
+    f = seq.f
+    v1 = seq.v1
+    v2 = seq.v2
+    for r in rows:
+        ns = pystep(state, int(f[r]), int(v1[r]), int(v2[r]))
+        if ns is None:
+            op = seq.ops[r] if seq.ops else None
+            what = (f"{op.process} {op.f} {op.value!r}" if op is not None
+                    else f"f={int(f[r])} v1={int(v1[r])} v2={int(v2[r])}")
+            diags.append(Diagnostic(
+                "W004", "error",
+                f"model {model.name!r} rejects witness step at row {r} "
+                f"({what}) from state {tuple(state)}", index=r))
+            break  # later steps run from a state that never existed
+        state = ns
+
+
+def audit(history, model, result: dict) -> dict:
+    """Audit one engine result's certificate.  Returns::
+
+        {"ok": bool, "checked": what-was-audited, "codes": [...],
+         "diagnostics": [Diagnostic...], "witness_ops": n | None}
+
+    ``checked`` is ``"linearization"`` (full replay ran),
+    ``"witness_dropped"`` / ``"frontier_dropped"`` (explicit drop reason
+    accepted, nothing to replay), ``"final_ops"`` (frontier rows
+    range-checked), or ``"undecided"``.  Never raises on a bad
+    certificate — :func:`maybe_audit` applies the raising policy.
+    """
+    seq = _as_seq(history, model)
+    diags: list[Diagnostic] = []
+    v = result.get("valid")
+    out: dict = {"ok": True, "checked": "undecided", "codes": [],
+                 "diagnostics": diags, "witness_ops": None}
+
+    if v is True:
+        lin = result.get("linearization")
+        if lin is None:
+            out["checked"] = "witness_dropped"
+            reason = result.get("witness_dropped")
+            if reason is None:
+                diags.append(Diagnostic(
+                    "W002", "error",
+                    "valid verdict carries neither `linearization` nor "
+                    "a `witness_dropped` reason — the certificate "
+                    "contract requires one of the two"))
+            else:
+                out["witness_dropped"] = reason
+        else:
+            out["checked"] = "linearization"
+            out["witness_ops"] = len(lin)
+            _audit_witness(seq, model, result, diags)
+    elif v is False:
+        frontier = result.get("final_ops")
+        if frontier is None:
+            out["checked"] = "frontier_dropped"
+            reason = result.get("frontier_dropped")
+            if reason is None:
+                diags.append(Diagnostic(
+                    "W002", "error",
+                    "invalid verdict carries neither `final_ops` nor a "
+                    "`frontier_dropped` reason — the certificate "
+                    "contract requires one of the two"))
+            else:
+                out["frontier_dropped"] = reason
+        else:
+            out["checked"] = "final_ops"
+            n = len(seq)
+            for r in frontier:
+                if not isinstance(r, int) or isinstance(r, bool) \
+                        or not 0 <= r < n:
+                    diags.append(Diagnostic(
+                        "W001", "error",
+                        f"blocking frontier references row {r!r}, not a "
+                        f"row of this {n}-op history"))
+
+    out["codes"] = sorted({d.code for d in diags})
+    out["ok"] = not diags
+    return out
+
+
+def _summary(a: dict) -> dict:
+    """The JSON-serializable form attached to result dicts."""
+    out = {"ok": a["ok"], "checked": a["checked"], "codes": a["codes"]}
+    if a.get("witness_ops") is not None:
+        out["witness_ops"] = a["witness_ops"]
+    if not a["ok"]:
+        out["diagnostics"] = [d.to_dict() for d in a["diagnostics"]]
+    return out
+
+
+def maybe_audit(seq, model, result: dict,
+                audit_flag: bool | None = None) -> dict:
+    """The engines' shared audit postamble: resolve the three-state
+    ``audit`` flag (None follows JEPSEN_TPU_AUDIT, default off), run the
+    audit, attach the summary as ``result["audit"]``, and raise
+    :class:`AuditError` on any W-code — a certificate its own engine
+    cannot replay is an engine bug, and opting into the audit means
+    wanting it loud.  ONE home for the policy, mirroring
+    ``lint.maybe_lint``."""
+    if not (audit_flag if audit_flag is not None else audit_enabled()):
+        return result
+    a = audit(seq, model, result)
+    result["audit"] = _summary(a)
+    if not a["ok"]:
+        raise AuditError(a)
+    return result

@@ -1,0 +1,192 @@
+"""Counterexample minimization — delta-debug invalid verdicts.
+
+An invalid verdict on a 10k-op history is true but useless to a human:
+the defect usually lives in a handful of ops.  :func:`shrink_invalid`
+is a ddmin-style delta debugger over the completed-op rows of an OpSeq:
+it removes row chunks while a bounded engine still answers ``invalid``,
+halving the chunk size down to single rows, and terminates in a
+1-minimal failing subhistory (removing any one remaining op makes the
+engine stop answering invalid).  The result is *independently*
+confirmed by :func:`brute_force_check`, a deliberately naive exact
+permutation search that shares no code with the engines — small enough
+concurrency makes exhaustive enumeration cheap, and an engine bug that
+survived the differential fuzz would have to be shared by this ~40-line
+recursion too.
+
+The minimal core is *explanatory*, not a substitute for the verdict's
+own certificate (the blocking frontier): removing ops can change a
+history's verdict in either direction, so each removal is re-validated
+by re-checking — the chain starts at the full history the engine
+decided invalid, and every link (including the final core) is a
+machine-confirmed invalid history.  ``linear_report``/the web UI render
+the core as the failure story — a 6-op story, not a 10k-op dump.
+"""
+
+from __future__ import annotations
+
+import os
+
+from ..history import INF_RET, OpSeq
+from ..models import ModelSpec
+
+
+def shrink_enabled() -> bool:
+    """JEPSEN_TPU_SHRINK=0/off/false/no disables counterexample
+    minimization in failure reports (default on; it only ever touches
+    reporting, never verdicts)."""
+    return os.environ.get("JEPSEN_TPU_SHRINK", "").strip().lower() not in (
+        "0", "off", "false", "no")
+
+
+def brute_force_check(seq: OpSeq, model: ModelSpec, *,
+                      max_ops: int = 16,
+                      max_nodes: int = 2_000_000):
+    """Exhaustive linearizability check by permutation enumeration.
+
+    True/False exactly; None when the history is too big (``max_ops``)
+    or the node budget runs out.  Deliberately engine-independent: a
+    plain DFS that at each step tries EVERY unlinearized op allowed by
+    the O(n) pairwise real-time test (op j may go next iff no other
+    unlinearized op returned before j invoked) and the model — no
+    window encodings, no dominance pruning, no candidate memoization.
+    A visited set on (linearized-set, state) keeps it finite; that is
+    bookkeeping, not search strategy.
+    """
+    n = len(seq)
+    if n > max_ops:
+        return None
+    inv = [int(x) for x in seq.inv]
+    ret = [int(x) for x in seq.ret]
+    f = [int(x) for x in seq.f]
+    v1 = [int(x) for x in seq.v1]
+    v2 = [int(x) for x in seq.v2]
+    ok_mask = 0
+    for i in range(n):
+        if bool(seq.ok[i]):
+            ok_mask |= 1 << i
+    pystep = model.pystep
+    visited: set = set()
+    stack = [(0, model.init)]
+    nodes = 0
+    while stack:
+        mask, state = stack.pop()
+        if (mask, state) in visited:
+            continue
+        visited.add((mask, state))
+        nodes += 1
+        if nodes > max_nodes:
+            return None
+        if mask & ok_mask == ok_mask:
+            return True
+        for j in range(n):
+            if (mask >> j) & 1:
+                continue
+            # real-time: some other unlinearized op returned before j
+            # invoked -> j cannot go next
+            if any(not (mask >> k) & 1 and k != j and ret[k] < inv[j]
+                   for k in range(n)):
+                continue
+            ns = pystep(state, f[j], v1[j], v2[j])
+            if ns is None:
+                continue
+            stack.append((mask | (1 << j), ns))
+    return False
+
+
+def _default_check(max_configs: int):
+    def check(sub: OpSeq, model: ModelSpec) -> dict:
+        from ..checker.seq import check_opseq
+
+        return check_opseq(sub, model, max_configs=max_configs,
+                           lint=False)
+
+    return check
+
+
+def shrink_invalid(seq: OpSeq, model: ModelSpec, *,
+                   check=None,
+                   max_checks: int = 400,
+                   max_configs: int = 200_000,
+                   brute_max_ops: int = 16) -> dict:
+    """ddmin an invalid history down to a minimal failing subhistory.
+
+    ``check(sub_seq, model) -> result dict`` re-verdicts candidates
+    (default: the bounded WGL host oracle); a removal is kept only while
+    the answer stays ``False``.  Returns::
+
+        {"rows": kept original-row indices, "n_from": n, "n_to": k,
+         "checks": engine calls spent, "minimal": 1-minimality proven,
+         "brute_force": True|False|None}
+
+    ``brute_force`` is the independent confirmation of the final core
+    (None when it exceeded ``brute_max_ops``).  ``minimal`` is False
+    when ``max_checks`` ran out first — the core is still a confirmed
+    invalid subhistory, just possibly not 1-minimal.  Idempotent:
+    shrinking a minimal core returns every row unchanged.
+    """
+    from ..decompose.partition import subseq
+
+    if check is None:
+        check = _default_check(max_configs)
+    checks = 0
+
+    def still_invalid(rows: list[int]) -> bool:
+        nonlocal checks
+        checks += 1
+        return check(subseq(seq, rows), model).get("valid") is False
+
+    rows = list(range(len(seq)))
+    out = {"rows": rows, "n_from": len(seq), "n_to": len(rows),
+           "checks": 0, "minimal": False, "brute_force": None}
+    if not rows or not still_invalid(rows):
+        # the bounded re-check cannot reproduce the invalid verdict
+        # (budget, or the result was not invalid): nothing to shrink
+        out["checks"] = checks
+        return out
+
+    chunk = max(1, len(rows) // 2)
+    minimal = False
+    while checks < max_checks:
+        i = 0
+        removed = False
+        while i < len(rows) and checks < max_checks:
+            cand = rows[:i] + rows[i + chunk:]
+            if cand and still_invalid(cand):
+                rows = cand
+                removed = True
+            else:
+                i += chunk
+        if chunk == 1:
+            if not removed:
+                minimal = True  # a clean single-row pass: 1-minimal
+                break
+        else:
+            chunk = max(1, chunk // 2)
+
+    sub = subseq(seq, rows)
+    out.update({
+        "rows": [int(r) for r in rows],
+        "n_to": len(rows),
+        "checks": checks,
+        "minimal": minimal,
+        "brute_force": brute_force_check(sub, model,
+                                         max_ops=brute_max_ops),
+    })
+    return out
+
+
+def shrink_summary(seq: OpSeq, shrunk: dict) -> dict:
+    """The JSON/report-ready form of a shrink outcome: the stats plus
+    the core rendered as op dicts (the "6-op story") when the OpSeq
+    still carries its source ops."""
+    out = {k: shrunk[k] for k in ("rows", "n_from", "n_to", "checks",
+                                  "minimal", "brute_force")}
+    if seq.ops:
+        ops = []
+        for r in shrunk["rows"]:
+            op = seq.ops[r]
+            d = op.to_dict()
+            d["crashed"] = int(seq.ret[r]) == INF_RET
+            ops.append(d)
+        out["ops"] = ops
+    return out

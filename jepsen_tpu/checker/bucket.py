@@ -166,9 +166,12 @@ def search_batch_bucketed(seqs: list[OpSeq], model: ModelSpec, *,
         for i in idxs:
             s = seqs[i]
             if lin.greedy_witness(s, model):
+                # the certificate indexes the key's OWN OpSeq, so it
+                # survives bucket assignment and reordering untouched
                 ready[i] = {"valid": True, "configs": s.n_must,
                             "max_depth": s.n_must,
-                            "engine": "greedy-witness"}
+                            "engine": "greedy-witness",
+                            "linearization": lin.greedy_linearization(s)}
             else:
                 run.append(i)
         if not run:
@@ -231,7 +234,8 @@ def search_batch_bucketed(seqs: list[OpSeq], model: ModelSpec, *,
             if lin.greedy_witness(s, model):
                 results[i] = {"valid": True, "configs": s.n_must,
                               "max_depth": s.n_must,
-                              "engine": "greedy-witness"}
+                              "engine": "greedy-witness",
+                              "linearization": lin.greedy_linearization(s)}
                 stats["greedy"] += 1
                 continue
             r = check_opseq_linear(seqs[i], model, lint=False)

@@ -54,6 +54,13 @@ from ..history import OpSeq
 from ..models import ModelSpec
 from .linearizable import INF32, encode_search
 
+#: the ONE default parent-table bound for witness-tracking callers
+#: (user-facing Linearizable, competition/portfolio legs, decomposed
+#: sub-searches, segment sweeps): ~a few hundred MB of dict at worst,
+#: after which the witness is dropped with an explicit reason and the
+#: verdict continues unaffected
+DEFAULT_WITNESS_CAP = 2_000_000
+
 
 def _advance(p: int, win: int, bit: int, n_det: int):
     """Set ``bit`` (window-relative) in win, then slide the prefix over
@@ -85,26 +92,35 @@ def check_opseq_linear(seq: OpSeq, model: ModelSpec, *,
                        resume_from: str | None = None,
                        decompose: bool = False,
                        decompose_cache=None,
-                       lint: bool | None = None) -> dict:
+                       lint: bool | None = None,
+                       audit: bool | None = None) -> dict:
     """Exact linearizability check.  Returns a knossos-style map
     {"valid": True|False|"unknown", "configs": n, "max_depth": d, ...};
     on invalid, ``final_ops`` holds the un-linearizable candidate rows at
     the deepest level reached (the :final-paths analog, truncated to 10
-    as checker.clj:136-139 truncates).  With ``witness_cap`` > 0, a
-    valid verdict carries ``linearization`` — witness row indices in
-    linearization order — as long as the parent table stayed under the
-    cap (a big sweep drops witness tracking rather than memory-bloat).
-    The default is OFF: verdict-only callers (competition legs, the
-    portfolio, fuzzers) keep the level-local memory profile; the
-    user-facing Linearizable checker opts in.
+    as checker.clj:136-139 truncates) — the blocking frontier the search
+    exhausted.  With ``witness_cap`` > 0, a valid verdict carries
+    ``linearization`` — witness row indices in linearization order — as
+    long as the parent table stayed under the cap (a big sweep drops
+    witness tracking rather than memory-bloat).  The default is OFF:
+    verdict-only callers (competition legs, the portfolio, fuzzers)
+    keep the level-local memory profile; the user-facing Linearizable
+    checker opts in.  Whenever a valid verdict has no witness it says
+    so explicitly: ``witness_dropped`` names the reason (tracking
+    disabled, cap exceeded, witnessless checkpoint), so a missing
+    certificate is a statement, never an accident.
 
     Checkpointing (SURVEY §5.4's search-checkpoint story, host side):
     with ``checkpoint_path`` and ``checkpoint_every`` N, the level set
     is snapshotted every N levels (atomic rename); ``resume_from``
     continues a run from such a snapshot after verifying it binds to
     this exact (history, model) — the level set IS the whole search
-    state, so nothing else needs saving.  Resumed runs report verdicts
-    only (no witness: the parent table is not serialized).
+    state, so nothing else needs saving.  When witness tracking is
+    live at snapshot time the shared parent table (the pre-snapshot
+    prefix orders, bounded by ``witness_cap``) is serialized too, so a
+    resumed run with ``witness_cap`` > 0 still emits a full witness;
+    resuming from a witnessless snapshot reports ``witness_dropped``
+    instead.
 
     ``decompose`` routes through the P-compositional decomposition
     layer (jepsen_tpu/decompose/) with this sweep as the sub-engine —
@@ -113,10 +129,16 @@ def check_opseq_linear(seq: OpSeq, model: ModelSpec, *,
 
     ``lint`` runs the O(n) well-formedness linter (analyze/lint.py)
     over the OpSeq first — on by default (None follows JEPSEN_TPU_LINT);
-    errors raise :class:`~jepsen_tpu.analyze.HistoryLintError`."""
+    errors raise :class:`~jepsen_tpu.analyze.HistoryLintError`.
+    ``audit`` replays the emitted certificate through the independent
+    audit pass (analyze/audit.py; None follows JEPSEN_TPU_AUDIT)."""
+    from ..analyze.audit import maybe_audit
     from ..analyze.lint import maybe_lint
 
     maybe_lint(seq, model, lint)
+
+    def finish(out: dict) -> dict:
+        return maybe_audit(seq, model, out, audit)
     if decompose:
         if checkpoint_path or resume_from:
             # the decomposed funnel has no serialized level-set to
@@ -137,19 +159,20 @@ def check_opseq_linear(seq: OpSeq, model: ModelSpec, *,
         def _sub(s, m, *, max_configs=max_configs, deadline=deadline):
             return check_opseq_linear(s, m, max_configs=max_configs,
                                       deadline=deadline, cancel=cancel,
+                                      witness_cap=witness_cap,
                                       lint=False)
 
         return check_opseq_decomposed(seq, model, cache=decompose_cache,
                                       direct=_direct, sub_check=_sub,
                                       sub_max_configs=max_configs,
-                                      deadline=deadline, lint=False)
+                                      deadline=deadline, lint=False,
+                                      witness=witness_cap > 0,
+                                      audit=audit)
     es = encode_search(seq)
     n_det, n_crash, W = es.n_det, es.n_crash, es.window
     if n_det == 0 and n_crash == 0:
-        out = {"valid": True, "configs": 0, "max_depth": 0}
-        if witness_cap:
-            out["linearization"] = []
-        return out
+        return finish({"valid": True, "configs": 0, "max_depth": 0,
+                       "linearization": []})
 
     det_inv = [int(x) for x in es.det_inv]
     det_ret = [int(x) for x in es.det_ret]
@@ -222,19 +245,37 @@ def check_opseq_linear(seq: OpSeq, model: ModelSpec, *,
         from .linearizable import history_digest
 
         _digest = history_digest(seq, model)  # computed once per run
-    if resume_from is not None:
-        level, depth, configs = _load_linear_checkpoint(
-            resume_from, model, _digest)
-        witness_cap = 0  # parent chains do not survive a snapshot
+    #: why a valid verdict will carry no witness (None = witness live)
+    witness_drop = None if witness_cap else \
+        "witness tracking disabled (witness_cap=0)"
     # (key, cmask) -> (op row, parent (key, cmask)); None once capped
     parents: dict | None = {root: None} if witness_cap else None
+    if resume_from is not None:
+        level, depth, configs, saved_parents = _load_linear_checkpoint(
+            resume_from, model, _digest)
+        if witness_cap and saved_parents is not None:
+            # the snapshot's parent table resumes the walk as if the
+            # run had never stopped (a live table is whole, so every
+            # level config's chain reaches the root through it)
+            parents = saved_parents
+            parents.setdefault(root, None)
+        elif witness_cap:
+            witness_cap = 0
+            parents = None
+            witness_drop = ("resumed from a witnessless checkpoint "
+                            "(no parent table was serialized)")
+        else:
+            witness_cap = 0
+            parents = None
 
     def remember(child_key, child_cm, op_row, par_key, par_cm):
-        nonlocal parents
+        nonlocal parents, witness_drop
         if parents is None:
             return
         if len(parents) >= witness_cap:
             parents = None  # witness off; the verdict is unaffected
+            witness_drop = (f"parent table exceeded "
+                            f"witness_cap={witness_cap}")
             return
         parents.setdefault((child_key, child_cm),
                            (op_row, (par_key, par_cm)))
@@ -280,14 +321,15 @@ def check_opseq_linear(seq: OpSeq, model: ModelSpec, *,
         if (checkpoint_path and checkpoint_every
                 and depth and depth % checkpoint_every == 0):
             _save_linear_checkpoint(checkpoint_path, model, _digest,
-                                    level, depth, configs)
+                                    level, depth, configs,
+                                    parents=parents)
         # --- crash closure within the level (depth unchanged) ----------
         work = [(k, cm) for k, ac in level.items() for cm in ac]
         while work:
             why = over_budget()
             if why:
-                return {"valid": "unknown", "configs": configs,
-                        "max_depth": depth, "info": why}
+                return finish({"valid": "unknown", "configs": configs,
+                               "max_depth": depth, "info": why})
             (p, win, state), cmask = work.pop()
             fr = frame(p, win)
             for c, f, v1, v2 in fr.crash:
@@ -312,7 +354,9 @@ def check_opseq_linear(seq: OpSeq, model: ModelSpec, *,
                 lin = walk((p, win, _s), ac[0])
                 if lin is not None:
                     out["linearization"] = lin
-                return out
+                else:
+                    out["witness_dropped"] = witness_drop
+                return finish(out)
 
         # --- expand determinate candidates to the next level -----------
         nxt: dict[tuple, list[int]] = {}
@@ -331,8 +375,8 @@ def check_opseq_linear(seq: OpSeq, model: ModelSpec, *,
                                  (p, win, state), cmask)
             why = over_budget()
             if why:
-                return {"valid": "unknown", "configs": configs,
-                        "max_depth": depth, "info": why}
+                return finish({"valid": "unknown", "configs": configs,
+                               "max_depth": depth, "info": why})
         if not nxt:
             # frontier died: collect the blocked candidates for reporting
             final_ops: list[int] = []
@@ -349,8 +393,9 @@ def check_opseq_linear(seq: OpSeq, model: ModelSpec, *,
                     if r not in seen:
                         seen.add(r)
                         final_ops.append(r)
-            return {"valid": False, "configs": configs,
-                    "max_depth": depth, "final_ops": sorted(final_ops)}
+            return finish({"valid": False, "configs": configs,
+                           "max_depth": depth,
+                           "final_ops": sorted(final_ops)})
         level = nxt
         depth += 1
 
@@ -361,9 +406,19 @@ def check_opseq_linear(seq: OpSeq, model: ModelSpec, *,
 # ---------------------------------------------------------------------------
 
 
+def _node_json(node) -> list:
+    (p, win, state), cm = node
+    return [p, win, list(state), cm]
+
+
+def _node_from_json(row) -> tuple:
+    p, win, state, cm = row
+    return ((p, win, tuple(state)), cm)
+
+
 def _save_linear_checkpoint(path: str, model: ModelSpec, digest: str,
-                            level: dict, depth: int, configs: int
-                            ) -> None:
+                            level: dict, depth: int, configs: int, *,
+                            parents: dict | None = None) -> None:
     import json
     import os
 
@@ -379,6 +434,15 @@ def _save_linear_checkpoint(path: str, model: ModelSpec, digest: str,
         "level": [[k[0], k[1], list(k[2]), list(ac)]
                   for k, ac in level.items()],
     }
+    if parents is not None:
+        # the SHARED parent table (bounded by witness_cap), not one
+        # root-to-config chain per level config — per-config chains
+        # would be O(|level| x depth) ints where the table is O(kept
+        # configs); a resumed run walks it exactly like a live one
+        payload["parents"] = [
+            _node_json(child) + [op_row, _node_json(par)]
+            for child, entry in parents.items() if entry is not None
+            for op_row, par in (entry,)]
     tmp = f"{path}.tmp"
     with open(tmp, "w") as f:
         json.dump(payload, f)
@@ -386,6 +450,10 @@ def _save_linear_checkpoint(path: str, model: ModelSpec, digest: str,
 
 
 def _load_linear_checkpoint(path: str, model: ModelSpec, digest: str):
+    """Returns (level, depth, configs, parents) — ``parents`` is the
+    snapshot's witness parent table ((key, cmask) -> (op row, parent
+    node)), or None when the snapshot carried no witness data (witness
+    tracking was off or capped when it was taken)."""
     import json
 
     with open(path) as f:
@@ -400,4 +468,11 @@ def _load_linear_checkpoint(path: str, model: ModelSpec, digest: str):
             "parameterization (digest mismatch)")
     level = {(p, win, tuple(state)): list(ac)
              for p, win, state, ac in payload["level"]}
-    return level, payload["depth"], payload["configs"]
+    parents = None
+    raw = payload.get("parents")
+    if raw is not None:
+        parents = {}
+        for p, win, state, cm, op_row, par in raw:
+            parents[((p, win, tuple(state)), cm)] = \
+                (op_row, _node_from_json(par))
+    return level, payload["depth"], payload["configs"], parents

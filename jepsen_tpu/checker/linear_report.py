@@ -102,6 +102,36 @@ def _svg(seq: OpSeq, result: dict) -> str:
     return "".join(parts)
 
 
+def shrink_block(result: dict) -> str:
+    """The minimal-counterexample story (analyze/shrink.py's outcome):
+    a failure report should lead with the 6-op core, not the 10k-op
+    haystack.  Shared with the web UI result page (web.result_block) —
+    ONE renderer for the shrink payload."""
+    sh = result.get("shrink")
+    if not sh:
+        return ""
+    confirm = {True: "brute-force checker says VALID — engine "
+                     "divergence, report it",
+               False: "independently confirmed invalid by the "
+                      "brute-force permutation checker",
+               None: "too large for the brute-force confirmation"
+               }[sh.get("brute_force")]
+    items = ""
+    for d in (sh.get("ops") or []):
+        tag = " <em>(crashed)</em>" if d.get("crashed") else ""
+        v = "" if d.get("value") is None else f" {d['value']!r}"
+        items += (f"<li><code>{html_mod.escape(str(d.get('process')))} "
+                  f"{html_mod.escape(str(d.get('f')))}"
+                  f"{html_mod.escape(v)}</code>{tag}</li>")
+    minimal = "1-minimal" if sh.get("minimal") else \
+        "reduced (check budget hit before 1-minimality)"
+    return (f"<h3>Minimal failing subhistory</h3>"
+            f"<p>{sh.get('n_from')} ops shrank to "
+            f"<b>{sh.get('n_to')}</b> ({minimal}, "
+            f"{sh.get('checks')} re-checks); {confirm}.</p>"
+            f"<ol>{items}</ol>")
+
+
 def render_linear_html(seq: OpSeq, result: dict) -> str:
     """The full linear.html document for an invalid verdict."""
     paths = (result.get("final_paths") or [])[:10]
@@ -129,6 +159,7 @@ padding:4px 8px;font-family:monospace;font-size:12px}}</style>
 <h2>Linearizability failure</h2>
 <p>configs explored: {result.get('configs')} ·
 max depth: {result.get('max_depth')} · {legend}</p>
+{shrink_block(result)}
 {_svg(seq, result)}
 <h3>Ops that could not be linearized (≤ 10)</h3>
 <ul>{frontier_items}</ul>

@@ -1857,11 +1857,35 @@ def greedy_witness(seq: OpSeq, model: ModelSpec) -> bool:
     return True
 
 
+def greedy_linearization(seq: OpSeq) -> list[int]:
+    """The certificate behind a True `greedy_witness`: the ok rows in
+    completion order — exactly the sequence the greedy replay already
+    model-checked, emitted so the verdict is auditable
+    (analyze/audit.py) instead of trust-me."""
+    return [i for i in sorted(range(len(seq)),
+                              key=lambda i: int(seq.ret[i]))
+            if bool(seq.ok[i])]
+
+
+#: certificate drop reasons for the device engines (the BFS keeps no
+#: parent chains in HBM — by design: a frontier of millions of configs
+#: times the search depth would not fit, and the user-facing checker
+#: reconstructs witnesses host-side instead)
+WITNESS_DROPPED_DEVICE = (
+    "device-bfs keeps no parent chains; re-check with the host "
+    "`linear` engine (witness_cap > 0) for a witness")
+FRONTIER_DROPPED_DEVICE = (
+    "device-bfs localizes the obstruction by depth/window only; "
+    "Linearizable re-verifies invalid device verdicts host-side to "
+    "extract the frontier")
+
+
 def search_opseq(seq: OpSeq, model: ModelSpec, *,
                  budget: int = 20_000_000,
                  dims: SearchDims | None = None,
                  on_slice=None, deadline: float | None = None,
-                 stop=None, lint: bool | None = None) -> dict:
+                 stop=None, lint: bool | None = None,
+                 audit: bool | None = None) -> dict:
     """Check one columnar history on device.  Returns a knossos-style map
     {"valid": True|False|"unknown", "configs": n, "max_depth": d}.
 
@@ -1873,17 +1897,28 @@ def search_opseq(seq: OpSeq, model: ModelSpec, *,
     ``stop`` (a ``threading.Event``) aborts between slices — the
     competition hook.  ``lint`` runs the O(n) well-formedness linter
     first (None follows JEPSEN_TPU_LINT; errors raise
-    HistoryLintError)."""
+    HistoryLintError).  Certificates: greedy/trivial verdicts carry
+    their ``linearization``; device verdicts carry explicit
+    ``witness_dropped``/``frontier_dropped`` reasons (the BFS keeps no
+    parent chains); ``audit`` replays whatever certificate is emitted
+    (None follows JEPSEN_TPU_AUDIT)."""
+    from ..analyze.audit import maybe_audit
     from ..analyze.lint import maybe_lint
 
     maybe_lint(seq, model, lint)
+
+    def finish(out: dict) -> dict:
+        return maybe_audit(seq, model, out, audit)
+
     es = encode_search(seq)
     if es.n_det == 0 and es.n_crash == 0:
-        return {"valid": True, "configs": 0, "max_depth": 0,
-                "engine": "trivial"}
+        return finish({"valid": True, "configs": 0, "max_depth": 0,
+                       "engine": "trivial", "linearization": []})
     if greedy_witness(seq, model):
-        return {"valid": True, "configs": es.n_det, "max_depth": es.n_det,
-                "engine": "greedy-witness"}
+        return finish({"valid": True, "configs": es.n_det,
+                       "max_depth": es.n_det,
+                       "engine": "greedy-witness",
+                       "linearization": greedy_linearization(seq)})
     if es.window > MAX_WINDOW or es.n_crash > MAX_CRASH:
         # past the device encoding limits: the linear host sweep has no
         # window/crash caps and dominates the WGL DFS on exactly the
@@ -1893,24 +1928,30 @@ def search_opseq(seq: OpSeq, model: ModelSpec, *,
         out = check_opseq_linear(seq, model, deadline=deadline,
                                  cancel=stop, lint=False)
         out["engine"] = "host-linear(fallback)"
-        return out
+        return finish(out)
 
     dims = dims or choose_dims(es, model)
     esp = pad_search(es, dims.n_det_pad, dims.n_crash_pad)
     status, configs, max_depth, dims, used_pallas = _run_kernel(
         esp, es, model, dims, budget, on_slice=on_slice,
         deadline=deadline, stop=stop)
-    return {"valid": _STATUS[status], "configs": configs,
-            "max_depth": max_depth,
-            "engine": _engine_label(used_pallas),
-            "frontier": dims.frontier,
-            "window": es.window, "concurrency": es.concurrency}
+    out = {"valid": _STATUS[status], "configs": configs,
+           "max_depth": max_depth,
+           "engine": _engine_label(used_pallas),
+           "frontier": dims.frontier,
+           "window": es.window, "concurrency": es.concurrency}
+    if out["valid"] is True:
+        out["witness_dropped"] = WITNESS_DROPPED_DEVICE
+    elif out["valid"] is False:
+        out["frontier_dropped"] = FRONTIER_DROPPED_DEVICE
+    return finish(out)
 
 
 def check_competition(seq: OpSeq, model: ModelSpec, *,
                       budget: int = 20_000_000,
                       max_configs: int = 50_000_000,
-                      lint: bool | None = None) -> dict:
+                      lint: bool | None = None,
+                      audit: bool | None = None) -> dict:
     """Race the exact host checkers against the device BFS search; the
     first conclusive verdict wins and retires the losers.
 
@@ -1924,18 +1965,28 @@ def check_competition(seq: OpSeq, model: ModelSpec, *,
     spaces at device throughput.  Host legs run in daemon threads (they
     release the GIL only at cancellation checks, but the device thread
     spends its time blocked in XLA executions, which do release it).
+
+    The winner's CERTIFICATE propagates with its verdict: host legs
+    carry real witnesses/frontiers (the wgl DFS for free, the linear
+    sweep under a bounded witness_cap), the device leg explicit drop
+    reasons; ``audit`` replays whichever certificate won (None follows
+    JEPSEN_TPU_AUDIT).
     """
     import threading
 
     from . import seq as seqmod
-    from .linear import check_opseq_linear
+    from .linear import DEFAULT_WITNESS_CAP, check_opseq_linear
 
     # one lint at the race's boundary; the legs run lint-free (they
     # share the seq, and a loser leg raising HistoryLintError inside a
     # daemon thread would be swallowed as a leg error)
+    from ..analyze.audit import maybe_audit
     from ..analyze.lint import maybe_lint
 
     maybe_lint(seq, model, lint)
+
+    def finish(out: dict) -> dict:
+        return maybe_audit(seq, model, out, audit)
 
     # the host DFS memoizes each config TWICE (visited + parent_of) as a
     # (bigint linearized-set, state tuple) pair: ~n/8 bytes of mask plus
@@ -1973,8 +2024,12 @@ def check_competition(seq: OpSeq, model: ModelSpec, *,
 
     def linear_leg():
         try:
+            # a bounded witness_cap: the leg's verdict stays the same,
+            # but a win carries a real certificate instead of a drop
             r = check_opseq_linear(seq, model, max_configs=max_configs,
-                                   cancel=done, lint=False)
+                                   cancel=done,
+                                   witness_cap=DEFAULT_WITNESS_CAP,
+                                   lint=False)
         except Exception:  # noqa: BLE001
             return
         submit(r, "competition(host-linear)")
@@ -1996,7 +2051,7 @@ def check_competition(seq: OpSeq, model: ModelSpec, *,
             if result:
                 out = dict(result)
                 out["engine"] += "+device-skipped(encoding limits)"
-                return out
+                return finish(out)
         return {"valid": "unknown", "configs": 0,
                 "engine": "competition(exhausted; device encoding limits)"}
 
@@ -2015,7 +2070,7 @@ def check_competition(seq: OpSeq, model: ModelSpec, *,
             t.join(timeout=5.0)
     with lock:
         if result:
-            return dict(result)
+            return finish(dict(result))
     # all inconclusive (budgets exhausted)
     return {**dev, "engine": "competition(exhausted)"}
 
@@ -2345,6 +2400,19 @@ def _drive_batch_compacting(fn, esps, model: ModelSpec, dims: SearchDims,
             out[4].astype(bool))
 
 
+def _audit_batch(seqs: list[OpSeq], model: ModelSpec,
+                 results: list[dict], audit: bool) -> list[dict]:
+    """Per-key certificate audit for the batch routes (one shared exit
+    so every return path of `search_batch` applies the same policy;
+    `search_batch` resolves the three-state flag to a bool at entry)."""
+    if audit:
+        from ..analyze.audit import maybe_audit
+
+        for s, r in zip(seqs, results):
+            maybe_audit(s, model, r, True)
+    return results
+
+
 def search_batch(seqs: list[OpSeq], model: ModelSpec, *,
                  budget: int = 2_000_000,
                  dims: SearchDims | None = None,
@@ -2352,7 +2420,8 @@ def search_batch(seqs: list[OpSeq], model: ModelSpec, *,
                  decompose: bool = False,
                  decompose_cache=None,
                  bucket: bool | None = None,
-                 lint: bool | None = None) -> list[dict]:
+                 lint: bool | None = None,
+                 audit: bool | None = None) -> list[dict]:
     """Check a batch of independent per-key histories in one device call.
 
     This is the TPU analog of jepsen.independent's bounded-pmap over
@@ -2377,9 +2446,20 @@ def search_batch(seqs: list[OpSeq], model: ModelSpec, *,
     JEPSEN_TPU_BATCH_BUCKETS env knob (default on); bucketing is
     verdict-identical either way and applies only to the ladder path
     (explicit ``dims`` or a mesh ``sharding`` pin the fused shape).
+
+    Per-key certificates: greedy-disposed keys carry their
+    ``linearization``, host-fallback keys whatever the host engine
+    emits, device-ridden keys explicit drop reasons — witnesses
+    survive bucket padding/reordering because row indices always index
+    the key's OWN OpSeq.  ``audit`` replays every key's certificate
+    (None follows JEPSEN_TPU_AUDIT).
     """
     if not seqs:
         return []
+    if audit is None:
+        from ..analyze.audit import audit_enabled
+
+        audit = audit_enabled()
     from ..analyze.lint import (Diagnostic, HistoryLintError,
                                 lint_enabled, lint_opseq)
 
@@ -2397,10 +2477,9 @@ def search_batch(seqs: list[OpSeq], model: ModelSpec, *,
         if any(d.severity == "error" for d in bad):
             raise HistoryLintError(bad)
     if decompose:
-        return _search_batch_decomposed(seqs, model, budget=budget,
-                                        dims=dims, sharding=sharding,
-                                        cache=decompose_cache,
-                                        bucket=bucket)
+        return _audit_batch(seqs, model, _search_batch_decomposed(
+            seqs, model, budget=budget, dims=dims, sharding=sharding,
+            cache=decompose_cache, bucket=bucket), audit)
     if bucket is None and sharding is None and dims is None \
             and len(seqs) > 1:
         from .bucket import bucketing_enabled
@@ -2409,7 +2488,9 @@ def search_batch(seqs: list[OpSeq], model: ModelSpec, *,
     if bucket and sharding is None and dims is None:
         from .bucket import search_batch_bucketed
 
-        return search_batch_bucketed(seqs, model, budget=budget)
+        return _audit_batch(seqs, model,
+                            search_batch_bucketed(seqs, model,
+                                                  budget=budget), audit)
     # greedy completion-order witnesses dispose of well-behaved keys
     # host-side in O(n); only contentious keys ride to the device
     results_by_idx: dict = {}
@@ -2418,18 +2499,24 @@ def search_batch(seqs: list[OpSeq], model: ModelSpec, *,
         if greedy_witness(s, model):
             results_by_idx[i] = {"valid": True, "configs": s.n_must,
                                  "max_depth": s.n_must,
-                                 "engine": "greedy-witness"}
+                                 "engine": "greedy-witness",
+                                 "linearization":
+                                     greedy_linearization(s)}
         else:
             rest.append(i)
     if not rest:
-        return [results_by_idx[i] for i in range(len(seqs))]
+        return _audit_batch(seqs, model,
+                            [results_by_idx[i]
+                             for i in range(len(seqs))], audit)
     if results_by_idx:
         sub = search_batch([seqs[i] for i in rest], model, budget=budget,
                            dims=dims, sharding=sharding, bucket=False,
-                           lint=False)
+                           lint=False, audit=False)
         for i, r in zip(rest, sub):
             results_by_idx[i] = r
-        return [results_by_idx[i] for i in range(len(seqs))]
+        return _audit_batch(seqs, model,
+                            [results_by_idx[i]
+                             for i in range(len(seqs))], audit)
 
     ess = [encode_search(s) for s in seqs]
     hard = [i for i, e in enumerate(ess)
@@ -2446,8 +2533,8 @@ def search_batch(seqs: list[OpSeq], model: ModelSpec, *,
                 out.append(r)
             else:
                 out.append(search_opseq(s, model, budget=budget,
-                                        lint=False))
-        return out
+                                        lint=False, audit=False))
+        return _audit_batch(seqs, model, out, audit)
 
     # the sharded path has no escalation ladder (the key axis must keep
     # covering the mesh at a fixed shape), so it starts at the wider
@@ -2508,16 +2595,21 @@ def search_batch(seqs: list[OpSeq], model: ModelSpec, *,
                 # overflowed the fixed mesh shape: redo solo with the
                 # adaptive ladder
                 out.append(search_opseq(seqs[i], model,
-                                        budget=budget, lint=False))
+                                        budget=budget, lint=False,
+                                        audit=False))
             else:
-                out.append({"valid": _STATUS[int(status[i])],
-                            "configs": int(configs[i]),
-                            "max_depth": int(depth[i]),
-                            "engine": "device-batch"})
-        return out
+                r = {"valid": _STATUS[int(status[i])],
+                     "configs": int(configs[i]),
+                     "max_depth": int(depth[i]),
+                     "engine": "device-batch"}
+                _device_batch_certificate(r)
+                out.append(r)
+        return _audit_batch(seqs, model, out, audit)
     esps = [pad_search(e, dims.n_det_pad, dims.n_crash_pad)
             for e in ess]
-    return _search_batch_ladder(seqs, esps, model, dims, budget)
+    return _audit_batch(seqs, model,
+                        _search_batch_ladder(seqs, esps, model, dims,
+                                             budget), audit)
 
 
 def _finalize_batch_status(status, count, ovf):
@@ -2528,6 +2620,17 @@ def _finalize_batch_status(status, count, ovf):
         status == -1,
         np.where(count <= 0, np.where(ovf, UNKNOWN, INVALID), UNKNOWN),
         status)
+
+
+def _device_batch_certificate(r: dict) -> dict:
+    """Attach the device batch engines' explicit certificate-drop
+    reasons — the ONE place the batch paths state why a device verdict
+    ships without a witness/frontier."""
+    if r.get("valid") is True:
+        r.setdefault("witness_dropped", WITNESS_DROPPED_DEVICE)
+    elif r.get("valid") is False:
+        r.setdefault("frontier_dropped", FRONTIER_DROPPED_DEVICE)
+    return r
 
 
 def _search_batch_ladder(seqs: list[OpSeq], esps: list[EncodedSearch],
@@ -2621,14 +2724,15 @@ def _search_batch_ladder(seqs: list[OpSeq], esps: list[EncodedSearch],
             # configs (ladder spend + solo spend)
             rem = budget - int(spent[i])
             r = search_opseq(seqs[i], model, budget=max(1000, rem),
-                             lint=False)
+                             lint=False, audit=False)
             r["configs"] = int(r.get("configs", 0)) + int(spent[i])
             out.append(r)
         else:
-            out.append({"valid": _STATUS[int(status[i])],
-                        "configs": int(configs[i]),
-                        "max_depth": int(depth[i]),
-                        "engine": batch_engine})
+            out.append(_device_batch_certificate(
+                {"valid": _STATUS[int(status[i])],
+                 "configs": int(configs[i]),
+                 "max_depth": int(depth[i]),
+                 "engine": batch_engine}))
     return out
 
 
@@ -2653,11 +2757,15 @@ def _search_batch_decomposed(seqs: list[OpSeq], model: ModelSpec, *,
     results: dict[int, dict] = {}
     rep: dict[str, int] = {}  # canonical key -> representative index
     todo: list[int] = []
+    drop = "canonical verdict-cache hit (the cache stores verdicts, " \
+           "not witnesses)"
     for i, k in enumerate(keys):
         e = cache.get(k)
         if e is not None and "v" in e:
             results[i] = {"valid": e["v"], "configs": 0,
                           "engine": "decompose-cache"}
+            results[i]["witness_dropped" if e["v"] is True
+                       else "frontier_dropped"] = drop
         elif k in rep:
             pass  # filled from the representative's verdict below
         else:
@@ -2671,6 +2779,19 @@ def _search_batch_decomposed(seqs: list[OpSeq], model: ModelSpec, *,
             results[i] = r
             if r.get("valid") in (True, False):
                 cache.put_verdict(keys[i], r["valid"])
+    def _copy_cert(dst: dict, src: dict) -> dict:
+        """Certificates transfer between canonically-equal keys: the
+        histories are row-aligned and value-bijective (canonical.py),
+        so one's witness row order / frontier rows are the other's.
+        The audit pass replays the copy against ITS history, keeping
+        this transfer falsifiable."""
+        for field in ("linearization", "final_ops", "witness_dropped",
+                      "frontier_dropped"):
+            if field in src:
+                v = src[field]
+                dst[field] = list(v) if isinstance(v, list) else v
+        return dst
+
     n_dup = 0
     solo: dict[str, dict] = {}
     for i, k in enumerate(keys):
@@ -2679,8 +2800,8 @@ def _search_batch_decomposed(seqs: list[OpSeq], model: ModelSpec, *,
         r = results[rep[k]]
         if r.get("valid") in (True, False):
             n_dup += 1
-            results[i] = {"valid": r["valid"], "configs": 0,
-                          "engine": "decompose-dedup"}
+            results[i] = _copy_cert({"valid": r["valid"], "configs": 0,
+                                     "engine": "decompose-dedup"}, r)
             continue
         # the representative was undecided in the batch: retry solo —
         # ONCE per canonical shape (copies are isomorphic problems, so
@@ -2699,11 +2820,13 @@ def _search_batch_decomposed(seqs: list[OpSeq], model: ModelSpec, *,
                 ri["valid"] = r2["valid"]
                 ri["engine"] = (ri.get("engine") or
                                 "device-batch") + "+decompose-retry"
+                _copy_cert(ri, r2)
             results[i] = r2
         else:
             n_dup += 1
-            results[i] = {"valid": r2.get("valid"), "configs": 0,
-                          "engine": "decompose-dedup"}
+            results[i] = _copy_cert(
+                {"valid": r2.get("valid"), "configs": 0,
+                 "engine": "decompose-dedup"}, r2)
     out = [results[i] for i in range(len(seqs))]
     stats = {"n_keys": len(seqs), "cache_hits": cache.hits,
              "cache_misses": cache.misses, "deduped": n_dup,
@@ -2798,11 +2921,22 @@ class Linearizable:
                  decompose: bool = False,
                  verdict_cache=None,
                  lint: bool | None = None,
-                 explain: bool | None = None):
+                 explain: bool | None = None,
+                 audit: bool | None = None,
+                 shrink: bool | None = None):
         self.model = model
         self.budget = budget
         self.host_threshold = host_threshold
         self.witness_threshold = witness_threshold
+        # ``audit`` replays every verdict's certificate through the
+        # independent audit pass (analyze/audit.py; None follows
+        # JEPSEN_TPU_AUDIT, set by the CLI's --audit).  ``shrink``
+        # delta-debugs invalid verdicts into a minimal failing
+        # subhistory for the report (analyze/shrink.py; None follows
+        # JEPSEN_TPU_SHRINK, default on — reporting only, never
+        # verdicts).
+        self.audit = audit
+        self.shrink = shrink
         # ``lint`` runs the well-formedness linter (analyze/lint.py)
         # over the history before any search: errors are fatal
         # (HistoryLintError), warnings ride the result dict as
@@ -2884,6 +3018,10 @@ class Linearizable:
         if lint_warnings and isinstance(out, dict):
             out.setdefault("lint_warnings",
                            [d.to_dict() for d in lint_warnings])
+        if isinstance(out, dict):
+            from ..analyze.audit import maybe_audit
+
+            maybe_audit(seq, model, out, self.audit)
         return out
 
     def _checked(self, test, seq, model, opts):
@@ -2920,12 +3058,12 @@ class Linearizable:
             out = check_opseq_decomposed(
                 seq, model, cache=cache,
                 sub_max_configs=self.budget,  # the user's sizing knob
-                sub_check=sub_check, lint=False,
+                sub_check=sub_check, lint=False, witness=True,
                 direct=lambda s: self._check_direct(test, s, model, opts))
             if out["valid"] is False and "report_file" not in out:
                 # the direct fallback renders its own report; a verdict
                 # decided by decomposition alone still gets one
-                self._render_failure(test, seq, out, opts)
+                self._render_failure(test, seq, out, opts, model)
             return out
         return self._check_direct(test, seq, model, opts)
 
@@ -2940,20 +3078,21 @@ class Linearizable:
             out = seqmod.check_opseq(seq, model, lint=False)
             out["engine"] = "host-oracle"
             if out["valid"] is False:
-                self._render_failure(test, seq, out, opts)
+                self._render_failure(test, seq, out, opts, model)
             return out
 
         if self.algorithm == "linear":
-            from .linear import check_opseq_linear
+            from .linear import DEFAULT_WITNESS_CAP, check_opseq_linear
 
             # user-facing path: track the valid-verdict witness (the
             # verdict-only callers — competition legs, portfolio,
             # fuzzers — leave it off and keep level-local memory)
-            out = check_opseq_linear(seq, model, witness_cap=2_000_000,
+            out = check_opseq_linear(seq, model,
+                                     witness_cap=DEFAULT_WITNESS_CAP,
                                      lint=False)
             out["engine"] = "host-linear"
             if out["valid"] is False:
-                self._render_failure(test, seq, out, opts)
+                self._render_failure(test, seq, out, opts, model)
             return out
 
         if self.algorithm in ("auto", "competition"):
@@ -2973,7 +3112,7 @@ class Linearizable:
                 # an exact host engine already produced this verdict
                 # (and its final_ops/final_paths report data);
                 # re-confirming would repeat the same search
-                self._render_failure(test, seq, out, opts)
+                self._render_failure(test, seq, out, opts, model)
                 return out
             # exact confirmation + witness for the report, on the
             # shortest sound prefix covering the failure region
@@ -2988,18 +3127,38 @@ class Linearizable:
                     confirm["engine"] = out["engine"] + "+host-witness"
                     confirm["device_configs"] = out["configs"]
                     confirm["witness_prefix_ops"] = len(target)
-                    self._render_failure(test, target, confirm, opts)
+                    self._render_failure(test, target, confirm, opts,
+                                         model)
                     return confirm
                 # prefix came back valid: fall through to the full
                 # device verdict (obstruction lies past the cut)
         return out
 
-    @staticmethod
-    def _render_failure(test, seq, result, opts):
+    #: don't delta-debug failure reports past this many rows — each
+    #: shrink probe is a bounded re-search, and a huge history's report
+    #: should not cost more than its verdict did
+    SHRINK_MAX_OPS = 400
+
+    def _render_failure(self, test, seq, result, opts, model):
         """linear.html — the knossos linear.svg analog
-        (checker.clj:128-135); reporting never affects the verdict."""
+        (checker.clj:128-135); reporting never affects the verdict.
+        Invalid verdicts are first delta-debugged into a minimal
+        failing subhistory (analyze/shrink.py) so the report tells a
+        6-op story instead of dumping the whole history."""
         from . import linear_report
 
+        if result.get("shrink") is None and len(seq) > 0 \
+                and len(seq) <= self.SHRINK_MAX_OPS:
+            from ..analyze.shrink import (shrink_enabled, shrink_invalid,
+                                          shrink_summary)
+
+            if self.shrink if self.shrink is not None \
+                    else shrink_enabled():
+                try:
+                    s = shrink_invalid(seq, model)
+                    result["shrink"] = shrink_summary(seq, s)
+                except Exception:  # noqa: BLE001 — reporting only
+                    pass
         path = linear_report.write_linear_html(test or {}, seq, result,
                                                opts)
         if path is not None:

@@ -63,9 +63,13 @@ def _portfolio_worker(builder, builder_args, algo, seed, max_configs,
         go.wait()
         t0 = time.perf_counter()
         if algo == "linear":
-            from .linear import check_opseq_linear
+            from .linear import DEFAULT_WITNESS_CAP, check_opseq_linear
 
+            # a bounded witness_cap: if this leg wins, its verdict
+            # carries a real certificate through the queue (row lists
+            # pickle fine) instead of a witness_dropped stub
             r = check_opseq_linear(seq, model, max_configs=max_configs,
+                                   witness_cap=DEFAULT_WITNESS_CAP,
                                    decompose=decompose)
         elif algo == "decompose":
             from ..decompose.engine import check_opseq_decomposed
@@ -86,8 +90,12 @@ def _portfolio_worker(builder, builder_args, algo, seed, max_configs,
                     and len(quiescence_segments(seq)) <= 1):
                 r = {"valid": "unknown", "info": "nothing decomposes"}
             else:
+                # witness=True: the winner's certificate propagates to
+                # the parent (stitched per-cell witnesses or an
+                # explicit witness_dropped reason)
                 r = check_opseq_decomposed(seq, model,
-                                           sub_max_configs=max_configs)
+                                           sub_max_configs=max_configs,
+                                           witness=True)
         else:
             from . import seq as seqmod
 

@@ -62,7 +62,8 @@ def check_opseq(seq: OpSeq, model: ModelSpec, *,
                 order_seed: int | None = None,
                 decompose: bool = False,
                 decompose_cache=None,
-                lint: bool | None = None) -> dict:
+                lint: bool | None = None,
+                audit: bool | None = None) -> dict:
     """Run the DFS over a columnar OpSeq.  Returns a knossos-style map:
 
     valid        True | False | "unknown"
@@ -71,6 +72,11 @@ def check_opseq(seq: OpSeq, model: ModelSpec, *,
     max_depth    deepest prefix length reached
     final_ops    (invalid only) row indices of candidate ops at the
                  deepest frontier — the ops that could not be linearized
+
+    Every valid verdict from this engine carries its witness (the DFS
+    parent chain is free), and every invalid one its blocking frontier;
+    ``audit`` replays that certificate through the independent audit
+    pass (analyze/audit.py; None follows JEPSEN_TPU_AUDIT).
 
     ``deadline`` (``time.perf_counter()`` clock) yields "unknown" once
     exceeded (checked every 4096 configs) — the wall-clock twin of
@@ -91,9 +97,14 @@ def check_opseq(seq: OpSeq, model: ModelSpec, *,
     malformed history to the search.  Verdict-identical on well-formed
     histories (tests/test_analyze.py's differential fuzz).
     """
+    from ..analyze.audit import maybe_audit
     from ..analyze.lint import maybe_lint
 
     maybe_lint(seq, model, lint)
+
+    def finish(out: dict) -> dict:
+        return maybe_audit(seq, model, out, audit)
+
     if decompose:
         from ..decompose.engine import check_opseq_decomposed
 
@@ -109,11 +120,14 @@ def check_opseq(seq: OpSeq, model: ModelSpec, *,
 
         # the entry seq was linted above (when enabled); cells/segments
         # are engine-derived projections, so re-linting them would only
-        # re-prove invariants subseq preserves by construction
+        # re-prove invariants subseq preserves by construction.
+        # witness=True: this DFS tracks parent chains anyway, so the
+        # decomposed route stitches them for free
         return check_opseq_decomposed(seq, model, cache=decompose_cache,
                                       direct=_direct, sub_check=_sub,
                                       sub_max_configs=max_configs,
-                                      deadline=deadline, lint=False)
+                                      deadline=deadline, lint=False,
+                                      witness=True, audit=audit)
     import random as _random
     import time
     n = len(seq)
@@ -122,8 +136,8 @@ def check_opseq(seq: OpSeq, model: ModelSpec, *,
         if bool(seq.ok[i]):
             ok_mask |= 1 << i
     if n == 0:
-        return {"valid": True, "configs": 0, "linearization": [],
-                "max_depth": 0}
+        return finish({"valid": True, "configs": 0, "linearization": [],
+                       "max_depth": 0})
 
     inv = [int(x) for x in seq.inv]
     ret = [int(x) for x in seq.ret]
@@ -152,23 +166,24 @@ def check_opseq(seq: OpSeq, model: ModelSpec, *,
         visited.add(key)
         configs += 1
         if configs > max_configs:
-            return {"valid": "unknown", "configs": configs,
-                    "max_depth": max_depth,
-                    "info": f"exceeded max_configs={max_configs}"}
+            return finish({"valid": "unknown", "configs": configs,
+                           "max_depth": max_depth,
+                           "info": f"exceeded max_configs={max_configs}"})
         if configs % 4096 == 0:
             if deadline is not None and time.perf_counter() > deadline:
-                return {"valid": "unknown", "configs": configs,
-                        "max_depth": max_depth,
-                        "info": "exceeded deadline"}
+                return finish({"valid": "unknown", "configs": configs,
+                               "max_depth": max_depth,
+                               "info": "exceeded deadline"})
             if cancel is not None and cancel.is_set():
-                return {"valid": "unknown", "configs": configs,
-                        "max_depth": max_depth, "info": "cancelled"}
+                return finish({"valid": "unknown", "configs": configs,
+                               "max_depth": max_depth,
+                               "info": "cancelled"})
 
         if (mask & ok_mask) == ok_mask:
             lin = _walk_parents(parent_of, key)
-            return {"valid": True, "configs": configs,
-                    "linearization": lin,
-                    "max_depth": len(lin)}
+            return finish({"valid": True, "configs": configs,
+                           "linearization": lin,
+                           "max_depth": len(lin)})
 
         # Enabled candidates: scan unlinearized ops in invocation order,
         # maintaining the min return among unlinearized seen so far.  Once
@@ -233,5 +248,7 @@ def check_opseq(seq: OpSeq, model: ModelSpec, *,
     final_paths = [{"linearized": _walk_parents(parent_of, bkey),
                     "state": bkey[1]}
                    for bkey in best_keys[:10]]
-    return {"valid": False, "configs": configs, "max_depth": max_depth,
-            "final_ops": best_frontier, "final_paths": final_paths}
+    return finish({"valid": False, "configs": configs,
+                   "max_depth": max_depth,
+                   "final_ops": best_frontier,
+                   "final_paths": final_paths})

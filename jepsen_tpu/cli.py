@@ -86,6 +86,14 @@ def add_test_opts(p: argparse.ArgumentParser) -> None:
                         "(jepsen_tpu.analyze) that runs in front of "
                         "every linearizability check.  Sets "
                         "JEPSEN_TPU_LINT=0 fleet-wide.")
+    p.add_argument("--audit", action="store_true", default=False,
+                   help="Independently audit every verdict's "
+                        "certificate (jepsen_tpu.analyze.audit): a "
+                        "valid verdict's linearization is replayed "
+                        "against the model, an invalid one's frontier "
+                        "range-checked; any W-code raises AuditError. "
+                        "Sets JEPSEN_TPU_AUDIT=1 fleet-wide so every "
+                        "suite-constructed checker honors it.")
     p.add_argument("--compile-cache-dir", metavar="DIR", default=None,
                    help="Persistent JAX compilation-cache directory "
                         "(jax_compilation_cache_dir): compiled search "
@@ -158,6 +166,11 @@ def test_opt_fn(parsed: argparse.Namespace) -> dict:
     if opts.pop("no_lint", False):
         os.environ["JEPSEN_TPU_LINT"] = "0"
         opts["no_lint"] = True
+    if opts.pop("audit", False):
+        # like --lin-decompose/--explain: suites construct their own
+        # checkers, so the audit opt-in travels by env var
+        os.environ["JEPSEN_TPU_AUDIT"] = "1"
+        opts["audit"] = True
     ccd = opts.get("compile_cache_dir")
     if ccd:
         # the env var carries the setting into spawned workers/children;

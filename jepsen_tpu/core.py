@@ -218,6 +218,7 @@ class ClientWorker(Worker):
                     time=relative_time_nanos())
             log_op(op)
 
+            stream_lint = test.get("__stream_lint__")
             if self.client is None:
                 # lazily reopen after a crash (core.clj:362-377)
                 try:
@@ -230,6 +231,8 @@ class ClientWorker(Worker):
                     conj_op(test, op)
                     conj_op(test, fail)
                     log_op(fail)
+                    if stream_lint is not None:
+                        stream_lint.on_complete(self.process)
                     self.client = None
                     continue
 
@@ -237,6 +240,11 @@ class ClientWorker(Worker):
             completion = invoke_op(op, test, self.client, self.aborting)
             conj_op(test, completion)
             log_op(completion)
+            if stream_lint is not None:
+                # close the live-lint open-op entry for this process;
+                # an :info retires the id below, so closing is right
+                # for every completion type
+                stream_lint.on_complete(self.process)
             if completion.type == "info":
                 # indeterminate: this process is hung; cycle to a new
                 # process id (core.clj:387-404)
@@ -410,6 +418,13 @@ def prepare_test(test: dict) -> dict:
                     AbortableBarrier(len(nodes)) if nodes else "no-barrier")
     test["active_histories"] = []
     test["__abort__"] = threading.Event()
+    from .analyze.lint import lint_enabled
+
+    if lint_enabled() and "__stream_lint__" not in test:
+        # emit-time H001/H002 guard over the live generator stream —
+        # same opt-out (JEPSEN_TPU_LINT=0 / --no-lint) as the post-run
+        # history linter
+        test["__stream_lint__"] = gen.StreamLinter()
     return test
 
 

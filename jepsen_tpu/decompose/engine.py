@@ -28,11 +28,12 @@ import hashlib
 import time
 from dataclasses import replace as _dc_replace
 
+from ..checker.linear import DEFAULT_WITNESS_CAP
 from ..history import OpSeq
 from ..models import ModelSpec
 from .cache import VerdictCache
 from .canonical import canonical_key, canonical_payload
-from .partition import (partition_by_key, quiescence_segments, subseq,
+from .partition import (quiescence_segments, subseq,
                         value_block_verdict)
 
 
@@ -49,25 +50,40 @@ class _DirectUndecided(Exception):
         self.result = result
 
 
-def _default_sub_check(sseq, smodel, *, max_configs, deadline):
+def _make_default_sub_check(witness: bool):
     from ..checker.linear import check_opseq_linear
 
-    # lint=False: cells/segments are engine-derived projections whose
-    # invariants subseq preserves by construction (the entry seq was
-    # linted at the decomposed checker's own boundary)
-    return check_opseq_linear(sseq, smodel, max_configs=max_configs,
-                              deadline=deadline, lint=False)
+    cap = DEFAULT_WITNESS_CAP if witness else 0
+
+    def sub_check(sseq, smodel, *, max_configs, deadline):
+        # lint=False: cells/segments are engine-derived projections
+        # whose invariants subseq preserves by construction (the entry
+        # seq was linted at the decomposed checker's own boundary)
+        return check_opseq_linear(sseq, smodel, max_configs=max_configs,
+                                  deadline=deadline, witness_cap=cap,
+                                  lint=False)
+
+    return sub_check
+
+
 
 
 def segment_states(sseq: OpSeq, model: ModelSpec, init_states, *,
                    max_configs: int = 50_000_000,
-                   deadline: float | None = None) -> set:
+                   deadline: float | None = None,
+                   witness: bool = False):
     """All model states reachable by fully linearizing a crash-free
     segment, starting from any state in ``init_states``.  Empty set
     means no linearization exists (the segment — hence its cell — is
     invalid).  The sweep is checker/linear.py's level-synchronous
     engine minus the crash machinery (segments before the last cut
-    carry no :info rows by construction)."""
+    carry no :info rows by construction).
+
+    With ``witness=True`` returns ``(states, wit)`` where ``wit`` maps
+    each reachable final state to ``(input_state, row_chain)`` — one
+    concrete linearization of the segment (sseq row indices) from that
+    input state — or ``wit=None`` when the parent table outgrew
+    ``DEFAULT_WITNESS_CAP`` (the verdict is unaffected)."""
     from ..checker.linear import _advance
     from ..checker.linearizable import INF32, encode_search
 
@@ -77,7 +93,8 @@ def segment_states(sseq: OpSeq, model: ModelSpec, init_states, *,
     n_det, W = es.n_det, es.window
     states0 = {tuple(int(x) for x in s) for s in init_states}
     if n_det == 0:
-        return states0
+        return (states0, {s: (s, []) for s in states0}) if witness \
+            else states0
 
     det_inv = [int(x) for x in es.det_inv]
     det_ret = [int(x) for x in es.det_ret]
@@ -118,6 +135,9 @@ def segment_states(sseq: OpSeq, model: ModelSpec, init_states, *,
         return fr
 
     level = {(0, 0, s) for s in states0}
+    # (p, win, state) -> (sseq row, parent config); roots absent.  Rows
+    # are det positions, which ARE sseq rows (crash-free, inv-sorted).
+    parents: dict | None = {} if witness else None
     configs = 0
     for _depth in range(n_det):
         if deadline is not None and time.perf_counter() > deadline:
@@ -132,11 +152,35 @@ def segment_states(sseq: OpSeq, model: ModelSpec, init_states, *,
                 if configs > max_configs:
                     raise _Inconclusive("segment sweep exceeded budget")
                 p2, win2 = _advance(p, win, i, n_det)
-                nxt.add((p2, win2, ns))
+                child = (p2, win2, ns)
+                if parents is not None and child not in nxt:
+                    if len(parents) >= DEFAULT_WITNESS_CAP:
+                        parents = None
+                    else:
+                        parents.setdefault(child,
+                                           (p + i, (p, win, state)))
+                nxt.add(child)
         level = nxt
         if not level:
-            return set()
-    return {state for _p, _w, state in level}
+            return (set(), {}) if witness else set()
+    states = {state for _p, _w, state in level}
+    if not witness:
+        return states
+    if parents is None:
+        return states, None
+    wit: dict = {}
+    for cfg in level:
+        state = cfg[2]
+        if state in wit:
+            continue
+        chain: list[int] = []
+        node = cfg
+        while node[0] != 0 or node[1] != 0:
+            row, node = parents[node]
+            chain.append(row)
+        chain.reverse()
+        wit[state] = (node[2], chain)
+    return states, wit
 
 
 def _skey(payload: bytes) -> str:
@@ -150,7 +194,9 @@ def check_opseq_decomposed(seq: OpSeq, model: ModelSpec, *,
                            deadline: float | None = None,
                            scheduler: str | None = None,
                            n_procs: int | None = None,
-                           lint: bool | None = None) -> dict:
+                           lint: bool | None = None,
+                           witness: bool = False,
+                           audit: bool | None = None) -> dict:
     """Check ``seq`` via decomposition; verdict-identical to ``direct``.
 
     cache       VerdictCache, a jsonl path, or None (no caching)
@@ -166,22 +212,47 @@ def check_opseq_decomposed(seq: OpSeq, model: ModelSpec, *,
     The result carries a ``decompose`` dict: cells, segments,
     cache_hits/misses, configs_searched, and the methods that fired.
 
+    **Certificates.**  A ``valid`` result always carries either
+    ``linearization`` — with ``witness=True``, per-cell witnesses
+    (sub-search parent chains, value-block construction, quiescence
+    chains) are stitched into one global order via the P-compositional
+    merge (``partition.merge_linearizations``; ``decompose.stitched``
+    marks it) — or an explicit ``witness_dropped`` reason naming the
+    stage that could not produce one (cache hits store verdicts only,
+    pool workers return verdicts only, ...).  An ``invalid`` result
+    carries ``final_ops`` mapped back to PARENT rows when the deciding
+    cell's engine produced a frontier, else ``frontier_dropped``.
+    ``audit`` runs the independent certificate audit (analyze/audit.py)
+    on the result (None follows JEPSEN_TPU_AUDIT).
+
     ``lint`` runs the O(n) well-formedness linter (analyze/lint.py)
     over the entry seq — on by default (None follows JEPSEN_TPU_LINT);
     errors raise before any partitioning or cache write (a malformed
     history must not poison the persisted verdict cache).  Engine
     entry points that already linted pass ``lint=False``.
     """
+    from ..analyze.audit import maybe_audit
     from ..analyze.lint import maybe_lint
+    from .partition import (cells_from_rows, key_partition_rows,
+                            merge_linearizations, value_block_witness)
 
     maybe_lint(seq, model, lint)
     if isinstance(cache, str):
         cache = VerdictCache(cache)
     if sub_check is None:
-        sub_check = _default_sub_check
+        sub_check = _make_default_sub_check(witness)
     stats = {"cells": 0, "segments": 0, "cache_hits": 0,
              "cache_misses": 0, "configs_searched": 0, "methods": []}
     methods: set = set()
+    #: first reason a witness / frontier could not be carried through
+    drops = {"witness": None, "frontier": None}
+
+    def drop(kind: str, reason: str) -> None:
+        if drops[kind] is None:
+            drops[kind] = reason
+
+    if not witness:
+        drop("witness", "witness not requested (witness=False)")
 
     def done(valid, extra: dict | None = None) -> dict:
         if cache is not None:
@@ -195,7 +266,15 @@ def check_opseq_decomposed(seq: OpSeq, model: ModelSpec, *,
         if extra:
             out = {**extra, **out, "engine": out["engine"],
                    "decompose": stats}
-        return out
+        # the certificate contract: a decided verdict either carries
+        # its evidence or says exactly why it cannot
+        if out["valid"] is True and "linearization" not in out:
+            out.setdefault("witness_dropped", drops["witness"]
+                           or "decomposed route produced no witness")
+        if out["valid"] is False and "final_ops" not in out:
+            out.setdefault("frontier_dropped", drops["frontier"]
+                           or "decomposed route produced no frontier")
+        return maybe_audit(seq, model, out, audit)
 
     wkey = None
     if cache is not None:
@@ -206,24 +285,38 @@ def check_opseq_decomposed(seq: OpSeq, model: ModelSpec, *,
         e = cache.get(wkey)
         if e is not None and "v" in e:
             methods.add("cache")
+            drop("witness", "whole-history verdict-cache hit "
+                            "(the cache stores verdicts, not witnesses)")
+            drop("frontier", "whole-history verdict-cache hit")
             return done(e["v"])
 
-    cells, cell_model, early = partition_by_key(seq, model)
-    if early is False:
+    # ONE key-partition scan serves the split, the early verdict, and
+    # the witness stitcher's cell-row -> parent-row maps
+    by_key, bad_rows = key_partition_rows(seq, model)
+    if by_key is not None and bad_rows:
         methods.add("key-partition")
         stats["cells"] = 1
         if cache is not None:
             cache.put_verdict(wkey, False)
-        return done(False)
-    if cells is None:
+        # the un-steppable :ok rows ARE the blocking frontier
+        return done(False,
+                    extra={"final_ops": [int(r) for r in bad_rows]})
+    if by_key is None:
         cells, cell_model = {0: seq}, model
-    elif len(cells) > 1:
-        methods.add("key-partition")
+        cell_rows: dict = {0: list(range(len(seq)))}
+    else:
+        cells, cell_model = cells_from_rows(seq, model, by_key)
+        cell_rows = by_key
+        if len(cells) > 1:
+            methods.add("key-partition")
     stats["cells"] = len(cells)
     order = sorted(cells, key=lambda k: -len(cells[k]))  # largest first
 
     def check_cell(cseq: OpSeq, is_whole: bool):
-        """-> (verdict True/False, direct-result dict or None)."""
+        """-> (verdict, direct-result | None, cell-row witness | None,
+        frontier cell rows | None).  Witness/frontier rows index the
+        CELL's projection; the caller maps them to parent rows through
+        ``cell_rows`` before they reach the result."""
         ckey = None
         if cache is not None:
             ckey = wkey if is_whole else canonical_key(cseq, cell_model)
@@ -231,13 +324,25 @@ def check_opseq_decomposed(seq: OpSeq, model: ModelSpec, *,
                 e = cache.get(ckey)
                 if e is not None and "v" in e:
                     methods.add("cache")
-                    return e["v"], None
+                    drop("witness", "cell verdict-cache hit (the cache "
+                                    "stores verdicts, not witnesses)")
+                    drop("frontier", "cell verdict-cache hit")
+                    return e["v"], None, None, None
         vb = value_block_verdict(cseq, cell_model)
         if vb is not None:
             methods.add("value-blocks")
             if cache is not None:
                 cache.put_verdict(ckey, vb)
-            return vb, None
+            lin = None
+            if vb is True and witness:
+                lin = value_block_witness(cseq, cell_model)
+                if lin is None:
+                    drop("witness",
+                         "value-block witness construction failed")
+            if vb is False:
+                drop("frontier", "cell decided invalid by the value-"
+                                 "block order test (no row frontier)")
+            return vb, None, lin, None
         segs = quiescence_segments(cseq)
         stats["segments"] += len(segs)
         if len(segs) <= 1:
@@ -257,14 +362,17 @@ def check_opseq_decomposed(seq: OpSeq, model: ModelSpec, *,
                 raise _Inconclusive(r.get("info", "sub-search undecided"))
             if cache is not None:
                 cache.put_verdict(ckey, v)
-            # a CELL's result rows (final_ops, linearization) index the
-            # cell's own projection, not the parent history — merging
-            # them into the whole-history result would make the failure
-            # report highlight unrelated ops; only whole-history results
-            # carry their row-level evidence out
-            return v, (r if is_whole else None)
+            lin = r.get("linearization")
+            if v is True and lin is None:
+                drop("witness", r.get("witness_dropped",
+                                      "sub-search produced no witness"))
+            return v, (r if is_whole else None), lin, r.get("final_ops")
         methods.add("quiescence")
         states = {tuple(cell_model.init)}
+        # model state -> one cell-row chain reaching it (threaded across
+        # segments); None once any stage cannot witness
+        chains: dict | None = {tuple(cell_model.init): []} if witness \
+            else None
         for rows in segs[:-1]:
             sseq = subseq(cseq, rows)
             e = ren = skey = None
@@ -275,6 +383,25 @@ def check_opseq_decomposed(seq: OpSeq, model: ModelSpec, *,
                 e = cache.get(skey)
             if e is not None and "out" in e:
                 states = set(ren.decode_states(e["out"]))
+                if chains is not None:
+                    chains = None
+                    drop("witness", "segment state-set cache hit (the "
+                                    "cache stores states, not chains)")
+            elif chains is not None:
+                states, wit = segment_states(sseq, cell_model, states,
+                                             max_configs=sub_max_configs,
+                                             deadline=deadline,
+                                             witness=True)
+                if cache is not None:
+                    cache.put_states(skey, ren.encode_states(states))
+                if wit is None:
+                    chains = None
+                    drop("witness", "segment witness table exceeded "
+                                    "its cap")
+                else:
+                    chains = {out_s: chains[in_s]
+                              + [int(rows[j]) for j in seg_chain]
+                              for out_s, (in_s, seg_chain) in wit.items()}
             else:
                 states = segment_states(sseq, cell_model, states,
                                         max_configs=sub_max_configs,
@@ -284,7 +411,10 @@ def check_opseq_decomposed(seq: OpSeq, model: ModelSpec, *,
             if not states:
                 if cache is not None:
                     cache.put_verdict(ckey, False)
-                return False, None
+                drop("frontier", "a quiescence segment has no "
+                                 "linearization (frontier not "
+                                 "localized)")
+                return False, None, None, None
         fseq = subseq(cseq, segs[-1])
         e = fkey = None
         if cache is not None:
@@ -292,8 +422,11 @@ def check_opseq_decomposed(seq: OpSeq, model: ModelSpec, *,
                                               instates=states)
             fkey = _skey(payload)
             e = cache.get(fkey)
+        lin = frontier = None
         if e is not None and "v" in e:
             v = e["v"]
+            drop("witness", "final-segment verdict-cache hit")
+            drop("frontier", "final-segment verdict-cache hit")
         else:
             v = False
             for s in sorted(states):
@@ -304,19 +437,35 @@ def check_opseq_decomposed(seq: OpSeq, model: ModelSpec, *,
                 rv = r.get("valid")
                 if rv is True:
                     v = True
+                    flin = r.get("linearization")
+                    if chains is not None and flin is not None:
+                        final_rows = segs[-1]
+                        lin = chains[tuple(s)] + [int(final_rows[j])
+                                                  for j in flin]
+                    elif witness:
+                        drop("witness", r.get(
+                            "witness_dropped",
+                            "final-segment sub-search produced no "
+                            "witness"))
                     break
                 if rv is not False:
                     raise _Inconclusive(
                         r.get("info", "final segment undecided"))
+                frontier = r.get("final_ops")
+            if v is False and frontier is not None:
+                # frontier rows index the final segment's projection
+                frontier = [int(segs[-1][j]) for j in frontier]
             if cache is not None:
                 cache.put_verdict(fkey, v)
         if cache is not None:
             cache.put_verdict(ckey, v)
-        return v, None
+        return v, None, lin, frontier
 
     try:
         verdict = True
         last_direct = None
+        cell_lins: dict = {}  # cell key -> PARENT-row witness
+        invalid_frontier = None  # parent rows of the deciding frontier
         pending = order
         if scheduler in ("pool", "device") and len(pending) > 1:
             from . import schedule
@@ -338,6 +487,10 @@ def check_opseq_decomposed(seq: OpSeq, model: ModelSpec, *,
                 # keeps pool-scheduled accounting as honest as the
                 # device branch's
                 stats["configs_searched"] += int(pool_configs)
+                drop("witness",
+                     "pool-scheduled cells return verdicts only")
+                drop("frontier",
+                     "pool-scheduled cells return verdicts only")
             else:
                 if deadline is not None and \
                         time.perf_counter() >= deadline:
@@ -353,6 +506,27 @@ def check_opseq_decomposed(seq: OpSeq, model: ModelSpec, *,
                     int(r.get("configs", 0) or 0) for r in cell_results)
                 stats["cell_engines"] = sorted(
                     {str(r.get("engine")) for r in cell_results})
+                for k, r in zip(pending, cell_results):
+                    if r.get("valid") is True:
+                        clin = r.get("linearization")
+                        if clin is not None:
+                            cell_lins[k] = [int(cell_rows[k][j])
+                                            for j in clin]
+                        else:
+                            drop("witness", r.get(
+                                "witness_dropped",
+                                "device-scheduled cell produced no "
+                                "witness"))
+                    elif r.get("valid") is False:
+                        cfr = r.get("final_ops")
+                        if cfr is not None and invalid_frontier is None:
+                            invalid_frontier = [int(cell_rows[k][j])
+                                                for j in cfr]
+                        else:
+                            drop("frontier", r.get(
+                                "frontier_dropped",
+                                "device-scheduled cell produced no "
+                                "frontier"))
             methods.add(scheduler)
             # one invalid cell decides the whole history (locality) —
             # a decided False must win over an undecided sibling, not
@@ -365,11 +539,16 @@ def check_opseq_decomposed(seq: OpSeq, model: ModelSpec, *,
                         raise _Inconclusive("scheduled cell undecided")
         else:
             for k in pending:
-                v, r = check_cell(cells[k], cells[k] is seq)
+                v, r, clin, cfr = check_cell(cells[k], cells[k] is seq)
                 if r is not None:
                     last_direct = r
+                if clin is not None:
+                    cell_lins[k] = [int(cell_rows[k][j]) for j in clin]
                 if v is False:
                     verdict = False
+                    if cfr is not None:
+                        invalid_frontier = [int(cell_rows[k][j])
+                                            for j in cfr]
                     break
     except _DirectUndecided as e:
         return done("unknown", extra=e.result)
@@ -385,4 +564,24 @@ def check_opseq_decomposed(seq: OpSeq, model: ModelSpec, *,
 
     if cache is not None:
         cache.put_verdict(wkey, verdict)
-    return done(verdict, extra=last_direct)
+    extra = dict(last_direct) if last_direct else {}
+    if verdict is True and witness and "linearization" not in extra:
+        if len(cell_lins) == len(cells):
+            # the P-compositional stitch: per-cell witnesses interleave
+            # into one global order respecting the PARENT's real-time
+            # precedence (partition.merge_linearizations)
+            g = merge_linearizations(seq, [cell_lins[k] for k in order])
+            if g is not None:
+                extra["linearization"] = g
+                if len(cells) > 1:
+                    stats["stitched"] = True
+            else:
+                drop("witness", "cell-witness stitch found no "
+                                "interleaving (engine bug; see W005)")
+        else:
+            drop("witness", drops["witness"]
+                 or "some cells produced no witness")
+    if verdict is False and "final_ops" not in extra \
+            and invalid_frontier is not None:
+        extra["final_ops"] = sorted(invalid_frontier)
+    return done(verdict, extra=extra or None)

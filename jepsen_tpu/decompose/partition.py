@@ -81,6 +81,47 @@ def quiescence_segments(seq: OpSeq) -> list[np.ndarray]:
             for i in range(len(bounds) - 1)]
 
 
+def key_partition_rows(seq: OpSeq, model: ModelSpec):
+    """The key-partition scan: ``(key -> parent row indices, bad_rows)``
+    or ``(None, None)`` when the model isn't multi-register.
+
+    ``bad_rows`` lists :ok rows whose key can never legally step (NIL or
+    out-of-range — pystep rejects them in every state); any such row
+    decides the whole history invalid, and the rows themselves ARE the
+    blocking frontier.  One home for the scan: ``partition_by_key``
+    projects with these rows, and the witness stitcher maps per-cell
+    linearizations back through them."""
+    if model.name != "multi-register":
+        return None, None
+    width = model.state_width
+    v1 = np.asarray(seq.v1)
+    ok = np.asarray(seq.ok)
+    by_key: dict[int, list[int]] = {}
+    bad_rows: list[int] = []
+    for i in range(len(seq)):
+        k = int(v1[i])
+        if k == NIL or not 0 <= k < width:
+            if bool(ok[i]):
+                bad_rows.append(i)
+            continue  # un-linearizable crashed op: droppable
+        by_key.setdefault(k, []).append(i)
+    return by_key, bad_rows
+
+
+def cells_from_rows(seq: OpSeq, model: ModelSpec, by_key: dict):
+    """(cells, cell_model) from a :func:`key_partition_rows` scan:
+    each key's projection becomes a register-shaped OpSeq (value moved
+    from the v2 lane to v1)."""
+    cell_model = register(int(model.init[0]))
+    cells = {}
+    for k, rows in by_key.items():
+        sub = subseq(seq, rows)
+        sub.v1 = np.asarray(sub.v2).copy()  # value lane becomes v1
+        sub.v2 = np.full(len(sub.v1), NIL, dtype=sub.v1.dtype)
+        cells[k] = sub
+    return cells, cell_model
+
+
 def partition_by_key(seq: OpSeq, model: ModelSpec):
     """Split a multi-register history into per-key register cells.
 
@@ -92,27 +133,12 @@ def partition_by_key(seq: OpSeq, model: ModelSpec):
     decides the whole history without any search.  A crashed op with
     such a key can never linearize either, but is never *required* to —
     dropping it is exact."""
-    if model.name != "multi-register":
+    by_key, bad_rows = key_partition_rows(seq, model)
+    if by_key is None:
         return None, None, None
-    width = model.state_width
-    initial = int(model.init[0])
-    v1 = np.asarray(seq.v1)
-    ok = np.asarray(seq.ok)
-    by_key: dict[int, list[int]] = {}
-    for i in range(len(seq)):
-        k = int(v1[i])
-        if k == NIL or not 0 <= k < width:
-            if bool(ok[i]):
-                return {}, None, False
-            continue  # un-linearizable crashed op: droppable
-        by_key.setdefault(k, []).append(i)
-    cell_model = register(initial)
-    cells = {}
-    for k, rows in by_key.items():
-        sub = subseq(seq, rows)
-        sub.v1 = np.asarray(sub.v2).copy()  # value lane becomes v1
-        sub.v2 = np.full(len(sub.v1), NIL, dtype=sub.v1.dtype)
-        cells[k] = sub
+    if bad_rows:
+        return {}, None, False
+    cells, cell_model = cells_from_rows(seq, model, by_key)
     return cells, cell_model, None
 
 
@@ -202,3 +228,183 @@ def value_block_verdict(seq: OpSeq, model: ModelSpec):
     return not _blocks_conflict(
         np.array([m[v] for v in vals], dtype=np.int64),
         np.array([M[v] for v in vals], dtype=np.int64))
+
+
+# ---------------------------------------------------------------------------
+# Witness construction & the P-compositional stitch
+#
+# The stitch rule lives HERE, next to the gates it inverts: every split
+# above is verdict-exact, and these two functions are the constructive
+# halves — a per-cell/per-block witness composes back into one global
+# linearization, which analyze/audit.py replays independently (W005 is
+# the code for getting THIS wrong).
+# ---------------------------------------------------------------------------
+
+
+def merge_linearizations(seq: OpSeq, lins: list[list[int]]):
+    """Interleave per-cell linearizations into one global witness.
+
+    ``lins`` are row-index sequences over ``seq`` (disjoint cells, each
+    internally a valid linearization of its own projection).  Returns a
+    single order over their union consistent with the PARENT history's
+    real-time order, or None when no interleaving exists — which, by
+    Herlihy–Wing locality (the union of the real-time partial order
+    with per-object linearization orders is acyclic), cannot happen for
+    witnesses of truly independent cells; a None here means a caller
+    bug, and callers degrade it to ``witness_dropped``, never to a
+    fabricated certificate.
+
+    The merge is the constructive half of the locality proof: a cell
+    head ``h`` may go next iff no unplaced witness op returned before
+    ``h`` invoked (``inv[h]`` below the min outstanding return).  A
+    minimal element of the acyclic union order is always such a head,
+    so the greedy never sticks.  Heads are tried in invocation order;
+    the outstanding-return minimum is a lazy-deletion heap.
+    """
+    import heapq
+
+    inv = [int(x) for x in seq.inv]
+    ret = [int(x) for x in seq.ret]
+    lins = [[int(r) for r in lin] for lin in lins if len(lin)]
+    total = sum(len(lin) for lin in lins)
+    ptr = [0] * len(lins)
+    ret_heap = [(ret[r], r) for lin in lins for r in lin]
+    heapq.heapify(ret_heap)
+    placed: set[int] = set()
+    out: list[int] = []
+    while len(out) < total:
+        while ret_heap and ret_heap[0][1] in placed:
+            heapq.heappop(ret_heap)
+        heads = sorted((inv[lins[c][ptr[c]]], c)
+                       for c in range(len(lins)) if ptr[c] < len(lins[c]))
+        chosen = -1
+        for _iv, c in heads:
+            h = lins[c][ptr[c]]
+            if ret_heap and ret_heap[0][1] == h:
+                # min outstanding return EXCLUDING h: pop h, peek, push
+                top = heapq.heappop(ret_heap)
+                while ret_heap and ret_heap[0][1] in placed:
+                    heapq.heappop(ret_heap)
+                thr = ret_heap[0][0] if ret_heap else None
+                heapq.heappush(ret_heap, top)
+            else:
+                thr = ret_heap[0][0] if ret_heap else None
+            if thr is None or inv[h] < thr:
+                chosen = c
+                break
+        if chosen < 0:
+            return None  # no eligible head: the cells were not independent
+        h = lins[chosen][ptr[chosen]]
+        ptr[chosen] += 1
+        placed.add(h)
+        out.append(h)
+    return out
+
+
+def value_block_witness(seq: OpSeq, model: ModelSpec):
+    """A concrete linearization for a ``value_block_verdict(...) is
+    True`` history, or None when the gate fails / the history is
+    invalid / blocks cannot order.
+
+    Constructive inverse of the verdict: each value's block is its
+    write followed by its reads in return order (real-time consistent
+    within the block by construction), blocks are topologically ordered
+    under the forced precedence ``A before B iff minret(A) <
+    maxinv(B)``, and always-legal NIL-value reads are inserted last at
+    the earliest real-time-consistent position.  Block contiguity is
+    what makes the flattened order model-legal: while a block runs, its
+    value IS the register's current value.
+
+    The topological order uses the two-candidate source rule: in this
+    threshold digraph a source (no incoming edge: ``maxinv(X)`` below
+    every other remaining block's minret) is always either the
+    remaining block with minimal ``maxinv`` or the one holding the
+    minimal ``minret`` — O(k log k) instead of a k² Kahn scan.
+    """
+    import heapq
+
+    applies, _reason, writes = value_block_gate(seq, model)
+    if not applies:
+        return None
+    n = len(seq)
+    if n == 0:
+        return []
+    f = np.asarray(seq.f)
+    v1 = [int(x) for x in seq.v1]
+    inv = [int(x) for x in seq.inv]
+    ret = [int(x) for x in seq.ret]
+    init = int(model.init[0])
+
+    rows_of: dict = {v: [i] for v, i in writes.items()}
+    m: dict = {v: ret[i] for v, i in writes.items()}
+    M: dict = {v: inv[i] for v, i in writes.items()}
+    nil_reads: list[int] = []
+    for i in range(n):
+        if int(f[i]) != R_READ:
+            continue
+        v = v1[i]
+        if v == NIL:
+            nil_reads.append(i)  # always legal: inserted after ordering
+            continue
+        if v == init and init != NIL:
+            # the init pseudo-block: pinned first via the [-1,-1]
+            # pseudo-write, exactly as value_block_verdict pins it
+            rows_of.setdefault(NIL, [])
+            m[NIL] = min(m.get(NIL, -1), ret[i])
+            M[NIL] = max(M.get(NIL, -1), inv[i])
+            rows_of[NIL].append(i)
+            continue
+        wi = writes.get(v)
+        if wi is None or ret[i] < inv[wi]:
+            return None  # invalid: no witness exists
+        m[v] = min(m[v], ret[i])
+        M[v] = max(M[v], inv[i])
+        rows_of[v].append(i)
+    # within-block order: write first, reads by return rank
+    for v, rows in rows_of.items():
+        head = rows[:1] if v in writes else []
+        rows_of[v] = head + sorted(rows[len(head):], key=ret.__getitem__)
+
+    keys = list(rows_of)
+    alive = set(keys)
+    by_M = [(M[k], k) for k in keys]
+    by_m = [(m[k], k) for k in keys]
+    heapq.heapify(by_M)
+    heapq.heapify(by_m)
+    order: list = []
+    while alive:
+        while by_M and by_M[0][1] not in alive:
+            heapq.heappop(by_M)
+        while by_m and by_m[0][1] not in alive:
+            heapq.heappop(by_m)
+        chosen = None
+        for x in (by_M[0][1], by_m[0][1]):
+            # source test: maxinv(x) below every OTHER block's minret
+            if by_m[0][1] == x:
+                top = heapq.heappop(by_m)
+                while by_m and by_m[0][1] not in alive:
+                    heapq.heappop(by_m)
+                thr = by_m[0][0] if by_m else None
+                heapq.heappush(by_m, top)
+            else:
+                thr = by_m[0][0]
+            if thr is None or M[x] < thr:
+                chosen = x
+                break
+        if chosen is None:
+            return None  # block cycle: the history is invalid
+        order.append(chosen)
+        alive.discard(chosen)
+    out: list[int] = []
+    for k in order:
+        out.extend(rows_of[k])
+    # NIL-value reads: earliest slot after everything that returned
+    # before they invoked (always exists in a real-time-consistent
+    # order, and a NIL read is model-legal anywhere)
+    for r in sorted(nil_reads, key=inv.__getitem__):
+        at = 0
+        for pos, q in enumerate(out):
+            if ret[q] < inv[r]:
+                at = pos + 1
+        out.insert(at, r)
+    return out

@@ -154,11 +154,82 @@ class InvalidOp(Exception):
     pass
 
 
+class StreamLinter:
+    """Emit-time well-formedness guard over a live generator stream.
+
+    The post-run history linter (analyze/lint.py) finds a double-invoke
+    hours after the generator emitted it; this catches the same defects
+    AT THE MOMENT OF EMISSION, naming the offending generator, so a
+    broken custom generator fails its first op instead of poisoning a
+    whole run's history.  Tracks per-process open ops from the emitted
+    stream (completions are closed by the worker via
+    :meth:`on_complete`); raises the SAME stable diagnostics as the
+    post-run linter:
+
+      * H001 — a generator emitted an invoke for a process whose
+        previous op is still open (single-threaded-process invariant,
+        core.clj:387-404);
+      * H002 — a generator emitted a completion-typed op for a process
+        with no open invoke.
+
+    Installed by ``core.prepare_test`` under ``test["__stream_lint__"]``
+    behind the same ``JEPSEN_TPU_LINT`` opt-out as the post-run linter;
+    nemesis emissions (:info journal entries, core.clj:315-327) are
+    exempt exactly as there.  Thread-safe: workers share one instance.
+    """
+
+    def __init__(self):
+        self._open: dict = {}  # process -> f of the open invoke
+        self._lock = threading.Lock()
+
+    def on_emit(self, op: OpDict, process, gen) -> None:
+        if not isinstance(process, int):
+            return  # nemesis journals :info events freely
+        t = op.get("type", "invoke")  # workers apply the same default
+        from .analyze.lint import Diagnostic, HistoryLintError
+
+        with self._lock:
+            if t == "invoke":
+                prev = self._open.get(process)
+                if prev is not None:
+                    raise HistoryLintError([Diagnostic(
+                        "H001", "error",
+                        f"generator {gen!r} emitted invoke "
+                        f"{op.get('f')!r} for process {process} while "
+                        f"its {prev!r} op is still open (live stream "
+                        f"lint; single-threaded-process invariant, "
+                        f"core.clj:387-404)",
+                        process=process, f=op.get("f"))])
+                self._open[process] = op.get("f")
+            elif t in ("ok", "fail", "info"):
+                if process not in self._open:
+                    raise HistoryLintError([Diagnostic(
+                        "H002", "error",
+                        f"generator {gen!r} emitted {t!r} completion "
+                        f"for process {process} with no open invoke "
+                        f"(live stream lint)",
+                        process=process, f=op.get("f"))])
+                del self._open[process]
+            # unknown types fall through to the post-run linter's H003
+
+    def on_complete(self, process) -> None:
+        """The worker closed this process's op (any completion type —
+        an :info retires the process id entirely)."""
+        with self._lock:
+            self._open.pop(process, None)
+
+
 def op_and_validate(gen, test, process) -> Optional[OpDict]:
-    """Ops must be None or dicts (generator.clj:26-35)."""
+    """Ops must be None or dicts (generator.clj:26-35); with the live
+    stream linter installed (``test["__stream_lint__"]``), emissions
+    are additionally checked for H001/H002 at emit time."""
     op = gen_op(gen, test, process)
     if op is not None and not isinstance(op, dict):
         raise InvalidOp(f"generator {gen!r} produced non-map op {op!r}")
+    if op is not None and isinstance(test, dict):
+        linter = test.get("__stream_lint__")
+        if linter is not None:
+            linter.on_emit(op, process, gen)
     return op
 
 

@@ -65,8 +65,112 @@ def home_html(base: str) -> str:
             f"{''.join(rows)}</table></body></html>")
 
 
+def result_block(result: dict) -> str:
+    """The verdict panel for a run's result page: validity, engine,
+    certificate summary, the static search plan when the result carries
+    one (``--explain``), and the audit/shrink outcomes when present —
+    so a browsing human sees WHY a verdict is trustworthy, not just
+    what it was."""
+    valid = result.get("valid")
+    cls = {True: "valid-true", False: "valid-false",
+           "unknown": "valid-unknown"}.get(valid, "")
+    rows = [("valid", valid), ("engine", result.get("engine")),
+            ("configs", result.get("configs"))]
+    lin = result.get("linearization")
+    if lin is not None:
+        rows.append(("certificate",
+                     f"linearization witness, {len(lin)} ops"))
+    elif result.get("witness_dropped"):
+        rows.append(("certificate",
+                     f"witness dropped: {result['witness_dropped']}"))
+    if result.get("final_ops") is not None:
+        rows.append(("blocking frontier",
+                     f"{len(result['final_ops'])} ops "
+                     f"{result['final_ops'][:10]}"))
+    elif result.get("frontier_dropped"):
+        rows.append(("blocking frontier",
+                     f"dropped: {result['frontier_dropped']}"))
+    a = result.get("audit")
+    if a:
+        rows.append(("audit", "ok (checked %s)" % a.get("checked")
+                     if a.get("ok")
+                     else "FAILED: %s" % ", ".join(a.get("codes", []))))
+    sh = result.get("shrink")
+    if sh:
+        bf = {True: "brute-force says VALID (divergence!)",
+              False: "brute-force confirmed",
+              None: "unconfirmed (too large)"}.get(sh.get("brute_force"))
+        rows.append(("minimal counterexample",
+                     f"{sh.get('n_from')} ops -> {sh.get('n_to')} "
+                     f"({bf})"))
+    body = "".join(f"<tr><th>{html.escape(str(k))}</th>"
+                   f"<td>{html.escape(str(v))}</td></tr>"
+                   for k, v in rows)
+    out = (f'<table class="{cls}"><caption>result</caption>{body}'
+           f"</table>")
+    plan = result.get("explain")
+    if isinstance(plan, dict):
+        # the plan block next to the verdict: dims, bucket, engine
+        # route, decomposition applicability — analyze.plan's renderer
+        # is the ONE formatter, here as everywhere
+        try:
+            from .analyze.plan import render_plan
+
+            out += f"<h3>Search plan</h3><pre>" \
+                   f"{html.escape(render_plan(plan))}</pre>"
+        except Exception:  # noqa: BLE001 — a malformed stored plan
+            pass           # must not take down the results page
+    if sh:
+        # the ONE shrink renderer, shared with linear.html — the two
+        # surfaces must tell the same failure story
+        from .checker.linear_report import shrink_block
+
+        out += shrink_block(result)
+    return out
+
+
+#: nested result fields worth a panel of their own
+_EVIDENCE = ("linearization", "witness_dropped", "final_ops",
+             "frontier_dropped", "explain", "audit", "shrink")
+
+
+def _evidence_results(result: dict, *, max_depth: int = 5,
+                      max_panels: int = 24):
+    """(path, sub-result) pairs for nested verdicts carrying evidence,
+    depth-first, bounded so a huge independent-key run cannot render
+    an unbounded page."""
+    out: list = []
+
+    def walk(d: dict, path: str, depth: int) -> None:
+        if depth > max_depth or len(out) >= max_panels:
+            return
+        for name, sub in d.items():
+            if not isinstance(sub, dict):
+                continue
+            p = f"{path}/{name}" if path else str(name)
+            if "valid" in sub and any(k in sub for k in _EVIDENCE):
+                out.append((p, sub))
+                if len(out) >= max_panels:
+                    return
+            walk(sub, p, depth + 1)
+
+    walk(result, "", 0)
+    return out
+
+
+def _load_result(d: str) -> dict | None:
+    p = os.path.join(d, "results.json")
+    try:
+        with open(p) as f:
+            out = json.load(f)
+        return out if isinstance(out, dict) else None
+    except Exception:
+        return None
+
+
 def dir_html(base: str, rel: str) -> str:
-    """Directory browser (web.clj:194-248)."""
+    """Directory browser (web.clj:194-248); run directories (those
+    holding a results.json) get the result panel on top."""
     d = os.path.join(base, rel)
     entries = sorted(os.listdir(d))
     items = []
@@ -76,9 +180,20 @@ def dir_html(base: str, rel: str) -> str:
         suffix = "/" if os.path.isdir(full) else ""
         items.append(f'<li><a href="{q}{suffix}">{html.escape(e)}{suffix}'
                      f"</a></li>")
+    result = _load_result(d)
+    block = ""
+    if result is not None:
+        # composed checkers nest per-checker (and per-key) results
+        # arbitrarily deep ({"workload": {"results": {0: {"linear":
+        # ...}}}}): render the top-level verdict plus every nested
+        # verdict that carries certificate/plan/audit/shrink evidence
+        block = result_block(result)
+        for path, sub in _evidence_results(result):
+            block += (f"<h2>{html.escape(path)}</h2>"
+                      + result_block(sub))
     return (f"<html><head><style>{STYLE}</style></head><body>"
             f"<h1>{html.escape(rel)}</h1><p><a href='/'>home</a> | "
-            f"<a href='?zip'>zip</a></p><ul>{''.join(items)}</ul>"
+            f"<a href='?zip'>zip</a></p>{block}<ul>{''.join(items)}</ul>"
             f"</body></html>")
 
 

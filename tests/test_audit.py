@@ -1,0 +1,621 @@
+"""Proof-carrying verdicts (ISSUE 4): certificates, audit, shrink.
+
+The contract under test: EVERY engine route's decided verdict carries a
+certificate — ``linearization`` (or an explicit ``witness_dropped``
+reason) on valid, ``final_ops`` (or ``frontier_dropped``) on invalid —
+and the independent audit pass (analyze/audit.py) replays it with zero
+W-codes.  The differential fuzz here spans the direct WGL oracle, the
+`linear` host sweep, the device solo/batch/bucketed engines, and the
+decomposed funnel (value blocks, quiescence chains, the P-compositional
+cell stitch), :info ops included.  Tampered certificates must trip the
+matching W-code — an audit that can't fail proves nothing.
+
+The shrinker satellites ride along: seeded-invalid histories reduce
+below 10 ops, the brute-force permutation checker independently
+confirms the core, and shrinking a minimum is a no-op.  So do the live
+generator-stream lint (H001/H002 at emit time) and the linear witness
+fixes (explicit drop reasons; chains surviving checkpoints).
+"""
+
+from __future__ import annotations
+
+import json
+import random
+
+import pytest
+
+from jepsen_tpu.analyze.audit import AuditError, audit, maybe_audit
+from jepsen_tpu.analyze.shrink import (brute_force_check, shrink_invalid,
+                                       shrink_summary)
+from jepsen_tpu.history import encode_ops, info_op, invoke_op, ok_op
+from jepsen_tpu.models import cas_register, multi_register, mutex, register
+from jepsen_tpu.synth import (corrupt_read, flip_read, register_history,
+                              sim_mutex_history, sim_register_history)
+
+
+def _assert_certified(seq, model, r, where=""):
+    """The certificate contract + a clean audit, for one result."""
+    v = r.get("valid")
+    if v is True:
+        assert "linearization" in r or "witness_dropped" in r, (where, r)
+    elif v is False:
+        assert "final_ops" in r or "frontier_dropped" in r, (where, r)
+    a = audit(seq, model, r)
+    assert a["ok"], (where, a["diagnostics"], r)
+    return a
+
+
+# ---------------------------------------------------------------------------
+# differential fuzz: every route, >= 200 histories, zero W-codes
+# ---------------------------------------------------------------------------
+
+
+def _histories():
+    """(label, model, seq) mix: cas-register with :info ops and
+    corruptions, unique-writes registers (value blocks), low-overlap
+    (quiescence), mutex crashes, multi-register (cell stitch)."""
+    cases = []
+    for i in range(60):
+        rng = random.Random(i)
+        m = cas_register()
+        h = sim_register_history(rng, n_procs=4, n_ops=22, crash_p=0.1,
+                                 cas=(i % 2 == 0))
+        if i % 3 == 0:
+            h = flip_read(rng, h)
+        cases.append(("cas", m, encode_ops(h, m.f_codes)))
+    for i in range(40):
+        rng = random.Random(1000 + i)
+        m = register(0)
+        h = register_history(rng, n_ops=30, n_procs=5, overlap=4,
+                             crash_p=0.0, n_values=10**6, cas=False)
+        if i % 2 == 0:
+            h = flip_read(rng, h)
+        cases.append(("uniq", m, encode_ops(h, m.f_codes)))
+    for i in range(30):
+        rng = random.Random(2000 + i)
+        m = cas_register()
+        h = register_history(rng, n_ops=36, n_procs=3, overlap=1,
+                             crash_p=0.02, max_crashes=2, n_values=4)
+        if i % 2 == 0:
+            h = flip_read(rng, h)
+        cases.append(("quiesce", m, encode_ops(h, m.f_codes)))
+    for i in range(30):
+        rng = random.Random(3000 + i)
+        m = mutex()
+        h = sim_mutex_history(rng, n_ops=22, n_procs=4, crash_p=0.06)
+        cases.append(("mutex", m, encode_ops(h, m.f_codes)))
+    for i in range(45):
+        rng = random.Random(4000 + i)
+        m = multi_register(3)
+        h = _sim_multireg(rng)
+        if i % 3 == 0:
+            h = _flip_mr_read(rng, h)
+        cases.append(("multireg", m, encode_ops(h, m.f_codes)))
+    assert len(cases) >= 200
+    return cases
+
+
+def _sim_multireg(rng, width=3, n_procs=4, n_ops=26, crash_p=0.05):
+    state = {k: 0 for k in range(width)}
+    h, pending, crashed = [], {}, set()
+    done = 0
+    while done < n_ops or pending:
+        live = [p for p in range(n_procs) if p not in crashed]
+        if not live:
+            break
+        p = rng.choice(live)
+        if p in pending:
+            f, k, v = pending.pop(p)
+            if crash_p and rng.random() < crash_p:
+                if rng.random() < 0.5 and f == "write":
+                    state[k] = v
+                crashed.add(p)
+                h.append(info_op(p, f, (k, v if f == "write" else None)))
+                continue
+            if f == "read":
+                h.append(ok_op(p, f, (k, state[k])))
+            else:
+                state[k] = v
+                h.append(ok_op(p, f, (k, v)))
+        elif done < n_ops:
+            f = rng.choice(["read", "write"])
+            k = rng.randrange(width)
+            v = None if f == "read" else rng.randrange(5)
+            h.append(invoke_op(p, f, (k, v)))
+            pending[p] = (f, k, v)
+            done += 1
+    return h
+
+
+def _flip_mr_read(rng, h):
+    from dataclasses import replace
+
+    idx = [i for i, op in enumerate(h)
+           if op.type == "ok" and op.f == "read"]
+    if not idx:
+        return h
+    h = list(h)
+    i = rng.choice(idx)
+    k, v = h[i].value
+    h[i] = replace(h[i], value=(k, (v or 0) + 7))
+    return h
+
+
+def test_fuzz_host_routes_carry_auditable_certificates():
+    """WGL oracle, linear sweep (witnessed), and the decomposed funnel
+    (witness=True) all emit certificates that replay clean, and the
+    witnesses are REAL on every route (non-vacuous coverage)."""
+    from jepsen_tpu.checker.linear import check_opseq_linear
+    from jepsen_tpu.checker.seq import check_opseq
+    from jepsen_tpu.decompose.engine import check_opseq_decomposed
+
+    witnessed = {"wgl": 0, "linear": 0, "decomposed": 0}
+    stitched = 0
+    for label, m, seq in _histories():
+        where = (label, len(seq))
+        a = check_opseq(seq, m)
+        _assert_certified(seq, m, a, where)
+        if a["valid"] is True:
+            witnessed["wgl"] += 1
+        b = check_opseq_linear(seq, m, witness_cap=500_000)
+        assert b["valid"] == a["valid"], where
+        _assert_certified(seq, m, b, where)
+        if b.get("linearization") is not None:
+            witnessed["linear"] += 1
+        d = check_opseq_decomposed(
+            seq, m, witness=True,
+            direct=lambda s, m=m: check_opseq(s, m, lint=False))
+        assert d["valid"] == a["valid"], (where, d)
+        _assert_certified(seq, m, d, where)
+        if d.get("linearization") is not None:
+            witnessed["decomposed"] += 1
+        if d["decompose"].get("stitched"):
+            stitched += 1
+    # every route must produce real witnesses, and the multi-cell
+    # stitch must actually run, or the parity claim is vacuous
+    assert all(n > 20 for n in witnessed.values()), witnessed
+    assert stitched > 10, stitched
+
+
+def test_fuzz_device_batch_routes_carry_certificates():
+    """Bucketed and fused device batches: every per-key verdict is
+    certified (greedy keys with real witnesses — surviving bucket
+    reordering — device keys with explicit drop reasons)."""
+    from jepsen_tpu.checker import linearizable as lin
+
+    m = cas_register()
+    seqs = []
+    for k in range(10):
+        rng = random.Random(k % 5)
+        h = sim_register_history(rng, n_procs=3, n_ops=16 + 8 * (k % 3),
+                                 crash_p=0.08)
+        if k % 3 == 0:
+            h = flip_read(random.Random(k), h)
+        seqs.append(encode_ops(h, m.f_codes))
+    for bucket in (False, True):
+        out = lin.search_batch(seqs, m, budget=150_000, bucket=bucket,
+                               audit=True)
+        greedy_wit = 0
+        for s, r in zip(seqs, out):
+            _assert_certified(s, m, r, f"bucket={bucket}")
+            if r.get("engine") == "greedy-witness":
+                assert r.get("linearization"), r
+                greedy_wit += 1
+        assert greedy_wit > 0
+
+
+def test_search_opseq_device_verdicts_state_their_drops():
+    from jepsen_tpu.checker import linearizable as lin
+
+    m = cas_register()
+    rng = random.Random(999)
+    h = flip_read(rng, register_history(rng, n_ops=60, n_procs=6,
+                                        overlap=6, n_values=5))
+    seq = encode_ops(h, m.f_codes)
+    r = lin.search_opseq(seq, m, budget=150_000, audit=True)
+    if r["valid"] is False and "device" in r.get("engine", ""):
+        assert r["frontier_dropped"]
+    _assert_certified(seq, m, r)
+
+
+# ---------------------------------------------------------------------------
+# tampered certificates must trip the matching W-code
+# ---------------------------------------------------------------------------
+
+
+def _valid_case():
+    m = cas_register()
+    rng = random.Random(11)
+    h = sim_register_history(rng, n_procs=4, n_ops=24, crash_p=0.0)
+    seq = encode_ops(h, m.f_codes)
+    from jepsen_tpu.checker.seq import check_opseq
+
+    r = check_opseq(seq, m)
+    assert r["valid"] is True and r["linearization"]
+    return m, seq, r
+
+
+def test_audit_flags_tampered_certificates():
+    m, seq, r = _valid_case()
+
+    def codes(**mut):
+        bad = {**r, **mut}
+        return audit(seq, m, bad)["codes"]
+
+    lin = r["linearization"]
+    # W001: out-of-range row
+    assert "W001" in codes(linearization=lin[:-1] + [len(seq) + 5])
+    # W002: duplicated row / missing ok row
+    assert "W002" in codes(linearization=lin + [lin[0]])
+    assert "W002" in codes(linearization=lin[:-1])
+    # W003: real-time order broken (move the first op last; with ops
+    # invoked over time the first returns before some later invoke)
+    assert "W003" in codes(linearization=lin[1:] + [lin[0]])
+    # W004: model-illegal order (reverse usually breaks replay; if not,
+    # swapping two ops of different values will)
+    rev = audit(seq, m, {**r, "linearization": list(reversed(lin))})
+    assert not rev["ok"]
+    # contract: a decided verdict with NO certificate and NO reason
+    bare = dict(r)
+    del bare["linearization"]
+    assert "W002" in audit(seq, m, bare)["codes"]
+    assert audit(seq, m, {"valid": False})["codes"] == ["W002"]
+    # explicit drop reasons are accepted
+    assert audit(seq, m, {"valid": True,
+                          "witness_dropped": "x"})["ok"]
+    assert audit(seq, m, {"valid": False,
+                          "frontier_dropped": "x"})["ok"]
+
+
+def test_audit_w005_flags_cross_cell_stitch_violations():
+    """A stitched multi-register witness that breaks cross-cell
+    precedence reads as W005 (same-cell breaks stay W003)."""
+    m = multi_register(2)
+    # key 0: write then (after it returns) key 1: write — real-time
+    # orders them across cells
+    h = [invoke_op(0, "write", (0, 5)), ok_op(0, "write", (0, 5)),
+         invoke_op(1, "write", (1, 6)), ok_op(1, "write", (1, 6))]
+    seq = encode_ops(h, m.f_codes)
+    good = {"valid": True, "linearization": [0, 1],
+            "decompose": {"stitched": True}}
+    assert audit(seq, m, good)["ok"]
+    bad = {"valid": True, "linearization": [1, 0],
+           "decompose": {"stitched": True}}
+    assert "W005" in audit(seq, m, bad)["codes"]
+    # without the stitch marker the same defect is plain W003
+    assert "W003" in audit(seq, m, {"valid": True,
+                                    "linearization": [1, 0]})["codes"]
+
+
+def test_maybe_audit_raises_loudly_and_attaches_summary():
+    m, seq, r = _valid_case()
+    out = maybe_audit(seq, m, dict(r), True)
+    assert out["audit"]["ok"] and out["audit"]["checked"] == \
+        "linearization"
+    with pytest.raises(AuditError):
+        maybe_audit(seq, m, {**r, "linearization":
+                             r["linearization"][:-1]}, True)
+    # off by default: no audit key, no raise
+    out2 = maybe_audit(seq, m, {**r, "linearization": []}, None)
+    assert "audit" not in out2
+
+
+def test_audit_env_knob(monkeypatch):
+    from jepsen_tpu.analyze.audit import audit_enabled
+
+    monkeypatch.delenv("JEPSEN_TPU_AUDIT", raising=False)
+    assert audit_enabled() is False
+    monkeypatch.setenv("JEPSEN_TPU_AUDIT", "1")
+    assert audit_enabled() is True
+    m, seq, r = _valid_case()
+    from jepsen_tpu.checker.seq import check_opseq
+
+    out = check_opseq(seq, m)  # audit=None follows the env knob
+    assert out["audit"]["ok"]
+
+
+def test_cli_audit_flag_sets_env_knob(monkeypatch):
+    import argparse
+
+    from jepsen_tpu import cli
+
+    monkeypatch.setenv("JEPSEN_TPU_AUDIT", "placeholder")
+    monkeypatch.delenv("JEPSEN_TPU_AUDIT")
+    p = argparse.ArgumentParser()
+    cli.add_test_opts(p)
+    opts = cli.test_opt_fn(p.parse_args(["--audit", "--dummy"]))
+    assert opts["audit"] is True
+    import os
+
+    assert os.environ.get("JEPSEN_TPU_AUDIT") == "1"
+
+
+# ---------------------------------------------------------------------------
+# counterexample minimization
+# ---------------------------------------------------------------------------
+
+
+def test_shrinker_reduces_seeded_invalid_below_10_ops():
+    """The bench-config shape (register_history + corrupt_read): the
+    shrinker must reduce the counterexample below 10 ops and the
+    brute-force permutation checker must independently confirm it."""
+    m = cas_register()
+    rng = random.Random(7)
+    h = register_history(rng, n_ops=120, n_procs=6, overlap=4,
+                         n_values=8)
+    h = corrupt_read(rng, h, at=0.5)
+    seq = encode_ops(h, m.f_codes)
+    out = shrink_invalid(seq, m)
+    assert out["n_to"] < 10, out
+    assert out["minimal"] is True
+    assert out["brute_force"] is False  # independently confirmed invalid
+    summ = shrink_summary(seq, out)
+    assert len(summ["ops"]) == out["n_to"]
+
+
+def test_shrinking_is_idempotent():
+    from jepsen_tpu.decompose.partition import subseq
+
+    m = cas_register()
+    for i in range(5):
+        rng = random.Random(40 + i)
+        h = flip_read(rng, sim_register_history(rng, n_procs=4,
+                                                n_ops=40, crash_p=0.05))
+        seq = encode_ops(h, m.f_codes)
+        out = shrink_invalid(seq, m)
+        if not out["minimal"]:
+            continue
+        core = subseq(seq, out["rows"])
+        again = shrink_invalid(core, m)
+        assert again["rows"] == list(range(len(core))), (i, again)
+        assert again["n_to"] == out["n_to"]
+
+
+def test_brute_force_agrees_with_oracle_on_small_histories():
+    from jepsen_tpu.checker.seq import check_opseq
+
+    m = cas_register()
+    for i in range(40):
+        rng = random.Random(70 + i)
+        h = sim_register_history(rng, n_procs=3, n_ops=7, crash_p=0.1)
+        if i % 2 == 0:
+            h = flip_read(rng, h)
+        seq = encode_ops(h, m.f_codes)
+        bf = brute_force_check(seq, m)
+        assert bf == check_opseq(seq, m)["valid"], i
+    # size gate: None past max_ops
+    rng = random.Random(1)
+    big = encode_ops(sim_register_history(rng, n_procs=3, n_ops=30),
+                     m.f_codes)
+    assert brute_force_check(big, m, max_ops=16) is None
+
+
+def test_shrink_is_wired_into_failure_reports():
+    from jepsen_tpu.checker.linearizable import Linearizable
+
+    m = cas_register()
+    rng = random.Random(9)
+    h = flip_read(rng, sim_register_history(rng, n_procs=4, n_ops=40,
+                                            crash_p=0.05))
+    res = Linearizable(m, algorithm="linear").check(
+        {"name": "shrinktest", "start_time": "t0"}, h)
+    assert res["valid"] is False
+    sh = res.get("shrink")
+    assert sh and sh["n_to"] < 10 and sh["brute_force"] is False
+    assert sh["ops"]  # the rendered story
+    with open(res["report_file"]) as f:
+        page = f.read()
+    assert "Minimal failing subhistory" in page
+
+
+# ---------------------------------------------------------------------------
+# linear.py witness satellites: explicit drops + snapshot survival
+# ---------------------------------------------------------------------------
+
+
+def test_linear_witness_dropped_reasons():
+    from jepsen_tpu.checker.linear import check_opseq_linear
+
+    m = cas_register()
+    rng = random.Random(3)
+    h = sim_register_history(rng, n_procs=4, n_ops=24, crash_p=0.05)
+    seq = encode_ops(h, m.f_codes)
+    # cap 0: tracking disabled, and it says so
+    r0 = check_opseq_linear(seq, m)
+    assert r0["valid"] is True and "linearization" not in r0
+    assert "witness_cap=0" in r0["witness_dropped"]
+    # tiny cap: table blows the cap, verdict unaffected, reason explicit
+    r1 = check_opseq_linear(seq, m, witness_cap=4)
+    assert r1["valid"] is True and "linearization" not in r1
+    assert "exceeded witness_cap=4" in r1["witness_dropped"]
+    # ample cap: a real, auditable witness
+    r2 = check_opseq_linear(seq, m, witness_cap=500_000)
+    assert audit(seq, m, r2)["ok"] and r2["linearization"]
+
+
+def test_linear_witness_survives_checkpoint_resume(tmp_path):
+    from jepsen_tpu.checker.linear import check_opseq_linear
+
+    m = cas_register()
+    rng = random.Random(5)
+    h = sim_register_history(rng, n_procs=4, n_ops=30, crash_p=0.05)
+    seq = encode_ops(h, m.f_codes)
+    ck = str(tmp_path / "linear.ck")
+    base = check_opseq_linear(seq, m, witness_cap=500_000,
+                              checkpoint_path=ck, checkpoint_every=3)
+    assert base["valid"] is True and base["linearization"]
+    # resume WITH a witness cap: the serialized pre-snapshot chains
+    # seed the walk, so the resumed verdict still carries a full,
+    # auditable witness
+    r = check_opseq_linear(seq, m, witness_cap=500_000, resume_from=ck)
+    assert r["valid"] is True
+    assert r["linearization"], r.get("witness_dropped")
+    assert audit(seq, m, r)["ok"]
+    # resume without a cap: explicit drop, not silence
+    r2 = check_opseq_linear(seq, m, resume_from=ck)
+    assert r2["valid"] is True and "witness_cap=0" in \
+        r2["witness_dropped"]
+
+
+def test_linear_witnessless_checkpoint_resume_says_so(tmp_path):
+    from jepsen_tpu.checker.linear import check_opseq_linear
+
+    m = cas_register()
+    rng = random.Random(6)
+    h = sim_register_history(rng, n_procs=4, n_ops=30, crash_p=0.05)
+    seq = encode_ops(h, m.f_codes)
+    ck = str(tmp_path / "nolin.ck")
+    check_opseq_linear(seq, m, checkpoint_path=ck, checkpoint_every=3)
+    r = check_opseq_linear(seq, m, witness_cap=500_000, resume_from=ck)
+    assert r["valid"] is True and "witnessless checkpoint" in \
+        r["witness_dropped"]
+
+
+# ---------------------------------------------------------------------------
+# live generator-stream lint (H001/H002 at emit time)
+# ---------------------------------------------------------------------------
+
+
+class _DoubleInvoker:
+    """Deliberately broken: never waits for completions."""
+
+    def op(self, test, process):
+        return {"type": "invoke", "f": "read", "value": None}
+
+
+class _OrphanCompleter:
+    def op(self, test, process):
+        return {"type": "ok", "f": "read", "value": 1}
+
+
+def test_stream_lint_raises_at_emission():
+    from jepsen_tpu.analyze.lint import HistoryLintError
+    from jepsen_tpu.generator import StreamLinter, op_and_validate
+
+    test = {"__stream_lint__": StreamLinter(), "concurrency": 2}
+    g = _DoubleInvoker()
+    op_and_validate(g, test, 0)  # first invoke is fine
+    op_and_validate(g, test, 1)  # other processes unaffected
+    with pytest.raises(HistoryLintError) as ei:
+        op_and_validate(g, test, 0)
+    d = ei.value.diagnostics[0]
+    assert d.code == "H001" and "_DoubleInvoker" in d.message
+    with pytest.raises(HistoryLintError) as ei:
+        op_and_validate(_OrphanCompleter(),
+                        {"__stream_lint__": StreamLinter()}, 3)
+    assert ei.value.diagnostics[0].code == "H002"
+
+
+def test_stream_lint_tolerates_wellformed_flow_and_nemesis():
+    from jepsen_tpu.generator import StreamLinter, op_and_validate
+
+    sl = StreamLinter()
+    test = {"__stream_lint__": sl}
+    g = _DoubleInvoker()
+    for p in (0, 1):
+        op_and_validate(g, test, p)
+        sl.on_complete(p)  # the worker closes the op
+        op_and_validate(g, test, p)  # next invoke is legal again
+        sl.on_complete(p)
+    # nemesis emissions are exempt (they journal :info freely)
+    for _ in range(3):
+        op_and_validate({"type": "info", "f": "start"}, test, "nemesis")
+
+
+def test_stream_lint_installed_behind_lint_knob(monkeypatch):
+    from jepsen_tpu import core
+
+    monkeypatch.delenv("JEPSEN_TPU_LINT", raising=False)
+    t = core.prepare_test({"name": "x", "nodes": []})
+    assert "__stream_lint__" in t
+    monkeypatch.setenv("JEPSEN_TPU_LINT", "0")
+    t2 = core.prepare_test({"name": "x", "nodes": []})
+    assert "__stream_lint__" not in t2
+
+
+# ---------------------------------------------------------------------------
+# standalone tooling: python -m jepsen_tpu.analyze --audit, fuzz --audit
+# ---------------------------------------------------------------------------
+
+
+def test_analyze_main_audit_mode(tmp_path):
+    from jepsen_tpu import store
+    from jepsen_tpu.analyze.__main__ import main
+    from jepsen_tpu.checker.seq import check_opseq
+
+    m = cas_register()
+    rng = random.Random(13)
+    h = sim_register_history(rng, n_procs=3, n_ops=16)
+    hist = [op for op in h]
+    hp = str(tmp_path / "history.jsonl")
+    with open(hp, "w") as f:
+        for op in hist:
+            f.write(json.dumps(op.to_dict()) + "\n")
+    seq = encode_ops(store.read_history(hp), m.f_codes)
+    r = check_opseq(seq, m)
+    rp = str(tmp_path / "result.json")
+    with open(rp, "w") as f:
+        json.dump({"valid": r["valid"],
+                   "linearization": r["linearization"]}, f)
+    assert main([hp, "--model", "cas-register", "--audit", rp]) == 0
+    # a tampered stored certificate fails loudly (exit 1)
+    with open(rp, "w") as f:
+        json.dump({"valid": r["valid"],
+                   "linearization": r["linearization"][:-1]}, f)
+    assert main([hp, "--model", "cas-register", "--audit", rp]) == 1
+    # --audit without --model is a usage error
+    assert main([hp, "--audit", rp]) == 254
+
+
+def test_fuzz_audit_mode_bounded_seeds(monkeypatch, capsys):
+    """tools/fuzz.py --audit: a bounded-seed pass stays clean — the
+    tier-1 gate for the certificate fuzz mode."""
+    import importlib.util
+    import os
+    import sys
+
+    path = os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), "tools", "fuzz.py")
+    spec = importlib.util.spec_from_file_location("_fuzz_audit", path)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    monkeypatch.setattr(sys, "argv",
+                        ["fuzz.py", "--rounds", "4", "--n-ops", "20",
+                         "--audit"])
+    assert mod.main() == 0
+
+
+# ---------------------------------------------------------------------------
+# web result page
+# ---------------------------------------------------------------------------
+
+
+def test_web_result_page_renders_plan_audit_and_shrink(tmp_path):
+    from jepsen_tpu import web
+    from jepsen_tpu.analyze.plan import explain
+
+    m = cas_register()
+    rng = random.Random(2)
+    h = sim_register_history(rng, n_procs=3, n_ops=16)
+    seq = encode_ops(h, m.f_codes)
+    run = tmp_path / "t" / "20260803T000000"
+    run.mkdir(parents=True)
+    result = {
+        "valid": False, "engine": "host-oracle", "configs": 123,
+        "final_ops": [1, 2],
+        "explain": explain(seq, m),
+        "audit": {"ok": True, "checked": "final_ops", "codes": []},
+        "shrink": {"n_from": 16, "n_to": 3, "rows": [0, 1, 2],
+                   "checks": 9, "minimal": True, "brute_force": False,
+                   "ops": [{"process": 0, "f": "write", "value": 1}]},
+    }
+    with open(run / "results.json", "w") as f:
+        json.dump(result, f)
+    page = web.dir_html(str(tmp_path), "t/20260803T000000")
+    assert "Search plan" in page and "SearchDims" in page
+    assert "audit" in page and "final_ops" in page
+    assert "Minimal failing subhistory" in page
+    assert "blocking frontier" in page
+    # a run dir without results.json renders the plain browser
+    (tmp_path / "t2").mkdir()
+    assert "Search plan" not in web.dir_html(str(tmp_path), "t2")

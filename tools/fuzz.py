@@ -94,24 +94,54 @@ ORACLE_CAP = 40_000
 DEVICE_BUDGET = 120_000
 
 
-def verdicts(h: list[Op], model) -> tuple:
-    """Three-way: (WGL oracle, device BFS, linear host sweep)."""
+def results(h: list[Op], model):
+    """Three-way full results: (WGL oracle, device BFS, linear host
+    sweep) — or None on an encode error (the caller reads
+    ``verdicts`` for that case).  The linear sweep runs with a witness
+    cap so its valid verdicts carry auditable certificates."""
     from jepsen_tpu.checker.linear import check_opseq_linear
 
+    s = encode_ops(h, model.f_codes)
+    a = oracle.check_opseq(s, model, max_configs=ORACLE_CAP)
+    b = lin.search_opseq(s, model, budget=DEVICE_BUDGET)
+    c = check_opseq_linear(s, model, max_configs=ORACLE_CAP,
+                           witness_cap=500_000)
+    return s, (a, b, c)
+
+
+def verdicts(h: list[Op], model) -> tuple:
+    """Three-way: (WGL oracle, device BFS, linear host sweep)."""
     try:
-        s = encode_ops(h, model.f_codes)
+        _s, (a, b, c) = results(h, model)
     except Exception as e:
         err = ("encode-error", str(e))
         return err, err, err
-    a = oracle.check_opseq(s, model, max_configs=ORACLE_CAP)
-    b = lin.search_opseq(s, model, budget=DEVICE_BUDGET)
-    c = check_opseq_linear(s, model, max_configs=ORACLE_CAP)
     return a["valid"], b["valid"], c["valid"]
 
 
-def diverges(h: list[Op], model) -> bool:
-    vs = [v for v in verdicts(h, model) if v != "unknown"]
+def audit_results(s, model, rs) -> list:
+    """Certificate audit over one round's three engine results:
+    returns the W-code diagnostics found (empty = all certificates
+    replay clean).  Fails loudly in --audit mode: a certificate its
+    own engine cannot replay is an engine bug even when all three
+    verdicts agree."""
+    from jepsen_tpu.analyze.audit import audit
+
+    bad = []
+    for engine, r in zip(("oracle", "device", "linear"), rs):
+        a = audit(s, model, r)
+        if not a["ok"]:
+            bad.extend((engine, d) for d in a["diagnostics"])
+    return bad
+
+
+def _diverge(vs) -> bool:
+    vs = [v for v in vs if v != "unknown"]
     return len(set(vs)) > 1  # capped-out engines are not divergences
+
+
+def diverges(h: list[Op], model) -> bool:
+    return _diverge(verdicts(h, model))
 
 
 def shrink(h: list[Op], model, *, max_passes: int = 8) -> list[Op]:
@@ -170,6 +200,10 @@ def main() -> int:
                     choices=sorted(MODELS))
     ap.add_argument("--replay", metavar="FILE")
     ap.add_argument("--out", default="fuzz-repro.json")
+    ap.add_argument("--audit", action="store_true",
+                    help="Also replay every engine's certificate "
+                         "through jepsen_tpu.analyze.audit; any W-code "
+                         "fails the run loudly (exit 1)")
     args = ap.parse_args()
 
     if args.replay:
@@ -184,7 +218,27 @@ def main() -> int:
                         crash_p)
         if rng.random() < 0.7:
             h = corrupt(rng, h)
-        if diverges(h, model):
+        div = None
+        if args.audit:
+            # one engine pass serves both the audit and the divergence
+            # test — the three searches dominate a round's cost
+            try:
+                s, rs = results(h, model)
+            except Exception:
+                div = False  # encode errors are the lint fuzzer's beat
+            else:
+                bad = audit_results(s, model, rs)
+                if bad:
+                    print(f"AUDIT FAILURE at round {i} "
+                          f"(seed {args.seed + i}):", file=sys.stderr)
+                    for engine, d in bad:
+                        print(f"  [{engine}] {d}", file=sys.stderr)
+                    json.dump([op.to_dict() for op in h],
+                              open(args.out, "w"), indent=1)
+                    print(f"history -> {args.out}")
+                    return 1
+                div = _diverge([r["valid"] for r in rs])
+        if diverges(h, model) if div is None else div:
             a, b, c = verdicts(h, model)
             print(f"DIVERGENCE at round {i} (seed {args.seed + i}): "
                   f"oracle={a} device={b} linear={c}; shrinking...",

@@ -1649,7 +1649,17 @@ def main():
 
 
 if __name__ == "__main__":
-    if "--run-tier" in sys.argv:
+    if "--stream-tier" in sys.argv:
+        # the streaming tier (jepsen_tpu/stream/bench.py): time-to-
+        # first-verdict, violation-detection latency, sustained
+        # multiplexed ingest -> BENCH_stream.json.  Host-only (the
+        # stream folds are host sweeps at this scale), so it runs
+        # standalone without the device probe machinery above.
+        os.environ.setdefault("JAX_PLATFORMS", "cpu")
+        from jepsen_tpu.stream.bench import run_stream_tier
+
+        run_stream_tier(REPO, quick=QUICK)
+    elif "--run-tier" in sys.argv:
         i = sys.argv.index("--run-tier")
         tier_name = sys.argv[i + 1]
         budget_arg = int(sys.argv[sys.argv.index("--budget") + 1])

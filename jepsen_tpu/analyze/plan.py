@@ -93,6 +93,102 @@ def quiescence_cuts(seq: OpSeq) -> np.ndarray:
     return np.nonzero(run_max[:-1] < inv[1:])[0] + 1
 
 
+# ---------------------------------------------------------------------------
+# Streaming applicability gate — the ONE home (stream/checker.py consumes)
+# ---------------------------------------------------------------------------
+
+#: model families whose segment folds can ride the device batch path
+#: (stream/device.py's pseudo-write/pseudo-read state pinning needs a
+#: single-value register)
+STREAM_DEVICE_FAMILIES = ("register", "cas-register")
+
+#: host-fold cost-proxy cap: a closed segment predicted past this folds
+#: on the device batch path instead of the host sweep
+STREAM_HOST_FOLD_MAX = 1 << 22
+
+
+def segment_fold_cost(n_rows: int, window: int) -> int:
+    """The host fold's cost proxy for one crash-free segment: rows times
+    the window-bounded interleaving factor (``segment_states`` is the
+    level-synchronous sweep, whose frontier is bounded by 2^(window-1)
+    per prefix position)."""
+    return (n_rows + 1) << min(max(window - 1, 0), 40)
+
+
+def segment_fold_route(n_rows: int, window: int, model: ModelSpec, *,
+                       host_fold_max: int | None = None) -> str:
+    """``"host"`` or ``"device"`` for one closed streaming segment.
+
+    The single routing rule the stream engine executes and
+    :func:`stream_plan` predicts: device dispatch needs the register
+    family (the state-pinning trick) AND a predicted host-fold cost
+    past the cap; everything else folds on host."""
+    if model.name not in STREAM_DEVICE_FAMILIES:
+        return "host"
+    cap = STREAM_HOST_FOLD_MAX if host_fold_max is None else host_fold_max
+    return "device" if segment_fold_cost(n_rows, window) > cap else "host"
+
+
+def stream_plan(seq: OpSeq, model: ModelSpec, *,
+                host_fold_max: int | None = None) -> dict:
+    """The streaming-applicability gate: would the incremental checker
+    (jepsen_tpu/stream/) pay off on this history, and how would it
+    route?  Predicts quiescence-cut density, expected segment sizes,
+    rows until the first closed segment (the time-to-first-verdict
+    proxy), and the host-fold vs device-dispatch split — using the SAME
+    cut primitive (:func:`quiescence_cuts`) and the SAME routing rule
+    (:func:`segment_fold_route`) the stream engine executes, so the
+    prediction cannot drift from the fold."""
+    from ..decompose.partition import partition_by_key, subseq
+    from ..history import max_concurrency
+
+    cells_map, cell_model, early = (None, model, None)
+    if key_partition_applies(model):
+        cells_map, cell_model, early = partition_by_key(seq, model)
+    cells = list(cells_map.values()) if cells_map else [seq]
+    if cell_model is None:
+        cell_model = model
+
+    seg_rows: list[int] = []
+    routes = {"host": 0, "device": 0}
+    ttfv_rows = None
+    for cseq in cells:
+        n = len(cseq)
+        if n == 0:
+            continue
+        cuts = quiescence_cuts(cseq)
+        bounds = [0, *cuts.tolist(), n]
+        if len(cuts) and (ttfv_rows is None or int(cuts[0]) < ttfv_rows):
+            ttfv_rows = int(cuts[0])
+        for i in range(len(bounds) - 1):
+            rows = bounds[i + 1] - bounds[i]
+            seg_rows.append(rows)
+            if i < len(bounds) - 2:  # closed segments fold mid-stream
+                w = max_concurrency(
+                    subseq(cseq, np.arange(bounds[i], bounds[i + 1])))
+                routes[segment_fold_route(
+                    rows, w, cell_model,
+                    host_fold_max=host_fold_max)] += 1
+    n_cells = max(1, len(cells))
+    n_rows = max(1, len(seq))
+    closed = sum(routes.values())
+    return {
+        "applies": closed > 0 and early is not False,
+        "cells": n_cells,
+        "segments": len(seg_rows),
+        "closed_segments": closed,
+        "cut_density": round(closed / n_rows, 4),
+        "expected_segment_rows": {
+            "mean": round(sum(seg_rows) / len(seg_rows), 2)
+            if seg_rows else 0,
+            "max": max(seg_rows) if seg_rows else 0,
+        },
+        "ttfv_rows": ttfv_rows,
+        "routes": routes,
+        "device_eligible": cell_model.name in STREAM_DEVICE_FAMILIES,
+    }
+
+
 def schedule_weight(seq: OpSeq) -> int:
     """The cell schedulers' cost proxy (largest-first ordering in
     decompose/schedule.py's host pool and device batch).
@@ -222,6 +318,7 @@ def explain(history, model: ModelSpec, *,
         "config_upper_bound_log2": round(
             ub_log2 + float(np.log2(max(1, es.n_det + 1))), 2),
         "decompositions": _decompositions(seq, model),
+        "streaming": stream_plan(seq, model),
     }
 
 
@@ -324,4 +421,12 @@ def render_plan(plan: dict, *, batch: bool = False) -> str:
         + "; quiescence "
         + (f"applies ({qc['segments']} segments)" if qc["applies"]
            else "n/a"))
+    st = plan.get("streaming")
+    if st:
+        lines.append(
+            "  streaming: "
+            + ("applies" if st["applies"] else "n/a")
+            + f" ({st['closed_segments']} closed segment(s), cut "
+              f"density {st['cut_density']}, ttfv ~{st['ttfv_rows']} "
+              f"rows, routes {st['routes']})")
     return "\n".join(lines)

@@ -72,6 +72,18 @@ def add_test_opts(p: argparse.ArgumentParser) -> None:
                         "canonical-hash verdict cache.  Verdict-"
                         "identical; sets JEPSEN_TPU_LIN_DECOMPOSE so "
                         "every suite-constructed checker honors it.")
+    p.add_argument("--stream", action="store_true", default=False,
+                   help="Check the history INCREMENTALLY while the "
+                        "test runs (jepsen_tpu/stream/): an op sink "
+                        "folds quiescence segments as they close, "
+                        "serves a live provisional verdict "
+                        "(web UI /api/live, store live.json), and "
+                        "flags a violation seconds after it happens.  "
+                        "Final verdicts are identical to the post-hoc "
+                        "checker.  Sets JEPSEN_TPU_STREAM=1 fleet-"
+                        "wide; JEPSEN_TPU_STREAM_CACHE points the "
+                        "sink at a shared verdict cache ('store' for "
+                        "the persisted default).")
     p.add_argument("--explain", action="store_true", default=False,
                    help="Print the static search PLAN instead of "
                         "running the linearizability search: SearchDims"
@@ -158,6 +170,11 @@ def test_opt_fn(parsed: argparse.Namespace) -> dict:
         # selector (JEPSEN_TPU_LIN_ALGORITHM)
         os.environ["JEPSEN_TPU_LIN_DECOMPOSE"] = "1"
         opts["lin_decompose"] = True
+    if opts.pop("stream", False):
+        # like --lin-decompose: core.prepare_test consults the env var,
+        # so the opt-in reaches every run this process starts
+        os.environ["JEPSEN_TPU_STREAM"] = "1"
+        opts["stream"] = True
     if opts.pop("explain", False):
         # like --lin-decompose: suites construct their own checkers, so
         # the plan-only mode travels by env var

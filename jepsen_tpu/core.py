@@ -24,6 +24,7 @@ Key semantics preserved exactly:
 from __future__ import annotations
 
 import logging
+import os as _stdlib_os
 import threading
 import time
 import traceback
@@ -53,11 +54,35 @@ def primary(test: dict):
     return test["nodes"][0]
 
 
+def _sink_op(test: dict, op: Op) -> None:
+    """Feed the streaming op sink (stream/checker.py), when installed.
+
+    Called under the history lock, so the sink sees events in exactly
+    history order and its event counter equals the op's eventual
+    :index.  A sink failure must never take down the run — the sink is
+    an observer; it disarms itself and the post-hoc checker still
+    decides."""
+    sink = test.get("__stream_check__")
+    if sink is None:
+        return
+    try:
+        sink.ingest(op)
+    except Exception:  # noqa: BLE001 — observer, not the run
+        log.warning("stream checker sink failed; disabling",
+                    exc_info=True)
+        test["__stream_check__"] = None
+        try:
+            sink.close()
+        except Exception:  # noqa: BLE001
+            pass
+
+
 def conj_op(test: dict, op: Op) -> Op:
     """Append to the test's history (core.clj:45-49)."""
     hist = test["history"]
     with test["_history_lock"]:
         hist.append(op)
+        _sink_op(test, op)
     return op
 
 
@@ -284,6 +309,8 @@ class NemesisWorker(Worker):
         for hist, lock in list(test["active_histories"]):
             with lock:
                 hist.append(op)
+                if hist is test.get("history"):
+                    _sink_op(test, op)
         try:
             completion = self.nemesis.invoke(test, op)
             completion = replace(completion, time=relative_time_nanos())
@@ -300,6 +327,8 @@ class NemesisWorker(Worker):
         for hist, lock in list(test["active_histories"]):
             with lock:
                 hist.append(completion)
+                if hist is test.get("history"):
+                    _sink_op(test, completion)
         log_op(completion)
         return completion
 
@@ -425,7 +454,47 @@ def prepare_test(test: dict) -> dict:
         # same opt-out (JEPSEN_TPU_LINT=0 / --no-lint) as the post-run
         # history linter
         test["__stream_lint__"] = gen.StreamLinter()
+    from .stream.checker import stream_enabled
+
+    if (test.get("stream") or stream_enabled()) \
+            and "__stream_check__" not in test:
+        # the streaming incremental checker (stream/checker.py): an op
+        # sink next to the stream linter, folding quiescence segments
+        # as they close so the verdict is live while workers still run.
+        # Needs the model; tests without one stay post-hoc only.
+        model = test.get("model")
+        if model is not None:
+            from .stream.checker import StreamChecker
+
+            cache = _stdlib_os.environ.get(
+                "JEPSEN_TPU_STREAM_CACHE", "").strip() or None
+            if cache in ("1", "store"):
+                from .decompose.cache import default_cache_path
+
+                cache = default_cache_path()
+            live = store.path(test, "live.json") if test.get("name") \
+                else None
+            test["__stream_check__"] = StreamChecker(
+                model, async_folds=True, cache=cache, live_path=live,
+                run_id=f"{test.get('name')}/{test['start_time']}"
+                if test.get("name") else None)
+        else:
+            log.info("streaming requested but the test carries no "
+                     "model; running post-hoc only")
     return test
+
+
+def _finalize_stream(test: dict) -> Optional[dict]:
+    """Flush + finalize the streaming op sink; returns its final result
+    (the verdict of exactly the prefix the run recorded) or None."""
+    sink = test.pop("__stream_check__", None)
+    if sink is None:
+        return None
+    try:
+        return sink.finalize()
+    except Exception:  # noqa: BLE001 — the sink must not mask the run
+        log.warning("stream checker finalize failed", exc_info=True)
+        return None
 
 
 def run(test: dict) -> dict:
@@ -436,37 +505,78 @@ def run(test: dict) -> dict:
     try:
         log.info("Running test: %s", test.get("name"))
         try:
-            control.setup_sessions(test)
-            with_os(test)
             try:
-                with_db(test)
+                control.setup_sessions(test)
+                with_os(test)
                 try:
-                    threads = list(range(test["concurrency"])) + ["nemesis"]
-                    with gen.with_threads(threads):
-                        with relative_time():
-                            # wall-clock anchor of op :time = 0, for
-                            # checkers that reason about absolute time
-                            # (e.g. the chronos schedule checker)
-                            test["start_wall_time"] = time.time()
-                            test["history"] = run_case(test)
-                    log.info("Run complete, writing")
-                    if test.get("name"):
-                        store.save_1(test, test["history"])
+                    with_db(test)
+                    try:
+                        threads = list(range(test["concurrency"])) \
+                            + ["nemesis"]
+                        with gen.with_threads(threads):
+                            with relative_time():
+                                # wall-clock anchor of op :time = 0, for
+                                # checkers that reason about absolute
+                                # time (e.g. the chronos schedule
+                                # checker)
+                                test["start_wall_time"] = time.time()
+                                test["history"] = run_case(test)
+                        log.info("Run complete, writing")
+                        if test.get("name"):
+                            store.save_1(test, test["history"])
+                    finally:
+                        teardown_db(test)
                 finally:
-                    teardown_db(test)
+                    teardown_os(test)
             finally:
-                teardown_os(test)
-        finally:
-            for s in (test.get("sessions") or {}).values():
-                try:
-                    s.remote.disconnect(s.node)
-                except Exception:
-                    pass
+                for s in (test.get("sessions") or {}).values():
+                    try:
+                        s.remote.disconnect(s.node)
+                    except Exception:
+                        pass
+        except BaseException as e:
+            if isinstance(e, (KeyboardInterrupt, SystemExit)):
+                # the user is leaving NOW: finalizing could run a full
+                # direct search (fallback path) — don't hold the exit
+                raise
+            # worker abort / setup / teardown failure: the op sink has
+            # still recorded everything that reached the history, and a
+            # crashed run owes its caller the verdict of that prefix
+            # (open invokes finalize as the :info tail).  The streamed
+            # result rides the exception AND the store, because this
+            # path re-raises and the caller never sees the test dict.
+            sres = _finalize_stream(test)
+            if sres is not None:
+                from .stream.service import result_summary
+
+                results = {"valid": sres.get("valid"), "aborted": True,
+                           "stream": result_summary(sres)}
+                e.stream_results = results
+                log.info("aborted run: streamed verdict for the "
+                         "recorded prefix is %r", sres.get("valid"))
+                if test.get("name"):
+                    try:
+                        store.save_1(test, test.get("history") or [])
+                        store.save_2(test, results)
+                    except Exception:  # noqa: BLE001 — already failing
+                        log.warning("could not persist the aborted "
+                                    "run's streamed verdict",
+                                    exc_info=True)
+            raise
 
         log.info("Analyzing")
         test["history"] = index_history(test["history"])
+        sres = _finalize_stream(test)
+        if sres is not None:
+            test["stream_results"] = sres
         test["results"] = checker_mod.check_safe(
             test["checker"], test, test["history"], {})
+        if sres is not None and isinstance(test["results"], dict):
+            # the live verdict next to the authoritative one (plus the
+            # cache counters the web result panel renders)
+            from .stream.service import result_summary
+
+            test["results"]["stream"] = result_summary(sres)
         log.info("Analysis complete")
         if test.get("name"):
             store.save_2(test, test["results"])

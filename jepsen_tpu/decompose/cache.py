@@ -34,14 +34,18 @@ class VerdictCache:
     """In-memory dict with append-through jsonl persistence.
 
     ``path=None`` keeps the cache purely in-memory (tests, one-shot
-    runs).  ``hits``/``misses`` count :meth:`get` outcomes since the
-    last :meth:`reset_stats` — the bench's hit-rate evidence."""
+    runs).  ``hits``/``misses`` count :meth:`get` outcomes and
+    ``inserts`` the entries actually stored since the last
+    :meth:`reset_stats` — the per-run reuse evidence the engines thread
+    into results (and the web result panel renders), so segment-level
+    reuse across streamed fleets is measured, not inferred."""
 
     def __init__(self, path: str | None = None):
         self.path = path
         self._d: dict[str, dict] = {}
         self.hits = 0
         self.misses = 0
+        self.inserts = 0
         self._fh = None
         if path is not None:
             self._load(path)
@@ -67,6 +71,7 @@ class VerdictCache:
     def reset_stats(self) -> None:
         self.hits = 0
         self.misses = 0
+        self.inserts = 0
 
     def get(self, key: str) -> dict | None:
         e = self._d.get(key)
@@ -90,11 +95,13 @@ class VerdictCache:
             return  # "unknown" is a budget artifact, not a verdict
         e = {"k": key, "v": bool(valid)}
         self._d[key] = e
+        self.inserts += 1
         self._append(e)
 
     def put_states(self, key: str, out_states: list[list[int]]) -> None:
         e = {"k": key, "out": [list(s) for s in out_states]}
         self._d[key] = e
+        self.inserts += 1
         self._append(e)
 
     def close(self) -> None:

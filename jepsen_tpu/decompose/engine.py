@@ -183,8 +183,16 @@ def segment_states(sseq: OpSeq, model: ModelSpec, init_states, *,
     return states, wit
 
 
-def _skey(payload: bytes) -> str:
-    return hashlib.sha256(payload).hexdigest()
+def _skey(payload: bytes, kind: bytes = b"seg") -> str:
+    """Segment-entry cache key.  ``kind`` namespaces the two entry
+    species a segment payload can produce — ``b"seg"`` for a reachable-
+    state set, ``b"fin"`` for a final-segment verdict — so a mid-stream
+    fold and a final check of the SAME content under the SAME input
+    states cannot overwrite each other's entries (they carry different
+    value shapes, and the kind checks would treat the clobbered entry
+    as a miss forever — cache thrash, not wrong verdicts, but thrash
+    that streamed fleets hit constantly on tiny repeated segments)."""
+    return hashlib.sha256(kind + b"|" + payload).hexdigest()
 
 
 def check_opseq_decomposed(seq: OpSeq, model: ModelSpec, *,
@@ -258,6 +266,7 @@ def check_opseq_decomposed(seq: OpSeq, model: ModelSpec, *,
         if cache is not None:
             stats["cache_hits"] = cache.hits
             stats["cache_misses"] = cache.misses
+            stats["cache_inserts"] = cache.inserts
         stats["methods"] = sorted(methods)
         out = {"valid": valid, "configs": stats["configs_searched"],
                "engine": "decompose(%s)" % ",".join(
@@ -420,7 +429,7 @@ def check_opseq_decomposed(seq: OpSeq, model: ModelSpec, *,
         if cache is not None:
             payload, _ren = canonical_payload(fseq, cell_model,
                                               instates=states)
-            fkey = _skey(payload)
+            fkey = _skey(payload, b"fin")
             e = cache.get(fkey)
         lin = frontier = None
         if e is not None and "v" in e:

@@ -1,0 +1,91 @@
+"""``python -m jepsen_tpu.stream`` — the checking service's front door.
+
+stdin mode (default) reads history JSONL from stdin and writes verdict
+lines to stdout; ``--listen HOST:PORT`` serves the same line protocol
+over TCP, one connection per run namespace.  See stream/service.py for
+the protocol and docs/stream.md for the walkthrough.
+"""
+
+from __future__ import annotations
+
+import argparse
+import logging
+import sys
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(
+        prog="python -m jepsen_tpu.stream",
+        description="Streaming incremental checking service: ingest "
+                    "history JSONL from concurrent runs, answer with "
+                    "live verdicts.")
+    p.add_argument("--model", default=None,
+                   help="Default model for runs that send no header "
+                        "(register, cas-register, mutex, "
+                        "multi-register, unordered-queue-N, "
+                        "fifo-queue-N).")
+    p.add_argument("--init", type=int, default=0,
+                   help="Default model's initial value.")
+    p.add_argument("--width", type=int, default=1,
+                   help="Default model's state width (multi-register).")
+    p.add_argument("--cache", metavar="PATH", default=None,
+                   help="Shared verdict-cache jsonl; 'store' selects "
+                        "the store-persisted default path.  Omit for "
+                        "an in-memory per-process cache.")
+    p.add_argument("--no-cache", action="store_true",
+                   help="Disable the verdict cache entirely.")
+    p.add_argument("--no-witness", action="store_true",
+                   help="Skip witness chains (verdicts only; faster).")
+    p.add_argument("--audit", action="store_true",
+                   help="Replay every final certificate through the "
+                        "independent audit (analyze/audit.py).")
+    p.add_argument("--host-fold-max", type=int, default=None,
+                   help="Override the plan gate's host-fold cost cap "
+                        "(analyze.plan.STREAM_HOST_FOLD_MAX).")
+    p.add_argument("--listen", metavar="HOST:PORT", default=None,
+                   help="Serve the line protocol over TCP instead of "
+                        "stdin/stdout.")
+    args = p.parse_args(argv)
+    logging.basicConfig(level=logging.WARNING)
+
+    from ..decompose.cache import VerdictCache, default_cache_path
+    from ..decompose.schedule import model_from_descriptor
+    from .service import StreamService, make_server, serve_stdio
+
+    model = None
+    if args.model:
+        model = model_from_descriptor(
+            (args.model, (args.init,), args.width))
+    cache = None
+    if not args.no_cache:
+        path = args.cache
+        if path == "store":
+            path = default_cache_path()
+        cache = VerdictCache(path)
+
+    if args.listen:
+        host, _, port = args.listen.rpartition(":")
+        srv = make_server(host or "127.0.0.1", int(port), model=model,
+                          cache=cache,
+                          witness=not args.no_witness,
+                          audit=True if args.audit else None,
+                          host_fold_max=args.host_fold_max)
+        print(f"stream service listening on "
+              f"{srv.server_address[0]}:{srv.server_address[1]}",
+              file=sys.stderr, flush=True)
+        try:
+            srv.serve_forever()
+        except KeyboardInterrupt:
+            srv.shutdown()
+        return 0
+
+    service = StreamService(model=model, cache=cache,
+                            witness=not args.no_witness,
+                            audit=True if args.audit else None,
+                            host_fold_max=args.host_fold_max)
+    serve_stdio(service, sys.stdin, sys.stdout)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

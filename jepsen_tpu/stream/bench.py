@@ -1,0 +1,164 @@
+"""The streaming bench tier — ``python bench.py --stream-tier``.
+
+Three measurements, written to ``BENCH_stream.json`` (one JSON object)
+and echoed as bench.py's usual single JSON line:
+
+  * **time-to-first-verdict** — a quiescent register workload streamed
+    op-by-op; wall clock (and event index) from the first ingest to the
+    first folded segment, i.e. the moment the verdict stops being
+    "open".  Post-hoc checking cannot answer before the last op by
+    construction; this is the number that makes streaming a different
+    execution mode rather than a faster one.
+  * **violation-detection latency** — the same workload with a read
+    corrupted near op k (~10% in): events and wall clock between
+    ingesting the violating op and the stream flipping ``invalid``,
+    plus the headroom to the end of the stream (how much run time the
+    early verdict saves).
+  * **sustained multiplexed ingest** — 4 concurrent synthetic streams
+    through one :class:`~jepsen_tpu.stream.service.StreamService`
+    namespace each, sharing one verdict cache; total ops/sec across
+    the fleet, with the cache counters showing cross-stream reuse.
+
+Every stream's final verdict is cross-checked against the post-hoc
+direct engine (``parity`` in the output) — a throughput number from a
+checker that disagrees with the oracle would be worthless.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import random
+import threading
+import time
+
+
+def _mk_history(seed: int, n_ops: int, *, corrupt_at: float | None = None):
+    from ..synth import corrupt_read, register_history
+
+    rng = random.Random(seed)
+    h = register_history(rng, n_ops=n_ops, n_procs=6, overlap=4,
+                         quiesce_every=8, n_values=5, cas=False)
+    violation_idx = None
+    if corrupt_at is not None:
+        h2 = corrupt_read(rng, h, at=corrupt_at)
+        violation_idx = next(i for i, (a, b) in enumerate(zip(h, h2))
+                             if a is not b)
+        h = h2
+    return h, violation_idx
+
+
+def _stream_one(model, h, *, cache=None):
+    """Stream a history op-by-op; returns (final result, timeline) where
+    timeline records first-verdict and first-invalid wall/event marks."""
+    from .checker import StreamChecker
+
+    sc = StreamChecker(model, cache=cache)
+    t0 = time.perf_counter()
+    tl = {"t0": t0, "first_verdict": None, "first_invalid": None,
+          "ingest_s": None}
+    for i, op in enumerate(h):
+        sc.ingest(op)
+        if tl["first_verdict"] is None or tl["first_invalid"] is None:
+            v = sc.verdict()
+            if tl["first_verdict"] is None and v["status"] != "open":
+                tl["first_verdict"] = (i, time.perf_counter() - t0)
+            if tl["first_invalid"] is None and v["status"] == "invalid":
+                tl["first_invalid"] = (i, time.perf_counter() - t0)
+    tl["ingest_s"] = time.perf_counter() - t0
+    return sc.finalize(), tl
+
+
+def run_stream_tier(repo: str, *, quick: bool = False) -> dict:
+    from ..checker.linear import check_opseq_linear
+    from ..decompose.cache import VerdictCache
+    from ..history import encode_ops
+    from ..models import register
+
+    n_ops = 400 if quick else 2000
+    model = register(0)
+    out: dict = {"metric": "streaming incremental checker",
+                 "n_ops": n_ops, "quick": quick, "parity": True}
+
+    def posthoc(h):
+        seq = encode_ops(h, model.f_codes)
+        t0 = time.perf_counter()
+        r = check_opseq_linear(seq, model, lint=False)
+        return r, time.perf_counter() - t0
+
+    # --- tier 1: time-to-first-verdict on a valid stream -------------
+    h, _ = _mk_history(11, n_ops)
+    r, tl = _stream_one(model, h)
+    ph, ph_s = posthoc(h)
+    out["parity"] &= r["valid"] == ph["valid"]
+    out["ttfv"] = {
+        "events": len(h),
+        "first_verdict_event": tl["first_verdict"][0]
+        if tl["first_verdict"] else None,
+        "first_verdict_s": round(tl["first_verdict"][1], 4)
+        if tl["first_verdict"] else None,
+        "stream_total_s": round(tl["ingest_s"], 4),
+        "posthoc_s": round(ph_s, 4),
+        "segments": r["stream"]["segments"],
+        "valid": r["valid"],
+    }
+
+    # --- tier 2: violation-detection latency -------------------------
+    h, k = _mk_history(12, n_ops, corrupt_at=0.1)
+    r, tl = _stream_one(model, h)
+    ph, _s = posthoc(h)
+    out["parity"] &= r["valid"] == ph["valid"]
+    inv = tl["first_invalid"]
+    # wall clock between ingesting the violating event and the verdict
+    # flipping (the op index delta is the protocol-level latency; the
+    # headroom is how much of the run the early verdict saves)
+    out["violation_latency"] = {
+        "violation_event": k,
+        "invalid_at_event": inv[0] if inv else None,
+        "event_delta": (inv[0] - k) if inv else None,
+        "invalid_at_s": round(inv[1], 4) if inv else None,
+        "headroom_events": (len(h) - 1 - inv[0]) if inv else None,
+        "detected_before_stream_end": bool(inv and inv[0] < len(h) - 1),
+        "valid": r["valid"],
+    }
+
+    # --- tier 3: sustained ingest, 4 concurrent streams --------------
+    cache = VerdictCache()  # in-memory, shared across the fleet
+    streams = [(i, _mk_history(100 + (i % 2), n_ops)[0])
+               for i in range(4)]  # two pairs share content: cache hits
+    results: dict = {}
+
+    def worker(i, h):
+        results[i] = _stream_one(model, h, cache=cache)
+
+    threads = [threading.Thread(target=worker, args=s) for s in streams]
+    t0 = time.perf_counter()
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    wall = time.perf_counter() - t0
+    total_events = sum(len(h) for _i, h in streams)
+    for i, h in streams:
+        ph, _s = posthoc(h)
+        out["parity"] &= results[i][0]["valid"] == ph["valid"]
+    out["multiplexed"] = {
+        "streams": len(streams),
+        "events_total": total_events,
+        "wall_s": round(wall, 4),
+        "events_per_sec": round(total_events / wall, 1) if wall else None,
+        "cache": {"hits": cache.hits, "misses": cache.misses,
+                  "inserts": cache.inserts},
+    }
+
+    path = os.path.join(repo, "BENCH_stream.json")
+    with open(path, "w") as f:
+        json.dump(out, f, indent=1)
+    print(json.dumps({
+        "metric": "stream: time-to-first-verdict (s) on a "
+                  f"{n_ops}-op quiescent register stream",
+        "value": out["ttfv"]["first_verdict_s"],
+        "unit": "seconds",
+        "detail": out,
+    }))
+    return out

@@ -1,0 +1,107 @@
+"""Device-dispatched segment folds — bucket.py applied to the stream.
+
+A mid-stream quiescence segment must be folded to its *reachable
+final-state set* so the next segment can compose; ``segment_states`` is
+an exact host sweep, but a wide segment makes it the one stage that
+could stall ingest.  For the single-value register family the fold
+reduces to ordinary linearizability checks the batched device engine
+already runs well:
+
+  * a **prepended pseudo-write** of candidate input state ``s_in``
+    (interval ``[-2, -1]``: it returns before every real op invokes, so
+    any linearization is forced to run it first — equivalent to
+    starting the model in ``s_in``);
+  * an **appended pseudo-read** of candidate output state ``s_out``
+    (invoking after every real op returns: forced last, legal iff the
+    register ends holding ``s_out``).
+
+``(s_in, s_out)`` is feasible iff that decorated segment linearizes, so
+the whole fold becomes one ``search_batch`` over the candidate pairs —
+uniformly shaped variants of one segment, exactly what the
+shape-bucketed scheduler (checker/bucket.py) pads tightest.  Candidate
+outputs are the segment's state-changing values (every row is :ok in a
+crash-free segment, so every write/successful cas linearizes and the
+final state is the last one's value).
+
+Returns None when the trick does not apply (no state-changing op — the
+host fold is trivially cheap there anyway — or a candidate-pair blowup
+past ``max_variants``, or any variant undecided under ``budget``); the
+caller then folds on host.  Routing between the two lives in
+``analyze.plan.segment_fold_route`` so the plan explainer and the
+stream engine cannot drift.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from ..history import NIL, OpSeq
+from ..models import R_CAS, R_READ, R_WRITE, ModelSpec
+
+#: candidate (s_in, s_out) pairs above which the fold falls back to the
+#: host sweep — each pair is one device-batched search
+MAX_VARIANTS = 512
+
+
+def _decorate(sseq: OpSeq, s_in: int, s_out: int) -> OpSeq:
+    """The segment with the state-pinning pseudo-ops attached."""
+    n = len(sseq)
+    lo = int(np.min(sseq.inv)) if n else 0
+    hi = int(np.max(sseq.ret)) if n else 0
+    return OpSeq(
+        process=np.concatenate([[np.int32(-1)], sseq.process,
+                                [np.int32(-2)]]).astype(np.int32),
+        f=np.concatenate([[R_WRITE], sseq.f, [R_READ]]).astype(np.int32),
+        v1=np.concatenate([[s_in], sseq.v1, [s_out]]).astype(np.int32),
+        v2=np.concatenate([[NIL], sseq.v2, [NIL]]).astype(np.int32),
+        inv=np.concatenate([[lo - 2], sseq.inv, [hi + 1]]).astype(np.int64),
+        ret=np.concatenate([[lo - 1], sseq.ret, [hi + 2]]).astype(np.int64),
+        ok=np.concatenate([[True], sseq.ok, [True]]).astype(bool),
+    )
+
+
+def device_fold_states(sseq: OpSeq, model: ModelSpec, in_states, *,
+                       budget: int = 2_000_000):
+    """Reachable output states of a crash-free register-family segment,
+    via the batched (bucketed) device engine.
+
+    Returns ``(states, configs)`` — the exact set ``segment_states``
+    would compute, plus the configs the searches billed — or ``None``
+    when ineligible/undecided (the caller folds on host)."""
+    if model.name not in ("register", "cas-register"):
+        return None
+    n = len(sseq)
+    if n == 0 or not bool(np.asarray(sseq.ok).all()):
+        return None
+    f = np.asarray(sseq.f)
+    changers = set()
+    for i in range(n):
+        fc = int(f[i])
+        if fc == R_WRITE:
+            changers.add(int(sseq.v1[i]))
+        elif fc == R_CAS:
+            changers.add(int(sseq.v2[i]))
+        elif fc != R_READ:
+            return None  # foreign op code: not this model family
+    if not changers:
+        # all-reads segment: the state never moves and the host fold is
+        # linear — no device win to be had
+        return None
+    ins = sorted({int(s[0]) for s in in_states})
+    outs = sorted(changers)
+    pairs = [(a, b) for a in ins for b in outs]
+    if not pairs or len(pairs) > MAX_VARIANTS:
+        return None
+    from ..checker.linearizable import search_batch
+
+    variants = [_decorate(sseq, a, b) for a, b in pairs]
+    results = search_batch(variants, model, budget=budget, lint=False)
+    configs = sum(int(r.get("configs", 0) or 0) for r in results)
+    states = set()
+    for (_a, b), r in zip(pairs, results):
+        v = r.get("valid")
+        if v is True:
+            states.add((b,))
+        elif v is not False:
+            return None  # undecided variant: the fold must stay exact
+    return states, configs

@@ -1,0 +1,216 @@
+"""The long-running checking service — many runs, one warm cache.
+
+``python -m jepsen_tpu.stream`` turns the incremental checker into a
+service that ingests history JSONL from many concurrent test runs (over
+stdin or a TCP socket) and answers with live verdicts.  All runs share
+one :class:`~jepsen_tpu.decompose.cache.VerdictCache`, so a segment any
+fleet member has ever folded is never re-searched — the sustained-
+traffic architecture the ROADMAP names: pay only for novel segments.
+
+Line protocol (one JSON object per line, newline-delimited):
+
+  in   {"run": ID, "model": NAME, "init": N, "width": W}   open a run
+  in   {"run": ID, "op": {process, type, f, value}}        one event
+  in   {"process": .., "type": .., ...}                    single-run
+                                                           shorthand
+  in   {"run": ID, "end": true}                            finalize
+  out  {"run": ID, "live": {...}}      status changed (open ->
+                                       valid-so-far -> invalid)
+  out  {"run": ID, "final": {...}}     the final verdict + stream stats
+  out  {"run": ID, "error": "..."}     a malformed line / unknown run
+
+Model names are the shard scheduler's descriptors
+(``decompose.schedule.model_from_descriptor``): register,
+cas-register, mutex, multi-register (width), unordered-queue-N,
+fifo-queue-N.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import socketserver
+import threading
+
+from ..history import Op
+
+log = logging.getLogger("jepsen")
+
+#: default run id for the single-run (bare-op) shorthand
+DEFAULT_RUN = "default"
+
+
+def result_summary(result: dict, *, max_frontier: int = 16) -> dict:
+    """The JSON-line form of a final result: verdict, engine, stream
+    stats, and a bounded certificate summary (a 10k-op linearization
+    does not belong on a protocol line)."""
+    out = {"valid": result.get("valid"),
+           "engine": result.get("engine"),
+           "configs": result.get("configs"),
+           "stream": result.get("stream")}
+    lin = result.get("linearization")
+    if lin is not None:
+        out["witness_ops"] = len(lin)
+    elif result.get("witness_dropped"):
+        out["witness_dropped"] = result["witness_dropped"]
+    fr = result.get("final_ops")
+    if fr is not None:
+        out["final_ops"] = list(fr[:max_frontier])
+        out["frontier_ops"] = len(fr)
+    elif result.get("frontier_dropped"):
+        out["frontier_dropped"] = result["frontier_dropped"]
+    if result.get("audit") is not None:
+        out["audit"] = result["audit"]
+    return out
+
+
+class StreamService:
+    """Multiplexes JSONL lines onto per-run :class:`StreamChecker`\\ s.
+
+    One instance per connection namespace; the verdict cache (and its
+    lock-free append-only jsonl) is shared across every instance the
+    process creates — that is the fleet-reuse story."""
+
+    def __init__(self, *, model=None, cache=None, witness: bool = True,
+                 audit: bool | None = None,
+                 host_fold_max: int | None = None):
+        self.default_model = model
+        self.cache = cache
+        self.witness = witness
+        self.audit = audit
+        self.host_fold_max = host_fold_max
+        self._runs: dict = {}
+        self._status: dict = {}
+
+    def open_run(self, run_id: str, model) -> None:
+        from .checker import StreamChecker
+
+        self._runs[run_id] = StreamChecker(
+            model, cache=self.cache, witness=self.witness,
+            host_fold_max=self.host_fold_max, run_id=run_id)
+        self._status[run_id] = "open"
+
+    def _model_from(self, d: dict):
+        from ..decompose.schedule import model_from_descriptor
+
+        name = d["model"]
+        init = int(d.get("init", 0))
+        width = int(d.get("width", 1))
+        return model_from_descriptor((name, (init,), width))
+
+    def handle_line(self, line: str, emit) -> None:
+        """Process one protocol line; ``emit(dict)`` writes a reply."""
+        line = line.strip()
+        if not line:
+            return
+        try:
+            d = json.loads(line)
+        except ValueError:
+            emit({"run": None, "error": "malformed JSON line"})
+            return
+        if not isinstance(d, dict):
+            emit({"run": None, "error": "expected a JSON object"})
+            return
+        run_id = d.get("run", DEFAULT_RUN)
+        try:
+            if "model" in d:
+                self.open_run(run_id, self._model_from(d))
+                return
+            if d.get("end"):
+                self.end_run(run_id, emit)
+                return
+            op = d.get("op")
+            if op is None and "type" in d:
+                op = d  # bare-op shorthand
+            if op is None:
+                emit({"run": run_id,
+                      "error": "line carries neither model/op/end"})
+                return
+            chk = self._runs.get(run_id)
+            if chk is None:
+                if self.default_model is None:
+                    emit({"run": run_id,
+                          "error": f"unknown run {run_id!r} and no "
+                                   f"default --model"})
+                    return
+                self.open_run(run_id, self.default_model)
+                chk = self._runs[run_id]
+            chk.ingest(Op.from_dict(op))
+            v = chk.verdict()
+            if v["status"] != self._status.get(run_id):
+                self._status[run_id] = v["status"]
+                emit({"run": run_id, "live": v})
+        except Exception as e:  # noqa: BLE001 — one line, not the service
+            log.warning("stream service: line failed: %s", e)
+            emit({"run": run_id, "error": f"{type(e).__name__}: {e}"})
+
+    def end_run(self, run_id: str, emit) -> None:
+        chk = self._runs.pop(run_id, None)
+        self._status.pop(run_id, None)
+        if chk is None:
+            emit({"run": run_id, "error": f"unknown run {run_id!r}"})
+            return
+        result = chk.finalize(audit=self.audit)
+        emit({"run": run_id, "final": result_summary(result)})
+
+    def end_all(self, emit) -> None:
+        """EOF / disconnect: every still-open run yields its verdict for
+        the prefix it recorded — nothing ingested is ever discarded."""
+        for run_id in list(self._runs):
+            self.end_run(run_id, emit)
+
+
+def serve_stdio(service: StreamService, stdin, stdout) -> None:
+    """The stdin/stdout loop (one writer thread: replies are lines)."""
+    lock = threading.Lock()
+
+    def emit(d: dict) -> None:
+        with lock:
+            stdout.write(json.dumps(d, separators=(",", ":")) + "\n")
+            stdout.flush()
+
+    for line in stdin:
+        service.handle_line(line, emit)
+    service.end_all(emit)
+
+
+class _Handler(socketserver.StreamRequestHandler):
+    def handle(self):
+        # each connection is its own run namespace (two fleets may both
+        # call their run "r1"); the verdict cache is the shared part
+        srv: _TCPServer = self.server
+        service = StreamService(model=srv.default_model,
+                                cache=srv.cache, witness=srv.witness,
+                                audit=srv.audit,
+                                host_fold_max=srv.host_fold_max)
+        lock = threading.Lock()
+
+        def emit(d: dict) -> None:
+            with lock:
+                self.wfile.write(
+                    (json.dumps(d, separators=(",", ":")) + "\n")
+                    .encode())
+
+        try:
+            for raw in self.rfile:
+                service.handle_line(raw.decode("utf-8", "replace"), emit)
+            service.end_all(emit)
+        except (BrokenPipeError, ConnectionResetError):
+            log.debug("stream service: client dropped the connection")
+
+
+class _TCPServer(socketserver.ThreadingTCPServer):
+    allow_reuse_address = True
+    daemon_threads = True
+
+
+def make_server(host: str, port: int, *, model=None, cache=None,
+                witness: bool = True, audit: bool | None = None,
+                host_fold_max: int | None = None) -> _TCPServer:
+    srv = _TCPServer((host, port), _Handler)
+    srv.default_model = model
+    srv.cache = cache
+    srv.witness = witness
+    srv.audit = audit
+    srv.host_fold_max = host_fold_max
+    return srv

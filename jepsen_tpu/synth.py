@@ -29,14 +29,21 @@ def register_history(rng: random.Random, *, n_ops: int, n_procs: int,
                      overlap: int = 4, crash_p: float = 0.0,
                      max_crashes: int = 16, n_values: int = 5,
                      cas: bool = True,
-                     unique_writes: bool = False) -> list[Op]:
+                     unique_writes: bool = False,
+                     quiesce_every: int | None = None) -> list[Op]:
     """Concurrent CAS-register history, valid by construction.
 
     ``unique_writes`` draws every write value from a fresh counter
     (starting at 1, so it never collides with a register's initial 0)
     instead of ``[0, n_values)`` — the unique-writes register class the
     per-value block decomposition (decompose/partition.py) is exact
-    on."""
+    on.
+
+    ``quiesce_every`` drains every pending op after each that many
+    invocations before invoking more — a *bursty* workload with
+    guaranteed quiescent points every ~that many ops, the shape the
+    quiescence cutter (and the streaming checker's online cuts) feeds
+    on: segments of roughly that size at the full ``overlap`` width."""
     state = None
     h: list[Op] = []
     pending: dict[int, tuple] = {}
@@ -48,7 +55,10 @@ def register_history(rng: random.Random, *, n_ops: int, n_procs: int,
         free = [p for p in range(n_procs)
                 if p not in pending and p not in crashed_procs]
         want_invoke = (done < n_ops and free
-                       and (len(pending) < overlap or not pending))
+                       and (len(pending) < overlap or not pending)
+                       and not (quiesce_every and done
+                                and done % quiesce_every == 0
+                                and pending))
         if want_invoke:
             p = rng.choice(free)
             fs = ["read", "write"] + (["cas"] if cas else [])

@@ -103,6 +103,30 @@ def result_block(result: dict) -> str:
         rows.append(("minimal counterexample",
                      f"{sh.get('n_from')} ops -> {sh.get('n_to')} "
                      f"({bf})"))
+    sm = result.get("stream")
+    if isinstance(sm, dict):
+        # the streamed verdict next to the authoritative one: a run
+        # result's "stream" is the service summary (stats nested), a
+        # raw streamed result carries the stats dict directly
+        st = sm.get("stream") if isinstance(sm.get("stream"), dict) \
+            else sm
+        rows.append(("streamed",
+                     f"{sm.get('valid', st.get('valid'))} after "
+                     f"{st.get('segments')} segment(s) / "
+                     f"{st.get('events')} events; first verdict at "
+                     f"event {st.get('first_verdict_event')}"))
+    # verdict-cache reuse counters (decomposed or streamed route):
+    # segment-level reuse across runs and fleets, measured not inferred
+    for src in (result.get("decompose"),
+                (result.get("stream") or {}).get("stream")
+                if isinstance(result.get("stream"), dict) else None,
+                result.get("stream")):
+        if isinstance(src, dict) and "cache_hits" in src:
+            rows.append(("verdict cache",
+                         f"{src['cache_hits']} hits / "
+                         f"{src['cache_misses']} misses / "
+                         f"{src.get('cache_inserts', 0)} inserts"))
+            break
     body = "".join(f"<tr><th>{html.escape(str(k))}</th>"
                    f"<td>{html.escape(str(v))}</td></tr>"
                    for k, v in rows)
@@ -168,9 +192,45 @@ def _load_result(d: str) -> dict | None:
         return None
 
 
+def live_panel(rel: str) -> str:
+    """The live-verdict panel for a run directory holding a
+    ``live.json`` snapshot (written by the streaming op sink,
+    stream/checker.py): a status strip polled from ``/api/live/<run>``
+    every 2s until the stream finalizes."""
+    api = "/api/live/" + urllib.parse.quote(rel.rstrip("/"))
+    return f"""
+<div id="live-panel"><h3>Live verdict</h3>
+<p id="live-status">loading…</p><pre id="live-json"></pre></div>
+<script>
+const CLS = {{"valid-so-far": "valid-true", "invalid": "valid-false",
+             "open": "valid-unknown"}};
+async function pollLive() {{
+  let done = false;
+  try {{
+    const r = await fetch({json.dumps(api)});
+    if (r.ok) {{
+      const d = await r.json();
+      const el = document.getElementById("live-status");
+      el.textContent = d.status + " — " + d.events + " events, "
+        + d.segments_closed + " segments closed, "
+        + d.checked_rows + "/" + d.rows + " rows checked"
+        + (d.final ? " — FINAL: " + d.final.valid : "");
+      el.className = CLS[d.status] || "";
+      document.getElementById("live-json").textContent =
+        JSON.stringify(d, null, 1);
+      done = !!d.final;
+    }}
+  }} catch (e) {{}}
+  if (!done) setTimeout(pollLive, 2000);
+}}
+pollLive();
+</script>"""
+
+
 def dir_html(base: str, rel: str) -> str:
     """Directory browser (web.clj:194-248); run directories (those
-    holding a results.json) get the result panel on top."""
+    holding a results.json) get the result panel on top, and a live
+    streaming run (live.json present) its auto-refreshing verdict."""
     d = os.path.join(base, rel)
     entries = sorted(os.listdir(d))
     items = []
@@ -180,14 +240,16 @@ def dir_html(base: str, rel: str) -> str:
         suffix = "/" if os.path.isdir(full) else ""
         items.append(f'<li><a href="{q}{suffix}">{html.escape(e)}{suffix}'
                      f"</a></li>")
-    result = _load_result(d)
     block = ""
+    if os.path.isfile(os.path.join(d, "live.json")):
+        block += live_panel(rel)
+    result = _load_result(d)
     if result is not None:
         # composed checkers nest per-checker (and per-key) results
         # arbitrarily deep ({"workload": {"results": {0: {"linear":
         # ...}}}}): render the top-level verdict plus every nested
         # verdict that carries certificate/plan/audit/shrink evidence
-        block = result_block(result)
+        block += result_block(result)
         for path, sub in _evidence_results(result):
             block += (f"<h2>{html.escape(path)}</h2>"
                       + result_block(sub))
@@ -276,6 +338,26 @@ class Handler(BaseHTTPRequestHandler):
         path = urllib.parse.unquote(parsed.path)
         if path == "/":
             self._send(200, home_html(self.base).encode())
+            return
+        if path.startswith("/api/live/"):
+            # the live provisional verdict of a (possibly running)
+            # streamed test: the op sink rewrites live.json atomically
+            # as the stream moves (stream/checker.py), so this is a
+            # plain read — no coordination with the runner process
+            rel = os.path.normpath(path[len("/api/live/"):]).lstrip("/")
+            if rel.startswith(".."):
+                self._send(403, b"forbidden", "text/plain")
+                return
+            p = os.path.join(self.base, rel, "live.json")
+            try:
+                with open(p, "rb") as f:
+                    body = f.read()
+            except OSError:
+                self._send(404, b'{"error": "no live stream"}',
+                           "application/json")
+                return
+            self._send(200, body, "application/json",
+                       extra={"Cache-Control": "no-store"})
             return
         if not path.startswith("/files/"):
             self._send(404, b"not found", "text/plain")

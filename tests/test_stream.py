@@ -1,0 +1,828 @@
+"""Streaming incremental checker (jepsen_tpu/stream/).
+
+The subsystem's contract is absolute: a history streamed op-by-op must
+reach EXACTLY the post-hoc verdict — same valid flag, audit-clean
+certificate — while surfacing invalidity before the stream ends
+whenever the violation is not in the final segment.  The differential
+fuzz here (200+ histories, :info crashes, never-quiescing workloads,
+mid-stream invalidations, multi-register cells) is the enforcement;
+the targeted tests pin the online-cut semantics, the device fold, the
+cache reuse, the runner/abort wiring, the plan gate, and the service
+mode.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import random
+import subprocess
+import sys
+import threading
+import urllib.request
+from dataclasses import replace
+
+import pytest
+
+from jepsen_tpu.history import encode_ops, info_op, invoke_op, ok_op
+from jepsen_tpu.models import (cas_register, multi_register, mutex,
+                               register)
+from jepsen_tpu.stream import StreamChecker
+from jepsen_tpu.synth import (corrupt_read, flip_read, register_history,
+                              sim_mutex_history, sim_register_history)
+
+
+def _direct(seq, model):
+    from jepsen_tpu.checker.seq import check_opseq
+
+    return check_opseq(seq, model)
+
+
+def _stream(h, model, **kw):
+    """Stream op-by-op; returns (final result, event index of the first
+    mid-stream invalid status, checker)."""
+    sc = StreamChecker(model, **kw)
+    invalid_at = None
+    for i, op in enumerate(h):
+        sc.ingest(op)
+        if invalid_at is None and sc.verdict()["status"] == "invalid":
+            invalid_at = i
+    return sc.finalize(), invalid_at, sc
+
+
+def sim_multireg_history(rng, width=3, n_procs=4, n_ops=30,
+                         crash_p=0.05):
+    state = {k: 0 for k in range(width)}
+    h, pending, crashed = [], {}, set()
+    done = 0
+    while done < n_ops or pending:
+        live = [p for p in range(n_procs) if p not in crashed]
+        if not live:
+            break
+        p = rng.choice(live)
+        if p in pending:
+            f, k, v = pending.pop(p)
+            if crash_p and rng.random() < crash_p:
+                if rng.random() < 0.5 and f == "write":
+                    state[k] = v
+                crashed.add(p)
+                h.append(info_op(p, f, (k, v if f == "write" else None)))
+                continue
+            if f == "read":
+                h.append(ok_op(p, f, (k, state[k])))
+            else:
+                state[k] = v
+                h.append(ok_op(p, f, (k, v)))
+        elif done < n_ops:
+            f = rng.choice(["read", "write"])
+            k = rng.randrange(width)
+            v = None if f == "read" else rng.randrange(5)
+            h.append(invoke_op(p, f, (k, v)))
+            pending[p] = (f, k, v)
+            done += 1
+    return h
+
+
+def _flip_mr_read(rng, h):
+    idx = [i for i, op in enumerate(h)
+           if op.type == "ok" and op.f == "read"]
+    if not idx:
+        return h
+    h = list(h)
+    i = rng.choice(idx)
+    k, v = h[i].value
+    h[i] = replace(h[i], value=(k, (v or 0) + 7))
+    return h
+
+
+# ---------------------------------------------------------------------------
+# differential fuzz: 200+ histories streamed vs checked post-hoc
+# ---------------------------------------------------------------------------
+
+
+def _fuzz_cases():
+    """(label, model, history) for 215 event-level histories: crashed
+    (:info) ops, never-quiescing overlap, quiescent bursts with
+    mid-stream invalidations, mutex, and multi-register cells."""
+    cases = []
+    for i in range(70):  # cas-register with crashes, 1/3 corrupted
+        rng = random.Random(i)
+        m = cas_register()
+        h = sim_register_history(rng, n_procs=4, n_ops=24, crash_p=0.1,
+                                 cas=(i % 2 == 0))
+        if i % 3 == 0:
+            h = flip_read(rng, h)
+        cases.append(("cas", m, h))
+    for i in range(45):  # quiescent bursts: the online-cut fast path
+        rng = random.Random(2000 + i)
+        m = cas_register()
+        h = register_history(rng, n_ops=36, n_procs=4, overlap=3,
+                             quiesce_every=6, crash_p=0.03,
+                             max_crashes=2, n_values=4, cas=False)
+        if i % 2 == 0:
+            h = flip_read(rng, h)
+        cases.append(("burst", m, h))
+    for i in range(30):  # never-quiescing: everything lands in the tail
+        rng = random.Random(5000 + i)
+        m = cas_register()
+        h = sim_register_history(rng, n_procs=6, n_ops=20, crash_p=0.05)
+        if i % 3 == 0:
+            h = flip_read(rng, h)
+        cases.append(("tail", m, h))
+    for i in range(35):  # mutex with crashed acquires/releases
+        rng = random.Random(3000 + i)
+        m = mutex()
+        h = sim_mutex_history(rng, n_ops=24, n_procs=4, crash_p=0.06)
+        cases.append(("mutex", m, h))
+    for i in range(35):  # multi-register: the locality path
+        rng = random.Random(4000 + i)
+        m = multi_register(3)
+        h = sim_multireg_history(rng)
+        if i % 3 == 0:
+            h = _flip_mr_read(rng, h)
+        cases.append(("multireg", m, h))
+    assert len(cases) >= 200
+    return cases
+
+
+def test_differential_fuzz_streamed_vs_posthoc():
+    """Every streamed final verdict equals the direct engine's, every
+    certificate audits clean, and a mid-stream invalid status is never
+    a false alarm."""
+    from jepsen_tpu.analyze.audit import audit
+
+    divergences = []
+    early = 0
+    methods: set = set()
+    for label, m, h in _fuzz_cases():
+        seq = encode_ops(h, m.f_codes)
+        d = _direct(seq, m)["valid"]
+        r, invalid_at, sc = _stream(h, m)
+        methods.update(r["stream"]["methods"])
+        if r["valid"] != d:
+            divergences.append((label, d, r["valid"], r["stream"]))
+            continue
+        a = audit(sc.seq(), m, r)
+        if not a["ok"]:
+            divergences.append((label, "audit", a["codes"],
+                                [str(x) for x in a["diagnostics"][:2]]))
+        if invalid_at is not None:
+            # an online invalid is FINAL: it must match the verdict
+            assert r["valid"] is False, (label, invalid_at, r)
+            if invalid_at < len(h) - 1:
+                early += 1
+    assert not divergences, divergences[:5]
+    # the fuzz must actually exercise the streaming machinery, and
+    # invalid verdicts must actually surface before streams end
+    assert {"quiescence", "sub-search", "key-partition"} <= methods, \
+        methods
+    assert early >= 10, early
+
+
+def test_streamed_equals_decomposed_engine():
+    """Bit-identical to ``check_opseq_decomposed`` (the acceptance
+    criterion's reference engine) on a stride of the corpus."""
+    from jepsen_tpu.decompose.engine import check_opseq_decomposed
+
+    for label, m, h in _fuzz_cases()[::7]:
+        seq = encode_ops(h, m.f_codes)
+        dec = check_opseq_decomposed(
+            seq, m, direct=lambda s, m=m: _direct(s, m))
+        r, _at, _sc = _stream(h, m)
+        assert r["valid"] == dec["valid"], (label, dec["valid"], r)
+
+
+def test_early_invalid_surfaces_before_stream_end():
+    """A violation at op k << n flips the live verdict to ``invalid``
+    before ingest of op n completes (the acceptance criterion), with
+    the bulk of the stream still to come."""
+    rng = random.Random(42)
+    m = register(0)
+    h = register_history(rng, n_ops=300, n_procs=5, overlap=4,
+                         quiesce_every=8, n_values=5, cas=False)
+    h = corrupt_read(rng, h, at=0.1)
+    seq = encode_ops(h, m.f_codes)
+    assert _direct(seq, m)["valid"] is False
+    r, invalid_at, _sc = _stream(h, m)
+    assert r["valid"] is False
+    assert invalid_at is not None and invalid_at < len(h) - 1
+    # the violation sits ~10% in; the invalid verdict must not wait for
+    # the tail of the stream
+    assert invalid_at < len(h) // 2, (invalid_at, len(h))
+    assert r["stream"]["invalid_event"] == invalid_at
+
+
+def test_never_quiescing_stream_stays_open_then_decides():
+    """High-overlap workloads never cut: the provisional verdict stays
+    ``open`` the whole stream and finalize still decides exactly."""
+    rng = random.Random(7)
+    m = cas_register()
+    # overlap 4 is refilled after every completion, so the pending set
+    # never empties mid-stream: no quiescent point ever exists
+    h = register_history(rng, n_ops=24, n_procs=6, overlap=4,
+                         n_values=4)
+    sc = StreamChecker(m)
+    for op in h:
+        sc.ingest(op)
+        assert sc.verdict()["status"] == "open"
+    r = sc.finalize()
+    assert r["valid"] == _direct(encode_ops(h, m.f_codes), m)["valid"]
+    assert r["stream"]["segments"] == 1
+
+
+def test_provisional_status_progression():
+    rng = random.Random(9)
+    m = cas_register()
+    h = register_history(rng, n_ops=30, n_procs=3, overlap=2,
+                         quiesce_every=5, crash_p=0.0, n_values=3,
+                         cas=False)
+    sc = StreamChecker(m)
+    seen = []
+    for op in h:
+        sc.ingest(op)
+        s = sc.verdict()["status"]
+        if not seen or seen[-1] != s:
+            seen.append(s)
+    assert seen[0] == "open"
+    assert "valid-so-far" in seen
+    r = sc.finalize()
+    assert r["valid"] is True
+
+
+# ---------------------------------------------------------------------------
+# independent [k v] workloads (the atomdemo / jepsen.independent shape)
+# ---------------------------------------------------------------------------
+
+
+def sim_indep_history(rng, n_keys=3, n_procs=4, n_ops=40, crash_p=0.05):
+    """Valid-by-construction independent CAS registers, KV-wrapped as
+    ``independent.concurrent_generator`` emits them."""
+    from jepsen_tpu import independent
+    from jepsen_tpu.history import fail_op
+
+    state = {k: 0 for k in range(n_keys)}
+    h, pending, crashed = [], {}, set()
+    done = 0
+    while done < n_ops or pending:
+        live = [p for p in range(n_procs) if p not in crashed]
+        if not live:
+            break
+        p = rng.choice(live)
+        if p in pending:
+            f, k, v = pending.pop(p)
+            if crash_p and rng.random() < crash_p:
+                if rng.random() < 0.5:
+                    if f == "write":
+                        state[k] = v
+                    elif f == "cas" and state[k] == v[0]:
+                        state[k] = v[1]
+                crashed.add(p)
+                h.append(info_op(p, f, independent.tuple_(
+                    k, v if f != "read" else None)))
+                continue
+            if f == "read":
+                h.append(ok_op(p, f, independent.tuple_(k, state[k])))
+            elif f == "write":
+                state[k] = v
+                h.append(ok_op(p, f, independent.tuple_(k, v)))
+            elif state[k] == v[0]:
+                state[k] = v[1]
+                h.append(ok_op(p, f, independent.tuple_(k, v)))
+            else:
+                h.append(fail_op(p, f, independent.tuple_(k, v)))
+        elif done < n_ops:
+            f = rng.choice(["read", "write", "cas"])
+            k = rng.randrange(n_keys)
+            v = (None if f == "read" else rng.randrange(5)
+                 if f == "write" else (rng.randrange(5),
+                                       rng.randrange(5)))
+            h.append(invoke_op(p, f, independent.tuple_(k, v)))
+            pending[p] = (f, k, v)
+            done += 1
+    return h
+
+
+def _flip_kv_read(rng, h):
+    from jepsen_tpu import independent
+
+    idx = [i for i, op in enumerate(h)
+           if op.type == "ok" and op.f == "read"]
+    if not idx:
+        return h
+    h = list(h)
+    i = rng.choice(idx)
+    kv = h[i].value
+    h[i] = replace(h[i], value=independent.tuple_(kv.key,
+                                                  (kv.value or 0) + 7))
+    return h
+
+
+def test_independent_streams_match_posthoc_per_key():
+    """An independent [k v] history (the atomdemo shape) demuxes into
+    per-key cells under the test model: the streamed overall verdict
+    AND every per-key verdict match independent.checker's post-hoc
+    split, per-key certificates audit clean, and corrupted keys flip
+    the live verdict mid-stream."""
+    from jepsen_tpu import independent
+    from jepsen_tpu.analyze.audit import audit
+
+    m = cas_register(0)
+    early = 0
+    for i in range(40):
+        rng = random.Random(9000 + i)
+        h = sim_indep_history(rng)
+        if i % 3 == 0:
+            h = _flip_kv_read(rng, h)
+        ks = independent.history_keys(h)
+        ref = {k: _direct(encode_ops(independent.subhistory(k, h),
+                                     m.f_codes), m)["valid"]
+               for k in ks}
+        r, invalid_at, sc = _stream(h, m)
+        assert r["valid"] == (False if False in ref.values()
+                              else True), (i, ref, r)
+        assert "independent" in r["stream"]["methods"]
+        if invalid_at is not None and invalid_at < len(h) - 1:
+            early += 1
+        for k in ks:
+            cr = sc.cell_results[k]
+            assert cr["valid"] == ref[k], (i, k, ref)
+            cert = {"valid": cr["valid"]}
+            if cr["linearization"] is not None:
+                cert["linearization"] = cr["linearization"]
+            elif cr["final_ops"] is not None:
+                cert["final_ops"] = cr["final_ops"]
+            else:
+                cert["witness_dropped"] = cert["frontier_dropped"] = \
+                    "per-key drop"
+            a = audit(sc.cell_seq(k), m, cert)
+            assert a["ok"], (i, k, a["codes"])
+        # the global result keeps the certificate contract (per-key
+        # evidence under `independent`, explicit drops at the top)
+        assert audit(sc.seq(), m, r)["ok"]
+        assert set(r["independent"]) == {str(k) for k in ks}
+    assert early >= 5, early
+
+
+def test_independent_stream_in_core_run(monkeypatch, tmp_path):
+    """The flagship atomdemo suite shape end-to-end through core.run
+    with streaming on: streamed verdict agrees with the independent
+    post-hoc checker."""
+    import threading as _t
+
+    from jepsen_tpu import (core, fixtures, generator as gen,
+                            independent)
+    from jepsen_tpu.checker import linearizable as lin
+
+    monkeypatch.setenv("JEPSEN_TPU_STREAM", "1")
+    registers: dict = {}
+    lock = _t.Lock()
+
+    from jepsen_tpu import client as client_mod
+
+    class MapClient(client_mod.Client):
+        def open(self, test, node):
+            return self
+
+        def invoke(self, test, op):
+            k, v = op.value.key, op.value.value
+            with lock:
+                reg = registers.setdefault(k, fixtures.AtomRegister(0))
+            if op.f == "write":
+                reg.write(v)
+                return replace(op, type="ok")
+            return replace(op, type="ok",
+                           value=independent.tuple_(k, reg.read()))
+
+    test = fixtures.noop_test() | {
+        "name": None,
+        "client": MapClient(),
+        "model": cas_register(0),
+        "checker": independent.checker(lin.linearizable()),
+        "concurrency": 4,
+        "generator": gen.clients(independent.concurrent_generator(
+            2, range(4),
+            lambda k: gen.limit(10, gen.mix([
+                {"type": "invoke", "f": "read", "value": None},
+                lambda t, p: {"type": "invoke", "f": "write",
+                              "value": random.randrange(5)},
+            ])))),
+    }
+    test = core.run(test)
+    assert test["results"]["valid"] is True
+    assert test["results"]["stream"]["valid"] is True
+    st = test["results"]["stream"]["stream"]
+    assert "independent" in st["methods"]
+    assert st["cells"] == 4
+
+
+# ---------------------------------------------------------------------------
+# online cuts vs post-hoc cuts
+# ---------------------------------------------------------------------------
+
+
+def test_online_cuts_match_posthoc_on_failfree_histories():
+    """Without :fail ops an online cut exists exactly where the offline
+    cutter puts one, so streamed segment counts equal the plan's
+    prediction (with fails, online cuts are a sound coarsening)."""
+    from jepsen_tpu.analyze.plan import stream_plan
+
+    m = register(0)
+    for i in range(10):
+        rng = random.Random(600 + i)
+        h = register_history(rng, n_ops=40, n_procs=4, overlap=3,
+                             quiesce_every=7, crash_p=0.0, n_values=4,
+                             cas=False)  # cas=False: no :fail ops
+        seq = encode_ops(h, m.f_codes)
+        plan = stream_plan(seq, m)
+        r, _at, _sc = _stream(h, m)
+        assert r["stream"]["segments"] == plan["segments"], (i, plan)
+        assert plan["applies"] is True
+
+
+# ---------------------------------------------------------------------------
+# cache reuse across streams (satellite: counters measured, not inferred)
+# ---------------------------------------------------------------------------
+
+
+def test_cache_reuse_across_streamed_runs(tmp_path):
+    from jepsen_tpu.decompose.cache import VerdictCache
+
+    m = cas_register()
+    rng = random.Random(77)
+    h = register_history(rng, n_ops=44, n_procs=3, overlap=1,
+                         crash_p=0.0, n_values=3)
+    path = str(tmp_path / "v.jsonl")
+    r1, _a, _s = _stream(h, m, cache=VerdictCache(path))
+    assert r1["stream"]["cache_inserts"] > 0
+    # same canonical shapes (processes renamed), cold cache object:
+    # zero search work, every segment a hit
+    h2 = [replace(op, process=op.process + 10) for op in h]
+    r2, _a, _s = _stream(h2, m, cache=VerdictCache(path))
+    assert r2["valid"] == r1["valid"]
+    assert r2["configs"] == 0
+    assert r2["stream"]["cache_hits"] >= r1["stream"]["cache_inserts"] - 2
+
+
+def test_shared_cache_counters_are_per_run():
+    """Concurrent streams share one VerdictCache (the service / fleet
+    mode): constructing or running a second checker must neither zero
+    nor inflate the first one's per-run counters."""
+    from jepsen_tpu.decompose.cache import VerdictCache
+
+    m = cas_register()
+    rng = random.Random(31)
+    h = register_history(rng, n_ops=30, n_procs=3, overlap=1,
+                         crash_p=0.0, n_values=3)
+    cache = VerdictCache()
+    sc1 = StreamChecker(m, cache=cache)
+    for op in h[:len(h) // 2]:
+        sc1.ingest(op)
+    # a second run opens mid-stream on the SAME cache object
+    sc2 = StreamChecker(m, cache=cache)
+    for op in h[len(h) // 2:]:
+        sc1.ingest(op)
+    r1 = sc1.finalize()
+    # run 2's CONSTRUCTION happened mid-stream: run 1 still reports
+    # its own FULL profile — exactly one cache lookup per segment
+    # (folds + non-empty finals), nothing reset, nothing leaked in
+    # (intra-run hits on repeated tiny segments are run 1's own)
+    assert r1["stream"]["cache_hits"] + r1["stream"]["cache_misses"] \
+        == r1["stream"]["segments"]
+    assert r1["stream"]["cache_inserts"] > 0
+    # run 2 streams the same content warm: every lookup hits, zero
+    # search work — and its counters are its own, not the union
+    for op in h:
+        sc2.ingest(op)
+    r2 = sc2.finalize()
+    assert r2["valid"] == r1["valid"]
+    assert r2["configs"] == 0
+    assert r2["stream"]["cache_misses"] == 0
+    assert r2["stream"]["cache_hits"] == r2["stream"]["segments"]
+
+
+def test_engine_results_carry_cache_insert_counters(tmp_path):
+    """The decomposed engine's results now expose hit/miss/insert
+    counters per run (satellite: reuse measured, not inferred)."""
+    from jepsen_tpu.decompose.cache import VerdictCache
+    from jepsen_tpu.decompose.engine import check_opseq_decomposed
+
+    m = cas_register()
+    rng = random.Random(5)
+    h = sim_register_history(rng, n_procs=3, n_ops=20)
+    seq = encode_ops(h, m.f_codes)
+    cache = VerdictCache(str(tmp_path / "v.jsonl"))
+    r = check_opseq_decomposed(seq, m, cache=cache,
+                               direct=lambda s: _direct(s, m))
+    assert r["decompose"]["cache_inserts"] == cache.inserts > 0
+    assert "cache_hits" in r["decompose"]
+
+
+def test_segment_and_final_cache_keys_do_not_collide(tmp_path):
+    """A mid-stream fold's state-set entry and a final segment's
+    verdict entry for the SAME canonical content must not overwrite
+    each other (the _skey kind namespace)."""
+    from jepsen_tpu.decompose.engine import _skey
+
+    assert _skey(b"x") != _skey(b"x", b"fin")
+
+
+# ---------------------------------------------------------------------------
+# device fold
+# ---------------------------------------------------------------------------
+
+
+def test_device_fold_states_matches_host_fold():
+    from jepsen_tpu.decompose.engine import segment_states
+    from jepsen_tpu.decompose.partition import (quiescence_segments,
+                                                subseq)
+    from jepsen_tpu.stream.device import device_fold_states
+
+    m = register(0)
+    rng = random.Random(5)
+    h = register_history(rng, n_ops=48, n_procs=6, overlap=5,
+                         quiesce_every=8, unique_writes=True, cas=False)
+    seq = encode_ops(h, m.f_codes)
+    segs = quiescence_segments(seq)
+    assert len(segs) >= 3
+    states = {tuple(m.init)}
+    checked = 0
+    for rows in segs[:-1]:
+        ss = subseq(seq, rows)
+        host = segment_states(ss, m, states)
+        dev = device_fold_states(ss, m, states)
+        if dev is not None:
+            assert dev[0] == host
+            checked += 1
+        states = host
+    assert checked >= 2
+
+
+def test_forced_device_routing_is_verdict_identical():
+    m = register(0)
+    rng = random.Random(6)
+    h = register_history(rng, n_ops=40, n_procs=5, overlap=4,
+                         quiesce_every=8, n_values=6, cas=False)
+    seq = encode_ops(h, m.f_codes)
+    d = _direct(seq, m)["valid"]
+    # host_fold_max=0 routes every eligible fold to the device batch
+    r, _at, _sc = _stream(h, m, host_fold_max=0)
+    assert r["valid"] == d
+    assert r["stream"]["routes"]["device"] >= 1
+    assert "device" in r["stream"]["methods"]
+    # device-folded segments drop chains, never fabricate them
+    if r["valid"] is True:
+        assert "linearization" in r or "witness_dropped" in r
+
+
+def test_async_folds_reach_the_same_verdict():
+    m = cas_register()
+    for i in range(6):
+        rng = random.Random(800 + i)
+        h = register_history(rng, n_ops=36, n_procs=4, overlap=2,
+                             quiesce_every=6, crash_p=0.05,
+                             max_crashes=2, n_values=4)
+        if i % 2 == 0:
+            h = flip_read(rng, h)
+        seq = encode_ops(h, m.f_codes)
+        sc = StreamChecker(m, async_folds=True)
+        for op in h:
+            sc.ingest(op)
+        r = sc.finalize()
+        assert r["valid"] == _direct(seq, m)["valid"], i
+
+
+# ---------------------------------------------------------------------------
+# the plan gate (satellite: predictor and engine share one rule)
+# ---------------------------------------------------------------------------
+
+
+def test_stream_plan_in_explain_and_route_rule():
+    from jepsen_tpu.analyze.plan import (STREAM_HOST_FOLD_MAX, explain,
+                                         segment_fold_route)
+
+    m = register(0)
+    rng = random.Random(3)
+    h = register_history(rng, n_ops=40, n_procs=4, overlap=3,
+                         quiesce_every=6, cas=False)
+    plan = explain(h, m)
+    st = plan["streaming"]
+    assert st["applies"] is True and st["closed_segments"] >= 2
+    assert st["ttfv_rows"] is not None
+    assert st["device_eligible"] is True
+    # the routing rule: device only for register families past the cap
+    assert segment_fold_route(8, 4, m) == "host"
+    assert segment_fold_route(8, 4, m, host_fold_max=0) == "device"
+    assert segment_fold_route(10**6, 30, mutex()) == "host"
+    assert segment_fold_route(10**6, 30, m) == "device"
+    assert STREAM_HOST_FOLD_MAX > 0
+
+
+def test_stream_plan_never_quiescing():
+    from jepsen_tpu.analyze.plan import stream_plan
+
+    m = cas_register()
+    rng = random.Random(8)
+    h = register_history(rng, n_ops=24, n_procs=6, overlap=4,
+                         n_values=4)
+    st = stream_plan(encode_ops(h, m.f_codes), m)
+    assert st["closed_segments"] == 0 and st["applies"] is False
+
+
+# ---------------------------------------------------------------------------
+# runner wiring + the abort-path fix
+# ---------------------------------------------------------------------------
+
+
+def _cas_test(state, store_base=None, **over):
+    from jepsen_tpu import fixtures, generator as gen
+    from jepsen_tpu.checker import linearizable as lin
+
+    return __import__("jepsen_tpu.fixtures", fromlist=["noop_test"]) \
+        .noop_test() | {
+        "name": None,
+        "db": fixtures.atom_db(state),
+        "client": fixtures.atom_client(state),
+        "model": cas_register(0),
+        "checker": lin.linearizable(),
+        "generator": gen.clients(gen.limit(
+            30, {"type": "invoke", "f": "read", "value": None})),
+        "concurrency": 3,
+    } | over
+
+
+def test_core_run_streams_and_threads_results(monkeypatch):
+    from jepsen_tpu import core, fixtures
+
+    monkeypatch.setenv("JEPSEN_TPU_STREAM", "1")
+    state = fixtures.AtomRegister()
+    test = core.run(_cas_test(state))
+    assert test["results"]["valid"] is True
+    s = test["results"]["stream"]
+    assert s["valid"] is True
+    assert s["stream"]["events"] == len(test["history"])
+    assert test["stream_results"]["valid"] is True
+
+
+def test_core_run_abort_still_yields_prefix_verdict(tmp_path,
+                                                    monkeypatch):
+    """Satellite fix: a crashed run must flush + finalize the op sink —
+    the prefix it recorded still gets a verdict, persisted to the
+    store and attached to the raised error."""
+    from jepsen_tpu import core, fixtures, generator as gen
+
+    monkeypatch.setenv("JEPSEN_TPU_STREAM", "1")
+
+    class ExplodingGen(gen.Generator):
+        def __init__(self, n):
+            self.n = n
+            self.lock = threading.Lock()
+
+        def op(self, test, process):
+            with self.lock:
+                self.n -= 1
+                if self.n < 0:
+                    raise RuntimeError("generator exploded!")
+            return {"type": "invoke", "f": "read", "value": None}
+
+    state = fixtures.AtomRegister()
+    test = _cas_test(state, name="abort-stream",
+                     store_base=str(tmp_path / "store"),
+                     generator=gen.clients(ExplodingGen(9)))
+    test["name"] = "abort-stream"
+    test["store_base"] = str(tmp_path / "store")
+    with pytest.raises(RuntimeError, match="generator exploded") as ei:
+        core.run(test)
+    sr = ei.value.stream_results
+    assert sr["aborted"] is True
+    assert sr["valid"] in (True, False)
+    assert sr["stream"]["stream"]["events"] > 0
+    # and it reached the store, happy path or not
+    import glob
+
+    paths = glob.glob(str(tmp_path / "store" / "abort-stream" / "*"
+                          / "results.json"))
+    assert paths, "aborted run wrote no results.json"
+    on_disk = json.load(open(paths[0]))
+    assert on_disk["aborted"] is True
+    assert on_disk["valid"] == sr["valid"]
+
+
+def test_cli_stream_flag_sets_env(monkeypatch):
+    import argparse
+
+    from jepsen_tpu import cli
+
+    monkeypatch.setenv("JEPSEN_TPU_STREAM", "placeholder")
+    monkeypatch.delenv("JEPSEN_TPU_STREAM")
+    p = argparse.ArgumentParser()
+    cli.add_test_opts(p)
+    opts = cli.test_opt_fn(p.parse_args(["--stream", "--dummy"]))
+    assert opts["stream"] is True
+    assert os.environ.get("JEPSEN_TPU_STREAM") == "1"
+
+
+# ---------------------------------------------------------------------------
+# web: /api/live + panels
+# ---------------------------------------------------------------------------
+
+
+def test_web_live_endpoint_and_panels(tmp_path):
+    from jepsen_tpu import store, web
+
+    base = str(tmp_path / "store")
+    test = {"name": "livedemo", "start_time": "20260803T120000",
+            "store_base": base}
+    store.save_1(test, [])
+    store.save_2(test, {
+        "valid": True,
+        "stream": {"valid": True, "engine": "stream(quiescence)",
+                   "stream": {"segments": 3, "events": 40,
+                              "first_verdict_event": 4,
+                              "cache_hits": 2, "cache_misses": 1,
+                              "cache_inserts": 3}}})
+    d = os.path.join(base, "livedemo", "20260803T120000")
+    with open(os.path.join(d, "live.json"), "w") as f:
+        json.dump({"status": "valid-so-far", "events": 40, "rows": 20,
+                   "segments_closed": 3, "checked_rows": 12}, f)
+
+    srv = web.make_server(host="127.0.0.1", port=0, base=base)
+    port = srv.server_address[1]
+    t = threading.Thread(target=srv.serve_forever, daemon=True)
+    t.start()
+    try:
+        api = json.loads(urllib.request.urlopen(
+            f"http://127.0.0.1:{port}/api/live/livedemo/20260803T120000"
+        ).read())
+        assert api["status"] == "valid-so-far"
+        page = urllib.request.urlopen(
+            f"http://127.0.0.1:{port}/files/livedemo/20260803T120000/"
+        ).read().decode()
+        assert "Live verdict" in page  # the polling panel
+        assert "streamed" in page  # the result-panel stream row
+        assert "verdict cache" in page  # hit/miss/insert counters
+        with pytest.raises(urllib.error.HTTPError):
+            urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/api/live/nosuch/run")
+    finally:
+        srv.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# service mode (tier-1-gated smoke)
+# ---------------------------------------------------------------------------
+
+
+def test_service_mode_smoke():
+    """``python -m jepsen_tpu.stream``: two interleaved runs over
+    stdin, one valid and one invalid, final verdicts + audit clean."""
+    rng = random.Random(1)
+    h_ok = sim_register_history(rng, n_procs=3, n_ops=14)
+    h_bad = flip_read(rng, sim_register_history(rng, n_procs=3,
+                                                n_ops=14))
+    lines = [json.dumps({"run": "a", "model": "cas-register"}),
+             json.dumps({"run": "b", "model": "cas-register"})]
+    for i in range(max(len(h_ok), len(h_bad))):
+        if i < len(h_ok):
+            lines.append(json.dumps({"run": "a",
+                                     "op": h_ok[i].to_dict()}))
+        if i < len(h_bad):
+            lines.append(json.dumps({"run": "b",
+                                     "op": h_bad[i].to_dict()}))
+    lines += [json.dumps({"run": "a", "end": True}),
+              json.dumps({"run": "b", "end": True})]
+    out = subprocess.run(
+        [sys.executable, "-m", "jepsen_tpu.stream", "--audit"],
+        input="\n".join(lines) + "\n", capture_output=True, text=True,
+        env={**os.environ, "JAX_PLATFORMS": "cpu"}, timeout=300)
+    assert out.returncode == 0, out.stderr[-2000:]
+    finals = {}
+    for ln in out.stdout.splitlines():
+        d = json.loads(ln)
+        assert "error" not in d, d
+        if "final" in d:
+            finals[d["run"]] = d["final"]
+    assert finals["a"]["valid"] is True
+    assert finals["b"]["valid"] is False
+    for f in finals.values():
+        assert f["audit"]["ok"] is True
+
+
+def test_service_in_process_multiplexing_and_eof_finalize():
+    """EOF finalizes every open run — the in-process twin of the
+    subprocess smoke, exercising bare-op shorthand + default model."""
+    from jepsen_tpu.models import cas_register as _cr
+    from jepsen_tpu.stream.service import StreamService, serve_stdio
+
+    rng = random.Random(2)
+    h = sim_register_history(rng, n_procs=3, n_ops=12)
+    lines = [json.dumps(op.to_dict()) for op in h]  # bare-op shorthand
+
+    import io
+
+    out = io.StringIO()
+    serve_stdio(StreamService(model=_cr()), iter(ln + "\n"
+                                                 for ln in lines), out)
+    msgs = [json.loads(x) for x in out.getvalue().splitlines()]
+    finals = [m for m in msgs if "final" in m]
+    assert len(finals) == 1 and finals[0]["run"] == "default"
+    assert finals[0]["final"]["valid"] in (True, False)

@@ -11,15 +11,45 @@ the default file lives at ``store/verdict_cache/verdicts.jsonl`` under
 :data:`jepsen_tpu.store.BASE`, one JSON object per line, append-only.
 Appends are small single-``write`` lines, so concurrent writers (the
 multiprocess pool) interleave whole lines; a torn final line (crash
-mid-write) is skipped on load.  Rewrites never happen — the newest
-entry for a key wins, and duplicate entries are only ever equal (the
-engines are deterministic on a canonical shape).
+mid-write) is skipped on load.  The newest entry for a key wins, and
+duplicate entries are only ever equal (the engines are deterministic on
+a canonical shape).
+
+Long campaigns append the same hot keys over and over (every run
+re-inserts the verdicts it used), so the jsonl grows without bound
+while the live entry set stays flat.  A **size-triggered compaction**
+(:meth:`VerdictCache.compact`, auto-armed past
+``compact_bytes`` / ``JEPSEN_TPU_CACHE_COMPACT_BYTES``) re-reads the
+file (merging entries other processes appended since load), rewrites
+exactly the live set to a temp file, and atomically replaces the jsonl.
+Entries another writer appends *around* a compaction can be lost from
+disk (its handle may briefly point at the replaced inode — every
+writer re-checks its inode each check window and re-points itself) —
+that costs a future cache miss, never a wrong verdict, because
+duplicate keys only ever carry equal values.
 """
 
 from __future__ import annotations
 
 import json
 import os
+
+#: default auto-compaction threshold (bytes); 0/unset-able via env
+_DEFAULT_COMPACT_BYTES = 64 << 20
+
+#: check the file size only every N appends — a stat per write would
+#: put syscall pressure on the hot insert path for nothing
+_COMPACT_CHECK_EVERY = 256
+
+
+def _compact_bytes_env() -> int:
+    raw = os.environ.get("JEPSEN_TPU_CACHE_COMPACT_BYTES", "").strip()
+    if not raw:
+        return _DEFAULT_COMPACT_BYTES
+    try:
+        return int(raw)
+    except ValueError:
+        return _DEFAULT_COMPACT_BYTES
 
 
 def default_cache_path(base: str | None = None) -> str:
@@ -40,12 +70,19 @@ class VerdictCache:
     into results (and the web result panel renders), so segment-level
     reuse across streamed fleets is measured, not inferred."""
 
-    def __init__(self, path: str | None = None):
+    def __init__(self, path: str | None = None,
+                 compact_bytes: int | None = None):
         self.path = path
         self._d: dict[str, dict] = {}
         self.hits = 0
         self.misses = 0
         self.inserts = 0
+        #: 0 disables auto-compaction; explicit compact() still works
+        self.compact_bytes = _compact_bytes_env() \
+            if compact_bytes is None else compact_bytes
+        self.compactions = 0
+        self.compacted_away = 0  # superseded lines dropped, lifetime
+        self._appends = 0  # since the last size check
         self._fh = None
         if path is not None:
             self._load(path)
@@ -84,11 +121,94 @@ class VerdictCache:
     def _append(self, e: dict) -> None:
         if self.path is None:
             return
+        self._appends += 1
+        if self._fh is not None and self._appends >= _COMPACT_CHECK_EVERY:
+            # another process may have compacted (os.replace) since we
+            # opened: a handle on the dead inode would silently write
+            # every future insert into the void.  Re-point it — losses
+            # are then bounded to one check window, not a lifetime.
+            try:
+                if os.fstat(self._fh.fileno()).st_ino \
+                        != os.stat(self.path).st_ino:
+                    self._fh.close()
+                    self._fh = None
+            except OSError:
+                self._fh.close()
+                self._fh = None
         if self._fh is None:
             os.makedirs(os.path.dirname(self.path) or ".", exist_ok=True)
             self._fh = open(self.path, "a")
         self._fh.write(json.dumps(e, separators=(",", ":")) + "\n")
         self._fh.flush()
+        if self.compact_bytes and self._appends >= _COMPACT_CHECK_EVERY:
+            self._appends = 0
+            try:
+                if self._fh.tell() > self.compact_bytes:
+                    self.compact()
+            except OSError:
+                pass
+
+    def compact(self) -> int:
+        """Rewrite the jsonl to exactly the live entry set, dropping
+        superseded duplicate lines; returns how many lines were dropped.
+
+        Entries appended by *other* processes since our load are merged
+        in first (a fresh read of the file), so compaction never
+        forgets another writer's verdict it could see.  The replace is
+        atomic (write temp + ``os.replace``), so a concurrent loader
+        always sees either the old or the new complete file."""
+        if self.path is None:
+            return 0
+        # merge in other writers' lines (newest-on-disk wins only for
+        # keys we don't hold — ours are equal by determinism anyway)
+        lines = 0
+        try:
+            with open(self.path) as f:
+                for line in f:
+                    line = line.strip()
+                    if not line:
+                        continue
+                    lines += 1
+                    try:
+                        e = json.loads(line)
+                        self._d.setdefault(e["k"], e)
+                    except (ValueError, KeyError):
+                        continue  # torn tail line
+        except OSError:
+            pass
+        tmp = f"{self.path}.compact.{os.getpid()}"
+        try:
+            with open(tmp, "w") as f:
+                for e in self._d.values():
+                    f.write(json.dumps(e, separators=(",", ":")) + "\n")
+                f.flush()
+                os.fsync(f.fileno())
+            os.replace(tmp, self.path)
+        except OSError:
+            try:
+                os.unlink(tmp)
+            except OSError:
+                pass
+            return 0
+        # our append handle points at the replaced inode; reopen so new
+        # inserts land in the compacted file
+        if self._fh is not None:
+            self._fh.close()
+            self._fh = None
+        dropped = max(0, lines - len(self._d))
+        self.compactions += 1
+        self.compacted_away += dropped
+        if self.compact_bytes:
+            try:
+                size = os.path.getsize(self.path)
+            except OSError:
+                size = 0
+            if size > self.compact_bytes // 2:
+                # the LIVE set itself is near/past the trigger: raise
+                # the bar, or every 256th append would re-run a full
+                # rewrite that drops ~nothing, forever
+                self.compact_bytes = max(self.compact_bytes, size) * 2
+        return dropped
 
     def put_verdict(self, key: str, valid) -> None:
         if valid not in (True, False):

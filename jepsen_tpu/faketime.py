@@ -34,3 +34,24 @@ def wrap(sess: control.Session, cmd: str, init_offset: int,
         sess.exec("mv", cmd, moved)
         sess.exec("echo", wrapper, lit(">"), cmd)
         sess.exec("chmod", "a+x", cmd)
+
+
+def unwrap(sess: control.Session, cmd: str) -> bool:
+    """Undo :func:`wrap`: restore the original binary over the wrapper
+    script.  Idempotent — unwrapping a never-wrapped (or already
+    unwrapped) cmd is a no-op.  Returns whether a wrapper was removed."""
+    from . import control_util as cu
+
+    moved = f"{cmd}.no-faketime"
+    if not cu.exists(sess, moved):
+        return False
+    sess.exec("mv", "-f", moved, cmd)
+    return True
+
+
+def wrapped(sess: control.Session, cmd: str) -> bool:
+    """Is cmd currently a faketime wrapper? (the .no-faketime original
+    exists exactly while wrapped)"""
+    from . import control_util as cu
+
+    return cu.exists(sess, f"{cmd}.no-faketime")

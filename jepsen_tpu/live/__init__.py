@@ -1,0 +1,49 @@
+"""Live fault-injection campaigns — real processes, real faults.
+
+The paper's core loop is "drive real clients against a real database
+while a nemesis injects faults".  This package is that loop as a
+reusable harness:
+
+  backend.py   :class:`LiveBackend` — spawn a real OS process per
+               logical node (launcher script + start-stop-daemon),
+               health-check with bounded-backoff retries, speak the
+               family's wire protocol by *reusing the suite library's
+               clients*, crash-recover via durable oplogs.  Families:
+               register (localnode), lock (hazelcast tryLock shape),
+               kv (etcd-v2 HTTP), queue (disque RESP).
+  matrix.py    the nemesis matrix — kill -9 + restart, SIGSTOP pause,
+               faketime clock skew, loopback port partitions, faultfs
+               disk faults — each with an availability probe that
+               yields a *skip reason* instead of a crash.
+  campaign.py  the suite×nemesis campaign runner: every executed cell
+               is a full ``core.run`` with the streaming checker and
+               certificate audit on, recording verdicts, detection
+               latency, and recovery time into ``store/campaigns/``.
+
+Front doors: ``python -m jepsen_tpu.live`` and ``tools/campaign.py``.
+
+Exports resolve lazily: the node server processes
+(``python -m jepsen_tpu.live.kv_server`` / ``queue_server``) import
+this package on startup, and an eager import here would drag the whole
+checker stack (and JAX) into every spawned daemon.
+"""
+
+_EXPORTS = {
+    "FAMILIES": "backend", "LiveBackend": "backend",
+    "ProcessDB": "backend",
+    "plan": "campaign", "render_plan": "campaign",
+    "run_campaign": "campaign", "run_cell": "campaign",
+    "MatrixNemesis": "matrix", "standard_matrix": "matrix",
+}
+
+__all__ = sorted(_EXPORTS)
+
+
+def __getattr__(name: str):
+    mod = _EXPORTS.get(name)
+    if mod is None:
+        raise AttributeError(f"module {__name__!r} has no attribute "
+                             f"{name!r}")
+    import importlib
+
+    return getattr(importlib.import_module(f".{mod}", __name__), name)

@@ -1,0 +1,109 @@
+"""``python -m jepsen_tpu.live`` — run (or plan) a nemesis campaign.
+
+  python -m jepsen_tpu.live --dry-run
+  python -m jepsen_tpu.live --families register,lock --nemeses \\
+      kill-restart,pause --time-limit 8
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import logging
+import sys
+
+
+def _split(v: str | None) -> list[str] | None:
+    return [x.strip() for x in v.split(",") if x.strip()] if v else None
+
+
+def main(argv=None) -> int:
+    from .backend import FAMILIES
+    from .campaign import plan, render_plan, run_campaign
+    from .matrix import standard_matrix
+
+    p = argparse.ArgumentParser(
+        prog="python -m jepsen_tpu.live",
+        description="Live fault-injection campaign: backend families "
+                    "× nemesis matrix, each cell a real-process run "
+                    "with streaming checking and certificate audit.")
+    p.add_argument("--families", default=None,
+                   help="Comma list (default: all of "
+                        f"{','.join(FAMILIES)}).")
+    p.add_argument("--nemeses", default=None,
+                   help="Comma list (default: all of "
+                        f"{','.join(standard_matrix())}).")
+    p.add_argument("--time-limit", type=int, default=8,
+                   help="Seconds of workload per cell.")
+    p.add_argument("--rate", type=float, default=None,
+                   help="Client op rate per cell.")
+    p.add_argument("--no-seeded", action="store_true",
+                   help="Skip the seeded-bug cells (volatile lock "
+                        "under kill -9).")
+    p.add_argument("--no-stream", action="store_true",
+                   help="Post-hoc checking only (no live verdicts, no "
+                        "detection latency).")
+    p.add_argument("--no-audit", action="store_true",
+                   help="Skip the certificate audit pass.")
+    p.add_argument("--store-base", default=None,
+                   help="Store root (default: store/).")
+    p.add_argument("--dry-run", action="store_true",
+                   help="Print the matrix with per-cell skip reasons; "
+                        "spawn nothing.")
+    p.add_argument("--json", action="store_true",
+                   help="Emit the plan/record as JSON.")
+    args = p.parse_args(argv)
+    logging.basicConfig(level=logging.WARNING)
+
+    opts: dict = {"time_limit": args.time_limit}
+    if args.rate is not None:
+        opts["rate"] = args.rate
+    if args.store_base:
+        opts["store_base"] = args.store_base
+    if args.no_stream:
+        opts["stream"] = False
+    if args.no_audit:
+        opts["audit"] = False
+
+    families = _split(args.families)
+    nemeses = _split(args.nemeses)
+    if args.dry_run:
+        cells = plan(families, nemeses, opts,
+                     seeded=not args.no_seeded)
+        if args.json:
+            print(json.dumps(cells, indent=1))
+        else:
+            print(render_plan(cells))
+        return 0
+
+    def progress(outcome: dict) -> None:
+        tag = f"{outcome['family']} × {outcome['nemesis']}" \
+            + (" [seeded]" if outcome.get("seeded") else "")
+        if outcome["status"] == "ok":
+            extra = ""
+            det = outcome.get("detection")
+            if det and "latency_s" in det:
+                extra = f", detected in {det['latency_s']}s"
+            print(f"  {tag}: valid={outcome.get('valid')} "
+                  f"({outcome.get('ops')} ops{extra})", flush=True)
+        else:
+            print(f"  {tag}: {outcome['status']} — "
+                  f"{outcome.get('reason')}", flush=True)
+
+    record = run_campaign(opts, families, nemeses,
+                          seeded=not args.no_seeded,
+                          progress=progress)
+    if args.json:
+        print(json.dumps(record, indent=1, default=str))
+    else:
+        s = record["summary"]
+        print(f"campaign {record['id']}: "
+              f"{s.get('ok', 0)} ok / {s.get('skipped', 0)} skipped / "
+              f"{s.get('failed', 0)} failed; "
+              f"{s.get('detected', 0)} violations detected, "
+              f"{s.get('audited_ok', 0)} cells audited ok")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,678 @@
+"""LiveBackend — real-process backends the nemesis matrix runs against.
+
+The tentpole generalization of the pgwire/localnode in-process-server
+pattern (ROADMAP "Scenario diversity"): a :class:`LiveBackend` describes
+one protocol family as
+
+  * a REAL OS process per logical node (`spawn` via the control plane's
+    start-stop-daemon, through a **launcher script** so clock nemeses
+    can faketime-wrap the node without touching the harness),
+  * a health check with bounded exponential-backoff retries
+    (:class:`reconnect.Backoff` — never a fixed-interval spin),
+  * the family's wire protocol, reusing the *existing suite clients*
+    (etcd's V2Client, disque's RESP client, localnode's register/lock
+    clients) so the suite library's wire code executes instead of
+    rotting as dead code,
+  * and a crash-recover contract: kill -9 must lose at most un-acked
+    ops (recorded :info), restart must replay durable state.
+
+:class:`ProcessDB` implements the db lifecycle once for every family;
+the generic nemeses at the bottom (kill/restart, SIGSTOP pause,
+faketime clock skew, loopback port partitions) act through the same
+pidfile/port surface, so a new family gets the whole matrix for free.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import random
+import socket
+import sys
+from dataclasses import replace
+
+from .. import checker as checker_mod, control, control_util as cu
+from .. import db as db_mod, fixtures, generator as gen, independent
+from .. import nemesis as nemesis_mod
+from ..checker import basic, linearizable as lin, timeline
+from ..models import cas_register, mutex
+from ..reconnect import Backoff
+from ..suites import disque as disque_suite, etcd as etcd_suite
+from ..suites import localnode as localnode_suite
+
+log = logging.getLogger("jepsen")
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+
+def node_port(test: dict, node, base_port: int) -> int:
+    return int(test.get("base_port", base_port)) + \
+        test["nodes"].index(node)
+
+
+def node_dir(test: dict, node) -> str:
+    return os.path.join(
+        test.get("data_root", "/tmp/jepsen-live"), str(node))
+
+
+def launcher_path(test: dict, node) -> str:
+    """The node's launcher script — the faketime wrap target."""
+    return os.path.join(node_dir(test, node), "server.sh")
+
+
+def pidfile_path(test: dict, node) -> str:
+    return os.path.join(node_dir(test, node), "server.pid")
+
+
+class LiveBackend:
+    """One protocol family's live contract.  Subclasses fill in the
+    server argv + the workload; the process lifecycle, health check,
+    and nemesis surface are shared."""
+
+    #: family name (campaign cell key)
+    name = "?"
+    #: default first port; node i listens on base_port + i
+    base_port = 18000
+    #: default node names (len = cluster size)
+    nodes = ["n1"]
+
+    def available(self, opts: dict) -> str | None:
+        """A skip reason when this family can't run here, else None."""
+        return None
+
+    def server_argv(self, test: dict, node) -> list[str]:
+        """The real command line of one node's server process."""
+        raise NotImplementedError
+
+    def workload(self, opts: dict) -> dict:
+        """{client, generator, checker, model?, final_generator?}."""
+        raise NotImplementedError
+
+    # ------------------------------------------------------------------
+
+    def db(self) -> "ProcessDB":
+        return ProcessDB(self)
+
+    def port(self, test: dict, node) -> int:
+        return node_port(test, node, self.base_port)
+
+    def health_check(self, test: dict, node) -> None:
+        """One readiness probe; raise when the node is not up yet."""
+        with socket.create_connection(
+                ("127.0.0.1", self.port(test, node)), timeout=1.0):
+            pass
+
+    def op_node(self, test: dict, op):
+        """The node a client op targets — recovery attribution: after
+        a kill, only an acked op against a KILLED node proves that
+        node recovered.  Single-node families route everything to
+        nodes[0]; key-sharded families override."""
+        return test["nodes"][0]
+
+    def build_test(self, opts: dict) -> dict:
+        """The family's test map, nemesis left to the matrix."""
+        w = self.workload(opts)
+        nodes = opts.get("nodes") or list(self.nodes)
+        test = fixtures.noop_test() | dict(opts) | {
+            "name": opts.get("name", f"live-{self.name}"),
+            "nodes": nodes,
+            "base_port": opts.get("base_port", self.base_port),
+            "remote": control.LocalRemote(),
+            "db": self.db(),
+            "client": w["client"],
+            "checker": w["checker"],
+            "concurrency": opts.get("concurrency",
+                                    w.get("concurrency", 4)),
+            "__live_backend__": self,
+        }
+        if w.get("model") is not None:
+            test["model"] = w["model"]
+        test["__workload__"] = w
+        return test
+
+
+class ProcessDB(db_mod.DB, db_mod.LogFiles):
+    """One real server process per logical node, any family.
+
+    The server starts through a launcher script (``server.sh``) so a
+    clock nemesis can faketime-wrap the *script* and every restart —
+    nemesis or recovery — inherits the skew until unwrapped."""
+
+    def __init__(self, backend: LiveBackend,
+                 health_backoff: Backoff | None = None):
+        self.backend = backend
+        # ~45s worst-case budget (3.5s exponential ramp + 21 capped 2s
+        # retries), matching localnode's generous poll: a contended
+        # single-core host forks daemons slowly
+        self.health_backoff = health_backoff or Backoff(
+            base=0.05, cap=2.0, factor=1.6, max_attempts=30, jitter=0.3)
+
+    def _write_launcher(self, sess: control.Session, test, node) -> None:
+        script = launcher_path(test, node)
+        if cu.exists(sess, f"{script}.no-faketime"):
+            # the script is currently a faketime wrapper; the original
+            # lives at .no-faketime — rewriting would silently unwrap
+            return
+        argv = " ".join(control.escape(a)
+                        for a in self.backend.server_argv(test, node))
+        body = f"#!/bin/sh\nexec {argv} \"$@\"\n"
+        sess.exec("mkdir", "-p", node_dir(test, node))
+        sess.exec("printf", "%s", body, control.lit(">"), script)
+        sess.exec("chmod", "a+x", script)
+
+    def setup(self, test, node):
+        sess = control.session(node, test)
+        d = node_dir(test, node)
+        sess.exec("mkdir", "-p", d)
+        self._write_launcher(sess, test, node)
+        log.info("%s starting live %s server on :%d", node,
+                 self.backend.name, self.backend.port(test, node))
+        cu.start_daemon(
+            sess, launcher_path(test, node),
+            logfile=os.path.join(d, "server.log"),
+            pidfile=pidfile_path(test, node),
+            chdir=REPO_ROOT,          # `-m` resolves against the repo
+            match_executable=False,   # many nodes share one launcher sh
+            match_process_name=False)
+        # bounded-backoff health check: capped exponential + jitter
+        # with a max-attempts budget, so a node that will never come up
+        # fails the setup with the real reason instead of spinning
+        try:
+            self.health_backoff.run(
+                lambda: self.backend.health_check(test, node),
+                desc=f"health-check {self.backend.name}/{node}")
+        except Exception as e:
+            raise RuntimeError(
+                f"live {self.backend.name} server on {node} "
+                f"(:{self.backend.port(test, node)}) never came up "
+                f"({e}); see {d}/server.log") from e
+
+    def teardown(self, test, node):
+        sess = control.session(node, test)
+        self.kill(test, node)
+        sess.exec("rm", "-rf", node_dir(test, node))
+
+    def log_files(self, test, node):
+        return [os.path.join(node_dir(test, node), "server.log")]
+
+    # -- the nemesis surface (pidfile-level faults) --------------------
+
+    def _signal(self, test, node, sig: str) -> None:
+        pid = pidfile_path(test, node)
+        control.session(node, test).exec_raw(
+            f"kill -{sig} $(cat {pid}) 2>/dev/null || true")
+
+    def kill(self, test, node) -> None:
+        """kill -9 by pidfile — a crash, not a shutdown."""
+        self._signal(test, node, "9")
+
+    def pause(self, test, node) -> None:
+        self._signal(test, node, "STOP")
+
+    def resume(self, test, node) -> None:
+        self._signal(test, node, "CONT")
+
+
+# ---------------------------------------------------------------------------
+# family implementations
+# ---------------------------------------------------------------------------
+
+
+class RegisterBackend(LiveBackend):
+    """The existing localnode register family: oplog+fsync CAS-register
+    processes, one key per node (key k -> nodes[k % N]), checked
+    per-key linearizable — the executable seed this harness
+    generalizes."""
+
+    name = "register"
+    base_port = 18100
+    nodes = ["n1", "n2", "n3"]
+
+    def server_argv(self, test, node):
+        return [sys.executable, "-m",
+                "jepsen_tpu.suites.localnode_server",
+                str(self.port(test, node)), node_dir(test, node)]
+
+    def op_node(self, test, op):
+        # RegisterClient routes key k to nodes[k % N]
+        v = op.value
+        if independent.is_tuple(v):
+            try:
+                return test["nodes"][int(v.key) % len(test["nodes"])]
+            except (TypeError, ValueError):
+                return None
+        return None  # un-keyed op: can't attribute
+
+    def workload(self, opts):
+        from ..checker import perf as perf_mod
+
+        rate = opts.get("rate", 25)
+        group = opts.get("group_size", 3)
+
+        def naturals():
+            k = 0
+            while True:
+                yield k
+                k += 1
+
+        generator = gen.stagger(
+            1.0 / rate,
+            independent.concurrent_generator(
+                group, naturals(),
+                lambda k: gen.limit(
+                    opts.get("ops_per_key", 30),
+                    gen.mix([localnode_suite.r, localnode_suite.w,
+                             localnode_suite.cas]))))
+        return {
+            "client": _PortedRegisterClient(self),
+            "generator": generator,
+            "model": cas_register(),
+            "concurrency": 2 * group,
+            "checker": checker_mod.compose({
+                "perf": perf_mod.perf(),
+                "workload": independent.checker(checker_mod.compose({
+                    "linear": lin.linearizable(),
+                    "timeline": timeline.timeline(),
+                })),
+            }),
+        }
+
+
+class _PortedRegisterClient(localnode_suite.RegisterClient):
+    """localnode's wire client, port base taken from the backend."""
+
+    def __init__(self, backend: LiveBackend, timeout: float = 2.0):
+        super().__init__(timeout)
+        self.backend = backend
+
+    def open(self, test, node):
+        c = type(self)(self.backend, self.timeout)
+        c.node = node
+        return c
+
+    def _sock(self, test, key):
+        node = test["nodes"][int(key) % len(test["nodes"])]
+        s = self.socks.get(node)
+        if s is None:
+            s = socket.create_connection(
+                ("127.0.0.1", self.backend.port(test, node)),
+                timeout=self.timeout)
+            self.socks[node] = s
+        return node, s
+
+
+class LockBackend(LiveBackend):
+    """The localnode lock family (hazelcast tryLock shape): one
+    cluster-wide mutex on nodes[0].  ``lock_volatile`` arms the seeded
+    bug — the server forgets its holder on kill -9, the double grant
+    the mutex checker must catch."""
+
+    name = "lock"
+    base_port = 18200
+    nodes = ["n1"]
+
+    def server_argv(self, test, node):
+        extra = ["volatile"] if test.get("lock_volatile") else []
+        return [sys.executable, "-m",
+                "jepsen_tpu.suites.localnode_server",
+                str(self.port(test, node)), node_dir(test, node), *extra]
+
+    def workload(self, opts):
+        import itertools
+
+        rate = opts.get("rate", 100)
+        if opts.get("seeded_lock"):
+            # the double-grant staging from the localnode regression
+            # test: one HOLDER (acquire, hold, release) and one
+            # acquire-ONLY process that never releases, so a volatile
+            # server's forgotten holder yields an ok-acquire pair NO
+            # :info release can explain — decisive, not timing luck
+            holder = gen.stagger(0.01, localnode_suite.lock_gen(
+                hold=opts.get("hold", 2.5)))
+            acquirer = gen.stagger(0.05, gen.each(
+                lambda: gen.seq(itertools.cycle(
+                    [{"type": "invoke", "f": "acquire",
+                      "value": None}]))))
+            generator = gen.reserve(1, holder, acquirer)
+            concurrency = 2
+        else:
+            generator = gen.stagger(
+                1.0 / rate,
+                localnode_suite.lock_gen(opts.get("hold", 0.0)))
+            concurrency = opts.get("concurrency", 4)
+        return {
+            "client": _PortedLockClient(self),
+            "generator": generator,
+            "model": mutex(),
+            "concurrency": concurrency,
+            "checker": checker_mod.compose({
+                "linear": lin.linearizable(mutex()),
+                "timeline": timeline.timeline(),
+            }),
+        }
+
+
+class _PortedLockClient(localnode_suite.LockWireClient):
+    def __init__(self, backend: LiveBackend, timeout: float = 2.0):
+        super().__init__(timeout)
+        self.backend = backend
+
+    def open(self, test, node):
+        c = type(self)(self.backend, self.timeout)
+        c.node = test["nodes"][0]
+        c.owner = f"c{id(c):x}"
+        return c
+
+    def _round_trip(self, test, line):
+        if self.sock is None:
+            try:
+                self.sock = socket.create_connection(
+                    ("127.0.0.1", self.backend.port(test, self.node)),
+                    timeout=self.timeout)
+            except OSError as e:
+                raise self._NeverReached(repr(e)) from e
+        return super()._round_trip(test, line)
+
+
+class KVBackend(LiveBackend):
+    """The KV/CAS family: etcd-v2-shaped HTTP nodes
+    (live/kv_server.py), spoken to by the etcd suite's own V2Client —
+    a single shared register under quorum-read semantics, checked
+    linearizable."""
+
+    name = "kv"
+    base_port = 18300
+    nodes = ["n1"]
+
+    def server_argv(self, test, node):
+        extra = ["volatile"] if test.get("kv_volatile") else []
+        return [sys.executable, "-m", "jepsen_tpu.live.kv_server",
+                str(self.port(test, node)), node_dir(test, node), *extra]
+
+    def health_check(self, test, node):
+        import urllib.error
+        import urllib.request
+
+        url = (f"http://127.0.0.1:{self.port(test, node)}"
+               f"/v2/keys/__health__")
+        try:
+            urllib.request.urlopen(url, timeout=1.0).close()
+        except urllib.error.HTTPError:
+            pass  # a 404 IS a healthy reply (missing key)
+
+    def workload(self, opts):
+        rate = opts.get("rate", 25)
+        return {
+            "client": _PortedV2Client(self),
+            "generator": gen.stagger(
+                1.0 / rate,
+                gen.mix([etcd_suite.r, etcd_suite.w, etcd_suite.cas])),
+            "model": cas_register(),
+            "concurrency": opts.get("concurrency", 4),
+            "checker": checker_mod.compose({
+                "linear": lin.linearizable(cas_register()),
+                "timeline": timeline.timeline(),
+            }),
+        }
+
+
+class _PortedV2Client(etcd_suite.V2Client):
+    """The etcd suite's v2 wire client, aimed at 127.0.0.1:port —
+    invoke/error mapping reused verbatim."""
+
+    def __init__(self, backend: LiveBackend, node=None,
+                 timeout: float = 2.0):
+        super().__init__(node, timeout)
+        self.backend = backend
+        self.base = None
+
+    def open(self, test, node):
+        c = type(self)(self.backend, node, self.timeout)
+        c.base = f"http://127.0.0.1:{self.backend.port(test, node)}"
+        return c
+
+    def _url(self, query=None):
+        import urllib.parse
+
+        q = f"?{urllib.parse.urlencode(query)}" if query else ""
+        return f"{self.base}/v2/keys/{self.key}{q}"
+
+
+class QueueBackend(LiveBackend):
+    """The queue family: disque-shaped RESP nodes
+    (live/queue_server.py) spoken to by the disque suite's own RESP
+    client; enqueue/dequeue+ack with a final drain, checked with
+    total-queue (at-least-once: lost acked jobs are the violation,
+    redelivered un-acked jobs are legal)."""
+
+    name = "queue"
+    base_port = 18400
+    nodes = ["n1"]
+
+    def server_argv(self, test, node):
+        extra = ["volatile"] if test.get("queue_volatile") else []
+        return [sys.executable, "-m", "jepsen_tpu.live.queue_server",
+                str(self.port(test, node)), node_dir(test, node), *extra]
+
+    def workload(self, opts):
+        return {
+            "client": _PortedDisqueClient(backend=self),
+            "generator": gen.delay(1.0 / opts.get("rate", 25),
+                                   gen.queue()),
+            "final_generator": gen.each(lambda: gen.once(
+                {"type": "invoke", "f": "drain", "value": None})),
+            "model": None,  # multiset semantics: post-hoc checker only
+            "concurrency": opts.get("concurrency", 4),
+            "checker": checker_mod.compose({
+                "queue": basic.total_queue(),
+            }),
+        }
+
+
+class _PortedDisqueClient(disque_suite.DisqueClient):
+    """The disque suite's RESP wire client against 127.0.0.1:port.
+    enqueue/dequeue/ack/drain logic and the indeterminacy mapping are
+    inherited unchanged."""
+
+    def __init__(self, node=None, queue: str = "jepsen",
+                 timeout_ms: int = 100, retry: int = 1,
+                 replicate: int = 1, backend: LiveBackend | None = None):
+        super().__init__(node, queue, timeout_ms, retry, replicate)
+        self.backend = backend
+        self.port = None
+
+    def open(self, test, node):
+        c = type(self)(node, self.queue, self.timeout_ms, self.retry,
+                       1, backend=self.backend)
+        c.port = self.backend.port(test, node)
+        return c
+
+    def _conn(self):
+        if self.conn is None:
+            self.conn = disque_suite.RespConn("127.0.0.1", self.port,
+                                              timeout=5.0)
+        return self.conn
+
+
+#: the campaign's family roster
+FAMILIES: dict[str, LiveBackend] = {
+    b.name: b for b in (RegisterBackend(), LockBackend(), KVBackend(),
+                        QueueBackend())
+}
+
+
+# ---------------------------------------------------------------------------
+# generic nemeses over the ProcessDB surface
+# ---------------------------------------------------------------------------
+
+
+class KillRestartNemesis(nemesis_mod.Nemesis):
+    """{:f kill | restart, :value [nodes] | None}: kill -9 the real
+    server process(es); restart re-runs the daemon start (durable
+    oplogs replay, so acked state survives)."""
+
+    def __init__(self, db: ProcessDB):
+        self.db = db
+
+    def invoke(self, test, op):
+        if op.f == "kill":
+            nodes = op.value or [random.choice(test["nodes"])]
+            for n in nodes:
+                self.db.kill(test, n)
+            return replace(op, type="info", value=list(nodes))
+        if op.f == "restart":
+            nodes = op.value or test["nodes"]
+            errs = {}
+            for n in nodes:
+                # a restart that fails (health-check budget, RemoteError
+                # from a loaded host's start-stop-daemon, exec timeout)
+                # must not crash the nemesis: ops keep failing
+                # :fail/:info until a later restart lands, which the
+                # checker handles
+                try:
+                    self.db.setup(test, n)
+                except Exception as e:  # noqa: BLE001 — best-effort
+                    log.warning("restart of %s failed: %s", n, e)
+                    errs[n] = str(e)
+            return replace(op, type="info",
+                           value={"restarted": list(nodes),
+                                  "errors": errs} if errs
+                           else list(nodes))
+        raise ValueError(f"kill-restart nemesis: unknown f {op.f!r}")
+
+
+class PauseNemesis(nemesis_mod.Nemesis):
+    """{:f pause | resume}: SIGSTOP/SIGCONT the server process — the
+    hammer-time fault (nemesis.clj:250-264), by pidfile instead of
+    killall so only the targeted node freezes."""
+
+    def __init__(self, db: ProcessDB):
+        self.db = db
+        self._paused: list = []
+
+    def invoke(self, test, op):
+        if op.f == "pause":
+            nodes = op.value or [random.choice(test["nodes"])]
+            for n in nodes:
+                self.db.pause(test, n)
+            self._paused = list(nodes)
+            return replace(op, type="info",
+                           value=["paused", list(nodes)])
+        if op.f == "resume":
+            nodes = op.value or self._paused or test["nodes"]
+            for n in nodes:
+                self.db.resume(test, n)
+            self._paused = []
+            return replace(op, type="info",
+                           value=["resumed", list(nodes)])
+        raise ValueError(f"pause nemesis: unknown f {op.f!r}")
+
+    def teardown(self, test):
+        # a still-frozen node would wedge teardown's kill/rm
+        for n in test.get("nodes") or []:
+            try:
+                self.db.resume(test, n)
+            except Exception:  # noqa: BLE001 — best-effort thaw
+                pass
+
+
+class ClockSkewNemesis(nemesis_mod.Nemesis):
+    """{:f skew | unskew}: faketime-wrap the node's launcher script
+    (faketime.wrap — idempotent) and crash-restart it, so the server
+    runs under a skewed/fast clock until unskewed.  The wrap survives
+    nemesis restarts because every restart execs the launcher."""
+
+    def __init__(self, db: ProcessDB, offset_s: int = 120,
+                 rate: float = 1.5):
+        self.db = db
+        self.offset_s = offset_s
+        self.rate = rate
+
+    def invoke(self, test, op):
+        from .. import faketime
+
+        if op.f == "skew":
+            nodes = op.value or [random.choice(test["nodes"])]
+            for n in nodes:
+                sess = control.session(n, test)
+                faketime.wrap(sess, launcher_path(test, n),
+                              self.offset_s, self.rate)
+                self.db.kill(test, n)
+                self.db.setup(test, n)
+            return replace(op, type="info",
+                           value=["skewed", list(nodes),
+                                  {"offset_s": self.offset_s,
+                                   "rate": self.rate}])
+        if op.f == "unskew":
+            nodes = op.value or test["nodes"]
+            for n in nodes:
+                sess = control.session(n, test)
+                if faketime.unwrap(sess, launcher_path(test, n)):
+                    self.db.kill(test, n)
+                    self.db.setup(test, n)
+            return replace(op, type="info",
+                           value=["unskewed", list(nodes)])
+        raise ValueError(f"clock-skew nemesis: unknown f {op.f!r}")
+
+    def teardown(self, test):
+        from .. import faketime
+
+        for n in test.get("nodes") or []:
+            try:
+                faketime.unwrap(control.session(n, test),
+                                launcher_path(test, n))
+            except Exception:  # noqa: BLE001 — best-effort unwrap
+                pass
+
+
+class PortPartitionNemesis(nemesis_mod.Nemesis):
+    """{:f start | stop}: loopback partition grudges.  Every node and
+    client lives on 127.0.0.1, so the link that can be cut is
+    client<->node: :start picks a victim component with the grudge
+    topology math (nemesis.split_one) and DROPs inbound traffic to its
+    ports via iptables; :stop deletes exactly the rules it added."""
+
+    def __init__(self, backend: LiveBackend,
+                 grudge=nemesis_mod.split_one):
+        self.backend = backend
+        self.grudge = grudge
+        self._rules: list[tuple] = []  # (node, port) rules installed
+
+    def _ipt(self, test, args: list[str]) -> None:
+        # the availability probe required euid 0, so no sudo wrapping
+        # (the container this runs in may not even ship a sudo binary)
+        control.session(test["nodes"][0], test).exec(
+            "iptables", "-w", *args)
+
+    def invoke(self, test, op):
+        if op.f == "start":
+            if self._rules:
+                return replace(op, type="info",
+                               value="already-partitioned")
+            victims, _rest = self.grudge(list(test["nodes"]))
+            for n in victims:
+                port = self.backend.port(test, n)
+                self._ipt(test, ["-I", "INPUT", "-p", "tcp", "-i", "lo",
+                                 "--dport", str(port), "-j", "DROP"])
+                self._rules.append((n, port))
+            return replace(op, type="info",
+                           value=["isolated", sorted(str(n)
+                                                     for n, _ in
+                                                     self._rules)])
+        if op.f == "stop":
+            self._heal(test)
+            return replace(op, type="info", value="network-healed")
+        raise ValueError(f"port-partition nemesis: unknown f {op.f!r}")
+
+    def _heal(self, test) -> None:
+        for n, port in self._rules:
+            try:
+                self._ipt(test, ["-D", "INPUT", "-p", "tcp", "-i", "lo",
+                                 "--dport", str(port), "-j", "DROP"])
+            except control.RemoteError as e:
+                log.warning("partition heal of %s failed: %s", n, e)
+        self._rules = []
+
+    def teardown(self, test):
+        self._heal(test)

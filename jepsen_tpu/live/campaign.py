@@ -1,0 +1,366 @@
+"""Campaign runner — suite×nemesis cells over real backend processes.
+
+A *campaign* executes the cross product of live backend families
+(live/backend.py) and matrix nemeses (live/matrix.py), each cell a full
+``core.run`` — real server processes, real faults, streaming checker on
+(``--stream``), certificate audit on — and records per-cell outcomes
+(verdict, certificate summary, audit, **detection latency** of the
+streamed verdict relative to the first fault, **recovery time** from
+kill to the next acked op) into ``store/campaigns/<ts>/``:
+
+  cells.jsonl     one line per cell, appended as each finishes (a
+                  crashed campaign keeps every completed cell)
+  campaign.json   the final grid + summary
+
+Degradation contract: a cell whose nemesis the host can't inject (no
+faketime, no NET_ADMIN, no FUSE) or whose backend can't start reports
+``skipped`` with the reason; an unexpected error reports ``failed``
+with the traceback — the campaign always runs to completion.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import os
+import time
+import traceback
+
+from .. import control, store
+from ..util import WorkerAbort
+from .backend import FAMILIES, LiveBackend
+from .matrix import MatrixNemesis, assemble, standard_matrix
+
+log = logging.getLogger("jepsen")
+
+#: faults the streamed checker should *detect* when crossed with a
+#: volatile backend — the seeded-bug cells (the localnode volatile
+#: lock's double grant under kill -9 is the reference finding)
+SEEDED = {
+    ("lock", "kill-restart"): {"lock_volatile": True,
+                               "seeded_lock": True, "hold": 4.0,
+                               "kill_every": 1.2, "time_limit": 10},
+}
+
+
+def campaign_dir(opts: dict) -> str:
+    base = opts.get("store_base", store.BASE)
+    return os.path.join(base, "campaigns",
+                        opts.get("campaign_id") or store.time_str())
+
+
+def plan(families: list[str] | None = None,
+         nemeses: list[str] | None = None,
+         opts: dict | None = None,
+         *, seeded: bool = True) -> list[dict]:
+    """The cell list with availability resolved — exactly what a
+    ``--dry-run`` prints and what :func:`run_campaign` executes."""
+    opts = dict(opts or {})
+    matrix = standard_matrix()
+    for k in families or []:
+        if k not in FAMILIES:
+            raise ValueError(f"unknown family {k!r}; have "
+                             f"{sorted(FAMILIES)}")
+    for k in nemeses or []:
+        if k not in matrix:
+            raise ValueError(f"unknown nemesis {k!r}; have "
+                             f"{sorted(matrix)}")
+    fams = {k: FAMILIES[k] for k in (families or list(FAMILIES))}
+    nems = {k: matrix[k] for k in (nemeses or list(matrix))}
+    cells = []
+    for fname, fam in fams.items():
+        freason = fam.available(opts)
+        for nname, nem in nems.items():
+            reason = freason or nem.available()
+            cells.append({"family": fname, "nemesis": nname,
+                          "seeded": False,
+                          "skip": reason})
+            if seeded and (fname, nname) in SEEDED \
+                    and reason is None:
+                cells.append({"family": fname, "nemesis": nname,
+                              "seeded": True, "skip": None})
+    return cells
+
+
+def _walk_audits(d, out: list) -> None:
+    if isinstance(d, dict):
+        a = d.get("audit")
+        if isinstance(a, dict) and "ok" in a:
+            out.append(a)
+        for v in d.values():
+            _walk_audits(v, out)
+
+
+def _audit_summary(results: dict) -> dict | None:
+    """Aggregate every nested audit outcome (independent keys, compose
+    members) into one ok/checked/codes record."""
+    audits: list = []
+    _walk_audits(results, audits)
+    if not audits:
+        return None
+    codes = sorted({c for a in audits for c in (a.get("codes") or [])})
+    checked = sorted({str(a.get("checked")) for a in audits})
+    return {"ok": all(a.get("ok") for a in audits),
+            "checked": checked,
+            "certificates": len(audits), "codes": codes}
+
+
+def _fault_fs(nemesis: str) -> set:
+    return {"kill-restart": {"kill"}, "pause": {"pause"},
+            "clock-skew": {"skew"}, "partition": {"start"},
+            "disk-faults": {"break-one-percent", "break-all"}} \
+        .get(nemesis, set())
+
+
+def _detection(test: dict, nemesis: str) -> dict | None:
+    """Streamed detection latency: the gap between the first injected
+    fault and the event where the streaming checker flipped to
+    invalid — the metric ROADMAP's streaming phase 2 asks to measure on
+    real crashes."""
+    sres = test.get("stream_results")
+    if not isinstance(sres, dict):
+        return None
+    st = sres.get("stream") or {}
+    inv = st.get("invalid_event")
+    at = "mid-stream"
+    if inv is None:
+        if sres.get("valid") is not False:
+            return None
+        # a crashed cell suppresses online cuts (an :info op may still
+        # linearize anywhere later), so a kill-seeded violation is
+        # necessarily confirmed when the stream finalizes — record the
+        # detection against the end of the recorded history, honestly
+        # labelled
+        inv = max(0, int(st.get("events") or 0) - 1)
+        at = "finalize"
+    hist = test.get("history") or []
+    fault_fs = _fault_fs(nemesis)
+    fault_idx = fault_t = None
+    for i, op in enumerate(hist):
+        if op.process == "nemesis" and op.f in fault_fs \
+                and op.type == "info":
+            fault_idx, fault_t = i, op.time
+            break
+    out = {"invalid_event": inv, "at": at,
+           "first_verdict_event": st.get("first_verdict_event")}
+    if fault_idx is not None and inv >= fault_idx:
+        out["fault_event"] = fault_idx
+        out["latency_events"] = inv - fault_idx
+        t_inv = hist[inv].time if inv < len(hist) else None
+        if t_inv is not None and fault_t is not None:
+            out["latency_s"] = round((t_inv - fault_t) / 1e9, 4)
+    return out
+
+
+def _recovery(test: dict) -> dict | None:
+    """kill -> next acked client op AGAINST A KILLED NODE, per kill:
+    how long the crashed node was dark.  On key-sharded families an
+    ok op on a healthy node proves nothing, so ops are attributed via
+    the backend's routing (``LiveBackend.op_node``); unattributable
+    ops are skipped rather than miscounted."""
+    hist = test.get("history") or []
+    backend = test.get("__live_backend__")
+    deltas = []
+    pending: tuple | None = None  # (kill time, killed-node names)
+    for op in hist:
+        if op.process == "nemesis" and op.f == "kill" \
+                and op.type == "info" \
+                and isinstance(op.value, (list, tuple)):
+            # the completion carries the killed node list (the invoke's
+            # value is the generator's, usually None)
+            pending = (op.time, {str(n) for n in op.value})
+        elif pending is not None and isinstance(op.process, int) \
+                and op.type == "ok" and op.time is not None \
+                and op.time > pending[0]:
+            node = None
+            if backend is not None:
+                try:
+                    node = backend.op_node(test, op)
+                except Exception:  # noqa: BLE001 — metric, not verdict
+                    node = None
+            if node is None or str(node) not in pending[1]:
+                continue
+            deltas.append((op.time - pending[0]) / 1e9)
+            pending = None
+    if not deltas:
+        return None
+    return {"n": len(deltas),
+            "mean_s": round(sum(deltas) / len(deltas), 4),
+            "max_s": round(max(deltas), 4)}
+
+
+def run_cell(cell: dict, opts: dict) -> dict:
+    """Execute one suite×nemesis cell end to end; never raises."""
+    from .. import core
+
+    out = dict(cell)
+    if cell.get("skip"):
+        out["status"] = "skipped"
+        out["reason"] = cell["skip"]
+        return out
+    backend: LiveBackend = FAMILIES[cell["family"]]
+    matrix = standard_matrix()
+    entry: MatrixNemesis = matrix[cell["nemesis"]]
+
+    copts = dict(opts)
+    tag = f"{cell['family']}-{cell['nemesis']}" \
+        + ("-seeded" if cell.get("seeded") else "")
+    copts["name"] = f"live-{tag}"
+    copts.setdefault("data_root",
+                     os.path.join("/tmp/jepsen-live", tag))
+    if cell.get("seeded"):
+        copts.update(SEEDED[(cell["family"], cell["nemesis"])])
+    if cell["nemesis"] == "disk-faults":
+        # disk faults only bite when the oplog lives on the faulty fs
+        from .. import faultfs
+
+        copts["data_root"] = os.path.join(faultfs.FAULTY,
+                                          "jepsen-live", tag)
+    copts.setdefault("stream", True)
+
+    # audit every live history: the campaign's point is verdicts a
+    # reviewer can replay, so the certificate audit runs fleet-wide
+    # (JEPSEN_TPU_AUDIT reaches every checker, incl. per-key cells)
+    prev_audit = os.environ.get("JEPSEN_TPU_AUDIT")
+    if copts.get("audit", True):
+        os.environ["JEPSEN_TPU_AUDIT"] = "1"
+    t0 = time.monotonic()
+    try:
+        try:
+            test = core.run(assemble(backend, entry, copts))
+        except WorkerAbort as e:
+            out["status"] = "skipped"
+            out["reason"] = f"backend couldn't run: {e}"
+            return out
+        except RuntimeError as e:
+            # a server that never came up is a host capability problem
+            # (port squatting, fork pressure), not a campaign failure
+            out["status"] = "skipped"
+            out["reason"] = f"backend couldn't start: {e}"
+            return out
+        except control.RemoteError as e:
+            # the control plane itself is missing a tool (no
+            # start-stop-daemon on alpine/macOS, no mkdir perms): the
+            # same degradation contract — skip with the reason
+            out["status"] = "skipped"
+            out["reason"] = f"control plane failed: {e}"
+            return out
+        except Exception as e:  # noqa: BLE001 — campaign must finish
+            out["status"] = "failed"
+            out["reason"] = f"{type(e).__name__}: {e}"
+            out["traceback"] = traceback.format_exc()[-2000:]
+            return out
+    finally:
+        if copts.get("audit", True):
+            if prev_audit is None:
+                os.environ.pop("JEPSEN_TPU_AUDIT", None)
+            else:
+                os.environ["JEPSEN_TPU_AUDIT"] = prev_audit
+    res = test.get("results") or {}
+    hist = test.get("history") or []
+    out["status"] = "ok"
+    out["valid"] = res.get("valid")
+    out["ops"] = sum(1 for op in hist if isinstance(op.process, int)
+                     and op.type in ("ok", "fail", "info"))
+    # injected faults only (heals excluded); each nemesis action
+    # journals both its invoke and its completion as 'info', hence /2
+    fault_fs = _fault_fs(cell["nemesis"])
+    out["faults"] = sum(1 for op in hist if op.process == "nemesis"
+                        and op.f in fault_fs) // 2
+    out["wall_s"] = round(time.monotonic() - t0, 2)
+    out["audit"] = _audit_summary(res)
+    sres = test.get("stream_results")
+    if isinstance(sres, dict):
+        from ..stream.service import result_summary
+
+        summ = result_summary(sres)
+        out["stream_valid"] = summ.get("valid")
+        out["certificate"] = {
+            k: v for k, v in summ.items()
+            if k in ("witness_ops", "witness_dropped", "final_ops",
+                     "frontier_ops", "frontier_dropped")}
+    out["detection"] = _detection(test, cell["nemesis"])
+    out["recovery"] = _recovery(test)
+    out["store"] = os.path.dirname(store.path(test, "x"))
+    return out
+
+
+def run_campaign(opts: dict | None = None,
+                 families: list[str] | None = None,
+                 nemeses: list[str] | None = None,
+                 *, seeded: bool = True,
+                 progress=None) -> dict:
+    """Run the whole matrix; returns (and persists) the campaign
+    record.  ``progress(cell_outcome)`` is called per finished cell."""
+    opts = dict(opts or {})
+    opts.setdefault("time_limit", 8)
+    cells = plan(families, nemeses, opts, seeded=seeded)
+    d = campaign_dir(opts)
+    os.makedirs(d, exist_ok=True)
+    cells_path = os.path.join(d, "cells.jsonl")
+
+    outcomes = []
+    with open(cells_path, "a") as fh:
+        for cell in cells:
+            outcome = run_cell(cell, opts)
+            outcomes.append(outcome)
+            fh.write(json.dumps(
+                {k: v for k, v in outcome.items()
+                 if k != "traceback"}, default=str) + "\n")
+            fh.flush()
+            if progress is not None:
+                progress(outcome)
+
+    by_status: dict = {}
+    for o in outcomes:
+        by_status[o["status"]] = by_status.get(o["status"], 0) + 1
+    record = {
+        "id": os.path.basename(d),
+        "started": opts.get("campaign_id") or os.path.basename(d),
+        "families": sorted({c["family"] for c in cells}),
+        "nemeses": sorted({c["nemesis"] for c in cells}),
+        "cells": outcomes,
+        "summary": {
+            **by_status,
+            "detected": sum(1 for o in outcomes
+                            if o.get("valid") is False),
+            "audited_ok": sum(1 for o in outcomes
+                              if (o.get("audit") or {}).get("ok")),
+        },
+    }
+    with open(os.path.join(d, "campaign.json"), "w") as f:
+        json.dump(record, f, indent=1, default=str)
+    return record
+
+
+def render_plan(cells: list[dict]) -> str:
+    """The --dry-run rendering: the grid with per-cell skip reasons."""
+    lines = []
+    fams = sorted({c["family"] for c in cells})
+    nems = []
+    for c in cells:
+        if c["nemesis"] not in nems:
+            nems.append(c["nemesis"])
+    width = max(len(f) for f in fams) + 2
+    lines.append(" " * width + "  ".join(f"{n:<14}" for n in nems))
+    for f in fams:
+        row = [f"{f:<{width}}"]
+        for n in nems:
+            cell = next(c for c in cells
+                        if c["family"] == f and c["nemesis"] == n
+                        and not c.get("seeded"))
+            row.append(f"{'run':<14}  " if cell["skip"] is None
+                       else f"{'skip':<14}  ")
+        lines.append("".join(row).rstrip())
+    lines.append("")
+    seen = set()
+    for c in cells:
+        if c.get("seeded"):
+            lines.append(f"seeded bug cell: {c['family']} × "
+                         f"{c['nemesis']} (expected invalid)")
+        elif c["skip"] and c["skip"] not in seen:
+            seen.add(c["skip"])
+            skips = sorted({f"{x['family']}×{x['nemesis']}"
+                            for x in cells if x.get("skip") == c["skip"]})
+            lines.append(f"skip {', '.join(skips)}: {c['skip']}")
+    return "\n".join(lines)

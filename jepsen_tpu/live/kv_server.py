@@ -1,0 +1,156 @@
+"""live KV/CAS node — an etcd-v2-shaped HTTP server, for real.
+
+One logical node of the live KV family: a REAL OS process serving the
+etcd **v2 keys surface** (`GET/PUT /v2/keys/<k>` with ``prevValue``
+CAS), exactly the wire protocol the etcd suite's ``V2Client``
+(suites/etcd.py) already speaks — so the live harness reuses that
+client unchanged and the suite's wire code stops being dead code.
+
+Durability contract is the localnode_server one: every state-changing
+op appends to an oplog and ``fsync()``\\ s BEFORE the reply leaves,
+under one global lock (the linearization point), so a kill -9 loses at
+most un-acked ops — the history's :info "maybe happened" case — and
+startup replays the oplog.  With ``volatile``, mutations skip the log:
+acked writes then vanish on crash, the seeded-bug mode a checker must
+catch.
+
+Status mapping (the v2 API shape V2Client's error handling relies on):
+
+  GET  missing key                 -> 404 {"errorCode": 100}
+  PUT  prevValue mismatch          -> 412 {"errorCode": 101}
+  PUT  prevValue on a missing key  -> 404 {"errorCode": 100}
+
+Usage:  python -m jepsen_tpu.live.kv_server PORT DATA_DIR [volatile]
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+import threading
+import urllib.parse
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+PREFIX = "/v2/keys/"
+
+
+class Store:
+    """key -> value-string map; durability via live.oplog.DurableLog
+    (fsync before the reply, torn tail line dropped on replay)."""
+
+    def __init__(self, data_dir: str, volatile: bool = False):
+        from .oplog import DurableLog
+
+        self.lock = threading.Lock()
+        self.state: dict[str, str] = {}
+        self.log = DurableLog(data_dir, volatile=volatile)
+        for line in self.log.replay():
+            try:
+                e = json.loads(line)
+            except ValueError:
+                continue
+            if e.get("op") == "set":
+                self.state[e["k"]] = e["v"]
+        self.log.open()
+
+    def _durable(self, entry: dict) -> None:
+        self.log.append(json.dumps(entry))
+
+    def get(self, key: str) -> str | None:
+        with self.lock:
+            return self.state.get(key)
+
+    def put(self, key: str, value: str,
+            prev: str | None = None) -> tuple[int, dict]:
+        """(status, body) — durable before return (the reply follows)."""
+        with self.lock:
+            if prev is not None:
+                cur = self.state.get(key)
+                if cur is None:
+                    return 404, {"errorCode": 100,
+                                 "message": "Key not found", "cause": key}
+                if cur != prev:
+                    return 412, {"errorCode": 101,
+                                 "message": "Compare failed",
+                                 "cause": f"[{prev} != {cur}]"}
+            self._durable({"op": "set", "k": key, "v": value})
+            self.state[key] = value
+            return 200, {"action": "compareAndSwap" if prev is not None
+                         else "set",
+                         "node": {"key": f"/{key}", "value": value}}
+
+
+class Handler(BaseHTTPRequestHandler):
+    protocol_version = "HTTP/1.1"
+
+    def log_message(self, fmt, *args):  # quiet
+        pass
+
+    def _reply(self, status: int, body: dict) -> None:
+        data = json.dumps(body).encode()
+        self.send_response(status)
+        self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Length", str(len(data)))
+        self.end_headers()
+        self.wfile.write(data)
+
+    def _key(self, parsed) -> str | None:
+        if not parsed.path.startswith(PREFIX):
+            return None
+        return urllib.parse.unquote(parsed.path[len(PREFIX):]) or None
+
+    def do_GET(self):  # noqa: N802 (stdlib API)
+        parsed = urllib.parse.urlparse(self.path)
+        key = self._key(parsed)
+        if key is None:
+            self._reply(404, {"errorCode": 100, "message": "bad path"})
+            return
+        v = self.server.store.get(key)
+        if v is None:
+            self._reply(404, {"errorCode": 100,
+                              "message": "Key not found", "cause": key})
+            return
+        self._reply(200, {"action": "get",
+                          "node": {"key": f"/{key}", "value": v}})
+
+    def do_PUT(self):  # noqa: N802 (stdlib API)
+        parsed = urllib.parse.urlparse(self.path)
+        key = self._key(parsed)
+        if key is None:
+            self._reply(404, {"errorCode": 100, "message": "bad path"})
+            return
+        try:
+            n = int(self.headers.get("Content-Length") or 0)
+            form = urllib.parse.parse_qs(
+                self.rfile.read(n).decode("utf-8", "replace"))
+            value = form["value"][0]
+        except (ValueError, KeyError, IndexError):
+            self._reply(400, {"errorCode": 209, "message": "bad form"})
+            return
+        query = urllib.parse.parse_qs(parsed.query)
+        prev = query.get("prevValue", [None])[0]
+        status, body = self.server.store.put(key, value, prev)
+        self._reply(status, body)
+
+
+class Server(ThreadingHTTPServer):
+    allow_reuse_address = True  # rebind fast after kill -9
+    daemon_threads = True
+
+
+def main(argv=None) -> None:
+    argv = sys.argv[1:] if argv is None else argv
+    if len(argv) not in (2, 3) or (len(argv) == 3
+                                   and argv[2] != "volatile"):
+        print("usage: kv_server PORT DATA_DIR [volatile]",
+              file=sys.stderr)
+        raise SystemExit(2)
+    port, data_dir = int(argv[0]), argv[1]
+    srv = Server(("127.0.0.1", port), Handler)
+    srv.store = Store(data_dir, volatile=len(argv) == 3)
+    print(f"kv_server: listening on 127.0.0.1:{port}", flush=True)
+    srv.serve_forever()
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,55 @@
+"""DurableLog — the one copy of the oplog+fsync durability contract.
+
+The live node servers (kv_server, queue_server) share the
+localnode_server durability discipline: every state-changing op is
+appended to an oplog and ``fsync()``\\ ed BEFORE the reply leaves
+(under the caller's state lock — the linearization point), so a
+kill -9 loses at most un-acked ops; startup replays the log, skipping
+a torn final line from a crashed writer.  With ``volatile``, nothing
+is logged — the deliberate seeded-bug mode.
+
+Stdlib-only on purpose: the servers import it at daemon startup, and
+dragging the checker stack (JAX) into every spawned node would
+multiply fork latency across a whole campaign.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Iterator
+
+
+class DurableLog:
+    def __init__(self, data_dir: str, name: str = "oplog",
+                 volatile: bool = False):
+        os.makedirs(data_dir, exist_ok=True)
+        self.path = os.path.join(data_dir, name)
+        self.volatile = volatile
+        self._fh = None
+
+    def replay(self) -> Iterator[str]:
+        """Recovery: yield each complete logged line (decoded,
+        newline-stripped).  A torn final line — no trailing newline,
+        the crashed-mid-write case — is dropped: it was never acked."""
+        if not os.path.exists(self.path):
+            return
+        with open(self.path, "rb") as f:
+            data = f.read()
+        complete = data[:data.rfind(b"\n") + 1] if b"\n" in data else b""
+        for raw in complete.splitlines():
+            yield raw.decode("utf-8", "replace")
+
+    def open(self) -> "DurableLog":
+        """Open the append handle (after replay, before serving)."""
+        self._fh = open(self.path, "ab")
+        return self
+
+    def append(self, line: str) -> None:
+        """Durable BEFORE return — the caller replies only after."""
+        if self.volatile:
+            return
+        if not line.endswith("\n"):
+            line += "\n"
+        self._fh.write(line.encode())
+        self._fh.flush()
+        os.fsync(self._fh.fileno())

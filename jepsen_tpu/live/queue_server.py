@@ -1,0 +1,214 @@
+"""live queue node — a disque-shaped RESP job queue, for real.
+
+One logical node of the live queue family: a REAL OS process speaking
+the RESP subset the disque suite's wire client (suites/disque.py:
+``RespConn``/``DisqueClient``) already uses —
+
+  ADDJOB <queue> <body> <timeout_ms> [RETRY s] [REPLICATE n]  -> +id
+  GETJOB TIMEOUT <ms> COUNT <n> FROM <queue>  -> [[queue id body]] | nil
+  ACKJOB <id>                                 -> :n
+
+so the live harness reuses that client unchanged.  Semantics mirror
+disque's at-least-once contract: a GETJOB claims a job for RETRY
+seconds; un-ACKed claims are *redelivered* once the retry window
+expires (the duplicate-delivery case the total-queue checker must
+tolerate), ACKJOB retires the job for good.
+
+Durability is the localnode_server contract: ADDJOB and ACKJOB append
+to an oplog and ``fsync()`` BEFORE the reply leaves, so acked state
+survives kill -9 (in-flight ops are the checker's :info case) and
+startup replays adds minus acks back into the pending set.  With
+``volatile``, nothing is logged — enqueues acked to the client vanish
+on crash: the seeded data-loss bug a queue checker exists to catch.
+
+Usage:  python -m jepsen_tpu.live.queue_server PORT DATA_DIR [volatile]
+"""
+
+from __future__ import annotations
+
+import sys
+import threading
+import time
+import socketserver
+from collections import OrderedDict
+
+
+class Store:
+    """Pending/claimed job sets with oplog+fsync durability."""
+
+    def __init__(self, data_dir: str, volatile: bool = False):
+        from .oplog import DurableLog
+
+        self.lock = threading.Lock()
+        self.cv = threading.Condition(self.lock)
+        self.next_id = 0
+        #: job id -> (body, retry_s), FIFO-ish delivery order
+        #: (redeliveries rejoin at the tail, like disque's best-effort
+        #: ordering)
+        self.pending: OrderedDict[str, tuple[str, float]] = OrderedDict()
+        #: job id -> (body, retry_s, redeliver-at-monotonic)
+        self.claimed: dict[str, tuple[str, float, float]] = {}
+        self.log = DurableLog(data_dir, volatile=volatile)
+        acked: set = set()
+        adds: OrderedDict[str, str] = OrderedDict()
+        for line in self.log.replay():
+            parts = line.split(" ", 2)
+            if len(parts) == 3 and parts[0] == "A":
+                adds[parts[1]] = parts[2]
+                n = int(parts[1].split("-")[-1])
+                self.next_id = max(self.next_id, n + 1)
+            elif len(parts) >= 2 and parts[0] == "K":
+                acked.add(parts[1])
+        for jid, body in adds.items():
+            if jid not in acked:
+                self.pending[jid] = (body, 1.0)
+        self.log.open()
+
+    def _durable(self, line: str) -> None:
+        self.log.append(line)
+
+    def _expire_claims(self) -> None:
+        """Redeliver claims whose retry window lapsed (caller holds
+        the lock)."""
+        now = time.monotonic()
+        for jid in [j for j, (_, _, t) in self.claimed.items()
+                    if t <= now]:
+            body, retry_s, _ = self.claimed.pop(jid)
+            self.pending[jid] = (body, retry_s)
+
+    def addjob(self, body: str, retry_s: float) -> str:
+        with self.cv:
+            jid = f"D-{self.next_id}"
+            self.next_id += 1
+            # durable BEFORE the reply: the linearization point
+            self._durable(f"A {jid} {body}\n")
+            self.pending[jid] = (body, retry_s)
+            self.cv.notify()
+            return jid
+
+    def getjob(self, timeout_ms: int) -> tuple[str, str] | None:
+        deadline = time.monotonic() + timeout_ms / 1000.0
+        with self.cv:
+            while True:
+                self._expire_claims()
+                if self.pending:
+                    jid, (body, retry_s) = \
+                        self.pending.popitem(last=False)
+                    self.claimed[jid] = (
+                        body, retry_s, time.monotonic() + retry_s)
+                    return jid, body
+                left = deadline - time.monotonic()
+                if left <= 0:
+                    return None
+                # wake early enough to notice an expiring claim
+                nxt = min([t for _, _, t in self.claimed.values()],
+                          default=deadline)
+                self.cv.wait(max(0.01, min(left,
+                                           nxt - time.monotonic())))
+
+    def ackjob(self, jid: str) -> int:
+        with self.cv:
+            known = jid in self.claimed or jid in self.pending
+            self._durable(f"K {jid}\n")
+            self.claimed.pop(jid, None)
+            self.pending.pop(jid, None)
+            return 1 if known else 0
+
+
+class Handler(socketserver.StreamRequestHandler):
+    """The RESP framing RespConn emits: arrays of bulk strings in, one
+    reply out per command."""
+
+    def _read_command(self) -> list[str] | None:
+        line = self.rfile.readline()
+        if not line:
+            return None
+        if not line.startswith(b"*"):
+            raise ValueError(f"bad array header {line!r}")
+        n = int(line[1:].strip())
+        args = []
+        for _ in range(n):
+            hdr = self.rfile.readline()
+            if not hdr.startswith(b"$"):
+                raise ValueError(f"bad bulk header {hdr!r}")
+            size = int(hdr[1:].strip())
+            data = self.rfile.read(size + 2)[:-2]
+            args.append(data.decode("utf-8", "replace"))
+        return args
+
+    def _send(self, payload: bytes) -> None:
+        self.wfile.write(payload)
+        self.wfile.flush()
+
+    def handle(self):
+        store: Store = self.server.store
+        while True:
+            try:
+                args = self._read_command()
+            except (ValueError, ConnectionError, OSError):
+                return
+            if args is None:
+                return
+            cmd = args[0].upper() if args else ""
+            try:
+                if cmd == "ADDJOB" and len(args) >= 4:
+                    retry_s = 1.0
+                    rest = [a.upper() for a in args[4:]]
+                    if "RETRY" in rest:
+                        retry_s = float(args[4 + rest.index("RETRY") + 1])
+                    jid = store.addjob(args[2], retry_s)
+                    self._send(f"+{jid}\r\n".encode())
+                elif cmd == "GETJOB":
+                    u = [a.upper() for a in args]
+                    timeout_ms = int(args[u.index("TIMEOUT") + 1]) \
+                        if "TIMEOUT" in u else 0
+                    queue = args[u.index("FROM") + 1] if "FROM" in u \
+                        else "jepsen"
+                    got = store.getjob(timeout_ms)
+                    if got is None:
+                        self._send(b"*-1\r\n")
+                    else:
+                        jid, body = got
+                        out = [f"*1\r\n*3\r\n".encode()]
+                        for s in (queue, jid, body):
+                            b = s.encode()
+                            out.append(f"${len(b)}\r\n".encode()
+                                       + b + b"\r\n")
+                        self._send(b"".join(out))
+                elif cmd == "ACKJOB" and len(args) >= 2:
+                    self._send(f":{store.ackjob(args[1])}\r\n".encode())
+                else:
+                    self._send(f"-ERR unknown command {cmd!r}\r\n"
+                               .encode())
+            except (BrokenPipeError, ConnectionResetError):
+                return
+            except Exception as e:  # noqa: BLE001 — one command, not
+                # the server: a malformed arg must not kill the node
+                try:
+                    self._send(f"-ERR {type(e).__name__}: {e}\r\n"
+                               .encode())
+                except OSError:
+                    return
+
+
+class Server(socketserver.ThreadingTCPServer):
+    allow_reuse_address = True  # rebind fast after kill -9
+    daemon_threads = True
+
+
+def main(argv=None) -> None:
+    argv = sys.argv[1:] if argv is None else argv
+    if len(argv) not in (2, 3) or (len(argv) == 3
+                                   and argv[2] != "volatile"):
+        print("usage: queue_server PORT DATA_DIR [volatile]",
+              file=sys.stderr)
+        raise SystemExit(2)
+    port, data_dir = int(argv[0]), argv[1]
+    srv = Server(("127.0.0.1", port), Handler)
+    srv.store = Store(data_dir, volatile=len(argv) == 3)
+    print(f"queue_server: listening on 127.0.0.1:{port}", flush=True)
+    srv.serve_forever()
+
+
+if __name__ == "__main__":
+    main()

@@ -10,10 +10,72 @@ during partitions.
 from __future__ import annotations
 
 import logging
+import random
 import threading
+import time
+from dataclasses import dataclass, field
 from typing import Any, Callable, Optional
 
 log = logging.getLogger("jepsen")
+
+
+@dataclass
+class Backoff:
+    """Capped exponential backoff with jitter and an attempts budget.
+
+    The raw schedule is ``min(cap, base * factor**attempt)``; each delay
+    is then shortened by up to ``jitter`` of itself (decorrelated
+    retries: a fleet of clients reopening after the same crash must not
+    reconnect in lockstep).  ``max_attempts`` bounds the whole loop — a
+    reopen loop against a dead server terminates with the last error
+    instead of spinning forever at a fixed interval.
+
+    ``rng`` is injectable so the schedule is unit-testable."""
+
+    base: float = 0.05
+    cap: float = 2.0
+    factor: float = 2.0
+    max_attempts: int = 8
+    jitter: float = 0.5
+    rng: random.Random = field(default_factory=random.Random)
+
+    def raw_delay(self, attempt: int) -> float:
+        """The un-jittered delay before retry ``attempt`` (0-based)."""
+        return min(self.cap, self.base * self.factor ** attempt)
+
+    def delay(self, attempt: int) -> float:
+        raw = self.raw_delay(attempt)
+        return raw * (1.0 - self.jitter * self.rng.random())
+
+    def delays(self) -> list[float]:
+        """The whole jittered schedule (one delay per retry; attempt 0
+        runs immediately, so there are ``max_attempts - 1`` sleeps)."""
+        return [self.delay(i) for i in range(max(0, self.max_attempts - 1))]
+
+    def budget_s(self) -> float:
+        """Worst-case total sleep time across the budget (no jitter)."""
+        return sum(self.raw_delay(i)
+                   for i in range(max(0, self.max_attempts - 1)))
+
+    def run(self, fn: Callable[[], Any], *, desc: str = "retry",
+            sleep: Callable[[float], None] = time.sleep):
+        """Call ``fn`` until it returns without raising; sleep the
+        jittered schedule between attempts; after ``max_attempts``
+        failures re-raise the last error."""
+        last: Optional[BaseException] = None
+        for attempt in range(max(1, self.max_attempts)):
+            try:
+                return fn()
+            except Exception as e:  # noqa: BLE001 — caller's fn decides
+                last = e
+                if attempt + 1 >= self.max_attempts:
+                    break
+                d = self.delay(attempt)
+                log.debug("%s failed (attempt %d/%d): %s; retrying in "
+                          "%.3fs", desc, attempt + 1, self.max_attempts,
+                          e, d)
+                sleep(d)
+        raise last  # type: ignore[misc]
 
 
 class Wrapper:
@@ -21,20 +83,30 @@ class Wrapper:
 
     def __init__(self, open: Callable[[], Any],
                  close: Callable[[Any], None] = lambda c: None,
-                 name: str = "conn", log_errors: bool = True):
+                 name: str = "conn", log_errors: bool = True,
+                 backoff: Optional[Backoff] = None):
         self._open = open
         self._close = close
         self.name = name
         self.log_errors = log_errors
+        self.backoff = backoff
         self._lock = threading.RLock()
         self._conn: Optional[Any] = None
         self._closed = True
+
+    def _open_retrying(self):
+        """One open attempt, or the backoff-scheduled reopen loop when a
+        :class:`Backoff` was given — capped exponential + jitter with an
+        attempts budget, never a fixed-interval spin."""
+        if self.backoff is None:
+            return self._open()
+        return self.backoff.run(self._open, desc=f"open {self.name}")
 
     def open(self) -> "Wrapper":
         """reconnect.clj:58-66."""
         with self._lock:
             if self._closed:
-                self._conn = self._open()
+                self._conn = self._open_retrying()
                 self._closed = False
         return self
 
@@ -54,7 +126,7 @@ class Wrapper:
             except Exception as e:
                 if self.log_errors:
                     log.warning("error closing %s: %s", self.name, e)
-            self._conn = self._open()
+            self._conn = self._open_retrying()
             self._closed = False
         return self
 

@@ -45,6 +45,16 @@ def main(argv=None) -> int:
     p.add_argument("--listen", metavar="HOST:PORT", default=None,
                    help="Serve the line protocol over TCP instead of "
                         "stdin/stdout.")
+    p.add_argument("--op-budget", type=int, default=None, metavar="N",
+                   help="Per-run admitted-op ceiling: past it, ops are "
+                        "shed with an 'overloaded' reply and the run "
+                        "finalizes on the admitted prefix.")
+    p.add_argument("--ingest-queue", type=int, default=0, metavar="N",
+                   help="Bounded per-connection ingest queue (0 = "
+                        "process inline): when the checker falls this "
+                        "many lines behind, further lines are shed "
+                        "with an 'overloaded' reply instead of "
+                        "stalling the socket.")
     args = p.parse_args(argv)
     logging.basicConfig(level=logging.WARNING)
 
@@ -69,7 +79,9 @@ def main(argv=None) -> int:
                           cache=cache,
                           witness=not args.no_witness,
                           audit=True if args.audit else None,
-                          host_fold_max=args.host_fold_max)
+                          host_fold_max=args.host_fold_max,
+                          op_budget=args.op_budget,
+                          ingest_max=args.ingest_queue)
         print(f"stream service listening on "
               f"{srv.server_address[0]}:{srv.server_address[1]}",
               file=sys.stderr, flush=True)
@@ -82,8 +94,10 @@ def main(argv=None) -> int:
     service = StreamService(model=model, cache=cache,
                             witness=not args.no_witness,
                             audit=True if args.audit else None,
-                            host_fold_max=args.host_fold_max)
-    serve_stdio(service, sys.stdin, sys.stdout)
+                            host_fold_max=args.host_fold_max,
+                            op_budget=args.op_budget)
+    serve_stdio(service, sys.stdin, sys.stdout,
+                ingest_max=args.ingest_queue)
     return 0
 
 

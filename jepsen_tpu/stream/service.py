@@ -18,6 +18,23 @@ Line protocol (one JSON object per line, newline-delimited):
                                        valid-so-far -> invalid)
   out  {"run": ID, "final": {...}}     the final verdict + stream stats
   out  {"run": ID, "error": "..."}     a malformed line / unknown run
+  out  {"run": ID, "overloaded": ...}  backpressure: the op was SHED
+                                       (per-run op budget exhausted, or
+                                       the connection's bounded ingest
+                                       queue is full)
+
+Backpressure: thousands of concurrent connections must degrade
+predictably, not by OOM or unbounded latency.  Two independent guards:
+
+  * **per-run op budget** (``op_budget``): past the budget, further ops
+    for that run are shed with an ``overloaded`` reply; the run still
+    finalizes normally and its final summary reports ``shed`` — the
+    verdict is for exactly the admitted prefix.
+  * **bounded ingest queue** (``ingest_max`` in :func:`serve_lines`):
+    each connection's reader never blocks on checking — lines queue up
+    to the bound, and when the checker can't keep up the line is shed
+    with an ``overloaded`` reply instead of stalling the socket (or
+    buffering without limit).
 
 Model names are the shard scheduler's descriptors
 (``decompose.schedule.model_from_descriptor``): register,
@@ -73,14 +90,19 @@ class StreamService:
 
     def __init__(self, *, model=None, cache=None, witness: bool = True,
                  audit: bool | None = None,
-                 host_fold_max: int | None = None):
+                 host_fold_max: int | None = None,
+                 op_budget: int | None = None):
         self.default_model = model
         self.cache = cache
         self.witness = witness
         self.audit = audit
         self.host_fold_max = host_fold_max
+        #: per-run admitted-op ceiling; None = unlimited
+        self.op_budget = op_budget
         self._runs: dict = {}
         self._status: dict = {}
+        self._ops: dict = {}   # run -> admitted ops
+        self._shed: dict = {}  # run -> ops shed past the budget
 
     def open_run(self, run_id: str, model) -> None:
         from .checker import StreamChecker
@@ -89,6 +111,8 @@ class StreamService:
             model, cache=self.cache, witness=self.witness,
             host_fold_max=self.host_fold_max, run_id=run_id)
         self._status[run_id] = "open"
+        self._ops[run_id] = 0
+        self._shed[run_id] = 0
 
     def _model_from(self, d: dict):
         from ..decompose.schedule import model_from_descriptor
@@ -135,6 +159,19 @@ class StreamService:
                     return
                 self.open_run(run_id, self.default_model)
                 chk = self._runs[run_id]
+            if self.op_budget is not None \
+                    and self._ops.get(run_id, 0) >= self.op_budget:
+                # shed, don't stall: the run keeps its verdict for the
+                # admitted prefix; the client learns explicitly that
+                # this op was dropped (first shed + every 1000th after,
+                # so a hot run can't flood the reply stream either)
+                shed = self._shed.get(run_id, 0) + 1
+                self._shed[run_id] = shed
+                if shed == 1 or shed % 1000 == 0:
+                    emit({"run": run_id, "overloaded": "op-budget",
+                          "budget": self.op_budget, "shed": shed})
+                return
+            self._ops[run_id] = self._ops.get(run_id, 0) + 1
             chk.ingest(Op.from_dict(op))
             v = chk.verdict()
             if v["status"] != self._status.get(run_id):
@@ -147,11 +184,16 @@ class StreamService:
     def end_run(self, run_id: str, emit) -> None:
         chk = self._runs.pop(run_id, None)
         self._status.pop(run_id, None)
+        self._ops.pop(run_id, None)
+        shed = self._shed.pop(run_id, 0)
         if chk is None:
             emit({"run": run_id, "error": f"unknown run {run_id!r}"})
             return
         result = chk.finalize(audit=self.audit)
-        emit({"run": run_id, "final": result_summary(result)})
+        summary = result_summary(result)
+        if shed:
+            summary["shed"] = shed
+        emit({"run": run_id, "final": summary})
 
     def end_all(self, emit) -> None:
         """EOF / disconnect: every still-open run yields its verdict for
@@ -160,7 +202,70 @@ class StreamService:
             self.end_run(run_id, emit)
 
 
-def serve_stdio(service: StreamService, stdin, stdout) -> None:
+def serve_lines(service: StreamService, lines, emit, *,
+                ingest_max: int = 0) -> int:
+    """Drain an iterable of protocol lines through the service; returns
+    how many lines were shed.
+
+    ``ingest_max=0`` processes inline (reader == checker: the socket
+    itself is the backpressure).  ``ingest_max>0`` decouples them: the
+    reader feeds a bounded queue a worker thread drains, and when the
+    checker falls behind by more than the bound, the line is SHED with
+    an explicit ``overloaded`` reply — bounded memory and a socket that
+    never stalls, the degradation mode thousands of connections need."""
+    if ingest_max <= 0:
+        for line in lines:
+            service.handle_line(line, emit)
+        service.end_all(emit)
+        return 0
+
+    import queue as _queue
+
+    q: _queue.Queue = _queue.Queue(maxsize=ingest_max)
+    _EOF = object()
+    broken: list = []  # the worker's fatal error, re-raised after join
+
+    def worker() -> None:
+        # a dead emit (client hung up) must not leave the reader
+        # blocked on a full queue: keep draining, surface the error
+        # after the join
+        while True:
+            item = q.get()
+            if item is _EOF:
+                return
+            if broken:
+                continue
+            try:
+                service.handle_line(item, emit)
+            except Exception as e:  # noqa: BLE001 — connection-fatal
+                broken.append(e)
+
+    t = threading.Thread(target=worker, name="stream-ingest",
+                         daemon=True)
+    t.start()
+    shed = 0
+    for line in lines:
+        try:
+            q.put_nowait(line)
+        except _queue.Full:
+            shed += 1
+            if shed == 1 or shed % 1000 == 0:
+                try:
+                    emit({"run": None, "overloaded": "ingest-queue",
+                          "queue": ingest_max, "shed": shed})
+                except Exception as e:  # noqa: BLE001 — same contract
+                    broken.append(e)
+                    break
+    q.put(_EOF)  # blocking put: drains behind whatever is queued
+    t.join()
+    if broken:
+        raise broken[0]
+    service.end_all(emit)
+    return shed
+
+
+def serve_stdio(service: StreamService, stdin, stdout, *,
+                ingest_max: int = 0) -> None:
     """The stdin/stdout loop (one writer thread: replies are lines)."""
     lock = threading.Lock()
 
@@ -169,9 +274,7 @@ def serve_stdio(service: StreamService, stdin, stdout) -> None:
             stdout.write(json.dumps(d, separators=(",", ":")) + "\n")
             stdout.flush()
 
-    for line in stdin:
-        service.handle_line(line, emit)
-    service.end_all(emit)
+    serve_lines(service, stdin, emit, ingest_max=ingest_max)
 
 
 class _Handler(socketserver.StreamRequestHandler):
@@ -182,7 +285,8 @@ class _Handler(socketserver.StreamRequestHandler):
         service = StreamService(model=srv.default_model,
                                 cache=srv.cache, witness=srv.witness,
                                 audit=srv.audit,
-                                host_fold_max=srv.host_fold_max)
+                                host_fold_max=srv.host_fold_max,
+                                op_budget=srv.op_budget)
         lock = threading.Lock()
 
         def emit(d: dict) -> None:
@@ -192,9 +296,10 @@ class _Handler(socketserver.StreamRequestHandler):
                     .encode())
 
         try:
-            for raw in self.rfile:
-                service.handle_line(raw.decode("utf-8", "replace"), emit)
-            service.end_all(emit)
+            serve_lines(service,
+                        (raw.decode("utf-8", "replace")
+                         for raw in self.rfile),
+                        emit, ingest_max=srv.ingest_max)
         except (BrokenPipeError, ConnectionResetError):
             log.debug("stream service: client dropped the connection")
 
@@ -206,11 +311,15 @@ class _TCPServer(socketserver.ThreadingTCPServer):
 
 def make_server(host: str, port: int, *, model=None, cache=None,
                 witness: bool = True, audit: bool | None = None,
-                host_fold_max: int | None = None) -> _TCPServer:
+                host_fold_max: int | None = None,
+                op_budget: int | None = None,
+                ingest_max: int = 0) -> _TCPServer:
     srv = _TCPServer((host, port), _Handler)
     srv.default_model = model
     srv.cache = cache
     srv.witness = witness
     srv.audit = audit
     srv.host_fold_max = host_fold_max
+    srv.op_budget = op_budget
+    srv.ingest_max = ingest_max
     return srv

@@ -230,10 +230,15 @@ class DisqueClient(client_mod.Client):
                 # guarantee nothing is pending redelivery
                 # (disque.clj:221-240 journals each sub-dequeue; we
                 # keep the drain op atomic).
+                # the ok value is the LIST of drained elements —
+                # checker.expand_queue_drain_ops turns each into a
+                # dequeue invoke/ok pair (checker.clj:213-244); a bare
+                # count would crash the total-queue checker (found the
+                # first time this client ran against a live server)
                 deadline = time.time() + 10
                 drain_timeout_ms = max(1000 * self.retry + 200,
                                        self.timeout_ms)
-                drained = 0
+                drained: list = []
                 empties = 0
                 while time.time() < deadline:
                     sub = self._dequeue(replace(op, f="dequeue"),
@@ -244,7 +249,7 @@ class DisqueClient(client_mod.Client):
                             return replace(op, type="ok", value=drained)
                     else:
                         empties = 0
-                        drained += 1
+                        drained.append(sub.value)
                 return replace(op, type="info", error="drain timeout")
             raise ValueError(f"unknown f {op.f!r}")
         except RespError as e:

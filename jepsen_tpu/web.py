@@ -59,10 +59,123 @@ def home_html(base: str) -> str:
                 f"</a></td><td>{html.escape(t)}</td>"
                 f"<td>{html.escape(str(valid))}</td>"
                 f'<td><a href="/files/{rel}/?zip">zip</a></td></tr>')
+    campaigns = ""
+    if os.path.isdir(os.path.join(base, "campaigns")):
+        campaigns = '<p><a href="/campaigns">fault-injection campaigns</a></p>'
     return (f"<html><head><title>Jepsen</title><style>{STYLE}</style></head>"
-            f"<body><h1>Jepsen results</h1><table>"
+            f"<body><h1>Jepsen results</h1>{campaigns}<table>"
             f"<tr><th>test</th><th>time</th><th>valid?</th><th></th></tr>"
             f"{''.join(rows)}</table></body></html>")
+
+
+# ---------------------------------------------------------------------------
+# campaign grid (live fault-injection campaigns, jepsen_tpu/live/)
+# ---------------------------------------------------------------------------
+
+
+def _load_campaign(base: str, cid: str) -> dict | None:
+    p = os.path.join(base, "campaigns", cid, "campaign.json")
+    try:
+        with open(p) as f:
+            out = json.load(f)
+        return out if isinstance(out, dict) else None
+    except Exception:
+        return None
+
+
+def campaigns_html(base: str) -> str:
+    """The campaign index: one row per recorded campaign."""
+    d = os.path.join(base, "campaigns")
+    rows = []
+    try:
+        cids = sorted(os.listdir(d), reverse=True)
+    except OSError:
+        cids = []
+    for cid in cids:
+        c = _load_campaign(base, cid)
+        if c is None:
+            continue
+        s = c.get("summary") or {}
+        q = urllib.parse.quote(cid)
+        rows.append(
+            f'<tr><td><a href="/campaigns/{q}">{html.escape(cid)}</a>'
+            f"</td><td>{s.get('ok', 0)}</td>"
+            f"<td>{s.get('skipped', 0)}</td>"
+            f"<td>{s.get('failed', 0)}</td>"
+            f"<td>{s.get('detected', 0)}</td>"
+            f"<td>{s.get('audited_ok', 0)}</td></tr>")
+    return (f"<html><head><title>Campaigns</title><style>{STYLE}</style>"
+            f"</head><body><h1>Fault-injection campaigns</h1>"
+            f"<p><a href='/'>home</a></p><table>"
+            f"<tr><th>campaign</th><th>ok</th><th>skipped</th>"
+            f"<th>failed</th><th>violations detected</th>"
+            f"<th>audited ok</th></tr>{''.join(rows)}</table>"
+            f"</body></html>")
+
+
+def campaign_html(base: str, cid: str) -> str:
+    """One campaign as a family × nemesis grid: every executed cell is
+    colored by its verdict and links to its run directory; skipped
+    cells show their reason inline."""
+    c = _load_campaign(base, cid)
+    if c is None:
+        return (f"<html><body>campaign {html.escape(cid)} has no "
+                f"readable campaign.json</body></html>")
+    cells = c.get("cells") or []
+    fams = sorted({x["family"] for x in cells})
+    nems = []
+    for x in cells:
+        if x["nemesis"] not in nems:
+            nems.append(x["nemesis"])
+
+    def cell_td(outs: list) -> str:
+        parts = []
+        for o in outs:
+            label = "seeded: " if o.get("seeded") else ""
+            if o.get("status") == "ok":
+                cls = {True: "valid-true",
+                       False: "valid-false"}.get(o.get("valid"),
+                                                 "valid-unknown")
+                body = f"{label}{o.get('valid')}"
+                det = o.get("detection") or {}
+                if det.get("latency_s") is not None:
+                    body += f" (detected in {det['latency_s']}s)"
+                rel = o.get("store")
+                if rel:
+                    # store paths are absolute-or-relative to the base;
+                    # link via /files using the run's name/time suffix
+                    tail = "/".join(str(rel).split(os.sep)[-2:])
+                    body = (f'<a href="/files/{urllib.parse.quote(tail)}'
+                            f'/">{html.escape(body)}</a>')
+                parts.append(f'<div class="{cls}">{body}</div>')
+            else:
+                reason = html.escape(str(o.get("reason") or ""))
+                parts.append(f'<div class="valid-unknown">'
+                             f"{label}{o.get('status')}"
+                             f"<br><small>{reason}</small></div>")
+        return f"<td>{''.join(parts)}</td>"
+
+    rows = []
+    for f in fams:
+        tds = []
+        for n in nems:
+            outs = [x for x in cells
+                    if x["family"] == f and x["nemesis"] == n]
+            tds.append(cell_td(outs))
+        rows.append(f"<tr><th>{html.escape(f)}</th>{''.join(tds)}</tr>")
+    s = c.get("summary") or {}
+    return (f"<html><head><title>{html.escape(cid)}</title>"
+            f"<style>{STYLE}</style></head><body>"
+            f"<h1>campaign {html.escape(cid)}</h1>"
+            f"<p><a href='/campaigns'>campaigns</a> | "
+            f"<a href='/'>home</a></p>"
+            f"<p>{s.get('ok', 0)} ok, {s.get('skipped', 0)} skipped, "
+            f"{s.get('failed', 0)} failed — "
+            f"{s.get('detected', 0)} violation(s) detected, "
+            f"{s.get('audited_ok', 0)} cell(s) audited ok</p>"
+            f"<table><tr><th>family \\ nemesis</th>"
+            + "".join(f"<th>{html.escape(n)}</th>" for n in nems)
+            + f"</tr>{''.join(rows)}</table></body></html>")
 
 
 def result_block(result: dict) -> str:
@@ -338,6 +451,17 @@ class Handler(BaseHTTPRequestHandler):
         path = urllib.parse.unquote(parsed.path)
         if path == "/":
             self._send(200, home_html(self.base).encode())
+            return
+        if path == "/campaigns" or path == "/campaigns/":
+            self._send(200, campaigns_html(self.base).encode())
+            return
+        if path.startswith("/campaigns/"):
+            cid = os.path.normpath(
+                path[len("/campaigns/"):]).lstrip("/")
+            if cid.startswith("..") or "/" in cid:
+                self._send(403, b"forbidden", "text/plain")
+                return
+            self._send(200, campaign_html(self.base, cid).encode())
             return
         if path.startswith("/api/live/"):
             # the live provisional verdict of a (possibly running)

@@ -1,0 +1,120 @@
+"""Streaming service backpressure: op budgets + bounded ingest queues.
+
+ROADMAP streaming phase 2: thousands of concurrent connections must
+degrade predictably.  The contract under test — past a per-run op
+budget, ops are SHED with an explicit ``overloaded`` reply (and the
+run still finalizes on the admitted prefix); a connection whose
+checker falls behind its bounded ingest queue sheds lines the same
+way instead of stalling the socket or buffering without bound.
+"""
+
+import json
+
+from jepsen_tpu.models import register
+from jepsen_tpu.stream.service import StreamService, serve_lines
+
+
+def _header(run="r1"):
+    return json.dumps({"run": run, "model": "register", "init": 0})
+
+
+def _op(run, process, typ, f, value):
+    return json.dumps({"run": run,
+                       "op": {"process": process, "type": typ,
+                              "f": f, "value": value}})
+
+
+def _ok_pair(run, process, f, value):
+    return [_op(run, process, "invoke", f, value),
+            _op(run, process, "ok", f, value)]
+
+
+def test_op_budget_sheds_with_overloaded_reply():
+    svc = StreamService(model=register(0), op_budget=6)
+    replies = []
+    lines = [_header()]
+    for i in range(8):  # 16 ops; budget admits 6
+        lines += _ok_pair("r1", 0, "write", i % 3)
+    for li in lines:
+        svc.handle_line(li, replies.append)
+    over = [r for r in replies if r.get("overloaded")]
+    assert over, "no overloaded reply despite blowing the budget"
+    assert over[0]["overloaded"] == "op-budget"
+    assert over[0]["budget"] == 6
+    # the run still finalizes: verdict of exactly the admitted prefix,
+    # with the shed count reported
+    svc.end_run("r1", replies.append)
+    finals = [r for r in replies if "final" in r]
+    assert len(finals) == 1
+    assert finals[0]["final"]["valid"] is True
+    assert finals[0]["final"]["shed"] == 16 - 6
+
+
+def test_budget_is_per_run_not_global():
+    svc = StreamService(model=register(0), op_budget=4)
+    replies = []
+    for run in ("a", "b"):
+        svc.handle_line(_header(run), replies.append)
+    for i in range(4):
+        for run in ("a", "b"):
+            for li in _ok_pair(run, 0, "write", 1):
+                svc.handle_line(li, replies.append)
+    # each run admitted exactly its own 4 ops, shed its own overflow
+    for run in ("a", "b"):
+        svc.end_run(run, replies.append)
+    finals = {r["run"]: r["final"] for r in replies if "final" in r}
+    assert finals["a"]["shed"] == 4
+    assert finals["b"]["shed"] == 4
+    assert finals["a"]["valid"] is True
+
+
+def test_no_budget_admits_everything():
+    svc = StreamService(model=register(0))
+    replies = []
+    svc.handle_line(_header(), replies.append)
+    for i in range(50):
+        for li in _ok_pair("r1", 0, "write", i % 4):
+            svc.handle_line(li, replies.append)
+    svc.end_run("r1", replies.append)
+    final = [r for r in replies if "final" in r][0]["final"]
+    assert "shed" not in final
+    assert final["valid"] is True
+    assert not any(r.get("overloaded") for r in replies)
+
+
+def test_serve_lines_inline_mode_processes_all():
+    svc = StreamService(model=register(0))
+    replies = []
+    lines = [_header()] + _ok_pair("r1", 0, "write", 2)
+    shed = serve_lines(svc, iter(lines), replies.append, ingest_max=0)
+    assert shed == 0
+    finals = [r for r in replies if "final" in r]
+    assert finals and finals[0]["final"]["valid"] is True
+
+
+def test_serve_lines_bounded_queue_sheds_when_swamped():
+    """A checker that can't keep up (artificially slowed) behind a
+    2-line queue: a fast producer's flood is shed with overloaded
+    replies, memory stays bounded, and EOF still finalizes whatever
+    was admitted."""
+    import time
+
+    svc = StreamService(model=register(0))
+    real = svc.handle_line
+
+    def slow_handle(line, emit):
+        time.sleep(0.01)
+        real(line, emit)
+
+    svc.handle_line = slow_handle
+    replies = []
+    lines = [_header()]
+    for i in range(100):
+        lines += _ok_pair("r1", 0, "write", i % 3)
+    shed = serve_lines(svc, iter(lines), replies.append, ingest_max=2)
+    assert shed > 0, "a 10ms/line checker behind a 2-line queue " \
+                     "must shed a 201-line burst"
+    over = [r for r in replies if r.get("overloaded") == "ingest-queue"]
+    assert over and over[0]["queue"] == 2
+    finals = [r for r in replies if "final" in r]
+    assert len(finals) == 1  # EOF finalized the admitted prefix

@@ -1,0 +1,96 @@
+"""Verdict-cache jsonl compaction — long campaigns must not grow the
+append-only store without bound.
+
+Contract: compaction rewrites exactly the live entry set (newest per
+key), drops superseded duplicate lines, survives concurrent writers'
+appends made since load (they are merged from a fresh read), replaces
+the file atomically, and auto-arms past the size threshold.
+"""
+
+import json
+import os
+
+from jepsen_tpu.decompose.cache import VerdictCache
+
+
+def _lines(path):
+    with open(path) as f:
+        return [json.loads(x) for x in f if x.strip()]
+
+
+def test_compact_drops_superseded_lines(tmp_path):
+    p = str(tmp_path / "v.jsonl")
+    c = VerdictCache(p, compact_bytes=0)  # manual compaction only
+    for _ in range(5):
+        c.put_verdict("k1", True)
+        c.put_verdict("k2", False)
+        c.put_states("k3", [[1, 2], [3, 4]])
+    assert len(_lines(p)) == 15
+    dropped = c.compact()
+    assert dropped == 12
+    live = _lines(p)
+    assert len(live) == 3
+    assert {e["k"] for e in live} == {"k1", "k2", "k3"}
+    # semantics intact after compaction + reload
+    c2 = VerdictCache(p)
+    assert c2.get("k1") == {"k": "k1", "v": True}
+    assert c2.get("k2") == {"k": "k2", "v": False}
+    assert c2.get("k3")["out"] == [[1, 2], [3, 4]]
+    assert c.compactions == 1
+    assert c.compacted_away == 12
+
+
+def test_compact_then_append_lands_in_new_file(tmp_path):
+    p = str(tmp_path / "v.jsonl")
+    c = VerdictCache(p, compact_bytes=0)
+    for _ in range(3):
+        c.put_verdict("a", True)
+    c.compact()
+    c.put_verdict("b", False)  # append handle must follow the replace
+    assert {e["k"] for e in _lines(p)} == {"a", "b"}
+    assert len(_lines(p)) == 2
+
+
+def test_compact_merges_other_writers_entries(tmp_path):
+    """A second process appended since our load: compaction must carry
+    its entries into the rewrite, not forget them."""
+    p = str(tmp_path / "v.jsonl")
+    c1 = VerdictCache(p, compact_bytes=0)
+    c1.put_verdict("mine", True)
+    c2 = VerdictCache(p, compact_bytes=0)
+    c2.put_verdict("theirs", False)
+    c1.compact()
+    keys = {e["k"] for e in _lines(p)}
+    assert keys == {"mine", "theirs"}
+    # and a fresh loader sees both
+    c3 = VerdictCache(p)
+    assert c3.get("mine")["v"] is True
+    assert c3.get("theirs")["v"] is False
+
+
+def test_auto_compaction_triggers_past_threshold(tmp_path):
+    p = str(tmp_path / "v.jsonl")
+    c = VerdictCache(p, compact_bytes=2000)
+    # hammer one hot key: the file grows while the live set stays at 1
+    for i in range(3000):
+        c.put_verdict("hot", True)
+    assert c.compactions >= 1, "size-triggered compaction never fired"
+    assert os.path.getsize(p) < 2000 + 4096  # bounded, not ~90KB
+    assert len(_lines(p)) < 300
+    c.close()
+    assert VerdictCache(p).get("hot")["v"] is True
+
+
+def test_compaction_disabled_with_zero_threshold(tmp_path):
+    p = str(tmp_path / "v.jsonl")
+    c = VerdictCache(p, compact_bytes=0)
+    for _ in range(600):
+        c.put_verdict("hot", True)
+    assert c.compactions == 0
+    assert len(_lines(p)) == 600
+
+
+def test_in_memory_cache_compact_is_noop():
+    c = VerdictCache(None)
+    c.put_verdict("x", True)
+    assert c.compact() == 0

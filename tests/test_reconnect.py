@@ -1,0 +1,122 @@
+"""reconnect.Backoff — the capped-exponential-with-jitter schedule.
+
+The satellite contract: reopen loops use capped exponential backoff
+with jitter and a max-attempts budget instead of fixed-interval
+retries, and the schedule itself is unit-tested (rng injectable, no
+real sleeping anywhere in here).
+"""
+
+import random
+
+import pytest
+
+from jepsen_tpu.reconnect import Backoff, Wrapper
+
+
+def test_raw_schedule_grows_then_caps():
+    b = Backoff(base=0.05, cap=2.0, factor=2.0, max_attempts=10,
+                jitter=0.0)
+    raws = [b.raw_delay(i) for i in range(9)]
+    # strictly growing until the cap, then flat at the cap
+    assert raws[0] == pytest.approx(0.05)
+    assert raws[1] == pytest.approx(0.10)
+    for a, b_ in zip(raws, raws[1:]):
+        assert b_ >= a
+    assert raws[-1] == 2.0
+    assert raws[-2] == 2.0  # capped before the end: 0.05*2^6 = 3.2 > 2
+
+
+def test_jitter_shortens_but_never_inflates():
+    b = Backoff(base=0.1, cap=5.0, factor=2.0, max_attempts=12,
+                jitter=0.5, rng=random.Random(42))
+    for i in range(11):
+        d = b.delay(i)
+        raw = b.raw_delay(i)
+        assert 0.5 * raw <= d <= raw
+
+
+def test_delays_budget_and_length():
+    b = Backoff(base=0.05, cap=1.0, factor=2.0, max_attempts=6,
+                jitter=0.0)
+    ds = b.delays()
+    # attempt 0 runs immediately: budget is max_attempts - 1 sleeps
+    assert len(ds) == 5
+    assert sum(ds) == pytest.approx(b.budget_s())
+    assert b.budget_s() == pytest.approx(0.05 + 0.1 + 0.2 + 0.4 + 0.8)
+
+
+def test_run_retries_until_success_with_scheduled_sleeps():
+    b = Backoff(base=0.05, cap=2.0, factor=2.0, max_attempts=8,
+                jitter=0.0)
+    slept = []
+    calls = {"n": 0}
+
+    def flaky():
+        calls["n"] += 1
+        if calls["n"] < 4:
+            raise OSError("not yet")
+        return "up"
+
+    assert b.run(flaky, sleep=slept.append) == "up"
+    assert calls["n"] == 4
+    assert slept == pytest.approx([0.05, 0.1, 0.2])
+
+
+def test_run_exhausts_budget_and_reraises_last():
+    b = Backoff(base=0.01, cap=0.02, max_attempts=3, jitter=0.0)
+    slept = []
+
+    def dead():
+        raise ConnectionRefusedError("still down")
+
+    with pytest.raises(ConnectionRefusedError):
+        b.run(dead, sleep=slept.append)
+    assert len(slept) == 2  # budget: 3 attempts = 2 sleeps
+
+
+def test_wrapper_reopen_uses_backoff():
+    """The reopen loop rides the schedule: a conn that fails twice then
+    succeeds opens without raising, with the scheduled sleeps."""
+    attempts = {"n": 0}
+
+    def opener():
+        attempts["n"] += 1
+        if attempts["n"] < 3:
+            raise OSError("refused")
+        return f"conn{attempts['n']}"
+
+    slept = []
+    b = Backoff(base=0.05, cap=1.0, factor=2.0, max_attempts=5,
+                jitter=0.0)
+    b_run = b.run
+
+    # spy on the sleeps without monkeypatching time.sleep globally
+    def run_spy(fn, **kw):
+        kw["sleep"] = slept.append
+        return b_run(fn, **kw)
+
+    b.run = run_spy
+    w = Wrapper(open=opener, backoff=b, log_errors=False)
+    assert w.conn() == "conn3"
+    assert slept == pytest.approx([0.05, 0.1])
+    # budget exhaustion propagates the last error out of open()
+    attempts["n"] = -100
+    w2 = Wrapper(open=opener,
+                 backoff=Backoff(base=0.0, cap=0.0, max_attempts=2,
+                                 jitter=0.0),
+                 log_errors=False)
+    with pytest.raises(OSError):
+        w2.reopen()
+
+
+def test_wrapper_without_backoff_single_attempt():
+    attempts = {"n": 0}
+
+    def opener():
+        attempts["n"] += 1
+        raise OSError("down")
+
+    w = Wrapper(open=opener, log_errors=False)
+    with pytest.raises(OSError):
+        w.open()
+    assert attempts["n"] == 1

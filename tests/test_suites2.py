@@ -169,7 +169,11 @@ def test_disque_client_roundtrip():
         assert out.type == "fail"  # empty queue
         c.invoke({}, Op(f="enqueue", value=7))
         out = c.invoke({}, Op(f="drain"))
-        assert out.type == "ok" and out.value == 1
+        # the ok value is the drained ELEMENT LIST — what
+        # expand_queue_drain_ops turns into dequeue invoke/ok pairs
+        # (a bare count crashed the total-queue checker the first time
+        # this client ran against a live server)
+        assert out.type == "ok" and out.value == [7]
     finally:
         dmod.PORT = orig
         c.close({})

@@ -1,0 +1,19 @@
+#!/usr/bin/env python
+"""Campaign front door — ``tools/campaign.py [--dry-run] ...``.
+
+A thin wrapper over ``python -m jepsen_tpu.live`` so operators (and
+CI) drive nemesis campaigns from the tools/ directory like the other
+utilities; ``--dry-run`` prints the suite×nemesis matrix with per-cell
+skip reasons without spawning a single process.
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+from jepsen_tpu.live.__main__ import main  # noqa: E402
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -107,6 +107,29 @@ STREAM_DEVICE_FAMILIES = ("register", "cas-register")
 STREAM_HOST_FOLD_MAX = 1 << 22
 
 
+#: bounded `:info` lookahead — after this many post-crash :ok rows
+#: accumulate at a pseudo-quiescent point, the stream runs a
+#: speculative fork check (the `:info` op present at each frontier
+#: position vs absent) so a kill-seeded violation flips the live
+#: verdict mid-stream instead of at finalize.  0 disables (finalize-
+#: only, the pre-lookahead behavior).
+STREAM_INFO_LOOKAHEAD = 16
+
+#: the fork cap: past this many pending `:info` ops the speculative
+#: check is skipped — bounding what the uncertain ops can do is what
+#: keeps the search online (Parsimonious Optimal DPOR's point,
+#: arXiv:2405.11128); the verdict still lands exactly at finalize
+STREAM_INFO_FORK_MAX = 6
+
+
+def info_fork_gate(n_infos: int, *, fork_max: int | None = None) -> bool:
+    """May the stream speculatively fork this many pending `:info`
+    ops?  The single rule the stream engine executes and
+    :func:`stream_plan` predicts."""
+    cap = STREAM_INFO_FORK_MAX if fork_max is None else fork_max
+    return 0 < n_infos <= cap
+
+
 def segment_fold_cost(n_rows: int, window: int) -> int:
     """The host fold's cost proxy for one crash-free segment: rows times
     the window-bounded interleaving factor (``segment_states`` is the
@@ -130,7 +153,8 @@ def segment_fold_route(n_rows: int, window: int, model: ModelSpec, *,
 
 
 def stream_plan(seq: OpSeq, model: ModelSpec, *,
-                host_fold_max: int | None = None) -> dict:
+                host_fold_max: int | None = None,
+                info_lookahead: int | None = None) -> dict:
     """The streaming-applicability gate: would the incremental checker
     (jepsen_tpu/stream/) pay off on this history, and how would it
     route?  Predicts quiescence-cut density, expected segment sizes,
@@ -149,13 +173,31 @@ def stream_plan(seq: OpSeq, model: ModelSpec, *,
     if cell_model is None:
         cell_model = model
 
+    horizon = STREAM_INFO_LOOKAHEAD if info_lookahead is None \
+        else max(0, int(info_lookahead))
     seg_rows: list[int] = []
     routes = {"host": 0, "device": 0}
     ttfv_rows = None
+    crashed_cells = info_rows = spec_checks = 0
+    forkable = True
     for cseq in cells:
         n = len(cseq)
         if n == 0:
             continue
+        infos = int((~cseq.ok).sum())
+        if infos:
+            crashed_cells += 1
+            info_rows += infos
+            if not info_fork_gate(infos):
+                forkable = False
+            elif horizon:
+                # one speculative fork check per horizon's worth of
+                # post-crash ok rows — the same counting basis the
+                # stream engine uses (it counts post-crash ok
+                # COMPLETIONS; statically, ok rows after the first
+                # crash row approximate that)
+                first = int(np.argmax(~cseq.ok))
+                spec_checks += int(cseq.ok[first:].sum()) // horizon
         cuts = quiescence_cuts(cseq)
         bounds = [0, *cuts.tolist(), n]
         if len(cuts) and (ttfv_rows is None or int(cuts[0]) < ttfv_rows):
@@ -186,6 +228,14 @@ def stream_plan(seq: OpSeq, model: ModelSpec, *,
         "ttfv_rows": ttfv_rows,
         "routes": routes,
         "device_eligible": cell_model.name in STREAM_DEVICE_FAMILIES,
+        "info_lookahead": {
+            "horizon": horizon,
+            "fork_max": STREAM_INFO_FORK_MAX,
+            "crashed_cells": crashed_cells,
+            "info_rows": info_rows,
+            "forkable": forkable,
+            "speculative_checks": spec_checks,
+        },
     }
 
 

@@ -474,8 +474,18 @@ def prepare_test(test: dict) -> dict:
                 cache = default_cache_path()
             live = store.path(test, "live.json") if test.get("name") \
                 else None
+            la = test.get("stream_lookahead")
+            if la is None:
+                env_la = _stdlib_os.environ.get(
+                    "JEPSEN_TPU_STREAM_LOOKAHEAD", "").strip()
+                if env_la:
+                    try:
+                        la = int(env_la)
+                    except ValueError:
+                        la = None
             test["__stream_check__"] = StreamChecker(
                 model, async_folds=True, cache=cache, live_path=live,
+                info_lookahead=la,
                 run_id=f"{test.get('name')}/{test['start_time']}"
                 if test.get("name") else None)
         else:

@@ -47,6 +47,22 @@ def main(argv=None) -> int:
                    help="Skip the certificate audit pass.")
     p.add_argument("--store-base", default=None,
                    help="Store root (default: store/).")
+    p.add_argument("--resume", metavar="CAMPAIGN_ID", default=None,
+                   help="Resume an interrupted campaign: skip every "
+                        "cell already recorded in store/campaigns/"
+                        "CAMPAIGN_ID/cells.jsonl, run the rest, and "
+                        "rewrite its campaign.json complete.")
+    p.add_argument("--cell-budget", type=float, default=None,
+                   metavar="S",
+                   help="Per-cell wall-clock watchdog budget in "
+                        "seconds (default: scaled from --time-limit). "
+                        "Past it the watchdog SIGKILLs the cell's "
+                        "wedged backend processes so the campaign "
+                        "degrades one cell, never hangs.")
+    p.add_argument("--cell-retries", type=int, default=None,
+                   metavar="N",
+                   help="Bounded retries per cell on harness (not "
+                        "verdict) errors (default 1).")
     p.add_argument("--dry-run", action="store_true",
                    help="Print the matrix with per-cell skip reasons; "
                         "spawn nothing.")
@@ -64,6 +80,12 @@ def main(argv=None) -> int:
         opts["stream"] = False
     if args.no_audit:
         opts["audit"] = False
+    if args.resume:
+        opts["campaign_id"] = args.resume
+    if args.cell_budget is not None:
+        opts["cell_budget"] = args.cell_budget
+    if args.cell_retries is not None:
+        opts["cell_retries"] = args.cell_retries
 
     families = _split(args.families)
     nemeses = _split(args.nemeses)
@@ -79,11 +101,16 @@ def main(argv=None) -> int:
     def progress(outcome: dict) -> None:
         tag = f"{outcome['family']} × {outcome['nemesis']}" \
             + (" [seeded]" if outcome.get("seeded") else "")
+        if outcome.get("attempts", 1) > 1:
+            tag += f" [attempt {outcome['attempts']}]"
+        if (outcome.get("watchdog") or {}).get("fired"):
+            tag += " [watchdog]"
         if outcome["status"] == "ok":
             extra = ""
             det = outcome.get("detection")
             if det and "latency_s" in det:
-                extra = f", detected in {det['latency_s']}s"
+                extra = (f", detected in {det['latency_s']}s "
+                         f"({det.get('at')})")
             print(f"  {tag}: valid={outcome.get('valid')} "
                   f"({outcome.get('ops')} ops{extra})", flush=True)
         else:
@@ -92,15 +119,19 @@ def main(argv=None) -> int:
 
     record = run_campaign(opts, families, nemeses,
                           seeded=not args.no_seeded,
-                          progress=progress)
+                          progress=progress,
+                          resume=bool(args.resume))
     if args.json:
         print(json.dumps(record, indent=1, default=str))
     else:
         s = record["summary"]
+        resumed = record.get("resumed_cells") or 0
         print(f"campaign {record['id']}: "
               f"{s.get('ok', 0)} ok / {s.get('skipped', 0)} skipped / "
-              f"{s.get('failed', 0)} failed; "
-              f"{s.get('detected', 0)} violations detected, "
+              f"{s.get('failed', 0)} failed"
+              + (f" / {resumed} resumed" if resumed else "")
+              + f"; {s.get('detected', 0)} violations detected "
+              f"({s.get('streamed_detections', 0)} streamed), "
               f"{s.get('audited_ok', 0)} cells audited ok")
     return 0
 

@@ -147,6 +147,32 @@ class ProcessDB(db_mod.DB, db_mod.LogFiles):
         # single-core host forks daemons slowly
         self.health_backoff = health_backoff or Backoff(
             base=0.05, cap=2.0, factor=1.6, max_attempts=30, jitter=0.3)
+        #: per-node STATEFUL health backoffs: reset() on success, so a
+        #: node that recovers then re-fails re-ramps from the base
+        #: delay; left exhausted, a node that never came up costs ONE
+        #: probe per later restart attempt instead of a fresh 45s ramp
+        self._node_health: dict = {}
+
+    def _health_wait(self, test, node) -> None:
+        """The health loop: probe until healthy (reset) or the node's
+        stateful backoff budget runs out (fail fast next time)."""
+        import time as _time
+
+        b = self._node_health.get(node)
+        if b is None:
+            b = replace(self.health_backoff)
+            self._node_health[node] = b
+        while True:
+            try:
+                self.backend.health_check(test, node)
+                b.reset()
+                return
+            except Exception as e:
+                if b.exhausted():
+                    raise RuntimeError(
+                        f"health budget exhausted after "
+                        f"{b.max_attempts} probes: {e}") from e
+                _time.sleep(b.step())
 
     def _write_launcher(self, sess: control.Session, test, node) -> None:
         script = launcher_path(test, node)
@@ -177,11 +203,13 @@ class ProcessDB(db_mod.DB, db_mod.LogFiles):
             match_process_name=False)
         # bounded-backoff health check: capped exponential + jitter
         # with a max-attempts budget, so a node that will never come up
-        # fails the setup with the real reason instead of spinning
+        # fails the setup with the real reason instead of spinning.
+        # The backoff is STATEFUL per node (reconnect.Backoff.step/
+        # reset): success re-arms it, exhaustion makes the NEXT restart
+        # of a still-dead node fail after one probe — a wedged node
+        # degrades its cell fast instead of re-paying the ramp
         try:
-            self.health_backoff.run(
-                lambda: self.backend.health_check(test, node),
-                desc=f"health-check {self.backend.name}/{node}")
+            self._health_wait(test, node)
         except Exception as e:
             raise RuntimeError(
                 f"live {self.backend.name} server on {node} "
@@ -403,15 +431,16 @@ class KVBackend(LiveBackend):
 
     def workload(self, opts):
         rate = opts.get("rate", 25)
+        model = cas_register(_PortedV2Client.MISSING)
         return {
             "client": _PortedV2Client(self),
             "generator": gen.stagger(
                 1.0 / rate,
                 gen.mix([etcd_suite.r, etcd_suite.w, etcd_suite.cas])),
-            "model": cas_register(),
+            "model": model,
             "concurrency": opts.get("concurrency", 4),
             "checker": checker_mod.compose({
-                "linear": lin.linearizable(cas_register()),
+                "linear": lin.linearizable(model),
                 "timeline": timeline.timeline(),
             }),
         }
@@ -419,7 +448,29 @@ class KVBackend(LiveBackend):
 
 class _PortedV2Client(etcd_suite.V2Client):
     """The etcd suite's v2 wire client, aimed at 127.0.0.1:port —
-    invoke/error mapping reused verbatim."""
+    invoke/error mapping reused verbatim, with one live-harness
+    sharpening: on loopback there is no middlebox, so a connection
+    REFUSED (the node is dead, nothing accepted the bytes) or an
+    explicit 503 rejection (the replicated family's "not leader":
+    refused before any mutation) proves the op never happened — those
+    become ``:fail`` instead of ``:info``.  Crash-heavy cells stay
+    checkable: every spurious ``:info`` widens the search's crash
+    frontier exponentially, and a kill-restart campaign cell would
+    otherwise drown its own post-hoc analysis.  Genuine indeterminacy
+    (timeouts, resets mid-flight, the replicated 504 no-quorum reply)
+    keeps riding ``:info``."""
+
+    #: error substrings that prove the request died before any server
+    #: processed it
+    _NEVER_HAPPENED = ("Connection refused", "HTTP Error 503")
+
+    #: what a 404 read means: the UNSET register — mapped to the
+    #: model's initial value instead of the suite's None, because None
+    #: encodes as NIL ("unknown-value read") which the checker treats
+    #: as unconstrained; a volatile cluster's amnesia (acked writes
+    #: un-written back to the unset state) would then be invisible.
+    #: The live workloads' models init at this value.
+    MISSING = -1
 
     def __init__(self, backend: LiveBackend, node=None,
                  timeout: float = 2.0):
@@ -431,6 +482,16 @@ class _PortedV2Client(etcd_suite.V2Client):
         c = type(self)(self.backend, node, self.timeout)
         c.base = f"http://127.0.0.1:{self.backend.port(test, node)}"
         return c
+
+    def invoke(self, test, op):
+        out = super().invoke(test, op)
+        if out.type == "info" and out.error is not None \
+                and any(s in str(out.error)
+                        for s in self._NEVER_HAPPENED):
+            return replace(out, type="fail")
+        if op.f == "read" and out.type == "ok" and out.value is None:
+            return replace(out, value=self.MISSING)
+        return out
 
     def _url(self, query=None):
         import urllib.parse
@@ -495,10 +556,114 @@ class _PortedDisqueClient(disque_suite.DisqueClient):
         return self.conn
 
 
+class ReplicatedBackend(LiveBackend):
+    """The replicated KV family: a 3-replica etcd-v2 cluster
+    (live/replicated_server.py) — leader lease, majority-ack writes
+    over the loopback wire, follower catch-up from the shared oplog —
+    driven through the etcd suite's ``V2Client`` unchanged, so the
+    partition and kill-restart nemeses exercise *consensus* (elections,
+    quorum loss, catch-up), not just single-node availability.
+
+    Seeded modes: ``replicated_volatile`` (no durable log + elections
+    skip the completeness check: a restarted empty replica can win and
+    un-write acked data — the kill-seeded violation the streaming
+    checker's `:info` lookahead flips mid-stream) and
+    ``replicated_split_brain`` (a leader never steps down: partition
+    it away and it serves stale reads beside its successor)."""
+
+    name = "replicated"
+    base_port = 18500
+    nodes = ["n1", "n2", "n3"]
+
+    def shared_oplog(self, test: dict) -> str:
+        return os.path.join(
+            test.get("data_root", "/tmp/jepsen-live"), "_shared",
+            "replicated-oplog")
+
+    def server_argv(self, test, node):
+        nodes = test["nodes"]
+        ports = [self.port(test, n) for n in nodes]
+        idx = nodes.index(node)
+        argv = [sys.executable, "-m",
+                "jepsen_tpu.live.replicated_server",
+                str(ports[idx]), node_dir(test, node),
+                "--id", str(idx),
+                "--peers", ",".join(str(p) for p in ports),
+                "--oplog", self.shared_oplog(test),
+                "--lease-ms", str(test.get("lease_ms", 700))]
+        if test.get("replicated_volatile"):
+            argv.append("volatile")
+        if test.get("replicated_split_brain"):
+            argv.append("split-brain")
+        return argv
+
+    def build_test(self, opts: dict) -> dict:
+        test = super().build_test(opts)
+        # a fresh cell must not replay a previous run's shared oplog
+        # (node dirs are wiped by teardown; the shared dir is not).
+        # build_test is the ONE safe place to wipe it: exactly once,
+        # before any node starts — a teardown-side wipe would race
+        # the per-node parallel teardown+setup cycle and could unlink
+        # an oplog a freshly started replica already opened
+        import shutil
+
+        shutil.rmtree(os.path.dirname(self.shared_oplog(test)),
+                      ignore_errors=True)
+        return test
+
+    def health_check(self, test, node):
+        import urllib.request
+
+        urllib.request.urlopen(
+            f"http://127.0.0.1:{self.port(test, node)}/_repl/status",
+            timeout=1.0).close()
+
+    def op_node(self, test, op):
+        # clients are bound round-robin to nodes (core.run_case) and a
+        # crashed process id cycles by +concurrency, so the worker's
+        # node is process % concurrency, mod the ring
+        try:
+            conc = int(test.get("concurrency") or 1)
+            return test["nodes"][(int(op.process) % conc)
+                                 % len(test["nodes"])]
+        except (TypeError, ValueError):
+            return None
+
+    def workload(self, opts):
+        rate = opts.get("rate", 25)
+        # seeded cells stage an INVALID crash-heavy history on
+        # purpose; the post-hoc checker gets a tighter budget and no
+        # ddmin shrink there (opts via SEEDED) so an expected-invalid
+        # cell reports in seconds, not minutes — the streamed verdict
+        # is the detection story, the post-hoc one the cross-check
+        # read_weight > 1 biases the mix toward reads — the seeded
+        # kill_all cell uses it so the first op a freshly amnesiac
+        # volatile cluster accepts is very likely a READ of the
+        # forgotten register (the client-visible violation), not a
+        # write that would quietly re-initialize it
+        reads = [etcd_suite.r] * max(1, int(opts.get("read_weight", 1)))
+        model = cas_register(_PortedV2Client.MISSING)
+        return {
+            "client": _PortedV2Client(self),
+            "generator": gen.stagger(
+                1.0 / rate,
+                gen.mix([*reads, etcd_suite.w, etcd_suite.cas])),
+            "model": model,
+            "concurrency": opts.get("concurrency", 6),
+            "checker": checker_mod.compose({
+                "linear": lin.linearizable(
+                    model,
+                    budget=int(opts.get("lin_budget", 20_000_000)),
+                    shrink=opts.get("lin_shrink")),
+                "timeline": timeline.timeline(),
+            }),
+        }
+
+
 #: the campaign's family roster
 FAMILIES: dict[str, LiveBackend] = {
     b.name: b for b in (RegisterBackend(), LockBackend(), KVBackend(),
-                        QueueBackend())
+                        QueueBackend(), ReplicatedBackend())
 }
 
 
@@ -510,14 +675,20 @@ FAMILIES: dict[str, LiveBackend] = {
 class KillRestartNemesis(nemesis_mod.Nemesis):
     """{:f kill | restart, :value [nodes] | None}: kill -9 the real
     server process(es); restart re-runs the daemon start (durable
-    oplogs replay, so acked state survives)."""
+    oplogs replay, so acked state survives).  With ``test["kill_all"]``
+    a valueless kill takes the WHOLE cluster — the correlated
+    power-failure fault replicated families must survive from their
+    durable log alone (and the volatile seeded mode must visibly
+    fail)."""
 
     def __init__(self, db: ProcessDB):
         self.db = db
 
     def invoke(self, test, op):
         if op.f == "kill":
-            nodes = op.value or [random.choice(test["nodes"])]
+            nodes = op.value or (
+                list(test["nodes"]) if test.get("kill_all")
+                else [random.choice(test["nodes"])])
             for n in nodes:
                 self.db.kill(test, n)
             return replace(op, type="info", value=list(nodes))

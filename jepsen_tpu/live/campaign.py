@@ -23,6 +23,7 @@ from __future__ import annotations
 import json
 import logging
 import os
+import threading
 import time
 import traceback
 
@@ -35,11 +36,27 @@ log = logging.getLogger("jepsen")
 
 #: faults the streamed checker should *detect* when crossed with a
 #: volatile backend — the seeded-bug cells (the localnode volatile
-#: lock's double grant under kill -9 is the reference finding)
+#: lock's double grant under kill -9 is the reference finding; the
+#: replicated cells stage consensus-level bugs: a volatile replica
+#: that forgets acked writes across kill -9 and can still win an
+#: election, and a split-brain leader that never steps down)
 SEEDED = {
     ("lock", "kill-restart"): {"lock_volatile": True,
                                "seeded_lock": True, "hold": 4.0,
                                "kill_every": 1.2, "time_limit": 10},
+    ("replicated", "kill-restart"): {"replicated_volatile": True,
+                                     "kill_all": True, "read_weight": 4,
+                                     "kill_every": 2.0, "lease_ms": 400,
+                                     "rate": 20, "concurrency": 4,
+                                     "time_limit": 12,
+                                     "lin_budget": 3_000_000,
+                                     "lin_shrink": False},
+    ("replicated", "partition"): {"replicated_split_brain": True,
+                                  "part_every": 2.0, "lease_ms": 500,
+                                  "rate": 15, "concurrency": 4,
+                                  "time_limit": 10,
+                                  "lin_budget": 3_000_000,
+                                  "lin_shrink": False},
 }
 
 
@@ -116,21 +133,23 @@ def _detection(test: dict, nemesis: str) -> dict | None:
     """Streamed detection latency: the gap between the first injected
     fault and the event where the streaming checker flipped to
     invalid — the metric ROADMAP's streaming phase 2 asks to measure on
-    real crashes."""
+    real crashes.  ``at`` labels *when* the verdict landed:
+    ``"streamed"`` (mid-stream — an online cut, or the bounded `:info`
+    lookahead fork on crash-seeded cells) vs ``"finalize"`` (only the
+    stream's close confirmed it)."""
     sres = test.get("stream_results")
     if not isinstance(sres, dict):
         return None
     st = sres.get("stream") or {}
     inv = st.get("invalid_event")
-    at = "mid-stream"
+    at = "streamed"
     if inv is None:
         if sres.get("valid") is not False:
             return None
-        # a crashed cell suppresses online cuts (an :info op may still
-        # linearize anywhere later), so a kill-seeded violation is
-        # necessarily confirmed when the stream finalizes — record the
-        # detection against the end of the recorded history, honestly
-        # labelled
+        # the violation outran every online cut AND the lookahead
+        # horizon (or lookahead was off/fork-capped): confirmed only
+        # when the stream finalized — record the detection against the
+        # end of the recorded history, honestly labelled
         inv = max(0, int(st.get("events") or 0) - 1)
         at = "finalize"
     hist = test.get("history") or []
@@ -189,8 +208,104 @@ def _recovery(test: dict) -> dict | None:
             "max_s": round(max(deltas), 4)}
 
 
+class _Watchdog:
+    """Per-cell wall-clock watchdog with SIGKILL escalation.
+
+    A wedged backend (a SIGSTOP'd node nobody resumes, a server stuck
+    in D-state on a faulty fs) must degrade ONE cell, never hang the
+    campaign.  Past the budget the watchdog sweeps every ``server.pid``
+    under the cell's data root and escalates per process: SIGCONT (thaw
+    a paused victim so signals can land), SIGTERM, then SIGKILL after a
+    short grace — client ops then fail fast, the generator's time limit
+    drains, and ``core.run`` unwinds normally.  The sweep repeats while
+    the cell is still running, so a nemesis that respawns the wedged
+    process doesn't escape it."""
+
+    def __init__(self, budget_s: float, data_root: str,
+                 grace_s: float = 5.0, resweep_s: float = 10.0):
+        self.budget_s = budget_s
+        self.data_root = data_root
+        self.grace_s = grace_s
+        self.resweep_s = resweep_s
+        self.fired = False
+        self.killed: list[int] = []
+        self._stop = threading.Event()
+        self._t = threading.Thread(target=self._run,
+                                   name="cell-watchdog", daemon=True)
+
+    def start(self) -> "_Watchdog":
+        self._t.start()
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+        self._t.join(timeout=5)
+
+    def _pids(self) -> list[int]:
+        import glob
+
+        pids = []
+        for pf in glob.glob(os.path.join(self.data_root, "*",
+                                         "server.pid")):
+            try:
+                with open(pf) as f:
+                    pids.append(int(f.read().split()[0]))
+            except (OSError, ValueError, IndexError):
+                pass
+        return pids
+
+    def _signal(self, pid: int, sig: int) -> bool:
+        try:
+            os.kill(pid, sig)
+            return True
+        except (OSError, ProcessLookupError):
+            return False
+
+    def _sweep(self) -> None:
+        import signal as _sig
+
+        victims = [p for p in self._pids() if self._signal(p, 0)]
+        if not victims:
+            return
+        log.warning("cell watchdog: budget %.0fs exceeded; escalating "
+                    "on pids %s", self.budget_s, victims)
+        for p in victims:
+            self._signal(p, _sig.SIGCONT)  # thaw: SIGTERM must land
+            self._signal(p, _sig.SIGTERM)
+        self._stop.wait(self.grace_s)
+        for p in victims:
+            if self._signal(p, 0):
+                self._signal(p, _sig.SIGKILL)
+            if p not in self.killed:
+                self.killed.append(p)
+
+    def _run(self) -> None:
+        if self._stop.wait(self.budget_s):
+            return
+        self.fired = True
+        while not self._stop.is_set():
+            try:
+                self._sweep()
+            except Exception:  # noqa: BLE001 — the watchdog never dies
+                log.warning("cell watchdog sweep failed", exc_info=True)
+            if self._stop.wait(self.resweep_s):
+                return
+
+
+def cell_budget(opts: dict) -> float:
+    """The cell's wall-clock budget: the workload time limit plus the
+    harness overheads (node startup health backoffs, heal+final phase,
+    analysis) with generous slack — a cell past this is wedged, not
+    slow."""
+    if opts.get("cell_budget"):
+        return float(opts["cell_budget"])
+    tl = float(opts.get("time_limit", 8))
+    return max(120.0, tl * 10 + 90.0)
+
+
 def run_cell(cell: dict, opts: dict) -> dict:
-    """Execute one suite×nemesis cell end to end; never raises."""
+    """Execute one suite×nemesis cell end to end; never raises.  A
+    wall-clock watchdog (:class:`_Watchdog`) guards the whole cell."""
     from .. import core
 
     out = dict(cell)
@@ -225,6 +340,7 @@ def run_cell(cell: dict, opts: dict) -> dict:
     if copts.get("audit", True):
         os.environ["JEPSEN_TPU_AUDIT"] = "1"
     t0 = time.monotonic()
+    wd = _Watchdog(cell_budget(copts), copts["data_root"]).start()
     try:
         try:
             test = core.run(assemble(backend, entry, copts))
@@ -251,6 +367,10 @@ def run_cell(cell: dict, opts: dict) -> dict:
             out["traceback"] = traceback.format_exc()[-2000:]
             return out
     finally:
+        wd.stop()
+        if wd.fired:
+            out["watchdog"] = {"fired": True, "budget_s": wd.budget_s,
+                               "killed": list(wd.killed)}
         if copts.get("audit", True):
             if prev_audit is None:
                 os.environ.pop("JEPSEN_TPU_AUDIT", None)
@@ -285,24 +405,92 @@ def run_cell(cell: dict, opts: dict) -> dict:
     return out
 
 
+def _cell_key(cell: dict) -> tuple:
+    return (cell["family"], cell["nemesis"], bool(cell.get("seeded")))
+
+
+def completed_cells(d: str) -> dict[tuple, dict]:
+    """The terminal outcomes already recorded in a campaign dir's
+    ``cells.jsonl`` (crash-safe: each line was flushed as its cell
+    finished) — what ``--resume`` skips.  Later lines win (a re-run
+    supersedes its predecessor), and outcomes the retry policy calls
+    *retryable harness errors* (:func:`_retryable`) are dropped: a
+    campaign killed right after a transient failure resumes by
+    re-running that cell, not by baking the failure into the record."""
+    out: dict[tuple, dict] = {}
+    try:
+        with open(os.path.join(d, "cells.jsonl")) as f:
+            for line in f:
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    o = json.loads(line)
+                    key = _cell_key(o)
+                except (ValueError, KeyError):
+                    continue
+                if _retryable(o, o):
+                    out.pop(key, None)
+                else:
+                    out[key] = o
+    except OSError:
+        pass
+    return out
+
+
+def _retryable(cell: dict, outcome: dict) -> bool:
+    """Harness errors retry; verdicts never do.  A ``failed`` cell hit
+    an unexpected harness exception; a runtime ``skipped`` (the plan
+    predicted runnable but the backend/control plane balked — port
+    squatting, fork pressure) is transient on a loaded host.  A
+    planned skip (capability probe) and every real verdict are
+    terminal."""
+    if outcome.get("status") == "failed":
+        return True
+    return outcome.get("status") == "skipped" and cell.get("skip") is None
+
+
 def run_campaign(opts: dict | None = None,
                  families: list[str] | None = None,
                  nemeses: list[str] | None = None,
                  *, seeded: bool = True,
-                 progress=None) -> dict:
+                 progress=None, resume: bool = False) -> dict:
     """Run the whole matrix; returns (and persists) the campaign
-    record.  ``progress(cell_outcome)`` is called per finished cell."""
+    record.  ``progress(cell_outcome)`` is called per finished cell.
+
+    Self-healing contract: every cell runs under a wall-clock watchdog
+    (:func:`run_cell`), a cell that fails on a *harness* error is
+    retried up to ``opts["cell_retries"]`` times (default 1 retry;
+    verdicts are never retried), and ``resume=True`` (with
+    ``opts["campaign_id"]`` naming an interrupted campaign) skips every
+    cell already recorded in its ``cells.jsonl`` — a killed campaign
+    resumes to completion without re-running finished cells."""
     opts = dict(opts or {})
     opts.setdefault("time_limit", 8)
     cells = plan(families, nemeses, opts, seeded=seeded)
     d = campaign_dir(opts)
     os.makedirs(d, exist_ok=True)
     cells_path = os.path.join(d, "cells.jsonl")
+    done = completed_cells(d) if resume else {}
+    retries = max(0, int(opts.get("cell_retries", 1)))
 
     outcomes = []
     with open(cells_path, "a") as fh:
         for cell in cells:
-            outcome = run_cell(cell, opts)
+            prior = done.get(_cell_key(cell))
+            if prior is not None:
+                prior = dict(prior)
+                prior["resumed"] = True
+                outcomes.append(prior)
+                continue
+            for attempt in range(1 + retries):
+                outcome = run_cell(cell, opts)
+                outcome["attempts"] = attempt + 1
+                if not _retryable(cell, outcome) or attempt >= retries:
+                    break
+                log.warning("cell %s×%s attempt %d failed (%s); "
+                            "retrying", cell["family"], cell["nemesis"],
+                            attempt + 1, outcome.get("reason"))
             outcomes.append(outcome)
             fh.write(json.dumps(
                 {k: v for k, v in outcome.items()
@@ -319,11 +507,15 @@ def run_campaign(opts: dict | None = None,
         "started": opts.get("campaign_id") or os.path.basename(d),
         "families": sorted({c["family"] for c in cells}),
         "nemeses": sorted({c["nemesis"] for c in cells}),
+        "resumed_cells": sum(1 for o in outcomes if o.get("resumed")),
         "cells": outcomes,
         "summary": {
             **by_status,
             "detected": sum(1 for o in outcomes
                             if o.get("valid") is False),
+            "streamed_detections": sum(
+                1 for o in outcomes
+                if (o.get("detection") or {}).get("at") == "streamed"),
             "audited_ok": sum(1 for o in outcomes
                               if (o.get("audit") or {}).get("ok")),
         },

@@ -1,0 +1,593 @@
+"""live replicated KV node — a 3-replica etcd-v2 cluster, for real.
+
+One logical node of the live **replicated** family: a REAL OS process
+serving the same etcd **v2 keys surface** as ``live/kv_server.py``
+(`GET/PUT /v2/keys/<k>` with ``prevValue`` CAS — the wire protocol the
+etcd suite's ``V2Client`` already speaks), but as one replica of a
+small consensus group, so the kill-restart and partition nemeses bite
+*consensus*, not just availability:
+
+  * **leader lease** — one node at a time holds a time-bounded lease
+    granted by a majority.  Followers refuse to vote while they honor
+    a live leader, and the leader serves with a safety margin
+    (``LEADER_MARGIN``) of the lease the followers honor, so a
+    deposed leader stops serving *before* its successor starts — the
+    stale-leader-read window is closed by construction (up to clock
+    rate skew past the margin, which the clock nemesis probes).
+  * **majority-ack writes** — the leader appends the entry to the
+    shared oplog (durable, fsync — the commit record), then
+    replicates it to every peer over the loopback wire and replies OK
+    only once a majority (itself included) acknowledged.  A write
+    that can't reach a majority returns 500, which ``V2Client`` maps
+    to ``:info`` — exactly the "maybe happened" the checker models.
+  * **follower catch-up from the shared oplog** — replica state is a
+    replay of the shared oplog prefix.  A restarted (or gapped)
+    follower re-reads the oplog tail; a freshly elected leader
+    catches up *before* serving, so an un-acked entry a crashed
+    leader left in the log is adopted consistently by everyone
+    (it was ``:info``: "took effect" is legal).
+
+Seeded-bug modes, the campaign's detection targets:
+
+  ``volatile``     mutations skip the shared oplog and elections skip
+                   the log-completeness check: a kill -9'd leader
+                   restarts empty, can win the next election, and
+                   serves reads that un-write acked data — the
+                   kill-seeded violation the streaming checker's
+                   bounded `:info` lookahead must flip mid-stream.
+  ``split-brain``  a leader never steps down and serves reads without
+                   a live lease: partition it away (or pause it past
+                   its lease) and it keeps answering from stale state
+                   while the majority elects a successor — two
+                   leaders, client-visible stale reads.
+
+Status mapping on the client surface is kv_server's, plus:
+
+  not the leader / no leader known  -> 503 {"errorCode": 300}
+                                       (REJECTED before any mutation:
+                                       the op definitely didn't happen)
+  no quorum after the oplog append  -> 504 {"errorCode": 301}
+                                       (INDETERMINATE: a successor may
+                                       adopt the entry — the client
+                                       must record :info)
+
+Internal peer surface (loopback only, same port):
+
+  GET  /_repl/status                     -> role/term/seq/leader json
+  GET  /_repl/vote?term=T&cand=I&seq=S   -> {"granted": bool, ...}
+  GET  /_repl/ping?term=T&leader=I       -> {"granted": bool, ...}
+  POST /_repl/append   {entry json}      -> {"seq": applied}
+
+Stdlib-only on purpose (plus live.oplog, itself stdlib-only): a
+replica forks at daemon startup and must not drag the checker stack.
+
+Usage::
+
+  python -m jepsen_tpu.live.replicated_server PORT DATA_DIR \
+      --id I --peers P1,P2,P3 --oplog PATH [--lease-ms MS] \
+      [volatile] [split-brain]
+"""
+
+from __future__ import annotations
+
+import json
+import random
+import sys
+import threading
+import time
+import urllib.error
+import urllib.parse
+import urllib.request
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+PREFIX = "/v2/keys/"
+
+#: the fraction of the follower-honored lease a leader trusts for its
+#: own serving — the stale-read window survives only a clock *rate*
+#: skew larger than 1/LEADER_MARGIN (2x at 0.5)
+LEADER_MARGIN = 0.5
+
+
+class Replica:
+    """One replica's state machine + consensus bookkeeping."""
+
+    def __init__(self, node_id: int, peers: list[int], oplog_path: str,
+                 lease_s: float = 0.7, volatile: bool = False,
+                 split_brain: bool = False):
+        import os
+
+        from .oplog import DurableLog
+
+        self.id = node_id
+        self.peers = peers  # ports, index == node id; includes self
+        self.lease_s = lease_s
+        self.volatile = volatile
+        self.split_brain = split_brain
+
+        self.lock = threading.RLock()
+        self.state: dict[str, str] = {}
+        self.seq = 0          # last applied entry seq
+        self.term = 0         # highest term seen
+        self.role = "follower"
+        self.leader_id: int | None = None
+        # the election timer starts NOW (not at epoch 0): the id
+        # stagger in _election_timeout differentiates who campaigns
+        # first, instead of every fresh replica dueling on tick one
+        self.lease_until = time.monotonic()
+        self.granted_term = 0    # highest term this node voted in
+
+        self.log = DurableLog(os.path.dirname(oplog_path) or ".",
+                              name=os.path.basename(oplog_path),
+                              volatile=volatile)
+        self._catch_up_locked()
+        self.log.open()
+        self._stop = threading.Event()
+        self._ticker = threading.Thread(target=self._tick_loop,
+                                        name="repl-tick", daemon=True)
+
+    # -- log replay / catch-up ----------------------------------------
+
+    def _apply_locked(self, e: dict) -> None:
+        self.state[e["k"]] = e["v"]
+        self.seq = e["seq"]
+
+    def _catch_up_locked(self) -> int:
+        """Replay every shared-oplog entry past the applied prefix —
+        restart recovery AND gap repair use the same path."""
+        applied = 0
+        for line in self.log.replay():
+            try:
+                e = json.loads(line)
+            except ValueError:
+                continue
+            if e.get("op") == "set" and int(e.get("seq", 0)) > self.seq:
+                self._apply_locked(e)
+                applied += 1
+        return applied
+
+    # -- lease / election ---------------------------------------------
+
+    def start(self) -> None:
+        self._ticker.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+
+    def _majority(self) -> int:
+        return len(self.peers) // 2 + 1
+
+    def _peer_get(self, port: int, path: str, timeout: float = 0.4):
+        url = f"http://127.0.0.1:{port}{path}"
+        with urllib.request.urlopen(url, timeout=timeout) as r:
+            return json.loads(r.read() or b"{}")
+
+    def _election_timeout(self) -> float:
+        # staggered by id so replicas don't duel; ~1.5-2.5 leases
+        return self.lease_s * (1.5 + 0.35 * self.id)
+
+    def _tick_loop(self) -> None:
+        while not self._stop.wait(self.lease_s / 4.0):
+            try:
+                self._tick()
+            except Exception:  # noqa: BLE001 — the loop must survive
+                pass
+
+    def _tick(self) -> None:
+        now = time.monotonic()
+        with self.lock:
+            role, term = self.role, self.term
+            expired = now > self.lease_until
+        if role == "leader":
+            if expired and not self.split_brain:
+                with self.lock:
+                    if self.role == "leader" \
+                            and time.monotonic() > self.lease_until:
+                        self.role = "follower"
+                        self.leader_id = None
+                return
+            self._heartbeat(term)
+        elif expired and now - self.lease_until > \
+                self._election_timeout() - self.lease_s:
+            self._campaign()
+
+    def _heartbeat(self, term: int) -> None:
+        acks = 1
+        with self.lock:
+            seq = self.seq
+        for i, port in enumerate(self.peers):
+            if i == self.id:
+                continue
+            try:
+                out = self._peer_get(
+                    port, f"/_repl/ping?term={term}&leader={self.id}"
+                          f"&seq={seq}")
+                if out.get("granted"):
+                    acks += 1
+            except OSError:
+                pass
+        if acks >= self._majority():
+            with self.lock:
+                if self.role == "leader" and self.term == term:
+                    # followers honor lease_s from *their* grant; the
+                    # leader trusts only the margin of it
+                    self.lease_until = time.monotonic() \
+                        + self.lease_s * LEADER_MARGIN
+
+    def _campaign(self) -> None:
+        with self.lock:
+            # a candidate first catches up from the shared oplog, so a
+            # won election never resurrects a stale seq (durable mode)
+            self._catch_up_locked()
+            self.term += 1
+            term, seq = self.term, self.seq
+            self.granted_term = term  # self-vote
+        votes = 1
+        for i, port in enumerate(self.peers):
+            if i == self.id:
+                continue
+            try:
+                out = self._peer_get(
+                    port,
+                    f"/_repl/vote?term={term}&cand={self.id}&seq={seq}")
+                if out.get("granted"):
+                    votes += 1
+            except OSError:
+                pass
+        if votes >= self._majority():
+            with self.lock:
+                if self.term == term:
+                    self.role = "leader"
+                    self.leader_id = self.id
+                    self.lease_until = time.monotonic() \
+                        + self.lease_s * LEADER_MARGIN
+            self._heartbeat(term)
+        else:
+            with self.lock:
+                if self.role != "leader":
+                    # lost: back off the election timer (jittered, id-
+                    # staggered) instead of re-campaigning every tick
+                    # and ratcheting terms into a permanent duel
+                    self.lease_until = time.monotonic() + self.lease_s \
+                        * (0.3 + 0.3 * self.id + 0.4 * random.random())
+
+    # -- peer surface --------------------------------------------------
+
+    def on_ping(self, term: int, leader: int,
+                leader_seq: int = 0) -> dict:
+        with self.lock:
+            if term < self.term:
+                return {"granted": False, "term": self.term}
+            if term > self.term or self.role != "leader":
+                if self.role == "leader" and self.split_brain:
+                    # the seeded defect: never concede leadership
+                    return {"granted": False, "term": self.term}
+                self.term = term
+                self.role = "follower"
+                self.leader_id = leader
+                self.lease_until = time.monotonic() + self.lease_s
+                if leader_seq > self.seq:
+                    # an idle cluster still converges: a healed
+                    # minority catches up from the shared oplog on the
+                    # next heartbeat, not only on the next write
+                    self._catch_up_locked()
+                return {"granted": True, "term": self.term,
+                        "seq": self.seq}
+            # same-term second leader can't exist (majority vote), so
+            # this is our own echo shape — grant
+            self.lease_until = time.monotonic() + self.lease_s
+            return {"granted": True, "term": self.term, "seq": self.seq}
+
+    def on_vote(self, term: int, cand: int, cand_seq: int) -> dict:
+        with self.lock:
+            fresh_leader = time.monotonic() < self.lease_until \
+                and self.leader_id is not None \
+                and self.leader_id != cand
+            if term <= self.granted_term or term < self.term:
+                return {"granted": False, "term": self.term}
+            if fresh_leader and not self.volatile:
+                # don't vote while honoring a live leader — the lease
+                # safety rule that closes the two-leader window
+                return {"granted": False, "term": self.term}
+            if not self.volatile and cand_seq < self.seq:
+                # log completeness: a data-losing candidate loses.
+                # volatile mode SKIPS this — the seeded bug: a freshly
+                # restarted empty node can win and un-write acked data
+                return {"granted": False, "term": self.term,
+                        "seq": self.seq}
+            self.granted_term = term
+            self.term = max(self.term, term)
+            if self.role == "leader" and not self.split_brain:
+                self.role = "follower"
+            self.leader_id = None  # until the winner heartbeats
+            # give the winner a full lease to establish itself before
+            # this granter's own election timer can fire
+            self.lease_until = time.monotonic() + self.lease_s
+            return {"granted": True, "term": self.term}
+
+    def on_append(self, e: dict) -> tuple[int, dict]:
+        term = int(e.get("term", 0))
+        with self.lock:
+            if term < self.term:
+                return 409, {"term": self.term}
+            if self.role == "leader" and self.split_brain \
+                    and int(e.get("leader", -1)) != self.id:
+                # the seeded defect, fully symmetric: a split-brain
+                # leader not only keeps serving, it refuses a rival's
+                # entries — its side of the brain stays frozen
+                return 409, {"term": self.term}
+            self.term = term
+            self.leader_id = int(e.get("leader", -1))
+            if self.role == "leader" and self.leader_id != self.id \
+                    and not self.split_brain:
+                self.role = "follower"
+            self.lease_until = time.monotonic() + self.lease_s
+            seq = int(e["seq"])
+            if seq == self.seq + 1:
+                self._apply_locked(e)
+            elif seq > self.seq:
+                self._catch_up_locked()
+                if seq == self.seq + 1 or (self.volatile
+                                           and seq > self.seq):
+                    # volatile: nothing durable to catch up from —
+                    # blind adoption keeps the cluster moving and
+                    # plants exactly the ghost-state divergence the
+                    # checker exists to catch
+                    self._apply_locked(e)
+            return 200, {"seq": self.seq}
+
+    # -- client surface (leader path) ---------------------------------
+
+    def leader_serving(self) -> bool:
+        with self.lock:
+            return self.role == "leader" and (
+                self.split_brain
+                or time.monotonic() < self.lease_until)
+
+    def get(self, key: str) -> tuple[int, dict]:
+        if not self.leader_serving():
+            return 503, {"errorCode": 300, "message": "not leader"}
+        with self.lock:
+            v = self.state.get(key)
+        if v is None:
+            return 404, {"errorCode": 100, "message": "Key not found",
+                         "cause": key}
+        return 200, {"action": "get",
+                     "node": {"key": f"/{key}", "value": v}}
+
+    def put(self, key: str, value: str,
+            prev: str | None = None) -> tuple[int, dict]:
+        if not self.leader_serving():
+            return 503, {"errorCode": 300, "message": "not leader"}
+        with self.lock:
+            if not self.leader_serving():
+                return 503, {"errorCode": 300, "message": "not leader"}
+            if prev is not None:
+                cur = self.state.get(key)
+                if cur is None:
+                    return 404, {"errorCode": 100,
+                                 "message": "Key not found",
+                                 "cause": key}
+                if cur != prev:
+                    return 412, {"errorCode": 101,
+                                 "message": "Compare failed",
+                                 "cause": f"[{prev} != {cur}]"}
+            entry = {"op": "set", "seq": self.seq + 1, "term": self.term,
+                     "leader": self.id, "k": key, "v": value}
+            # the commit record first (durable before any ack can
+            # exist), then the wire — under the lock: the
+            # linearization point of an acked write is in here
+            self.log.append(json.dumps(entry))
+            acks = 1
+            for i, port in enumerate(self.peers):
+                if i == self.id:
+                    continue
+                try:
+                    data = json.dumps(entry).encode()
+                    req = urllib.request.Request(
+                        f"http://127.0.0.1:{port}/_repl/append",
+                        data=data, method="POST",
+                        headers={"Content-Type": "application/json"})
+                    with urllib.request.urlopen(req, timeout=0.5):
+                        acks += 1
+                except OSError:
+                    pass
+            if acks < self._majority():
+                # the entry is in the shared log — a successor will
+                # adopt it — but THIS client gets indeterminacy (504,
+                # NOT 503: a 503 means "definitely didn't happen")
+                return 504, {"errorCode": 301, "message": "no quorum"}
+            self._apply_locked(entry)
+            return 200, {"action": "compareAndSwap" if prev is not None
+                         else "set",
+                         "node": {"key": f"/{key}", "value": value}}
+
+    def status(self) -> dict:
+        with self.lock:
+            return {"id": self.id, "role": self.role, "term": self.term,
+                    "seq": self.seq, "leader": self.leader_id,
+                    "lease_remaining_s": round(
+                        self.lease_until - time.monotonic(), 3),
+                    "volatile": self.volatile,
+                    "split_brain": self.split_brain}
+
+
+class Handler(BaseHTTPRequestHandler):
+    protocol_version = "HTTP/1.1"
+
+    def log_message(self, fmt, *args):  # quiet
+        pass
+
+    def _reply(self, status: int, body: dict) -> None:
+        data = json.dumps(body).encode()
+        self.send_response(status)
+        self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Length", str(len(data)))
+        self.end_headers()
+        self.wfile.write(data)
+
+    def _key(self, parsed) -> str | None:
+        if not parsed.path.startswith(PREFIX):
+            return None
+        return urllib.parse.unquote(parsed.path[len(PREFIX):]) or None
+
+    # -- proxy: follower forwards client ops to its leader ------------
+
+    def _proxy(self, rep: Replica, body: bytes | None) -> bool:
+        """Forward this request to the believed leader; False when no
+        usable leader (caller replies 503).  A proxied request is never
+        re-proxied (X-Repl-Proxied), so confused views can't loop."""
+        if self.headers.get("X-Repl-Proxied"):
+            return False
+        with rep.lock:
+            lid = rep.leader_id
+        if lid is None or lid == rep.id:
+            return False
+        url = f"http://127.0.0.1:{rep.peers[lid]}{self.path}"
+        req = urllib.request.Request(
+            url, data=body, method=self.command,
+            headers={"X-Repl-Proxied": "1",
+                     "Content-Type": self.headers.get(
+                         "Content-Type") or "application/octet-stream"})
+        try:
+            with urllib.request.urlopen(req, timeout=1.5) as r:
+                self._reply(r.status, json.loads(r.read() or b"{}"))
+                return True
+        except urllib.error.HTTPError as e:
+            try:
+                body = json.loads(e.read() or b"{}")
+            except ValueError:
+                body = {"errorCode": 301, "message": "proxy error"}
+            self._reply(e.code, body)
+            return True
+        except urllib.error.URLError as e:
+            if isinstance(getattr(e, "reason", None),
+                          ConnectionRefusedError):
+                # nothing accepted the forwarded bytes: the op
+                # definitely didn't happen — safe to fall back to the
+                # caller's 503
+                return False
+            # anything else (timeout, reset, ...) may have fired AFTER
+            # the leader processed the op — indeterminate, never
+            # "didn't happen" (a 503 would let the client record :fail
+            # for a write that actually committed: a false violation)
+            self._reply(504, {"errorCode": 301,
+                              "message": "proxy indeterminate"})
+            return True
+        except ConnectionRefusedError:
+            return False
+        except (OSError, ValueError):
+            # includes a malformed 200 body: the leader PROCESSED the
+            # op — indeterminate
+            self._reply(504, {"errorCode": 301,
+                              "message": "proxy indeterminate"})
+            return True
+
+    # -- HTTP dispatch -------------------------------------------------
+
+    def do_GET(self):  # noqa: N802 (stdlib API)
+        rep: Replica = self.server.replica
+        parsed = urllib.parse.urlparse(self.path)
+        q = urllib.parse.parse_qs(parsed.query)
+        if parsed.path == "/_repl/status":
+            self._reply(200, rep.status())
+            return
+        if parsed.path == "/_repl/ping":
+            self._reply(200, rep.on_ping(
+                int(q["term"][0]), int(q["leader"][0]),
+                int(q.get("seq", ["0"])[0])))
+            return
+        if parsed.path == "/_repl/vote":
+            self._reply(200, rep.on_vote(int(q["term"][0]),
+                                         int(q["cand"][0]),
+                                         int(q["seq"][0])))
+            return
+        key = self._key(parsed)
+        if key is None:
+            self._reply(404, {"errorCode": 100, "message": "bad path"})
+            return
+        status, body = rep.get(key)
+        if status == 503 and self._proxy(rep, None):
+            return
+        self._reply(status, body)
+
+    def do_POST(self):  # noqa: N802 (stdlib API)
+        rep: Replica = self.server.replica
+        parsed = urllib.parse.urlparse(self.path)
+        n = int(self.headers.get("Content-Length") or 0)
+        raw = self.rfile.read(n)
+        if parsed.path == "/_repl/append":
+            try:
+                status, body = rep.on_append(json.loads(raw))
+            except (ValueError, KeyError):
+                status, body = 400, {"message": "bad entry"}
+            self._reply(status, body)
+            return
+        self._reply(404, {"errorCode": 100, "message": "bad path"})
+
+    def do_PUT(self):  # noqa: N802 (stdlib API)
+        rep: Replica = self.server.replica
+        parsed = urllib.parse.urlparse(self.path)
+        key = self._key(parsed)
+        if key is None:
+            self._reply(404, {"errorCode": 100, "message": "bad path"})
+            return
+        n = int(self.headers.get("Content-Length") or 0)
+        raw = self.rfile.read(n)
+        try:
+            form = urllib.parse.parse_qs(raw.decode("utf-8", "replace"))
+            value = form["value"][0]
+        except (ValueError, KeyError, IndexError):
+            self._reply(400, {"errorCode": 209, "message": "bad form"})
+            return
+        prev = urllib.parse.parse_qs(parsed.query).get(
+            "prevValue", [None])[0]
+        status, body = rep.put(key, value, prev)
+        if status == 503 and self._proxy(rep, raw):
+            return
+        self._reply(status, body)
+
+
+class Server(ThreadingHTTPServer):
+    allow_reuse_address = True  # rebind fast after kill -9
+    daemon_threads = True
+
+
+def main(argv=None) -> None:
+    argv = list(sys.argv[1:] if argv is None else argv)
+    flags = {"volatile": False, "split-brain": False}
+    opts = {"--id": None, "--peers": None, "--oplog": None,
+            "--lease-ms": "700"}
+    pos: list[str] = []
+    i = 0
+    while i < len(argv):
+        a = argv[i]
+        if a in flags:
+            flags[a] = True
+        elif a in opts and i + 1 < len(argv):
+            opts[a] = argv[i + 1]
+            i += 1
+        else:
+            pos.append(a)
+        i += 1
+    if len(pos) != 2 or opts["--id"] is None or opts["--peers"] is None \
+            or opts["--oplog"] is None:
+        print("usage: replicated_server PORT DATA_DIR --id I "
+              "--peers P1,P2,.. --oplog PATH [--lease-ms MS] "
+              "[volatile] [split-brain]", file=sys.stderr)
+        raise SystemExit(2)
+    port = int(pos[0])
+    peers = [int(x) for x in opts["--peers"].split(",") if x.strip()]
+    rep = Replica(int(opts["--id"]), peers, opts["--oplog"],
+                  lease_s=int(opts["--lease-ms"]) / 1000.0,
+                  volatile=flags["volatile"],
+                  split_brain=flags["split-brain"])
+    srv = Server(("127.0.0.1", port), Handler)
+    srv.replica = rep
+    rep.start()
+    print(f"replicated_server: id={rep.id} listening on "
+          f"127.0.0.1:{port}", flush=True)
+    srv.serve_forever()
+
+
+if __name__ == "__main__":
+    main()

@@ -38,6 +38,9 @@ class Backoff:
     max_attempts: int = 8
     jitter: float = 0.5
     rng: random.Random = field(default_factory=random.Random)
+    #: stateful cursor for step()/exhausted() loops (health monitors);
+    #: run() keeps its own per-call counter and ignores this
+    attempt: int = field(default=0, init=False, compare=False)
 
     def raw_delay(self, attempt: int) -> float:
         """The un-jittered delay before retry ``attempt`` (0-based)."""
@@ -56,6 +59,33 @@ class Backoff:
         """Worst-case total sleep time across the budget (no jitter)."""
         return sum(self.raw_delay(i)
                    for i in range(max(0, self.max_attempts - 1)))
+
+    # -- the stateful schedule (continuous health loops) ---------------
+
+    def step(self) -> float:
+        """The next delay in the STATEFUL schedule; the cursor
+        advances.  A monitor loop sleeps ``step()`` after each failed
+        probe and calls :meth:`reset` after each success, so a node
+        that recovers then re-fails starts from the base delay — not
+        the capped one it had ratcheted to."""
+        d = self.delay(self.attempt)
+        self.attempt += 1
+        return d
+
+    def exhausted(self) -> bool:
+        """Has the stateful cursor spent the schedule's sleep budget
+        (``max_attempts - 1`` sleeps — the same budget :meth:`run`
+        spends across its ``max_attempts`` calls)?  A bounded loop
+        checks this after each failed probe; :meth:`reset` re-arms.
+        An exhausted-but-unreset Backoff makes later loops fail FAST
+        (one probe, no re-ramp) until a success resets it — the
+        self-healing campaign wants a permanently dead node to cost
+        one probe per restart attempt, not a full ramp."""
+        return self.attempt >= max(1, self.max_attempts) - 1
+
+    def reset(self) -> None:
+        """Re-arm the stateful schedule (successful health check)."""
+        self.attempt = 0
 
     def run(self, fn: Callable[[], Any], *, desc: str = "retry",
             sleep: Callable[[float], None] = time.sleep):

@@ -55,6 +55,25 @@ def main(argv=None) -> int:
                         "many lines behind, further lines are shed "
                         "with an 'overloaded' reply instead of "
                         "stalling the socket.")
+    p.add_argument("--info-lookahead", type=int, default=None,
+                   metavar="N",
+                   help="Bounded :info lookahead horizon: after N "
+                        "post-crash ok ops at a pseudo-quiescent "
+                        "point, speculatively fork-check the crashed "
+                        "segment so kill-seeded violations flip the "
+                        "live verdict mid-stream (default: "
+                        "analyze.plan.STREAM_INFO_LOOKAHEAD; 0 "
+                        "disables — finalize-only).")
+    p.add_argument("--persist-dir", metavar="DIR", default=None,
+                   help="Persist each run's live snapshot and final "
+                        "verdict to DIR/<run>.json — a run whose "
+                        "connection drops mid-history still leaves "
+                        "its prefix verdict on disk.")
+    p.add_argument("--idle-timeout", type=float, default=None,
+                   metavar="S",
+                   help="Reap (finalize) runs silent for S seconds: a "
+                        "vanished client can't pin an open checker "
+                        "forever.  Default: never.")
     args = p.parse_args(argv)
     logging.basicConfig(level=logging.WARNING)
 
@@ -80,8 +99,11 @@ def main(argv=None) -> int:
                           witness=not args.no_witness,
                           audit=True if args.audit else None,
                           host_fold_max=args.host_fold_max,
+                          info_lookahead=args.info_lookahead,
                           op_budget=args.op_budget,
-                          ingest_max=args.ingest_queue)
+                          ingest_max=args.ingest_queue,
+                          persist_dir=args.persist_dir,
+                          idle_timeout=args.idle_timeout)
         print(f"stream service listening on "
               f"{srv.server_address[0]}:{srv.server_address[1]}",
               file=sys.stderr, flush=True)
@@ -95,7 +117,10 @@ def main(argv=None) -> int:
                             witness=not args.no_witness,
                             audit=True if args.audit else None,
                             host_fold_max=args.host_fold_max,
-                            op_budget=args.op_budget)
+                            info_lookahead=args.info_lookahead,
+                            op_budget=args.op_budget,
+                            persist_dir=args.persist_dir,
+                            idle_timeout=args.idle_timeout)
     serve_stdio(service, sys.stdin, sys.stdout,
                 ingest_max=args.ingest_queue)
     return 0

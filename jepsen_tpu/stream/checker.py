@@ -133,6 +133,8 @@ class _Cell:
         self.rows: list[_Row] = []  # retained rows of CLOSED segments
         self.pending = 0  # invoked, completion still unknown
         self.crashed = False  # an :info row suppresses all later cuts
+        self.ok_in_buf = 0  # post-crash :ok rows (lookahead cadence)
+        self.la_checked = 0  # ok_in_buf at the last speculative check
         self.states: set = {tuple(init_state)}
         # state -> cell-row chain reaching it; None once any stage drops
         self.chains: dict | None = {tuple(init_state): []} if witness \
@@ -155,6 +157,18 @@ class StreamChecker:
     sub_max_configs  per-sub-search budget, as the decomposed engine
     host_fold_max    override for the plan gate's host-fold cost cap
                      (``analyze.plan.segment_fold_route``)
+    info_lookahead   bounded `:info` lookahead horizon: after this many
+                     post-crash :ok rows accumulate at a pseudo-
+                     quiescent point, the crashed cell's open segment
+                     is speculatively fork-checked (each `:info` op
+                     present at any frontier position vs absent) so a
+                     kill-seeded violation flips the live verdict
+                     mid-stream.  None = the plan default
+                     (``analyze.plan.STREAM_INFO_LOOKAHEAD``); 0
+                     disables (finalize-only).  Final verdicts are
+                     identical either way: a speculative invalid is
+                     sound (every fork fails, so no suffix can repair
+                     the prefix), and anything else changes nothing.
     device_budget    config budget per device dispatch
     live_path        when set, a JSON snapshot of :meth:`verdict` is
                      rewritten there (atomically) as the stream moves —
@@ -167,9 +181,11 @@ class StreamChecker:
                  async_folds: bool = False,
                  sub_max_configs: int = 50_000_000,
                  host_fold_max: int | None = None,
+                 info_lookahead: int | None = None,
                  device_budget: int = 2_000_000,
                  live_path: str | None = None,
                  run_id: str | None = None):
+        from ..analyze.plan import STREAM_INFO_LOOKAHEAD
         from ..decompose.cache import VerdictCache
 
         self.model = model
@@ -184,6 +200,8 @@ class StreamChecker:
         self.witness = witness
         self.sub_max_configs = sub_max_configs
         self.host_fold_max = host_fold_max
+        self.info_lookahead = STREAM_INFO_LOOKAHEAD \
+            if info_lookahead is None else max(0, int(info_lookahead))
         self.device_budget = device_budget
         self.live_path = live_path
         self.run_id = run_id
@@ -226,7 +244,7 @@ class StreamChecker:
         self._seq: OpSeq | None = None
         self._stats = {"segments": 0, "configs_searched": 0,
                        "routes": {"host": 0, "device": 0},
-                       "checked_rows": 0}
+                       "checked_rows": 0, "lookahead_checks": 0}
         self._methods: set = set()
         self._drops = {"witness": None, "frontier": None}
         if not witness:
@@ -420,6 +438,15 @@ class StreamChecker:
             # verdict-neutral, so dropping it is exact
         else:  # fail: definitely didn't happen — drop the row
             row.status = "fail"
+        c2 = self._cells.get(row.cell_key) \
+            if row.cell_key not in ("__bad__", "__float__") else None
+        if c2 is not None:
+            if ctype == OK and c2.crashed:
+                # the lookahead cadence counts POST-crash completions
+                # only — the same basis stream_plan's
+                # ``speculative_checks`` prediction uses
+                c2.ok_in_buf += 1
+            self._maybe_lookahead(c2)
 
     # ------------------------------------------------------------------
     # segment folding
@@ -428,11 +455,13 @@ class StreamChecker:
     def _close_segment(self, cell: _Cell) -> None:
         retained = [r for r in cell.buf if r.status == "ok"]
         cell.buf = []
+        cell.ok_in_buf = 0
+        cell.la_checked = 0
         for r in retained:
             r.cell_pos = len(cell.rows)
             cell.rows.append(r)
         if self._q is not None:
-            self._q.put((cell, retained))
+            self._q.put(("fold", cell, retained))
         else:
             self._fold(cell, retained)
 
@@ -441,9 +470,18 @@ class StreamChecker:
             task = self._q.get()
             if task is None:
                 return
-            cell, retained = task
+            kind, cell, rows = task
+            if kind == "spec":
+                try:
+                    self._speculate(cell, rows)
+                except Exception:  # noqa: BLE001 — speculation must
+                    # never degrade the stream; finalize still decides
+                    log.debug("stream: lookahead check crashed",
+                              exc_info=True)
+                self._maybe_write_live()
+                continue
             try:
-                self._fold(cell, retained)
+                self._fold(cell, rows)
             except Exception:  # noqa: BLE001 — one segment, not the run
                 log.warning("stream: segment fold crashed; falling back",
                             exc_info=True)
@@ -555,6 +593,80 @@ class StreamChecker:
             elif self._first_verdict_event is None:
                 self._first_verdict_event = self._events - 1
 
+    # ------------------------------------------------------------------
+    # bounded `:info` lookahead (speculative fork check)
+    # ------------------------------------------------------------------
+
+    def _maybe_lookahead(self, cell: _Cell) -> None:
+        """Schedule a speculative fork check of a crashed cell's open
+        segment once a horizon's worth of post-crash :ok rows has
+        accumulated at a pseudo-quiescent point (nothing pending, no
+        floating keys) — the bounded-lookahead cut that lets a
+        kill-seeded violation flip the live verdict mid-stream even
+        though the `:info` op suppresses real quiescence cuts."""
+        h = self.info_lookahead
+        if not h or not cell.crashed or cell.pending != 0 \
+                or self._floating_n != 0 or self._invalid is not None \
+                or self._fallback or cell.fallback:
+            return
+        if cell.ok_in_buf - cell.la_checked < h:
+            return
+        cell.la_checked = cell.ok_in_buf
+        from ..analyze.plan import info_fork_gate
+
+        n_infos = sum(1 for r in cell.buf if r.status == "info")
+        if not info_fork_gate(n_infos):
+            # too many uncertain ops to fork online (the POP-DPOR
+            # bound): the verdict still lands exactly at finalize
+            return
+        rows = [r for r in cell.buf if r.status in ("ok", "info")]
+        if self._q is not None:
+            self._q.put(("spec", cell, rows))
+        else:
+            try:
+                self._speculate(cell, rows)
+            except Exception:  # noqa: BLE001 — speculation must never
+                # degrade the stream (the op was already admitted;
+                # raising here would poison ingest for a resolved row)
+                log.debug("stream: lookahead check crashed",
+                          exc_info=True)
+
+    def _speculate(self, cell: _Cell, rows: list[_Row]) -> None:
+        """The fork check itself: the crashed cell's open segment from
+        every carried frontier state, with each `:info` op free to
+        linearize at any position — or never (the sub-search already
+        forks exactly present-at-each-position vs absent).  Sound as a
+        FINAL verdict: later ops invoke after every retained op here
+        returned, so they cannot interleave into this prefix, and the
+        `:info` ops were given every placement including "later" — if
+        no fork linearizes, no suffix can repair it.  A valid or
+        inconclusive outcome changes nothing: the segment stays open
+        and finalize folds it exactly as finalize-only mode would —
+        final-verdict parity with lookahead off, by construction."""
+        if self._invalid is not None or self._fallback or cell.fallback:
+            return
+        sseq = _rows_opseq(rows, self._enc, value_lane=self._multi)
+        sub = self._default_sub_check()
+        with self._lock:
+            self._stats["lookahead_checks"] += 1
+            self._methods.add("lookahead")
+        for s in sorted(cell.states):
+            r = sub(sseq, _dc_replace(self._cell_model, init=tuple(s)),
+                    max_configs=self.sub_max_configs)
+            with self._lock:
+                self._stats["configs_searched"] += int(
+                    r.get("configs", 0) or 0)
+            if r.get("valid") is not False:
+                return  # some fork linearizes (or undecided): no news
+        with self._lock:
+            self._drop("frontier", "info-lookahead fork check found no "
+                       "linearization (frontier spans the fork)")
+            self._mark_invalid({
+                "reason": "info-lookahead: no fork of the crashed "
+                          "op(s) linearizes the prefix",
+                "cell": cell.key, "event": self._events - 1,
+                "infos": sum(1 for r in rows if r.status == "info")})
+
     def _mark_invalid(self, info: dict) -> None:
         if self._invalid is None:
             self._invalid = info
@@ -595,6 +707,7 @@ class StreamChecker:
                 "checked_rows": checked,
                 "open_rows": max(0, rows - checked),
                 "routes": dict(self._stats["routes"]),
+                "lookahead_checks": self._stats["lookahead_checks"],
                 "fallback": self._fallback,
                 "first_verdict_event": self._first_verdict_event,
                 "invalid_event": self._invalid_event,
@@ -738,6 +851,7 @@ class StreamChecker:
                 "events": self._events,
                 "checked_rows": stats["checked_rows"],
                 "routes": dict(stats["routes"]),
+                "lookahead_checks": stats["lookahead_checks"],
                 "methods": sorted(self._methods),
                 "first_verdict_event": self._first_verdict_event,
                 "invalid_event": self._invalid_event,

@@ -46,8 +46,11 @@ from __future__ import annotations
 
 import json
 import logging
+import os
+import re
 import socketserver
 import threading
+import time
 
 from ..history import Op
 
@@ -55,6 +58,10 @@ log = logging.getLogger("jepsen")
 
 #: default run id for the single-run (bare-op) shorthand
 DEFAULT_RUN = "default"
+
+
+def _safe_run_id(run_id: str) -> str:
+    return re.sub(r"[^A-Za-z0-9._-]", "_", str(run_id))[:120]
 
 
 def result_summary(result: dict, *, max_frontier: int = 16) -> dict:
@@ -91,28 +98,50 @@ class StreamService:
     def __init__(self, *, model=None, cache=None, witness: bool = True,
                  audit: bool | None = None,
                  host_fold_max: int | None = None,
-                 op_budget: int | None = None):
+                 info_lookahead: int | None = None,
+                 op_budget: int | None = None,
+                 persist_dir: str | None = None,
+                 idle_timeout: float | None = None):
         self.default_model = model
         self.cache = cache
         self.witness = witness
         self.audit = audit
         self.host_fold_max = host_fold_max
+        self.info_lookahead = info_lookahead
         #: per-run admitted-op ceiling; None = unlimited
         self.op_budget = op_budget
+        #: when set, each run keeps a live snapshot at
+        #: persist_dir/<run>.json — finalize (normal, reaped, or the
+        #: dropped-connection salvage) lands the final verdict there,
+        #: so a verdict survives even a client that vanished
+        self.persist_dir = persist_dir
+        #: seconds of per-run silence before the reaper finalizes it
+        #: (None = never): a client that opened a run and went away
+        #: must not leak an open checker forever
+        self.idle_timeout = idle_timeout
         self._runs: dict = {}
         self._status: dict = {}
         self._ops: dict = {}   # run -> admitted ops
         self._shed: dict = {}  # run -> ops shed past the budget
+        self._last: dict = {}  # run -> monotonic last-activity
+        self._lock = threading.RLock()  # handler vs reaper thread
 
     def open_run(self, run_id: str, model) -> None:
         from .checker import StreamChecker
 
+        live = None
+        if self.persist_dir:
+            live = os.path.join(self.persist_dir,
+                                f"{_safe_run_id(run_id)}.json")
         self._runs[run_id] = StreamChecker(
             model, cache=self.cache, witness=self.witness,
-            host_fold_max=self.host_fold_max, run_id=run_id)
+            host_fold_max=self.host_fold_max,
+            info_lookahead=self.info_lookahead, run_id=run_id,
+            live_path=live)
         self._status[run_id] = "open"
         self._ops[run_id] = 0
         self._shed[run_id] = 0
+        self._last[run_id] = time.monotonic()
 
     def _model_from(self, d: dict):
         from ..decompose.schedule import model_from_descriptor
@@ -135,7 +164,12 @@ class StreamService:
         if not isinstance(d, dict):
             emit({"run": None, "error": "expected a JSON object"})
             return
+        with self._lock:
+            self._handle(d, emit)
+
+    def _handle(self, d: dict, emit) -> None:
         run_id = d.get("run", DEFAULT_RUN)
+        self._last[run_id] = time.monotonic()
         try:
             if "model" in d:
                 self.open_run(run_id, self._model_from(d))
@@ -181,11 +215,23 @@ class StreamService:
             log.warning("stream service: line failed: %s", e)
             emit({"run": run_id, "error": f"{type(e).__name__}: {e}"})
 
-    def end_run(self, run_id: str, emit) -> None:
-        chk = self._runs.pop(run_id, None)
-        self._status.pop(run_id, None)
-        self._ops.pop(run_id, None)
-        shed = self._shed.pop(run_id, 0)
+    def end_run(self, run_id: str, emit, *,
+                reason: str | None = None,
+                only_if_idle_for: float | None = None) -> None:
+        with self._lock:
+            if only_if_idle_for is not None:
+                # the reaper decided on a stale snapshot; re-check
+                # idleness under the SAME lock as the pop, so a run
+                # whose client just resumed is never truncated
+                t = self._last.get(run_id)
+                if t is None or run_id not in self._runs \
+                        or time.monotonic() - t <= only_if_idle_for:
+                    return
+            chk = self._runs.pop(run_id, None)
+            self._status.pop(run_id, None)
+            self._ops.pop(run_id, None)
+            self._last.pop(run_id, None)
+            shed = self._shed.pop(run_id, 0)
         if chk is None:
             emit({"run": run_id, "error": f"unknown run {run_id!r}"})
             return
@@ -193,13 +239,45 @@ class StreamService:
         summary = result_summary(result)
         if shed:
             summary["shed"] = shed
+        if reason:
+            summary["finalized_by"] = reason
         emit({"run": run_id, "final": summary})
 
-    def end_all(self, emit) -> None:
+    def end_all(self, emit, *, reason: str | None = None) -> None:
         """EOF / disconnect: every still-open run yields its verdict for
         the prefix it recorded — nothing ingested is ever discarded."""
         for run_id in list(self._runs):
-            self.end_run(run_id, emit)
+            self.end_run(run_id, emit, reason=reason)
+
+    def abandon(self) -> None:
+        """The connection died without finalizing (TCP reset, broken
+        pipe): finalize every open run with NOBODY listening — the
+        prefix verdict still lands in the verdict cache and, with
+        ``persist_dir``, on disk — instead of leaking the run open."""
+        self.end_all(lambda d: None, reason="connection-dropped")
+
+    def reap_idle(self, emit, *, now: float | None = None) -> list:
+        """Finalize runs silent for longer than ``idle_timeout``;
+        returns the reaped run ids.  The idle-run reaper knob: a
+        service holding thousands of concurrent runs must not let a
+        vanished client pin a checker (and its memory) forever."""
+        if self.idle_timeout is None:
+            return []
+        now = time.monotonic() if now is None else now
+        with self._lock:
+            stale = [r for r, t in self._last.items()
+                     if r in self._runs and now - t > self.idle_timeout]
+            for r in [r for r in self._last if r not in self._runs]:
+                del self._last[r]
+        reaped = []
+        for run_id in stale:
+            before = run_id in self._runs
+            self.end_run(run_id, emit, reason="idle-reaper",
+                         only_if_idle_for=self.idle_timeout)
+            if before and run_id not in self._runs:
+                log.info("stream service: reaped idle run %r", run_id)
+                reaped.append(run_id)
+        return reaped
 
 
 def serve_lines(service: StreamService, lines, emit, *,
@@ -212,7 +290,45 @@ def serve_lines(service: StreamService, lines, emit, *,
     reader feeds a bounded queue a worker thread drains, and when the
     checker falls behind by more than the bound, the line is SHED with
     an explicit ``overloaded`` reply — bounded memory and a socket that
-    never stalls, the degradation mode thousands of connections need."""
+    never stalls, the degradation mode thousands of connections need.
+
+    Every exit finalizes every open run: the normal EOF path emits the
+    finals; an error path (reader died, client hung up mid-history)
+    salvages them silently (:meth:`StreamService.abandon`) so the
+    prefix verdict still lands in the cache/persist-dir instead of
+    leaking the run open.  When the service carries an
+    ``idle_timeout``, a reaper thread finalizes silent runs while the
+    connection idles."""
+    reaper_stop = None
+    if service.idle_timeout is not None:
+        reaper_stop = threading.Event()
+
+        def _reap_loop() -> None:
+            tick = max(0.05, min(1.0, service.idle_timeout / 4.0))
+            while not reaper_stop.wait(tick):
+                try:
+                    service.reap_idle(emit)
+                except Exception:  # noqa: BLE001 — reaper best-effort
+                    log.debug("stream service: reaper failed",
+                              exc_info=True)
+
+        threading.Thread(target=_reap_loop, name="stream-reaper",
+                         daemon=True).start()
+    try:
+        return _serve_lines(service, lines, emit,
+                            ingest_max=ingest_max)
+    except BaseException:
+        # the connection died mid-history without finalizing: salvage
+        # a prefix verdict for every open run, then surface the error
+        service.abandon()
+        raise
+    finally:
+        if reaper_stop is not None:
+            reaper_stop.set()
+
+
+def _serve_lines(service: StreamService, lines, emit, *,
+                 ingest_max: int) -> int:
     if ingest_max <= 0:
         for line in lines:
             service.handle_line(line, emit)
@@ -228,15 +344,16 @@ def serve_lines(service: StreamService, lines, emit, *,
     def worker() -> None:
         # a dead emit (client hung up) must not leave the reader
         # blocked on a full queue: keep draining, surface the error
-        # after the join
+        # after the join.  Lines already queued are still ADMITTED
+        # (with nobody listening) — the client sent them before dying,
+        # and the salvaged prefix verdict should cover them
         while True:
             item = q.get()
             if item is _EOF:
                 return
-            if broken:
-                continue
             try:
-                service.handle_line(item, emit)
+                service.handle_line(
+                    item, (lambda d: None) if broken else emit)
             except Exception as e:  # noqa: BLE001 — connection-fatal
                 broken.append(e)
 
@@ -286,7 +403,10 @@ class _Handler(socketserver.StreamRequestHandler):
                                 cache=srv.cache, witness=srv.witness,
                                 audit=srv.audit,
                                 host_fold_max=srv.host_fold_max,
-                                op_budget=srv.op_budget)
+                                info_lookahead=srv.info_lookahead,
+                                op_budget=srv.op_budget,
+                                persist_dir=srv.persist_dir,
+                                idle_timeout=srv.idle_timeout)
         lock = threading.Lock()
 
         def emit(d: dict) -> None:
@@ -301,7 +421,16 @@ class _Handler(socketserver.StreamRequestHandler):
                          for raw in self.rfile),
                         emit, ingest_max=srv.ingest_max)
         except (BrokenPipeError, ConnectionResetError):
+            # serve_lines already salvaged every open run's prefix
+            # verdict (StreamService.abandon) before re-raising
             log.debug("stream service: client dropped the connection")
+        except OSError:
+            # NOT a client hangup (disk trouble under --persist-dir,
+            # socket weirdness): salvage still ran, but say so loudly
+            log.warning("stream service: connection failed",
+                        exc_info=True)
+        finally:
+            service.abandon()  # no-op when end_all already ran
 
 
 class _TCPServer(socketserver.ThreadingTCPServer):
@@ -312,14 +441,20 @@ class _TCPServer(socketserver.ThreadingTCPServer):
 def make_server(host: str, port: int, *, model=None, cache=None,
                 witness: bool = True, audit: bool | None = None,
                 host_fold_max: int | None = None,
+                info_lookahead: int | None = None,
                 op_budget: int | None = None,
-                ingest_max: int = 0) -> _TCPServer:
+                ingest_max: int = 0,
+                persist_dir: str | None = None,
+                idle_timeout: float | None = None) -> _TCPServer:
     srv = _TCPServer((host, port), _Handler)
     srv.default_model = model
     srv.cache = cache
     srv.witness = witness
     srv.audit = audit
     srv.host_fold_max = host_fold_max
+    srv.info_lookahead = info_lookahead
     srv.op_budget = op_budget
     srv.ingest_max = ingest_max
+    srv.persist_dir = persist_dir
+    srv.idle_timeout = idle_timeout
     return srv

@@ -139,7 +139,17 @@ def campaign_html(base: str, cid: str) -> str:
                 body = f"{label}{o.get('valid')}"
                 det = o.get("detection") or {}
                 if det.get("latency_s") is not None:
-                    body += f" (detected in {det['latency_s']}s)"
+                    # streamed = the live verdict flipped mid-run (an
+                    # online cut or the :info lookahead fork);
+                    # finalize = only the stream's close confirmed it
+                    at = det.get("at") or "streamed"
+                    body += f" (detected in {det['latency_s']}s, {at})"
+                elif det.get("at") == "finalize":
+                    body += " (detected at finalize)"
+                if (o.get("watchdog") or {}).get("fired"):
+                    body += " [watchdog]"
+                if o.get("attempts", 1) > 1:
+                    body += f" [attempt {o['attempts']}]"
                 rel = o.get("store")
                 if rel:
                     # store paths are absolute-or-relative to the base;
@@ -171,7 +181,8 @@ def campaign_html(base: str, cid: str) -> str:
             f"<a href='/'>home</a></p>"
             f"<p>{s.get('ok', 0)} ok, {s.get('skipped', 0)} skipped, "
             f"{s.get('failed', 0)} failed — "
-            f"{s.get('detected', 0)} violation(s) detected, "
+            f"{s.get('detected', 0)} violation(s) detected"
+            f" ({s.get('streamed_detections', 0)} streamed), "
             f"{s.get('audited_ok', 0)} cell(s) audited ok</p>"
             f"<table><tr><th>family \\ nemesis</th>"
             + "".join(f"<th>{html.escape(n)}</th>" for n in nems)

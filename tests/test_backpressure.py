@@ -118,3 +118,112 @@ def test_serve_lines_bounded_queue_sheds_when_swamped():
     assert over and over[0]["queue"] == 2
     finals = [r for r in replies if "final" in r]
     assert len(finals) == 1  # EOF finalized the admitted prefix
+
+
+# ---------------------------------------------------------------------------
+# dropped connections + the idle-run reaper (the self-healing service)
+# ---------------------------------------------------------------------------
+
+
+def test_dropped_connection_persists_prefix_verdict(tmp_path):
+    """A TCP connection that dies mid-history (the reader raises)
+    must not leak its runs open: every open run is finalized silently
+    and — with ``persist_dir`` — its final verdict lands on disk."""
+    import pytest
+
+    pdir = str(tmp_path / "runs")
+    svc = StreamService(model=register(0), persist_dir=pdir)
+    replies = []
+
+    def lines():
+        yield _header("r1")
+        for li in _ok_pair("r1", 0, "write", 2):
+            yield li
+        raise ConnectionResetError("client vanished mid-history")
+
+    with pytest.raises(ConnectionResetError):
+        serve_lines(svc, lines(), replies.append, ingest_max=0)
+    # the run was salvaged, not leaked: nothing open, and no final was
+    # EMITTED (the client is gone) — it was persisted instead
+    assert not svc._runs
+    assert not [r for r in replies if "final" in r]
+    with open(f"{pdir}/r1.json") as f:
+        snap = json.load(f)
+    assert snap["final"]["valid"] is True
+    assert snap["rows"] == 1
+
+
+def test_dropped_emit_in_queued_mode_still_salvages(tmp_path):
+    """Same contract on the bounded-queue path: the worker's emit
+    blowing up (broken pipe) re-raises after the join, with every
+    open run finalized first."""
+    import pytest
+
+    pdir = str(tmp_path / "runs")
+    svc = StreamService(model=register(0), persist_dir=pdir)
+
+    calls = {"n": 0}
+
+    def dying_emit(d):
+        calls["n"] += 1
+        raise BrokenPipeError("peer reset")
+
+    lines = [_header("r9")] + _ok_pair("r9", 0, "write", 1)
+    # the header line emits nothing; the first status change tries to
+    # emit and dies — connection-fatal
+    with pytest.raises(BrokenPipeError):
+        serve_lines(svc, iter(lines), dying_emit, ingest_max=2)
+    assert not svc._runs
+    with open(f"{pdir}/r9.json") as f:
+        assert json.load(f)["final"]["valid"] is True
+
+
+def test_idle_run_reaper_finalizes_silent_runs():
+    """The idle-timeout knob: a run silent past the timeout is
+    finalized (prefix verdict emitted, labelled by the reaper); a
+    fresh run is left alone."""
+    import time
+
+    svc = StreamService(model=register(0), idle_timeout=10.0)
+    replies = []
+    svc.handle_line(_header("old"), replies.append)
+    for li in _ok_pair("old", 0, "write", 1):
+        svc.handle_line(li, replies.append)
+    svc.handle_line(_header("fresh"), replies.append)
+    # age only the old run
+    svc._last["old"] = time.monotonic() - 60.0
+    reaped = svc.reap_idle(replies.append)
+    assert reaped == ["old"]
+    finals = [r for r in replies if "final" in r]
+    assert len(finals) == 1 and finals[0]["run"] == "old"
+    assert finals[0]["final"]["valid"] is True
+    assert finals[0]["final"]["finalized_by"] == "idle-reaper"
+    assert "fresh" in svc._runs
+    # reaping again finds nothing new
+    assert svc.reap_idle(replies.append) == []
+
+
+def test_reaper_thread_runs_inside_serve_lines():
+    """With ``idle_timeout`` set, serve_lines keeps a reaper ticking
+    while the connection idles: a run that goes silent mid-connection
+    is finalized without the client ever sending `end`."""
+    import threading
+    import time
+
+    svc = StreamService(model=register(0), idle_timeout=0.15)
+    replies = []
+    fed = threading.Event()
+
+    def lines():
+        yield _header("r1")
+        for li in _ok_pair("r1", 0, "write", 1):
+            yield li
+        fed.set()
+        # the connection now idles (reader blocked) long past the
+        # idle timeout, then closes cleanly
+        time.sleep(0.8)
+
+    serve_lines(svc, lines(), replies.append, ingest_max=0)
+    finals = [r for r in replies if "final" in r]
+    assert len(finals) == 1
+    assert finals[0]["final"].get("finalized_by") == "idle-reaper"

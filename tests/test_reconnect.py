@@ -120,3 +120,90 @@ def test_wrapper_without_backoff_single_attempt():
     with pytest.raises(OSError):
         w.open()
     assert attempts["n"] == 1
+
+
+# ---------------------------------------------------------------------------
+# the stateful schedule: step()/exhausted()/reset() for health loops
+# ---------------------------------------------------------------------------
+
+
+def test_step_walks_the_schedule_and_reset_rearms():
+    b = Backoff(base=0.05, cap=2.0, factor=2.0, max_attempts=6,
+                jitter=0.0)
+    first_run = [b.step() for _ in range(3)]
+    assert first_run == [pytest.approx(0.05), pytest.approx(0.1),
+                         pytest.approx(0.2)]
+    # a successful health check resets: the next failure ramps from
+    # the BASE delay again, not from where the last outage left off
+    b.reset()
+    assert b.step() == pytest.approx(0.05)
+
+
+def test_step_pins_at_cap_past_the_schedule():
+    b = Backoff(base=0.5, cap=1.0, factor=2.0, max_attempts=3,
+                jitter=0.0)
+    assert b.step() == pytest.approx(0.5)
+    assert b.step() == pytest.approx(1.0)
+    assert b.exhausted()
+    # stepping an exhausted backoff stays pinned at the cap — a caller
+    # that ignores exhausted() still never spins faster than the cap
+    assert b.step() == pytest.approx(1.0)
+    assert b.step() == pytest.approx(1.0)
+
+
+def test_exhausted_flips_at_the_attempts_budget_and_reset_clears():
+    b = Backoff(base=0.01, cap=0.1, factor=2.0, max_attempts=4,
+                jitter=0.0)
+    seen = 0
+    while not b.exhausted():
+        b.step()
+        seen += 1
+    assert seen == 3  # the sleeps budget: max_attempts - 1
+    b.reset()
+    assert not b.exhausted()
+
+
+def test_process_db_health_loop_resets_on_success_and_fails_fast():
+    """The live/backend.py wiring: one stateful Backoff per node —
+    success resets it (a node that recovers then re-fails re-ramps
+    from base), exhaustion makes the NEXT wait on a still-dead node
+    fail after a single probe instead of re-paying the whole ramp."""
+    from jepsen_tpu.live import backend as live_backend
+
+    class FlakyBackend(live_backend.LiveBackend):
+        name = "flaky"
+
+        def __init__(self):
+            self.healthy = False
+            self.probes = 0
+
+        def health_check(self, test, node):
+            self.probes += 1
+            if not self.healthy:
+                raise OSError("still down")
+
+    fb = FlakyBackend()
+    db = live_backend.ProcessDB(
+        fb, health_backoff=Backoff(base=0.001, cap=0.002, factor=2.0,
+                                   max_attempts=3, jitter=0.0))
+    test = {"nodes": ["n1"]}
+
+    with pytest.raises(RuntimeError):
+        db._health_wait(test, "n1")
+    assert fb.probes == 3  # the full (tiny) budget
+    # still dead: the node's backoff is exhausted, so the next wait
+    # costs exactly ONE probe
+    with pytest.raises(RuntimeError):
+        db._health_wait(test, "n1")
+    assert fb.probes == 4
+    # the node comes back: one probe succeeds and RESETS the schedule
+    fb.healthy = True
+    db._health_wait(test, "n1")
+    assert fb.probes == 5
+    assert db._node_health["n1"].attempt == 0
+    # it fails again later: the ramp starts over from base (a fresh
+    # budget), not from the exhausted cursor
+    fb.healthy = False
+    with pytest.raises(RuntimeError):
+        db._health_wait(test, "n1")
+    assert fb.probes == 8

@@ -826,3 +826,139 @@ def test_service_in_process_multiplexing_and_eof_finalize():
     finals = [m for m in msgs if "final" in m]
     assert len(finals) == 1 and finals[0]["run"] == "default"
     assert finals[0]["final"]["valid"] in (True, False)
+
+
+# ---------------------------------------------------------------------------
+# bounded `:info` lookahead: mid-stream crash-fault detection
+# ---------------------------------------------------------------------------
+
+
+def _kill_shaped_history(corrupt: bool, n_tail: int = 60):
+    """An acked write, a crashed (:info) write, then a long read tail —
+    the campaign's kill-cell shape.  ``corrupt`` makes one tail read
+    return a value no fork of the crashed op can explain."""
+    h = [invoke_op(0, "write", 3), ok_op(0, "write", 3),
+         invoke_op(1, "write", 4), info_op(1, "write", 4)]
+    for i in range(n_tail):
+        p = 2 + (i % 3)
+        v = 2 if (corrupt and i == 12) else 3
+        h += [invoke_op(p, "read", None), ok_op(p, "read", v)]
+    return h
+
+
+def test_info_lookahead_flips_verdict_mid_stream():
+    """The tentpole behavior: a violation that only a crashed op's
+    fork can decide flips the LIVE verdict mid-stream (bounded
+    lookahead), where finalize-only mode stays silent until the end —
+    and both reach the identical final verdict."""
+    m = register(0)
+    h = _kill_shaped_history(corrupt=True)
+    r_la, at_la, _ = _stream(h, m, info_lookahead=8)
+    r_off, at_off, _ = _stream(h, m, info_lookahead=0)
+    assert r_la["valid"] is False and r_off["valid"] is False
+    assert at_la is not None and at_la < len(h) - 1, \
+        "lookahead never flipped the live verdict mid-stream"
+    assert at_off is None, \
+        "finalize-only mode flipped mid-stream without any cut?"
+    assert r_la["stream"]["lookahead_checks"] >= 1
+    assert r_off["stream"]["lookahead_checks"] == 0
+    # the violating read sits at ~event 30; detection must not wait
+    # for the tail
+    assert at_la < len(h) - 20, (at_la, len(h))
+
+
+def test_info_lookahead_no_false_alarm_on_valid_crash_history():
+    """A crashed op that CAN linearize must not trip the fork check:
+    the live verdict stays non-final and finalize says valid."""
+    m = register(0)
+    h = _kill_shaped_history(corrupt=False)
+    # the tail reads 3 forever; make a later segment read the crashed
+    # value 4 so the :info op must be PRESENT in one fork
+    h += [invoke_op(1, "read", None), ok_op(1, "read", 4)]
+    r, at, _ = _stream(h, m, info_lookahead=8)
+    assert r["valid"] is True, r
+    assert at is None
+    assert r["stream"]["lookahead_checks"] >= 1
+
+
+def test_info_lookahead_fuzz_parity_with_finalize_only():
+    """The satellite fuzz: across the crash-bearing corpus, an
+    aggressive lookahead horizon reaches EXACTLY the final verdicts of
+    finalize-only mode (and of the direct engine), audits clean, and
+    the speculative checks actually fire."""
+    from jepsen_tpu.analyze.audit import audit
+
+    fired = 0
+    early_la = 0
+    for label, m, h in _fuzz_cases():
+        if not any(op.type == "info" for op in h):
+            continue
+        r_la, at_la, sc = _stream(h, m, info_lookahead=4)
+        r_off, _at, _sc = _stream(h, m, info_lookahead=0)
+        d = _direct(encode_ops(h, m.f_codes), m)["valid"]
+        assert r_la["valid"] == r_off["valid"] == d, \
+            (label, d, r_la["valid"], r_off["valid"])
+        a = audit(sc.seq(), m, r_la)
+        assert a["ok"], (label, a["codes"])
+        fired += r_la["stream"]["lookahead_checks"]
+        if at_la is not None and r_la["valid"] is False:
+            early_la += 1
+    assert fired >= 10, \
+        f"the lookahead fuzz never exercised the fork check ({fired})"
+    assert early_la >= 1
+
+
+def test_info_lookahead_respects_fork_cap():
+    """Past STREAM_INFO_FORK_MAX pending :info ops the speculative
+    check is skipped (bounded fork), and the verdict still lands at
+    finalize."""
+    from jepsen_tpu.analyze.plan import STREAM_INFO_FORK_MAX
+
+    m = register(0)
+    h = [invoke_op(0, "write", 3), ok_op(0, "write", 3)]
+    # more crashed writers than the fork cap
+    for j in range(STREAM_INFO_FORK_MAX + 1):
+        p = 10 + j
+        h += [invoke_op(p, "write", 4), info_op(p, "write", 4)]
+    for i in range(40):
+        p = 2 + (i % 3)
+        h += [invoke_op(p, "read", None),
+              ok_op(p, "read", 2 if i == 5 else 3)]
+    r, at, _ = _stream(h, m, info_lookahead=8)
+    assert r["stream"]["lookahead_checks"] == 0
+    assert r["valid"] is False  # finalize still decides exactly
+    d = _direct(encode_ops(h, m.f_codes), m)["valid"]
+    assert d is False
+
+
+def test_stream_plan_reports_info_lookahead_gate():
+    """analyze.plan.stream_plan predicts the lookahead route with the
+    same primitives the checker executes: horizon, fork cap, crashed
+    cells, and the speculative-check cadence."""
+    from jepsen_tpu.analyze.plan import (STREAM_INFO_FORK_MAX,
+                                         STREAM_INFO_LOOKAHEAD,
+                                         info_fork_gate, stream_plan)
+
+    assert info_fork_gate(1) and info_fork_gate(STREAM_INFO_FORK_MAX)
+    assert not info_fork_gate(0)
+    assert not info_fork_gate(STREAM_INFO_FORK_MAX + 1)
+
+    m = register(0)
+    h = _kill_shaped_history(corrupt=False)
+    seq = encode_ops(h, m.f_codes)
+    sp = stream_plan(seq, m)
+    la = sp["info_lookahead"]
+    assert la["horizon"] == STREAM_INFO_LOOKAHEAD
+    assert la["fork_max"] == STREAM_INFO_FORK_MAX
+    assert la["crashed_cells"] == 1
+    assert la["info_rows"] == 1
+    assert la["forkable"] is True
+    assert la["speculative_checks"] \
+        == 61 // STREAM_INFO_LOOKAHEAD
+    # a crash-free history predicts no speculative work
+    h2 = [op for op in _kill_shaped_history(corrupt=False)
+          if op.process != 1]
+    sp2 = stream_plan(encode_ops(h2, m.f_codes), m, info_lookahead=8)
+    assert sp2["info_lookahead"]["crashed_cells"] == 0
+    assert sp2["info_lookahead"]["speculative_checks"] == 0
+    assert sp2["info_lookahead"]["horizon"] == 8

@@ -5,6 +5,13 @@ A thin wrapper over ``python -m jepsen_tpu.live`` so operators (and
 CI) drive nemesis campaigns from the tools/ directory like the other
 utilities; ``--dry-run`` prints the suite×nemesis matrix with per-cell
 skip reasons without spawning a single process.
+
+Self-healing knobs (see ``python -m jepsen_tpu.live --help``):
+``--resume CAMPAIGN_ID`` continues an interrupted campaign without
+re-running cells already recorded in its ``cells.jsonl``;
+``--cell-budget S`` bounds each cell's wall clock (the watchdog
+SIGKILLs wedged backend processes past it); ``--cell-retries N``
+bounds retries on harness (not verdict) errors.
 """
 
 import os

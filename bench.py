@@ -56,6 +56,13 @@ sys.path.insert(0, REPO)
 
 QUICK = "--quick" in sys.argv
 
+# --trace: record flight-recorder spans (jepsen_tpu/obs) through every
+# tier — the env var reaches the tier children, each of which dumps its
+# Chrome trace to BENCH_trace_<tier>.json next to the numbers, so a
+# bench regression comes with its own where-did-the-wall-go evidence
+if "--trace" in sys.argv:
+    os.environ["JEPSEN_TPU_TRACE"] = "1"
+
 T0 = time.time()
 # Total wall-clock budget for the whole script.
 BUDGET_S = float(os.environ.get("BENCH_BUDGET_S", "300" if QUICK else "1100"))
@@ -1663,7 +1670,14 @@ if __name__ == "__main__":
         i = sys.argv.index("--run-tier")
         tier_name = sys.argv[i + 1]
         budget_arg = int(sys.argv[sys.argv.index("--budget") + 1])
-        run_tier_child(tier_name, budget_arg)
+        from jepsen_tpu import obs as _obs
+
+        with _obs.span(f"tier:{tier_name}", cat="run"):
+            run_tier_child(tier_name, budget_arg)
+        if _obs.enabled():
+            # the tier's flight recording lands next to the numbers
+            _obs.write_trace(os.path.join(
+                REPO, f"BENCH_trace_{tier_name}.json"))
     else:
         try:
             main()

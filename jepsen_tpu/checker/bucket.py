@@ -46,8 +46,21 @@ import os
 import time
 from concurrent.futures import ThreadPoolExecutor
 
+from .. import obs
 from ..history import OpSeq
 from ..models import ModelSpec
+from ..obs import metrics as obs_metrics
+
+#: flight-recorder counters: padded-vs-useful rows shipped to device
+#: (padding efficiency on /metrics) and per-stage wall histograms —
+#: the same numbers the per-run ``bucket_batch`` stats dict reports,
+#: aggregated process-wide
+_M_BUCKET_OPS = obs_metrics.REGISTRY.counter(
+    "jtpu_bucket_ops_total",
+    "Bucketed device batch rows, useful vs padded", ("kind",))
+_M_BUCKET_S = obs_metrics.REGISTRY.histogram(
+    "jtpu_bucket_seconds",
+    "Wall seconds per bucket stage (prep/device)", ("stage",))
 
 #: default cap on distinct buckets per batch: each bucket is a device
 #: dispatch (and possibly a compile on first contact), so unbounded
@@ -160,25 +173,34 @@ def search_batch_bucketed(seqs: list[OpSeq], model: ModelSpec, *,
         """Host stage for one bucket: greedy-witness disposal, then
         tight dims + padding for the keys that must ride the device.
         Pure numpy/Python — safe to run in the pipeline thread while
-        the previous bucket executes."""
-        ready: dict[int, dict] = {}
-        run: list[int] = []
-        for i in idxs:
-            s = seqs[i]
-            if lin.greedy_witness(s, model):
-                # the certificate indexes the key's OWN OpSeq, so it
-                # survives bucket assignment and reordering untouched
-                ready[i] = {"valid": True, "configs": s.n_must,
-                            "max_depth": s.n_must,
-                            "engine": "greedy-witness",
-                            "linearization": lin.greedy_linearization(s)}
-            else:
-                run.append(i)
-        if not run:
-            return ready, run, None, None
-        dims = lin.batch_dims([ess[i] for i in run], model, frontier=32)
-        esps = [lin.pad_search(ess[i], dims.n_det_pad, dims.n_crash_pad)
-                for i in run]
+        the previous bucket executes (its span lands on the prep
+        thread's track, so the trace timeline SHOWS the overlap)."""
+        t_prep = time.perf_counter()
+        with obs.span("bucket.prep", cat="host", keys=len(idxs)):
+            ready: dict[int, dict] = {}
+            run: list[int] = []
+            for i in idxs:
+                s = seqs[i]
+                if lin.greedy_witness(s, model):
+                    # the certificate indexes the key's OWN OpSeq, so
+                    # it survives bucket assignment and reordering
+                    # untouched
+                    ready[i] = {"valid": True, "configs": s.n_must,
+                                "max_depth": s.n_must,
+                                "engine": "greedy-witness",
+                                "linearization":
+                                    lin.greedy_linearization(s)}
+                else:
+                    run.append(i)
+            if not run:
+                _M_BUCKET_S.observe(time.perf_counter() - t_prep,
+                                    stage="prep")
+                return ready, run, None, None
+            dims = lin.batch_dims([ess[i] for i in run], model,
+                                  frontier=32)
+            esps = [lin.pad_search(ess[i], dims.n_det_pad,
+                                   dims.n_crash_pad) for i in run]
+        _M_BUCKET_S.observe(time.perf_counter() - t_prep, stage="prep")
         return ready, run, dims, esps
 
     useful_total = padded_total = 0
@@ -199,12 +221,18 @@ def search_batch_bucketed(seqs: list[OpSeq], model: ModelSpec, *,
                 stats["greedy"] += len(ready)
                 t0 = time.perf_counter()
                 if run:
-                    sub = lin._search_batch_ladder(
-                        [seqs[i] for i in run], esps, model, dims,
-                        budget)
+                    with obs.span("bucket.device", cat="device",
+                                  bucket=b, keys=len(run),
+                                  dims=[dims.n_det_pad, dims.window,
+                                        dims.n_crash_pad]):
+                        sub = lin._search_batch_ladder(
+                            [seqs[i] for i in run], esps, model, dims,
+                            budget)
                     for i, r in zip(run, sub):
                         results[i] = r
                 dt = time.perf_counter() - t0
+                if run:
+                    _M_BUCKET_S.observe(dt, stage="device")
                 useful = sum(ess[i].n_det + ess[i].n_crash for i in run)
                 padded = (len(run) * (dims.n_det_pad + dims.n_crash_pad)
                           if run else 0)
@@ -249,6 +277,9 @@ def search_batch_bucketed(seqs: list[OpSeq], model: ModelSpec, *,
         fused_padded = len(run_all) * (fdims.n_det_pad
                                        + fdims.n_crash_pad)
     kc1 = lin.kernel_cache_stats()
+    if useful_total or padded_total:
+        _M_BUCKET_OPS.inc(useful_total, kind="useful")
+        _M_BUCKET_OPS.inc(padded_total, kind="padded")
     stats.update({
         "useful_ops": useful_total,
         "padded_ops": padded_total,

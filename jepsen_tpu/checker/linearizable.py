@@ -78,8 +78,16 @@ import jax.numpy as jnp
 import numpy as np
 from jax import lax
 
+from .. import obs
 from ..history import INF_RET, NIL, OpSeq, encode_ops
 from ..models import ModelSpec
+from ..obs import metrics as _obs_metrics
+
+#: flight-recorder twin of KERNEL_CACHE_STATS (module handle: a
+#: registry get-or-create per lookup would tax the dispatch path)
+_M_KCACHE = _obs_metrics.REGISTRY.counter(
+    "jtpu_kernel_cache_total",
+    "Compiled-kernel cache lookups (hit/miss)", ("event",))
 
 # int32 value standing in for "+infinity" event rank on device.
 INF32 = np.int32(2**31 - 1)
@@ -1248,7 +1256,7 @@ def search_opseq_sharded(seq: OpSeq, model: ModelSpec, mesh, *,
                     tuple(d.id for d in mesh.devices.flat))
         key = (model.name, dims, axis, mesh_key, _dominance_key())
         fn = _SHARDED_CACHE.get(key)
-        KERNEL_CACHE_STATS["hits" if fn is not None else "misses"] += 1
+        _kc_record(fn is not None)
         if fn is None:
             fn = jax.jit(build_sharded_search_step_fn(
                 model, dims, mesh, axis))
@@ -1339,6 +1347,14 @@ def kernel_cache_stats() -> dict:
     """Snapshot of the process-lifetime kernel-cache counters."""
     return dict(KERNEL_CACHE_STATS)
 
+
+def _kc_record(hit: bool) -> None:
+    """One kernel-cache lookup, counted in BOTH sinks: the legacy
+    process dict (bucket_batch deltas, bench rows) and the flight-
+    recorder registry (/metrics jtpu_kernel_cache_total)."""
+    KERNEL_CACHE_STATS["hits" if hit else "misses"] += 1
+    _M_KCACHE.inc(event="hit" if hit else "miss")
+
 #: initial BFS levels per device call; the driver adapts from here so
 #: each call lands near _SLICE_TARGET_S seconds of device time (axon
 #: kills executions past its ~60 s watchdog; slices also amortize to
@@ -1416,12 +1432,16 @@ def _drive_slices(call, carry, is_active, *, on_slice=None,
     between slices with the carry as-is — still-active carries map to
     an "unknown" verdict in the callers.  The first slice's wall time
     includes trace+compile, so it never feeds cap adaptation."""
+    from .. import obs
+
     lvl_cap = _SLICE_LEVELS0
     first = True
     while True:
         t0 = time.perf_counter()
-        carry = call(carry, lvl_cap)
-        jax.block_until_ready(carry)
+        with obs.span("device.slice", cat="device", levels=lvl_cap,
+                      first=first):
+            carry = call(carry, lvl_cap)
+            jax.block_until_ready(carry)
         dt = time.perf_counter() - t0
         if on_slice is not None:
             on_slice(carry)
@@ -1521,7 +1541,7 @@ def get_kernel(model: ModelSpec, dims: SearchDims):
     key = (model.name, dims, _dominance_key(),
            "pallas" if use_p else "xla")
     fn = _KERNEL_CACHE.get(key)
-    KERNEL_CACHE_STATS["hits" if fn is not None else "misses"] += 1
+    _kc_record(fn is not None)
     if fn is None:
         if use_p:
             from . import pallas_level
@@ -1704,6 +1724,11 @@ def _run_kernel(esp: EncodedSearch, es: EncodedSearch, model: ModelSpec,
         _trace(f"run F={F} cap={lvl_cap} first={int(first)} "
                f"depth={prev_depth}")
         t0 = time.perf_counter()
+        # manual span (not `with`): the slice's wall is t0..dt below,
+        # and the except arm re-runs the slice inside the same window
+        _slice_span = obs.span("device.slice", cat="device", frontier=F,
+                               levels=lvl_cap, first=first)
+        _slice_span.__enter__()
         try:
             carry = fn(*args, jnp.int32(budget), jnp.int32(lvl_cap),
                        jnp.bool_(bail), *carry)
@@ -1728,6 +1753,8 @@ def _run_kernel(esp: EncodedSearch, es: EncodedSearch, model: ModelSpec,
                 jax.block_until_ready(carry)
             else:
                 raise
+        finally:
+            _slice_span.__exit__(None, None, None)
         # only a slice that actually EXECUTED on pallas counts (a
         # fallback flips _PALLAS_BROKEN before the redo)
         used_pallas = used_pallas or (want_pallas
@@ -2239,7 +2266,7 @@ def get_batch_kernel(model: ModelSpec, dims: SearchDims,
     key = ("batch", model.name, dims, sel, _dominance_key(),
            "pallas" if use_p else "xla")
     fn = _KERNEL_CACHE.get(key)
-    KERNEL_CACHE_STATS["hits" if fn is not None else "misses"] += 1
+    _kc_record(fn is not None)
     if fn is None:
         if use_p:
             # vmap of the fused level-loop kernel: the pallas batching

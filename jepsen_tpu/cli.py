@@ -93,6 +93,16 @@ def add_test_opts(p: argparse.ArgumentParser) -> None:
                         "JEPSEN_TPU_EXPLAIN so every suite-constructed "
                         "Linearizable checker honors it; the verdict "
                         "reports as \"unknown\" with the plan attached.")
+    p.add_argument("--trace", action="store_true", default=False,
+                   help="Record flight-recorder spans for the whole "
+                        "run (jepsen_tpu.obs): worker ops, nemesis "
+                        "injections, bucket prep/device stages, "
+                        "segment folds, checker phases — exported as "
+                        "Chrome-trace/Perfetto JSON to the run's "
+                        "store dir (trace.json; web UI timeline "
+                        "panel, python -m jepsen_tpu.obs report).  "
+                        "Sets JEPSEN_TPU_TRACE=1 fleet-wide; off "
+                        "costs nothing.")
     p.add_argument("--no-lint", action="store_true", default=False,
                    help="Disable the history well-formedness linter "
                         "(jepsen_tpu.analyze) that runs in front of "
@@ -180,6 +190,11 @@ def test_opt_fn(parsed: argparse.Namespace) -> dict:
         # the plan-only mode travels by env var
         os.environ["JEPSEN_TPU_EXPLAIN"] = "1"
         opts["explain"] = True
+    if opts.pop("trace", False):
+        # like --stream: core.run consults the env var, so tracing
+        # reaches every run (and child process) this process starts
+        os.environ["JEPSEN_TPU_TRACE"] = "1"
+        opts["trace"] = True
     if opts.pop("no_lint", False):
         os.environ["JEPSEN_TPU_LINT"] = "0"
         opts["no_lint"] = True

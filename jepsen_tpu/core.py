@@ -28,18 +28,43 @@ import os as _stdlib_os
 import threading
 import time
 import traceback
+from contextlib import contextmanager
 from dataclasses import replace
 from typing import Optional
 
 from . import checker as checker_mod
-from . import control, db as db_mod, store
+from . import control, db as db_mod, obs, store
 from . import generator as gen
 from . import os as os_mod
 from .history import Op, index as index_history
+from .obs import metrics as obs_metrics
 from .util import (AbortableBarrier, WithThreadName, WorkerAbort, fcatch,
                    real_pmap, relative_time, relative_time_nanos)
 
 log = logging.getLogger("jepsen")
+
+#: flight-recorder counters (module-scope handles: get-or-create per op
+#: would put a registry lookup on the worker hot path)
+_M_OPS = obs_metrics.REGISTRY.counter(
+    "jtpu_ops_total", "Client worker op completions by type", ("type",))
+_M_NEMESIS = obs_metrics.REGISTRY.counter(
+    "jtpu_nemesis_ops_total", "Nemesis injections applied (completions)")
+
+
+@contextmanager
+def _phase(test: dict, name: str, cat: str):
+    """One run phase: an obs span (the trace timeline) plus an always-on
+    wall-clock entry in ``test["phase_s"]`` keyed by category — the
+    cheap per-phase accounting campaign cells record even with tracing
+    off."""
+    t0 = time.perf_counter()
+    with obs.span(name, cat=cat):
+        try:
+            yield
+        finally:
+            d = test.setdefault("phase_s", {})
+            d[cat] = round(d.get(cat, 0.0)
+                           + time.perf_counter() - t0, 4)
 
 
 def synchronize(test: dict) -> None:
@@ -262,7 +287,11 @@ class ClientWorker(Worker):
                     continue
 
             conj_op(test, op)
-            completion = invoke_op(op, test, self.client, self.aborting)
+            with obs.span(f"op:{op.f}", cat="op",
+                          process=self.process):
+                completion = invoke_op(op, test, self.client,
+                                       self.aborting)
+            _M_OPS.inc(type=completion.type)
             conj_op(test, completion)
             log_op(completion)
             if stream_lint is not None:
@@ -312,7 +341,9 @@ class NemesisWorker(Worker):
                 if hist is test.get("history"):
                     _sink_op(test, op)
         try:
-            completion = self.nemesis.invoke(test, op)
+            with obs.span(f"nemesis:{op.f}", cat="nemesis"):
+                completion = self.nemesis.invoke(test, op)
+            _M_NEMESIS.inc()
             completion = replace(completion, time=relative_time_nanos())
         except BaseException as e:
             if self.aborting.is_set():
@@ -507,19 +538,44 @@ def _finalize_stream(test: dict) -> Optional[dict]:
         return None
 
 
+def _export_trace(test: dict, run_id: str) -> None:
+    """Land the run's span buffer as ``store/<run>/trace.json`` (the
+    Chrome-trace file Perfetto and the web timeline panel load), then
+    drop the buffer so a fleet process doesn't hold one per run."""
+    if not obs.enabled():
+        return
+    try:
+        if test.get("name"):
+            obs.write_trace(store.path_mkdirs(test, "trace.json"),
+                            run=run_id)
+            obs.drop_recorder(run_id)
+    except Exception:  # noqa: BLE001 — observer, not the run
+        log.warning("trace export failed", exc_info=True)
+
+
 def run(test: dict) -> dict:
     """Run a complete test; returns the test dict with :history and
     :results (core.clj:500-610)."""
     test = prepare_test(test)
     store.start_logging(test)
+    # flight recorder: all spans below (workers, checkers, bucket
+    # scheduler, stream folds) attribute to this run's ring buffer
+    run_id = f"{test.get('name') or 'noname'}/{test['start_time']}"
+    test["__obs_run__"] = run_id
+    obs.set_run(run_id)
+    run_span = obs.span("run", cat="run", run=run_id,
+                        test_name=test.get("name"))
+    run_span.__enter__()
     try:
         log.info("Running test: %s", test.get("name"))
         try:
             try:
                 control.setup_sessions(test)
-                with_os(test)
+                with _phase(test, "os.setup", "setup"):
+                    with_os(test)
                 try:
-                    with_db(test)
+                    with _phase(test, "db.setup", "setup"):
+                        with_db(test)
                     try:
                         threads = list(range(test["concurrency"])) \
                             + ["nemesis"]
@@ -530,10 +586,13 @@ def run(test: dict) -> dict:
                                 # time (e.g. the chronos schedule
                                 # checker)
                                 test["start_wall_time"] = time.time()
-                                test["history"] = run_case(test)
+                                with _phase(test, "workload",
+                                            "workload"):
+                                    test["history"] = run_case(test)
                         log.info("Run complete, writing")
                         if test.get("name"):
-                            store.save_1(test, test["history"])
+                            with obs.span("store.save", cat="store"):
+                                store.save_1(test, test["history"])
                     finally:
                         teardown_db(test)
                 finally:
@@ -579,8 +638,9 @@ def run(test: dict) -> dict:
         sres = _finalize_stream(test)
         if sres is not None:
             test["stream_results"] = sres
-        test["results"] = checker_mod.check_safe(
-            test["checker"], test, test["history"], {})
+        with _phase(test, "analyze", "check"):
+            test["results"] = checker_mod.check_safe(
+                test["checker"], test, test["history"], {})
         if sres is not None and isinstance(test["results"], dict):
             # the live verdict next to the authoritative one (plus the
             # cache counters the web result panel renders)
@@ -593,6 +653,9 @@ def run(test: dict) -> dict:
         log_results(test)
         return test
     finally:
+        run_span.__exit__(None, None, None)
+        _export_trace(test, run_id)
+        obs.set_run(None)
         store.stop_logging(test)
 
 

@@ -34,6 +34,16 @@ from __future__ import annotations
 import json
 import os
 
+from ..obs import metrics as obs_metrics
+
+#: the flight-recorder twin of the per-instance hit/miss/insert
+#: counters: every VerdictCache in the process feeds one registry
+#: metric, so /metrics' cache-hit ratio covers the whole fleet while
+#: per-run result dicts keep their own exact counts
+_M_VCACHE = obs_metrics.REGISTRY.counter(
+    "jtpu_verdict_cache_total",
+    "Verdict-cache lookups/writes (hit/miss/insert)", ("event",))
+
 #: default auto-compaction threshold (bytes); 0/unset-able via env
 _DEFAULT_COMPACT_BYTES = 64 << 20
 
@@ -114,8 +124,10 @@ class VerdictCache:
         e = self._d.get(key)
         if e is None:
             self.misses += 1
+            _M_VCACHE.inc(event="miss")
             return None
         self.hits += 1
+        _M_VCACHE.inc(event="hit")
         return e
 
     def _append(self, e: dict) -> None:
@@ -216,12 +228,14 @@ class VerdictCache:
         e = {"k": key, "v": bool(valid)}
         self._d[key] = e
         self.inserts += 1
+        _M_VCACHE.inc(event="insert")
         self._append(e)
 
     def put_states(self, key: str, out_states: list[list[int]]) -> None:
         e = {"k": key, "out": [list(s) for s in out_states]}
         self._d[key] = e
         self.inserts += 1
+        _M_VCACHE.inc(event="insert")
         self._append(e)
 
     def close(self) -> None:

@@ -28,6 +28,7 @@ import hashlib
 import time
 from dataclasses import replace as _dc_replace
 
+from .. import obs
 from ..checker.linear import DEFAULT_WITNESS_CAP
 from ..history import OpSeq
 from ..models import ModelSpec
@@ -397,10 +398,12 @@ def check_opseq_decomposed(seq: OpSeq, model: ModelSpec, *,
                     drop("witness", "segment state-set cache hit (the "
                                     "cache stores states, not chains)")
             elif chains is not None:
-                states, wit = segment_states(sseq, cell_model, states,
-                                             max_configs=sub_max_configs,
-                                             deadline=deadline,
-                                             witness=True)
+                with obs.span("segment.fold", cat="fold",
+                              rows=len(rows)):
+                    states, wit = segment_states(
+                        sseq, cell_model, states,
+                        max_configs=sub_max_configs,
+                        deadline=deadline, witness=True)
                 if cache is not None:
                     cache.put_states(skey, ren.encode_states(states))
                 if wit is None:
@@ -412,9 +415,11 @@ def check_opseq_decomposed(seq: OpSeq, model: ModelSpec, *,
                               + [int(rows[j]) for j in seg_chain]
                               for out_s, (in_s, seg_chain) in wit.items()}
             else:
-                states = segment_states(sseq, cell_model, states,
-                                        max_configs=sub_max_configs,
-                                        deadline=deadline)
+                with obs.span("segment.fold", cat="fold",
+                              rows=len(rows)):
+                    states = segment_states(sseq, cell_model, states,
+                                            max_configs=sub_max_configs,
+                                            deadline=deadline)
                 if cache is not None:
                     cache.put_states(skey, ren.encode_states(states))
             if not states:
@@ -488,10 +493,12 @@ def check_opseq_decomposed(seq: OpSeq, model: ModelSpec, *,
             left = (max(0.1, deadline - time.perf_counter())
                     if deadline is not None else None)
             if scheduler == "pool":
-                verdicts, pool_configs = schedule.pool_check_cells(
-                    cell_list, cell_model, n_procs=n_procs,
-                    cache_path=getattr(cache, "path", None),
-                    max_configs=sub_max_configs, deadline_s=left)
+                with obs.span("cells.pool", cat="check",
+                              cells=len(cell_list)):
+                    verdicts, pool_configs = schedule.pool_check_cells(
+                        cell_list, cell_model, n_procs=n_procs,
+                        cache_path=getattr(cache, "path", None),
+                        max_configs=sub_max_configs, deadline_s=left)
                 # workers report their explored configs; billing them
                 # keeps pool-scheduled accounting as honest as the
                 # device branch's
@@ -504,8 +511,10 @@ def check_opseq_decomposed(seq: OpSeq, model: ModelSpec, *,
                 if deadline is not None and \
                         time.perf_counter() >= deadline:
                     raise _Inconclusive("deadline before device batch")
-                cell_results = schedule.device_batch_cells(
-                    cell_list, cell_model, budget=sub_max_configs)
+                with obs.span("cells.device", cat="device",
+                              cells=len(cell_list)):
+                    cell_results = schedule.device_batch_cells(
+                        cell_list, cell_model, budget=sub_max_configs)
                 verdicts = [r.get("valid") for r in cell_results]
                 # the device engine's full per-cell dicts keep the
                 # accounting honest through the decomposed path:
@@ -548,7 +557,10 @@ def check_opseq_decomposed(seq: OpSeq, model: ModelSpec, *,
                         raise _Inconclusive("scheduled cell undecided")
         else:
             for k in pending:
-                v, r, clin, cfr = check_cell(cells[k], cells[k] is seq)
+                with obs.span("cell.check", cat="check", cell=str(k),
+                              rows=len(cells[k])):
+                    v, r, clin, cfr = check_cell(cells[k],
+                                                 cells[k] is seq)
                 if r is not None:
                     last_direct = r
                 if clin is not None:

@@ -27,12 +27,22 @@ import threading
 import time
 import traceback
 
-from .. import control, store
+from .. import control, obs, store
+from ..obs import metrics as obs_metrics
 from ..util import WorkerAbort
 from .backend import FAMILIES, LiveBackend
 from .matrix import MatrixNemesis, assemble, standard_matrix
 
 log = logging.getLogger("jepsen")
+
+#: flight-recorder counters: watchdog escalations and finished cells by
+#: status — the campaign half of the fleet-health /metrics surface
+_M_WATCHDOG = obs_metrics.REGISTRY.counter(
+    "jtpu_watchdog_total", "Cell watchdog events (fired/killed)",
+    ("event",))
+_M_CELLS = obs_metrics.REGISTRY.counter(
+    "jtpu_campaign_cells_total", "Campaign cells finished, by status",
+    ("status",))
 
 #: faults the streamed checker should *detect* when crossed with a
 #: volatile backend — the seeded-bug cells (the localnode volatile
@@ -171,6 +181,36 @@ def _detection(test: dict, nemesis: str) -> dict | None:
     return out
 
 
+def _phase_times(test: dict, nemesis: str) -> dict | None:
+    """Per-cell phase wall-clock: setup/workload/check straight from
+    ``core.run``'s always-on phase accounting (``test["phase_s"]``),
+    nemesis/heal from the history's nemesis op pairs (the nemesis
+    worker is single-threaded, so an action's invoke and completion
+    are consecutive same-``f`` entries).  What makes a slow cell
+    diagnosable from cells.jsonl without a rerun."""
+    ph = dict(test.get("phase_s") or {})
+    fault_fs = _fault_fs(nemesis)
+    nem = heal = 0.0
+    open_t: dict = {}
+    for op in (test.get("history") or []):
+        if op.process != "nemesis" or op.time is None:
+            continue
+        if op.f in open_t:
+            dt = (op.time - open_t.pop(op.f)) / 1e9
+            if op.f in fault_fs:
+                nem += dt
+            else:
+                heal += dt
+        else:
+            open_t[op.f] = op.time
+    out = {"setup": ph.get("setup"), "workload": ph.get("workload"),
+           "nemesis": round(nem, 4) if nem else None,
+           "heal": round(heal, 4) if heal else None,
+           "check": ph.get("check")}
+    out = {k: v for k, v in out.items() if v is not None}
+    return out or None
+
+
 def _recovery(test: dict) -> dict | None:
     """kill -> next acked client op AGAINST A KILLED NODE, per kill:
     how long the crashed node was dark.  On key-sharded families an
@@ -222,11 +262,15 @@ class _Watchdog:
     process doesn't escape it."""
 
     def __init__(self, budget_s: float, data_root: str,
-                 grace_s: float = 5.0, resweep_s: float = 10.0):
+                 grace_s: float = 5.0, resweep_s: float = 10.0,
+                 label: str | None = None):
         self.budget_s = budget_s
         self.data_root = data_root
         self.grace_s = grace_s
         self.resweep_s = resweep_s
+        #: cell-attributed logger: a fleet's watchdog warnings must
+        #: name the cell they escalated on
+        self.log = obs.log_ctx(log, cell=label)
         self.fired = False
         self.killed: list[int] = []
         self._stop = threading.Event()
@@ -267,8 +311,8 @@ class _Watchdog:
         victims = [p for p in self._pids() if self._signal(p, 0)]
         if not victims:
             return
-        log.warning("cell watchdog: budget %.0fs exceeded; escalating "
-                    "on pids %s", self.budget_s, victims)
+        self.log.warning("cell watchdog: budget %.0fs exceeded; "
+                         "escalating on pids %s", self.budget_s, victims)
         for p in victims:
             self._signal(p, _sig.SIGCONT)  # thaw: SIGTERM must land
             self._signal(p, _sig.SIGTERM)
@@ -287,7 +331,8 @@ class _Watchdog:
             try:
                 self._sweep()
             except Exception:  # noqa: BLE001 — the watchdog never dies
-                log.warning("cell watchdog sweep failed", exc_info=True)
+                self.log.warning("cell watchdog sweep failed",
+                                 exc_info=True)
             if self._stop.wait(self.resweep_s):
                 return
 
@@ -340,10 +385,15 @@ def run_cell(cell: dict, opts: dict) -> dict:
     if copts.get("audit", True):
         os.environ["JEPSEN_TPU_AUDIT"] = "1"
     t0 = time.monotonic()
-    wd = _Watchdog(cell_budget(copts), copts["data_root"]).start()
+    wd = _Watchdog(cell_budget(copts), copts["data_root"],
+                   label=tag).start()
     try:
         try:
-            test = core.run(assemble(backend, entry, copts))
+            with obs.span(f"cell:{tag}", cat="campaign",
+                          family=cell["family"],
+                          nemesis=cell["nemesis"],
+                          seeded=bool(cell.get("seeded"))):
+                test = core.run(assemble(backend, entry, copts))
         except WorkerAbort as e:
             out["status"] = "skipped"
             out["reason"] = f"backend couldn't run: {e}"
@@ -371,6 +421,9 @@ def run_cell(cell: dict, opts: dict) -> dict:
         if wd.fired:
             out["watchdog"] = {"fired": True, "budget_s": wd.budget_s,
                                "killed": list(wd.killed)}
+            _M_WATCHDOG.inc(event="fired")
+            if wd.killed:
+                _M_WATCHDOG.inc(len(wd.killed), event="killed")
         if copts.get("audit", True):
             if prev_audit is None:
                 os.environ.pop("JEPSEN_TPU_AUDIT", None)
@@ -401,6 +454,7 @@ def run_cell(cell: dict, opts: dict) -> dict:
                      "frontier_ops", "frontier_dropped")}
     out["detection"] = _detection(test, cell["nemesis"])
     out["recovery"] = _recovery(test)
+    out["phases"] = _phase_times(test, cell["nemesis"])
     out["store"] = os.path.dirname(store.path(test, "x"))
     return out
 
@@ -488,10 +542,13 @@ def run_campaign(opts: dict | None = None,
                 outcome["attempts"] = attempt + 1
                 if not _retryable(cell, outcome) or attempt >= retries:
                     break
-                log.warning("cell %s×%s attempt %d failed (%s); "
-                            "retrying", cell["family"], cell["nemesis"],
-                            attempt + 1, outcome.get("reason"))
+                obs.log_ctx(
+                    log,
+                    cell=f"{cell['family']}x{cell['nemesis']}").warning(
+                    "attempt %d failed (%s); retrying", attempt + 1,
+                    outcome.get("reason"))
             outcomes.append(outcome)
+            _M_CELLS.inc(status=str(outcome.get("status")))
             fh.write(json.dumps(
                 {k: v for k, v in outcome.items()
                  if k != "traceback"}, default=str) + "\n")

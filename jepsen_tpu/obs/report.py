@@ -1,0 +1,107 @@
+"""Trace summarization — fold a trace.json into a phase-time table.
+
+Answers the measure-then-optimize question directly: of a run's wall
+clock, how much was device execution, how much host prep/fold work,
+and how much nothing at all (idle — the pipelining headroom).  Used by
+``tools/trace_report.py`` and ``python -m jepsen_tpu.obs report``.
+
+Per-category *busy* time is the **interval union** of that category's
+spans (two overlapped device dispatches don't double-bill), and idle
+is the run extent minus the union of every non-envelope span —
+envelope categories (the ``run`` span wrapping the whole test) exist
+to anchor the extent, not to claim the time.
+"""
+
+from __future__ import annotations
+
+import json
+
+#: categories that wrap other work rather than doing any themselves
+ENVELOPE_CATS = ("run",)
+
+
+def load_trace(path: str) -> dict:
+    with open(path) as f:
+        return json.load(f)
+
+
+def _union_us(ivs: list[tuple[float, float]]) -> float:
+    """Total length of the union of [start, end) microsecond intervals."""
+    if not ivs:
+        return 0.0
+    ivs = sorted(ivs)
+    total = 0.0
+    cur_s, cur_e = ivs[0]
+    for s, e in ivs[1:]:
+        if s > cur_e:
+            total += cur_e - cur_s
+            cur_s, cur_e = s, e
+        else:
+            cur_e = max(cur_e, e)
+    return total + (cur_e - cur_s)
+
+
+def phase_table(trace: dict) -> dict:
+    """-> {wall_s, phases: [{cat, spans, busy_s, pct}], idle_s,
+    idle_pct, top: [{name, count, total_s}]} for one Chrome trace."""
+    events = [e for e in trace.get("traceEvents", [])
+              if e.get("ph") == "X"]
+    if not events:
+        return {"wall_s": 0.0, "phases": [], "idle_s": 0.0,
+                "idle_pct": None, "top": []}
+    t0 = min(e["ts"] for e in events)
+    t1 = max(e["ts"] + e.get("dur", 0) for e in events)
+    wall_us = max(0.0, t1 - t0)
+
+    by_cat: dict[str, list] = {}
+    by_name: dict[str, list] = {}
+    for e in events:
+        by_cat.setdefault(e.get("cat") or "span", []).append(e)
+        by_name.setdefault(e.get("name") or "?", []).append(e)
+
+    phases = []
+    work_ivs = []
+    for cat in sorted(by_cat):
+        ivs = [(e["ts"], e["ts"] + e.get("dur", 0)) for e in by_cat[cat]]
+        busy = _union_us(ivs)
+        if cat not in ENVELOPE_CATS:
+            work_ivs.extend(ivs)
+        phases.append({"cat": cat, "spans": len(ivs),
+                       "busy_s": round(busy / 1e6, 4),
+                       "pct": round(100 * busy / wall_us, 1)
+                       if wall_us else None})
+    phases.sort(key=lambda p: -p["busy_s"])
+    idle_us = max(0.0, wall_us - _union_us(work_ivs))
+    top = sorted(({"name": n,
+                   "count": len(es),
+                   "total_s": round(sum(e.get("dur", 0)
+                                        for e in es) / 1e6, 4)}
+                  for n, es in by_name.items()),
+                 key=lambda r: -r["total_s"])[:12]
+    return {"wall_s": round(wall_us / 1e6, 4),
+            "phases": phases,
+            "idle_s": round(idle_us / 1e6, 4),
+            "idle_pct": round(100 * idle_us / wall_us, 1)
+            if wall_us else None,
+            "top": top}
+
+
+def render_report(rep: dict) -> str:
+    """The human table the CLI prints."""
+    lines = [f"wall: {rep['wall_s']}s   idle: {rep['idle_s']}s"
+             + (f" ({rep['idle_pct']}%)"
+                if rep.get("idle_pct") is not None else "")]
+    if rep["phases"]:
+        lines.append(f"{'phase':<12} {'spans':>6} {'busy_s':>10} "
+                     f"{'% wall':>7}")
+        for p in rep["phases"]:
+            pct = "" if p["pct"] is None else f"{p['pct']:>6.1f}%"
+            lines.append(f"{p['cat']:<12} {p['spans']:>6} "
+                         f"{p['busy_s']:>10.4f} {pct:>7}")
+    if rep["top"]:
+        lines.append("")
+        lines.append(f"{'span':<32} {'count':>6} {'total_s':>10}")
+        for r in rep["top"]:
+            lines.append(f"{r['name']:<32} {r['count']:>6} "
+                         f"{r['total_s']:>10.4f}")
+    return "\n".join(lines)

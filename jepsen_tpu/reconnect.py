@@ -19,6 +19,17 @@ from typing import Any, Callable, Optional
 log = logging.getLogger("jepsen")
 
 
+def _note_exhausted() -> None:
+    """One backoff schedule out of budget — a fleet-health signal (a
+    campaign whose exhaustion counter climbs has nodes that stay dead
+    through whole ramps) fed to the flight recorder's /metrics."""
+    from .obs import metrics as _obs_metrics
+
+    _obs_metrics.REGISTRY.counter(
+        "jtpu_backoff_exhausted_total",
+        "Reconnect backoff schedules that ran out of budget").inc()
+
+
 @dataclass
 class Backoff:
     """Capped exponential backoff with jitter and an attempts budget.
@@ -70,6 +81,13 @@ class Backoff:
         the capped one it had ratcheted to."""
         d = self.delay(self.attempt)
         self.attempt += 1
+        budget = max(1, self.max_attempts) - 1
+        if self.attempt == budget or (budget == 0
+                                      and self.attempt == 1):
+            # the cursor just crossed the budget (a zero-sleep budget
+            # is born exhausted: its first step counts) — the same
+            # event run() records on its final failure
+            _note_exhausted()
         return d
 
     def exhausted(self) -> bool:
@@ -99,6 +117,7 @@ class Backoff:
             except Exception as e:  # noqa: BLE001 — caller's fn decides
                 last = e
                 if attempt + 1 >= self.max_attempts:
+                    _note_exhausted()
                     break
                 d = self.delay(attempt)
                 log.debug("%s failed (attempt %d/%d): %s; retrying in "

@@ -59,10 +59,25 @@ from dataclasses import replace as _dc_replace
 
 import numpy as np
 
+from .. import obs
 from ..history import INF_RET, INFO, INVOKE, NIL, OK, Op, OpSeq, ValueEncoder
 from ..models import ModelSpec
+from ..obs import metrics as obs_metrics
 
 log = logging.getLogger("jepsen")
+
+#: flight-recorder counters (module handles — ingest is the hot path)
+_M_INGESTED = obs_metrics.REGISTRY.counter(
+    "jtpu_stream_ops_ingested_total",
+    "History events ingested by streaming checkers")
+_M_FOLDED = obs_metrics.REGISTRY.counter(
+    "jtpu_stream_segments_folded_total",
+    "Closed quiescence segments folded, by route", ("route",))
+_M_FORKS = obs_metrics.REGISTRY.counter(
+    "jtpu_stream_forks_total",
+    "Bounded :info lookahead forks, spawned vs capped", ("outcome",))
+_M_FOLD_S = obs_metrics.REGISTRY.histogram(
+    "jtpu_fold_seconds", "Wall seconds per streamed segment fold")
 
 #: how often (events) the live snapshot is rewritten at most
 _LIVE_EVERY = 64
@@ -276,6 +291,7 @@ class StreamChecker:
                 raise RuntimeError("stream already finalized")
             i = self._events
             self._events += 1
+            _M_INGESTED.inc()
             if not isinstance(op.process, int):
                 return  # nemesis journal entries are not client ops
             if op.type == INVOKE:
@@ -493,6 +509,13 @@ class StreamChecker:
         """Fold one closed, crash-free segment into the cell's carried
         state frontier — the streaming twin of the decomposed engine's
         quiescence loop."""
+        t0 = time.perf_counter()
+        with obs.span("stream.fold", cat="fold", run=self.run_id,
+                      cell=str(cell.key), rows=len(retained)):
+            self._fold_inner(cell, retained)
+        _M_FOLD_S.observe(time.perf_counter() - t0)
+
+    def _fold_inner(self, cell: _Cell, retained: list[_Row]) -> None:
         from ..decompose.canonical import canonical_payload
         from ..decompose.engine import _Inconclusive, _skey, segment_states
 
@@ -515,6 +538,7 @@ class StreamChecker:
             if e is not None and "out" in e:
                 self._cstats["hits"] += 1
                 self._methods.add("cache")
+                _M_FOLDED.inc(route="cache")
                 states = set(ren.decode_states(e["out"]))
                 if cell.chains is not None:
                     cell.chains = None
@@ -540,6 +564,7 @@ class StreamChecker:
             if out is not None:
                 states, configs = out
                 self._stats["routes"]["device"] += 1
+                _M_FOLDED.inc(route="device")
                 self._stats["configs_searched"] += configs
                 self._methods.add("device")
                 if cell.chains is not None:
@@ -548,6 +573,7 @@ class StreamChecker:
                                "carries states only")
         if states is None:
             self._stats["routes"]["host"] += 1
+            _M_FOLDED.inc(route="host")
             try:
                 if cell.chains is not None:
                     states, wit = segment_states(
@@ -618,6 +644,7 @@ class StreamChecker:
         if not info_fork_gate(n_infos):
             # too many uncertain ops to fork online (the POP-DPOR
             # bound): the verdict still lands exactly at finalize
+            _M_FORKS.inc(outcome="capped")
             return
         rows = [r for r in cell.buf if r.status in ("ok", "info")]
         if self._q is not None:
@@ -645,6 +672,12 @@ class StreamChecker:
         final-verdict parity with lookahead off, by construction."""
         if self._invalid is not None or self._fallback or cell.fallback:
             return
+        _M_FORKS.inc(outcome="spawned")
+        with obs.span("stream.fork", cat="fold", run=self.run_id,
+                      cell=str(cell.key), rows=len(rows)):
+            self._speculate_inner(cell, rows)
+
+    def _speculate_inner(self, cell: _Cell, rows: list[_Row]) -> None:
         sseq = _rows_opseq(rows, self._enc, value_lane=self._multi)
         sub = self._default_sub_check()
         with self._lock:
@@ -796,7 +829,8 @@ class StreamChecker:
                 row.ret = INF_RET
             self._open.clear()
         self._drain_folds()
-        out = self._finish(audit)
+        with obs.span("stream.finalize", cat="check", run=self.run_id):
+            out = self._finish(audit)
         self._finalized = out
         self._maybe_write_live(force=True, final={
             "valid": out.get("valid"), "engine": out.get("engine")})

@@ -52,9 +52,21 @@ import socketserver
 import threading
 import time
 
+from .. import obs
 from ..history import Op
+from ..obs import metrics as obs_metrics
 
 log = logging.getLogger("jepsen")
+
+#: flight-recorder handles: backpressure sheds by reason, and how many
+#: runs this process currently multiplexes (the fleet-health gauge the
+#: /metrics scrape and /api/stats snapshot expose)
+_M_SHED = obs_metrics.REGISTRY.counter(
+    "jtpu_shed_total", "Ops/lines shed under backpressure, by reason",
+    ("reason",))
+_M_RUNS_OPEN = obs_metrics.REGISTRY.gauge(
+    "jtpu_stream_runs_open",
+    "Streaming runs currently open in this process")
 
 #: default run id for the single-run (bare-op) shorthand
 DEFAULT_RUN = "default"
@@ -101,8 +113,13 @@ class StreamService:
                  info_lookahead: int | None = None,
                  op_budget: int | None = None,
                  persist_dir: str | None = None,
-                 idle_timeout: float | None = None):
+                 idle_timeout: float | None = None,
+                 conn: str | None = None):
         self.default_model = model
+        #: connection label for log attribution (TCP peer address);
+        #: every service log line carries run_id=/conn= via obs.log_ctx
+        #: so a multiplexed-run failure names its run and socket
+        self.conn = conn
         self.cache = cache
         self.witness = witness
         self.audit = audit
@@ -126,9 +143,17 @@ class StreamService:
         self._last: dict = {}  # run -> monotonic last-activity
         self._lock = threading.RLock()  # handler vs reaper thread
 
+    def _log(self, run_id: str | None = None) -> logging.LoggerAdapter:
+        """The context-stamped logger for one run's lines."""
+        return obs.log_ctx(log, run_id=run_id, conn=self.conn)
+
     def open_run(self, run_id: str, model) -> None:
         from .checker import StreamChecker
 
+        if run_id not in self._runs:
+            # re-opening an existing run replaces its checker below;
+            # the open-runs gauge must count runs, not header lines
+            _M_RUNS_OPEN.inc()
         live = None
         if self.persist_dir:
             live = os.path.join(self.persist_dir,
@@ -201,6 +226,7 @@ class StreamService:
                 # so a hot run can't flood the reply stream either)
                 shed = self._shed.get(run_id, 0) + 1
                 self._shed[run_id] = shed
+                _M_SHED.inc(reason="op-budget")
                 if shed == 1 or shed % 1000 == 0:
                     emit({"run": run_id, "overloaded": "op-budget",
                           "budget": self.op_budget, "shed": shed})
@@ -212,7 +238,8 @@ class StreamService:
                 self._status[run_id] = v["status"]
                 emit({"run": run_id, "live": v})
         except Exception as e:  # noqa: BLE001 — one line, not the service
-            log.warning("stream service: line failed: %s", e)
+            self._log(run_id).warning("stream service: line failed: %s",
+                                      e)
             emit({"run": run_id, "error": f"{type(e).__name__}: {e}"})
 
     def end_run(self, run_id: str, emit, *,
@@ -228,6 +255,8 @@ class StreamService:
                         or time.monotonic() - t <= only_if_idle_for:
                     return
             chk = self._runs.pop(run_id, None)
+            if chk is not None:
+                _M_RUNS_OPEN.dec()
             self._status.pop(run_id, None)
             self._ops.pop(run_id, None)
             self._last.pop(run_id, None)
@@ -236,6 +265,11 @@ class StreamService:
             emit({"run": run_id, "error": f"unknown run {run_id!r}"})
             return
         result = chk.finalize(audit=self.audit)
+        # with tracing on, every fold/fork span landed in this run's
+        # ring buffer; the run is over, so the buffer must go — a
+        # service multiplexing thousands of runs cannot keep one per
+        # run id forever
+        obs.drop_recorder(run_id)
         summary = result_summary(result)
         if shed:
             summary["shed"] = shed
@@ -275,7 +309,7 @@ class StreamService:
             self.end_run(run_id, emit, reason="idle-reaper",
                          only_if_idle_for=self.idle_timeout)
             if before and run_id not in self._runs:
-                log.info("stream service: reaped idle run %r", run_id)
+                self._log(run_id).info("stream service: reaped idle run")
                 reaped.append(run_id)
         return reaped
 
@@ -366,6 +400,7 @@ def _serve_lines(service: StreamService, lines, emit, *,
             q.put_nowait(line)
         except _queue.Full:
             shed += 1
+            _M_SHED.inc(reason="ingest-queue")
             if shed == 1 or shed % 1000 == 0:
                 try:
                     emit({"run": None, "overloaded": "ingest-queue",
@@ -394,11 +429,57 @@ def serve_stdio(service: StreamService, stdin, stdout, *,
     serve_lines(service, stdin, emit, ingest_max=ingest_max)
 
 
+#: HTTP request lines the JSONL port also answers — a Prometheus
+#: scraper (or curl) pointed at the service port gets its metrics
+#: without a second listener to deploy
+_SCRAPE_RE = re.compile(rb"^(GET|HEAD)\s+(/metrics|/api/stats)\b")
+
+
+def _http_scrape(wfile, target: str) -> None:
+    """One-shot HTTP/1.0 response on the protocol socket: the process
+    registry as Prometheus text (``/metrics``) or the JSON snapshot
+    (``/api/stats``)."""
+    if target == "/metrics":
+        body = obs_metrics.render().encode()
+        ctype = "text/plain; version=0.0.4; charset=utf-8"
+    else:
+        body = json.dumps(obs_metrics.snapshot()).encode()
+        ctype = "application/json"
+    wfile.write(b"HTTP/1.0 200 OK\r\n"
+                + f"Content-Type: {ctype}\r\n"
+                  f"Content-Length: {len(body)}\r\n\r\n".encode()
+                + body)
+
+
 class _Handler(socketserver.StreamRequestHandler):
     def handle(self):
         # each connection is its own run namespace (two fleets may both
         # call their run "r1"); the verdict cache is the shared part
         srv: _TCPServer = self.server
+        conn = "%s:%s" % self.client_address[:2]
+        clog = obs.log_ctx(log, conn=conn)
+        try:
+            first = self.rfile.readline()
+        except (BrokenPipeError, ConnectionResetError, OSError):
+            # a probe that connected and reset without a byte is not
+            # worth a traceback (load balancers do this all day)
+            clog.debug("stream service: connection reset before any "
+                       "input")
+            return
+        m = _SCRAPE_RE.match(first)
+        if m:
+            # a metrics scrape, not a run: drain the request headers
+            # (closing with unread bytes makes the kernel RST and can
+            # truncate the reply mid-scrape), answer HTTP, hang up
+            try:
+                while True:
+                    ln = self.rfile.readline()
+                    if not ln or ln in (b"\r\n", b"\n"):
+                        break
+                _http_scrape(self.wfile, m.group(2).decode())
+            except (BrokenPipeError, ConnectionResetError):
+                pass
+            return
         service = StreamService(model=srv.default_model,
                                 cache=srv.cache, witness=srv.witness,
                                 audit=srv.audit,
@@ -406,7 +487,8 @@ class _Handler(socketserver.StreamRequestHandler):
                                 info_lookahead=srv.info_lookahead,
                                 op_budget=srv.op_budget,
                                 persist_dir=srv.persist_dir,
-                                idle_timeout=srv.idle_timeout)
+                                idle_timeout=srv.idle_timeout,
+                                conn=conn)
         lock = threading.Lock()
 
         def emit(d: dict) -> None:
@@ -415,20 +497,23 @@ class _Handler(socketserver.StreamRequestHandler):
                     (json.dumps(d, separators=(",", ":")) + "\n")
                     .encode())
 
+        import itertools
+
+        lines = (raw.decode("utf-8", "replace")
+                 for raw in itertools.chain([first] if first else [],
+                                            self.rfile))
         try:
-            serve_lines(service,
-                        (raw.decode("utf-8", "replace")
-                         for raw in self.rfile),
-                        emit, ingest_max=srv.ingest_max)
+            serve_lines(service, lines, emit,
+                        ingest_max=srv.ingest_max)
         except (BrokenPipeError, ConnectionResetError):
             # serve_lines already salvaged every open run's prefix
             # verdict (StreamService.abandon) before re-raising
-            log.debug("stream service: client dropped the connection")
+            clog.debug("stream service: client dropped the connection")
         except OSError:
             # NOT a client hangup (disk trouble under --persist-dir,
             # socket weirdness): salvage still ran, but say so loudly
-            log.warning("stream service: connection failed",
-                        exc_info=True)
+            clog.warning("stream service: connection failed",
+                         exc_info=True)
         finally:
             service.abandon()  # no-op when end_all already ran
 

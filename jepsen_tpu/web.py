@@ -20,6 +20,7 @@ import zipfile
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
 from . import store
+from .obs import metrics as obs_metrics
 
 log = logging.getLogger("jepsen")
 
@@ -83,6 +84,38 @@ def _load_campaign(base: str, cid: str) -> dict | None:
         return None
 
 
+#: the /campaigns fleet-health strip: polls /api/stats every 5s and
+#: shows the live registry's headline numbers (open runs, cache hit
+#: ratio, sheds, watchdog firings) so a running fleet is glanceable
+#: from the grid page itself
+_HEALTH_STRIP = """
+<p id="fleet-health" style="font-family:monospace"></p>
+<script>
+async function pollStats() {
+  try {
+    const r = await fetch("/api/stats");
+    if (r.ok) {
+      const s = await r.json();
+      const v = (n) => {
+        const m = s[n]; if (!m) return 0;
+        const vv = m.values;
+        return typeof vv === "number" ? vv
+          : Object.values(vv || {}).reduce((a, b) => a + b, 0);
+      };
+      const d = s.derived || {};
+      document.getElementById("fleet-health").textContent =
+        "fleet: " + v("jtpu_stream_runs_open") + " open runs · "
+        + "cache hit ratio " + (d.verdict_cache_hit_ratio ?? "n/a")
+        + " · " + v("jtpu_shed_total") + " shed · watchdog "
+        + v("jtpu_watchdog_total");
+    }
+  } catch (e) {}
+  setTimeout(pollStats, 5000);
+}
+pollStats();
+</script>"""
+
+
 def campaigns_html(base: str) -> str:
     """The campaign index: one row per recorded campaign."""
     d = os.path.join(base, "campaigns")
@@ -106,7 +139,7 @@ def campaigns_html(base: str) -> str:
             f"<td>{s.get('audited_ok', 0)}</td></tr>")
     return (f"<html><head><title>Campaigns</title><style>{STYLE}</style>"
             f"</head><body><h1>Fault-injection campaigns</h1>"
-            f"<p><a href='/'>home</a></p><table>"
+            f"<p><a href='/'>home</a></p>{_HEALTH_STRIP}<table>"
             f"<tr><th>campaign</th><th>ok</th><th>skipped</th>"
             f"<th>failed</th><th>violations detected</th>"
             f"<th>audited ok</th></tr>{''.join(rows)}</table>"
@@ -132,6 +165,11 @@ def campaign_html(base: str, cid: str) -> str:
         parts = []
         for o in outs:
             label = "seeded: " if o.get("seeded") else ""
+            # phase-time tooltip (cells.jsonl "phases"): slow cells are
+            # diagnosable from the grid without rerunning them
+            ph = o.get("phases") or {}
+            tip = " · ".join(f"{k} {v}s" for k, v in ph.items())
+            title = f' title="{html.escape(tip)}"' if tip else ""
             if o.get("status") == "ok":
                 cls = {True: "valid-true",
                        False: "valid-false"}.get(o.get("valid"),
@@ -157,10 +195,10 @@ def campaign_html(base: str, cid: str) -> str:
                     tail = "/".join(str(rel).split(os.sep)[-2:])
                     body = (f'<a href="/files/{urllib.parse.quote(tail)}'
                             f'/">{html.escape(body)}</a>')
-                parts.append(f'<div class="{cls}">{body}</div>')
+                parts.append(f'<div class="{cls}"{title}>{body}</div>')
             else:
                 reason = html.escape(str(o.get("reason") or ""))
-                parts.append(f'<div class="valid-unknown">'
+                parts.append(f'<div class="valid-unknown"{title}>'
                              f"{label}{o.get('status')}"
                              f"<br><small>{reason}</small></div>")
         return f"<td>{''.join(parts)}</td>"
@@ -351,10 +389,103 @@ pollLive();
 </script>"""
 
 
+def trace_panel(rel: str) -> str:
+    """The zoomable flight-recorder timeline for a run directory
+    holding a ``trace.json`` (written by ``--trace`` runs): spans drawn
+    per thread track, colored by category, wheel-zoom + drag-pan, span
+    details on hover.  The same file loads in Perfetto for the full
+    treatment — this panel is the no-tools-needed first look."""
+    src = "/files/" + urllib.parse.quote(rel.rstrip("/")) + "/trace.json"
+    return f"""
+<div id="trace-panel"><h3>Trace timeline</h3>
+<p><a href="{src}">trace.json</a> — open in
+<a href="https://ui.perfetto.dev">Perfetto</a> for the full UI.
+Scroll to zoom, drag to pan.</p>
+<canvas id="trace-c" height="240"
+        style="border:1px solid #ccc;width:100%"></canvas>
+<div id="trace-hover" style="font-family:monospace">&nbsp;</div>
+<script>
+(async () => {{
+  const r = await fetch({json.dumps(src)});
+  if (!r.ok) return;
+  const tr = await r.json();
+  const evs = (tr.traceEvents || []).filter(e => e.ph === "X");
+  if (!evs.length) return;
+  const names = {{}};
+  for (const e of tr.traceEvents)
+    if (e.ph === "M" && e.name === "thread_name")
+      names[e.tid] = e.args.name;
+  const tids = [...new Set(evs.map(e => e.tid))].sort((a,b) => a-b);
+  // reduce, not Math.min(...spread): a full 65k-span ring buffer
+  // would blow the engine's argument limit and blank the panel
+  let t0 = Infinity, t1 = -Infinity;
+  for (const e of evs) {{
+    if (e.ts < t0) t0 = e.ts;
+    const end = e.ts + (e.dur || 0);
+    if (end > t1) t1 = end;
+  }}
+  const c = document.getElementById("trace-c");
+  c.width = c.clientWidth; const W = c.width, LANE = 22, PAD = 110;
+  c.height = tids.length * LANE + 20;
+  const ctx = c.getContext("2d");
+  const color = cat => {{
+    let h = 0; for (const ch of (cat || "")) h = (h * 31 + ch.charCodeAt(0)) % 360;
+    return `hsl(${{h}},60%,60%)`;
+  }};
+  let view = [t0, Math.max(t1, t0 + 1)];
+  function draw() {{
+    ctx.clearRect(0, 0, W, c.height);
+    const [v0, v1] = view, sc = (W - PAD) / (v1 - v0);
+    ctx.font = "10px monospace"; ctx.fillStyle = "#333";
+    tids.forEach((t, i) => ctx.fillText(
+      (names[t] || ("tid " + t)).slice(0, 16), 2, i * LANE + 14));
+    for (const e of evs) {{
+      const x = PAD + (e.ts - v0) * sc,
+            w = Math.max(1, (e.dur || 0) * sc),
+            y = tids.indexOf(e.tid) * LANE + 4;
+      if (x + w < PAD || x > W) continue;
+      const cx = Math.max(PAD, x);
+      ctx.fillStyle = color(e.cat);
+      ctx.fillRect(cx, y, w - (cx - x), LANE - 8);
+    }}
+  }}
+  c.addEventListener("wheel", ev => {{
+    ev.preventDefault();
+    const [v0, v1] = view, span = v1 - v0,
+          fx = (ev.offsetX - PAD) / (W - PAD),
+          at = v0 + fx * span,
+          f = ev.deltaY > 0 ? 1.25 : 0.8;
+    view = [at - (at - v0) * f, at + (v1 - at) * f]; draw();
+  }});
+  let drag = null;
+  c.addEventListener("mousedown", ev => drag = ev.offsetX);
+  c.addEventListener("mouseup", () => drag = null);
+  c.addEventListener("mousemove", ev => {{
+    const [v0, v1] = view, sc = (W - PAD) / (v1 - v0);
+    if (drag !== null) {{
+      const dt = (drag - ev.offsetX) / sc;
+      view = [v0 + dt, v1 + dt]; drag = ev.offsetX; draw(); return;
+    }}
+    const t = v0 + (ev.offsetX - PAD) / sc,
+          lane = Math.floor(ev.offsetY / LANE), tid = tids[lane];
+    const hit = evs.find(e => e.tid === tid && e.ts <= t
+                              && t <= e.ts + (e.dur || 0));
+    document.getElementById("trace-hover").textContent = hit
+      ? hit.name + " [" + hit.cat + "] "
+        + ((hit.dur || 0) / 1000).toFixed(3) + " ms "
+        + JSON.stringify(hit.args || {{}})
+      : "\\u00a0";
+  }});
+  draw();
+}})();
+</script></div>"""
+
+
 def dir_html(base: str, rel: str) -> str:
     """Directory browser (web.clj:194-248); run directories (those
-    holding a results.json) get the result panel on top, and a live
-    streaming run (live.json present) its auto-refreshing verdict."""
+    holding a results.json) get the result panel on top, a live
+    streaming run (live.json present) its auto-refreshing verdict, and
+    a traced run (trace.json present) the flight-recorder timeline."""
     d = os.path.join(base, rel)
     entries = sorted(os.listdir(d))
     items = []
@@ -367,6 +498,8 @@ def dir_html(base: str, rel: str) -> str:
     block = ""
     if os.path.isfile(os.path.join(d, "live.json")):
         block += live_panel(rel)
+    if os.path.isfile(os.path.join(d, "trace.json")):
+        block += trace_panel(rel)
     result = _load_result(d)
     if result is not None:
         # composed checkers nest per-checker (and per-key) results
@@ -465,6 +598,21 @@ class Handler(BaseHTTPRequestHandler):
             return
         if path == "/campaigns" or path == "/campaigns/":
             self._send(200, campaigns_html(self.base).encode())
+            return
+        if path == "/metrics":
+            # the flight recorder's Prometheus scrape surface: this
+            # process's registry (point your scraper at the runner /
+            # stream-service process for fleet counters)
+            self._send(200, obs_metrics.render().encode(),
+                       "text/plain; version=0.0.4; charset=utf-8",
+                       extra={"Cache-Control": "no-store"})
+            return
+        if path == "/api/stats":
+            # the JSON twin: raw metric values + derived ratios (cache
+            # hit ratio, padding efficiency), polled by /campaigns
+            self._send(200, json.dumps(obs_metrics.snapshot()).encode(),
+                       "application/json",
+                       extra={"Cache-Control": "no-store"})
             return
         if path.startswith("/campaigns/"):
             cid = os.path.normpath(

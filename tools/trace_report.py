@@ -1,0 +1,25 @@
+#!/usr/bin/env python3
+"""Summarize a flight-recorder trace.json into a phase-time table.
+
+  python tools/trace_report.py store/my-test/latest/trace.json
+  python tools/trace_report.py my-test            # latest run's trace
+  python tools/trace_report.py trace.json --json  # machine-readable
+
+The table answers "where did the wall-clock go": per-category busy
+time (interval union — overlapped spans don't double-bill), device vs
+host split, and the idle remainder that pipelining could still hide.
+Thin wrapper over jepsen_tpu.obs.report so the web UI, the ``obs``
+CLI, and this tool all fold traces identically.
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+from jepsen_tpu.obs.__main__ import main  # noqa: E402
+
+if __name__ == "__main__":
+    argv = sys.argv[1:]
+    sys.exit(main(["report"] + argv))

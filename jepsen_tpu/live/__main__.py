@@ -66,10 +66,27 @@ def main(argv=None) -> int:
     p.add_argument("--dry-run", action="store_true",
                    help="Print the matrix with per-cell skip reasons; "
                         "spawn nothing.")
+    p.add_argument("--no-corpus", action="store_true",
+                   help="Don't bank completed cell histories into "
+                        "store/corpus/ (the differential-fuzz "
+                        "regression pool).")
+    p.add_argument("--sweep", nargs="?", const="/tmp/jepsen-live",
+                   default=None, metavar="DATA_ROOT",
+                   help="Remove every partition/link rule journaled "
+                        "under DATA_ROOT (default /tmp/jepsen-live) "
+                        "and exit — restores connectivity after a "
+                        "SIGKILL'd runner.")
     p.add_argument("--json", action="store_true",
                    help="Emit the plan/record as JSON.")
     args = p.parse_args(argv)
     logging.basicConfig(level=logging.WARNING)
+
+    if args.sweep is not None:
+        from .links import sweep_tree
+
+        n = sweep_tree(args.sweep)
+        print(f"swept {n} journaled rule(s) under {args.sweep}")
+        return 0
 
     opts: dict = {"time_limit": args.time_limit}
     if args.rate is not None:
@@ -80,6 +97,8 @@ def main(argv=None) -> int:
         opts["stream"] = False
     if args.no_audit:
         opts["audit"] = False
+    if args.no_corpus:
+        opts["corpus"] = False
     if args.resume:
         opts["campaign_id"] = args.resume
     if args.cell_budget is not None:

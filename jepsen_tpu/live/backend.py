@@ -39,6 +39,7 @@ from ..models import cas_register, mutex
 from ..reconnect import Backoff
 from ..suites import disque as disque_suite, etcd as etcd_suite
 from ..suites import localnode as localnode_suite
+from . import links as links_mod
 
 log = logging.getLogger("jepsen")
 
@@ -76,9 +77,24 @@ class LiveBackend:
     base_port = 18000
     #: default node names (len = cluster size)
     nodes = ["n1"]
+    #: True when the nodes talk to EACH OTHER (consensus families):
+    #: the per-peer-link grudges (live/links.py) only apply here — a
+    #: family whose nodes never exchange a packet has no links to cut
+    peer_linked = False
 
     def available(self, opts: dict) -> str | None:
         """A skip reason when this family can't run here, else None."""
+        return None
+
+    def addr(self, test: dict, node) -> str:
+        """The node's own loopback address (127.0.1.N — the link
+        identity the per-peer partitioner matches on)."""
+        return links_mod.node_addr(test, node)
+
+    def leader(self, test: dict):
+        """The node currently leading, for leader-aware grudges; None
+        for leaderless families (the grudge falls back to a random
+        victim)."""
         return None
 
     def server_argv(self, test: dict, node) -> list[str]:
@@ -100,7 +116,8 @@ class LiveBackend:
     def health_check(self, test: dict, node) -> None:
         """One readiness probe; raise when the node is not up yet."""
         with socket.create_connection(
-                ("127.0.0.1", self.port(test, node)), timeout=1.0):
+                (self.addr(test, node), self.port(test, node)),
+                timeout=1.0):
             pass
 
     def op_node(self, test: dict, op):
@@ -260,7 +277,8 @@ class RegisterBackend(LiveBackend):
     def server_argv(self, test, node):
         return [sys.executable, "-m",
                 "jepsen_tpu.suites.localnode_server",
-                str(self.port(test, node)), node_dir(test, node)]
+                str(self.port(test, node)), node_dir(test, node),
+                "--host", self.addr(test, node)]
 
     def op_node(self, test, op):
         # RegisterClient routes key k to nodes[k % N]
@@ -324,7 +342,8 @@ class _PortedRegisterClient(localnode_suite.RegisterClient):
         s = self.socks.get(node)
         if s is None:
             s = socket.create_connection(
-                ("127.0.0.1", self.backend.port(test, node)),
+                (self.backend.addr(test, node),
+                 self.backend.port(test, node)),
                 timeout=self.timeout)
             self.socks[node] = s
         return node, s
@@ -344,7 +363,8 @@ class LockBackend(LiveBackend):
         extra = ["volatile"] if test.get("lock_volatile") else []
         return [sys.executable, "-m",
                 "jepsen_tpu.suites.localnode_server",
-                str(self.port(test, node)), node_dir(test, node), *extra]
+                str(self.port(test, node)), node_dir(test, node),
+                "--host", self.addr(test, node), *extra]
 
     def workload(self, opts):
         import itertools
@@ -396,7 +416,8 @@ class _PortedLockClient(localnode_suite.LockWireClient):
         if self.sock is None:
             try:
                 self.sock = socket.create_connection(
-                    ("127.0.0.1", self.backend.port(test, self.node)),
+                    (self.backend.addr(test, self.node),
+                     self.backend.port(test, self.node)),
                     timeout=self.timeout)
             except OSError as e:
                 raise self._NeverReached(repr(e)) from e
@@ -416,14 +437,15 @@ class KVBackend(LiveBackend):
     def server_argv(self, test, node):
         extra = ["volatile"] if test.get("kv_volatile") else []
         return [sys.executable, "-m", "jepsen_tpu.live.kv_server",
-                str(self.port(test, node)), node_dir(test, node), *extra]
+                str(self.port(test, node)), node_dir(test, node),
+                "--host", self.addr(test, node), *extra]
 
     def health_check(self, test, node):
         import urllib.error
         import urllib.request
 
-        url = (f"http://127.0.0.1:{self.port(test, node)}"
-               f"/v2/keys/__health__")
+        url = (f"http://{self.addr(test, node)}:"
+               f"{self.port(test, node)}/v2/keys/__health__")
         try:
             urllib.request.urlopen(url, timeout=1.0).close()
         except urllib.error.HTTPError:
@@ -480,7 +502,8 @@ class _PortedV2Client(etcd_suite.V2Client):
 
     def open(self, test, node):
         c = type(self)(self.backend, node, self.timeout)
-        c.base = f"http://127.0.0.1:{self.backend.port(test, node)}"
+        c.base = (f"http://{self.backend.addr(test, node)}:"
+                  f"{self.backend.port(test, node)}")
         return c
 
     def invoke(self, test, op):
@@ -514,7 +537,8 @@ class QueueBackend(LiveBackend):
     def server_argv(self, test, node):
         extra = ["volatile"] if test.get("queue_volatile") else []
         return [sys.executable, "-m", "jepsen_tpu.live.queue_server",
-                str(self.port(test, node)), node_dir(test, node), *extra]
+                str(self.port(test, node)), node_dir(test, node),
+                "--host", self.addr(test, node), *extra]
 
     def workload(self, opts):
         return {
@@ -541,22 +565,95 @@ class _PortedDisqueClient(disque_suite.DisqueClient):
                  replicate: int = 1, backend: LiveBackend | None = None):
         super().__init__(node, queue, timeout_ms, retry, replicate)
         self.backend = backend
+        self.host = None
         self.port = None
 
     def open(self, test, node):
         c = type(self)(node, self.queue, self.timeout_ms, self.retry,
                        1, backend=self.backend)
+        c.host = self.backend.addr(test, node)
         c.port = self.backend.port(test, node)
         return c
 
     def _conn(self):
         if self.conn is None:
-            self.conn = disque_suite.RespConn("127.0.0.1", self.port,
-                                              timeout=5.0)
+            self.conn = disque_suite.RespConn(
+                self.host or "127.0.0.1", self.port, timeout=5.0)
         return self.conn
 
 
-class ReplicatedBackend(LiveBackend):
+class ConsensusBackend(LiveBackend):
+    """The shared shape of the replicated families: N real replicas
+    over one shared fsync'd oplog, a ``/_repl/status`` surface (on
+    ``status_port_offset`` above the client port), per-node loopback
+    addresses with source-bound peer traffic, and round-robin client
+    binding — everything a consensus family needs besides its own
+    server argv and workload."""
+
+    nodes = ["n1", "n2", "n3"]
+    peer_linked = True
+    #: the /_repl/status surface's offset from the client port (the
+    #: RESP queue family serves consensus on a separate HTTP port)
+    status_port_offset = 0
+    #: shared-oplog filename under <data_root>/_shared/
+    oplog_name = "oplog"
+
+    def shared_oplog(self, test: dict) -> str:
+        return os.path.join(
+            test.get("data_root", "/tmp/jepsen-live"), "_shared",
+            self.oplog_name)
+
+    def peers_spec(self, test: dict) -> str:
+        """host:port per replica — each node's OWN loopback address,
+        so peer traffic is distinguishable per link."""
+        return ",".join(f"{self.addr(test, n)}:{self.port(test, n)}"
+                        for n in test["nodes"])
+
+    def leader(self, test):
+        """The replica currently claiming leadership (status surface;
+        client-side request, so a partitioned leader still answers) —
+        what the isolate-leader grudge targets."""
+        from .replicated_server import http_json
+
+        for node in test["nodes"]:
+            try:
+                _st, out = http_json(
+                    self.addr(test, node),
+                    self.port(test, node) + self.status_port_offset,
+                    "/_repl/status", timeout=0.5)
+                if out.get("role") == "leader":
+                    return node
+            except OSError:
+                pass
+        return None
+
+    def op_node(self, test, op):
+        # clients are bound round-robin to nodes (core.run_case) and a
+        # crashed process id cycles by +concurrency, so the worker's
+        # node is process % concurrency, mod the ring
+        try:
+            conc = int(test.get("concurrency") or 1)
+            return test["nodes"][(int(op.process) % conc)
+                                 % len(test["nodes"])]
+        except (TypeError, ValueError):
+            return None
+
+    def build_test(self, opts: dict) -> dict:
+        test = super().build_test(opts)
+        # a fresh cell must not replay a previous run's shared oplog
+        # (node dirs are wiped by teardown; the shared dir is not).
+        # build_test is the ONE safe place to wipe it: exactly once,
+        # before any node starts — a teardown-side wipe would race
+        # the per-node parallel teardown+setup cycle and could unlink
+        # an oplog a freshly started replica already opened
+        import shutil
+
+        shutil.rmtree(os.path.dirname(self.shared_oplog(test)),
+                      ignore_errors=True)
+        return test
+
+
+class ReplicatedBackend(ConsensusBackend):
     """The replicated KV family: a 3-replica etcd-v2 cluster
     (live/replicated_server.py) — leader lease, majority-ack writes
     over the loopback wire, follower catch-up from the shared oplog —
@@ -573,22 +670,16 @@ class ReplicatedBackend(LiveBackend):
 
     name = "replicated"
     base_port = 18500
-    nodes = ["n1", "n2", "n3"]
-
-    def shared_oplog(self, test: dict) -> str:
-        return os.path.join(
-            test.get("data_root", "/tmp/jepsen-live"), "_shared",
-            "replicated-oplog")
+    oplog_name = "replicated-oplog"
 
     def server_argv(self, test, node):
-        nodes = test["nodes"]
-        ports = [self.port(test, n) for n in nodes]
-        idx = nodes.index(node)
+        idx = test["nodes"].index(node)
         argv = [sys.executable, "-m",
                 "jepsen_tpu.live.replicated_server",
-                str(ports[idx]), node_dir(test, node),
+                str(self.port(test, node)), node_dir(test, node),
                 "--id", str(idx),
-                "--peers", ",".join(str(p) for p in ports),
+                "--peers", self.peers_spec(test),
+                "--host", self.addr(test, node),
                 "--oplog", self.shared_oplog(test),
                 "--lease-ms", str(test.get("lease_ms", 700))]
         if test.get("replicated_volatile"):
@@ -597,37 +688,12 @@ class ReplicatedBackend(LiveBackend):
             argv.append("split-brain")
         return argv
 
-    def build_test(self, opts: dict) -> dict:
-        test = super().build_test(opts)
-        # a fresh cell must not replay a previous run's shared oplog
-        # (node dirs are wiped by teardown; the shared dir is not).
-        # build_test is the ONE safe place to wipe it: exactly once,
-        # before any node starts — a teardown-side wipe would race
-        # the per-node parallel teardown+setup cycle and could unlink
-        # an oplog a freshly started replica already opened
-        import shutil
-
-        shutil.rmtree(os.path.dirname(self.shared_oplog(test)),
-                      ignore_errors=True)
-        return test
-
     def health_check(self, test, node):
         import urllib.request
 
         urllib.request.urlopen(
-            f"http://127.0.0.1:{self.port(test, node)}/_repl/status",
-            timeout=1.0).close()
-
-    def op_node(self, test, op):
-        # clients are bound round-robin to nodes (core.run_case) and a
-        # crashed process id cycles by +concurrency, so the worker's
-        # node is process % concurrency, mod the ring
-        try:
-            conc = int(test.get("concurrency") or 1)
-            return test["nodes"][(int(op.process) % conc)
-                                 % len(test["nodes"])]
-        except (TypeError, ValueError):
-            return None
+            f"http://{self.addr(test, node)}:{self.port(test, node)}"
+            f"/_repl/status", timeout=1.0).close()
 
     def workload(self, opts):
         rate = opts.get("rate", 25)
@@ -660,10 +726,162 @@ class ReplicatedBackend(LiveBackend):
         }
 
 
+class ReplicatedQueueBackend(ConsensusBackend):
+    """The replicated QUEUE family: a 3-node disque-RESP cluster
+    (live/replicated_queue.py) over the shared-oplog consensus core,
+    driven by the disque suite's ``DisqueClient`` unchanged — the
+    family where redelivery-under-partition bugs live.  Claims are
+    leader-local, so every leader change redelivers un-acked jobs
+    (at-least-once, which ``total_queue`` tolerates); ADDJOB/ACKJOB
+    are majority-ack commits, so losing an acked enqueue is the
+    violation it must catch.
+
+    Seeded mode ``rqueue_volatile``: no durable log + completeness-
+    free elections + blind adoption — under a bridge grudge a cut-off
+    replica wins an election through the overlap node and serves a
+    pending set missing acked ADDJOBs (the lost-enqueue violation the
+    seeded redelivery cell stages)."""
+
+    name = "replicated-queue"
+    base_port = 18600
+    oplog_name = "rqueue-oplog"
+    #: consensus/status rides a separate HTTP port above the RESP one
+    from .replicated_queue import PEER_OFFSET as status_port_offset
+
+    def server_argv(self, test, node):
+        idx = test["nodes"].index(node)
+        argv = [sys.executable, "-m",
+                "jepsen_tpu.live.replicated_queue",
+                str(self.port(test, node)), node_dir(test, node),
+                "--id", str(idx),
+                "--peers", self.peers_spec(test),
+                "--host", self.addr(test, node),
+                "--oplog", self.shared_oplog(test),
+                "--lease-ms", str(test.get("lease_ms", 700))]
+        if test.get("rqueue_volatile"):
+            argv.append("volatile")
+        return argv
+
+    def workload(self, opts):
+        return {
+            "client": _PortedDisqueClient(backend=self),
+            "generator": gen.delay(1.0 / opts.get("rate", 25),
+                                   gen.queue()),
+            "final_generator": gen.each(lambda: gen.once(
+                {"type": "invoke", "f": "drain", "value": None})),
+            "model": None,  # multiset semantics: post-hoc checker only
+            "concurrency": opts.get("concurrency", 4),
+            "checker": checker_mod.compose({
+                "queue": basic.total_queue(),
+            }),
+        }
+
+
+class PgwireBackend(LiveBackend):
+    """The SQL family the campaign was missing: the pg-wire register
+    server (suites/pgwire.py's MiniPGServer + engine, made durable by
+    live/pgwire_server.py) as a real OS process, driven by the
+    cockroach suite's own ``RegisterClient`` — the psycopg2-shaped txn
+    machinery (BEGIN/COMMIT/ROLLBACK, retries, reconnects) finally
+    executes under the whole nemesis matrix."""
+
+    name = "pgwire"
+    base_port = 18700
+    nodes = ["n1"]
+
+    def server_argv(self, test, node):
+        return [sys.executable, "-m", "jepsen_tpu.live.pgwire_server",
+                str(self.port(test, node)), node_dir(test, node),
+                "--host", self.addr(test, node)]
+
+    def workload(self, opts):
+        rate = opts.get("rate", 25)
+        group = opts.get("group_size", 3)
+
+        def naturals():
+            k = 0
+            while True:
+                yield k
+                k += 1
+
+        generator = gen.stagger(
+            1.0 / rate,
+            independent.concurrent_generator(
+                group, naturals(),
+                lambda k: gen.limit(
+                    opts.get("ops_per_key", 30),
+                    gen.mix([localnode_suite.r, localnode_suite.w,
+                             localnode_suite.cas]))))
+        model = cas_register(_PortedPGClient.MISSING)
+        return {
+            "client": _PortedPGClient(backend=self),
+            "generator": generator,
+            "model": model,
+            "concurrency": 2 * group,
+            "checker": checker_mod.compose({
+                "workload": independent.checker(checker_mod.compose({
+                    "linear": lin.linearizable(),
+                    "timeline": timeline.timeline(),
+                })),
+            }),
+        }
+
+
+class _PortedPGClient:
+    """The cockroach suite's RegisterClient aimed at the live pgwire
+    node, with the same two live-harness sharpenings as the V2 shim:
+    a read of a missing row maps to the model's initial value (a None
+    read encodes as NIL — unconstrained — and amnesia would be
+    invisible), and a connection refused on loopback maps to ``:fail``
+    (the op definitely never happened)."""
+
+    MISSING = -1
+
+    def __init__(self, backend: LiveBackend | None = None, node=None):
+        from ..suites import cockroach as cockroach_suite
+
+        self.backend = backend
+        self._inner = cockroach_suite.RegisterClient(node)
+
+    def open(self, test, node):
+        from ..suites import pgwire as pgwire_mod
+
+        node = test["nodes"][0]  # single gateway node
+        c = type(self)(self.backend, node)
+        c._inner.conn = pgwire_mod.connect(
+            host=self.backend.addr(test, node),
+            port=self.backend.port(test, node),
+            user="root", dbname="jepsen", connect_timeout=5)
+        c._inner.conn.autocommit = False
+        return c
+
+    def setup(self, test):
+        self._inner.setup(test)
+
+    def teardown(self, test):
+        self._inner.teardown(test)
+
+    def invoke(self, test, op):
+        out = self._inner.invoke(test, op)
+        if out.type == "info" and out.error is not None \
+                and "Connection refused" in str(out.error):
+            out = replace(out, type="fail")
+        v = out.value
+        if op.f == "read" and out.type == "ok" \
+                and independent.is_tuple(v) and v.value is None:
+            out = replace(out, value=independent.tuple_(v.key,
+                                                        self.MISSING))
+        return out
+
+    def close(self, test):
+        self._inner.close(test)
+
+
 #: the campaign's family roster
 FAMILIES: dict[str, LiveBackend] = {
     b.name: b for b in (RegisterBackend(), LockBackend(), KVBackend(),
-                        QueueBackend(), ReplicatedBackend())
+                        QueueBackend(), ReplicatedBackend(),
+                        ReplicatedQueueBackend(), PgwireBackend())
 }
 
 
@@ -798,52 +1016,65 @@ class ClockSkewNemesis(nemesis_mod.Nemesis):
 
 
 class PortPartitionNemesis(nemesis_mod.Nemesis):
-    """{:f start | stop}: loopback partition grudges.  Every node and
-    client lives on 127.0.0.1, so the link that can be cut is
-    client<->node: :start picks a victim component with the grudge
-    topology math (nemesis.split_one) and DROPs inbound traffic to its
-    ports via iptables; :stop deletes exactly the rules it added."""
+    """{:f start | stop}: whole-port partition grudges — the blunt
+    cut that takes a node away from clients AND peers: :start picks a
+    victim component with the grudge topology math (nemesis.split_one)
+    and DROPs inbound traffic to its ports via iptables; :stop heals.
+    (The surgical per-peer-link grudges live in
+    :class:`links.LinkPartitionNemesis`.)
+
+    Every rule is journaled to the data root BEFORE install
+    (live/links.py's journal) and heal is a journal sweep — the old
+    in-process ``_rules`` list leaked live DROP rules whenever a
+    watchdog SIGKILL'd the runner mid-partition; the journal survives
+    the runner, so campaign start, the watchdog, and ``--sweep`` can
+    always restore connectivity."""
 
     def __init__(self, backend: LiveBackend,
                  grudge=nemesis_mod.split_one):
         self.backend = backend
         self.grudge = grudge
-        self._rules: list[tuple] = []  # (node, port) rules installed
-
-    def _ipt(self, test, args: list[str]) -> None:
-        # the availability probe required euid 0, so no sudo wrapping
-        # (the container this runs in may not even ship a sudo binary)
-        control.session(test["nodes"][0], test).exec(
-            "iptables", "-w", *args)
+        # the availability probe required euid 0 + iptables, so the
+        # engine runs the binary directly (the container this runs in
+        # may not even ship a sudo binary)
+        self._engine = links_mod.IptablesEngine()
+        self._cut: list[str] = []  # victim nodes, for the op value
 
     def invoke(self, test, op):
+        data_root = test.get("data_root", "/tmp/jepsen-live")
         if op.f == "start":
-            if self._rules:
+            if self._cut:
                 return replace(op, type="info",
                                value="already-partitioned")
             victims, _rest = self.grudge(list(test["nodes"]))
+            # every port the node serves on: the client port AND the
+            # consensus/status surface where the family splits them
+            # (replicated-queue's peer HTTP rides port + offset) — a
+            # "partitioned" node that still heartbeats isn't one
+            offset = int(getattr(self.backend,
+                                 "status_port_offset", 0) or 0)
             for n in victims:
-                port = self.backend.port(test, n)
-                self._ipt(test, ["-I", "INPUT", "-p", "tcp", "-i", "lo",
-                                 "--dport", str(port), "-j", "DROP"])
-                self._rules.append((n, port))
+                ports = [self.backend.port(test, n)]
+                if offset:
+                    ports.append(ports[0] + offset)
+                for port in ports:
+                    rule = {"kind": "port", "port": port,
+                            "node": str(n),
+                            "engine": self._engine.name}
+                    links_mod.journal_append(data_root, rule)
+                    self._engine.install(rule)
+                self._cut.append(str(n))
             return replace(op, type="info",
-                           value=["isolated", sorted(str(n)
-                                                     for n, _ in
-                                                     self._rules)])
+                           value=["isolated", sorted(self._cut)])
         if op.f == "stop":
             self._heal(test)
             return replace(op, type="info", value="network-healed")
         raise ValueError(f"port-partition nemesis: unknown f {op.f!r}")
 
     def _heal(self, test) -> None:
-        for n, port in self._rules:
-            try:
-                self._ipt(test, ["-D", "INPUT", "-p", "tcp", "-i", "lo",
-                                 "--dport", str(port), "-j", "DROP"])
-            except control.RemoteError as e:
-                log.warning("partition heal of %s failed: %s", n, e)
-        self._rules = []
+        links_mod.sweep(test.get("data_root", "/tmp/jepsen-live"),
+                        engine=self._engine)
+        self._cut = []
 
     def teardown(self, test):
         self._heal(test)

@@ -1,0 +1,313 @@
+"""The campaign->fuzz regression net — banked live histories.
+
+GPUexplore's loop (arXiv:1801.05857) is accelerated search plus cheap
+independent validation; this module is the corpus half of that loop
+for the live harness: every completed campaign cell's history is
+**audited** (the cell already ran with ``JEPSEN_TPU_AUDIT=1``),
+**canonicalized** (process renaming, event-rank erasure, value
+renaming — ``decompose/canonical.py``, the verdict cache's own key
+space), and **appended to a pool** under ``store/corpus/`` that
+``tools/fuzz.py --corpus`` replays through every engine route (direct
+device BFS, decomposed, bucketed, streaming) with verdict-parity
+assertions.  Each real fault run permanently widens the differential
+net: a checker regression that would mis-judge a history a REAL
+partition once produced fails CI, not a user.
+
+Pool layout — ``store/corpus/pool.jsonl``, one entry per line::
+
+  {"id": <canonical sha256>, "family": ..., "nemesis": ...,
+   "seeded": bool, "model": {"name": ..., "init"/"capacity": ...},
+   "routes": "engines" | "queue", "valid": true|false|null,
+   "ops": [...], "n_ops": N, "truncated": bool, "banked": <ts>}
+
+``routes`` picks the replay: register/mutex-model histories ride all
+four linearizability engine routes; multiset queue histories (no
+per-op model) replay through the ``total_queue`` checker.  ``valid``
+records the banked expectation when it is unambiguous (the entry
+covers the cell's whole checked history); demuxed per-key entries
+leave it null and rely on cross-route parity.
+
+Dedup is by canonical id — re-running the same campaign grows the
+pool by zero — and the pool is bounded (oldest entries compact away
+past ``POOL_MAX``).
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import os
+import time
+from dataclasses import replace
+
+from .. import independent
+from ..history import NIL, Op, encode_ops
+from ..obs import metrics as obs_metrics
+
+log = logging.getLogger("jepsen")
+
+POOL = "pool.jsonl"
+#: ops per banked entry — longer histories bank a completed prefix
+#: (marked truncated, expectation dropped); keeps every entry cheap
+#: enough to replay through four engines in CI
+MAX_OPS = 240
+#: pool bound: past it the oldest entries compact away
+POOL_MAX = 512
+
+_M_BANKED = obs_metrics.REGISTRY.counter(
+    "jtpu_corpus_entries_total",
+    "Histories banked into the fuzz corpus", ("family",))
+_M_POOL = obs_metrics.REGISTRY.gauge(
+    "jtpu_corpus_pool_size", "Current fuzz-corpus pool size")
+
+
+def corpus_dir(base: str = "store") -> str:
+    return os.path.join(base, "corpus")
+
+
+def _model_for(spec: dict):
+    """Entry model dict -> ModelSpec (the fuzz replay's constructor)."""
+    from ..models import cas_register, mutex, register, unordered_queue
+
+    name = spec["name"]
+    if name == "cas-register":
+        return cas_register(int(spec.get("init", NIL)))
+    if name == "register":
+        return register(int(spec.get("init", 0)))
+    if name == "mutex":
+        return mutex()
+    if name == "unordered-queue":
+        return unordered_queue(int(spec.get("capacity", 16)))
+    raise ValueError(f"corpus: unknown model {name!r}")
+
+
+def entry_model(entry: dict):
+    return _model_for(entry["model"])
+
+
+def _model_spec(model) -> dict | None:
+    """ModelSpec -> serializable entry model (register/mutex only —
+    the families the engine routes can replay)."""
+    if model is None:
+        return None
+    if model.name == "cas-register":
+        return {"name": "cas-register", "init": int(model.init[0])}
+    if model.name == "register":
+        return {"name": "register", "init": int(model.init[0])}
+    if model.name == "mutex":
+        return {"name": "mutex"}
+    return None
+
+
+def _canon_op(op: Op) -> dict:
+    """The banked op: semantics only — times, indices, and error
+    strings are noise the engines never read (and the canonical id
+    already erases)."""
+    v = op.value
+    if isinstance(v, tuple):
+        v = list(v)
+    return {"process": op.process, "type": op.type, "f": op.f,
+            "value": v}
+
+
+def _client_ops(history) -> list[Op]:
+    return [op for op in (history or [])
+            if isinstance(op.process, int)]
+
+
+def _bounded(ops: list[Op]) -> tuple[list[Op], bool]:
+    """Cap an entry at MAX_OPS, completing the prefix so it stays a
+    well-formed history (pending invokes become crashed :info — a
+    legal history whose verdict may differ from the full cell's, so
+    truncated entries drop the banked expectation)."""
+    from ..history import complete
+
+    if len(ops) <= MAX_OPS:
+        return ops, False
+    return complete(ops[:MAX_OPS]), True
+
+
+def _canonical_id(ops: list[Op], model) -> str:
+    from ..decompose.canonical import canonical_key
+
+    seq = encode_ops(ops, model.f_codes)
+    return canonical_key(seq, model)
+
+
+def _demux(ops: list[Op]) -> dict | None:
+    """Split an independent-keyed history (values are [k v] tuples)
+    into per-key sub-histories with raw values; None when the history
+    isn't keyed."""
+    if not any(independent.is_tuple(op.value) for op in ops):
+        return None
+    by_key: dict = {}
+    for op in ops:
+        v = op.value
+        if not independent.is_tuple(v):
+            continue  # un-keyed op in a keyed history: drop
+        by_key.setdefault(v.key, []).append(replace(op, value=v.value))
+    return by_key
+
+
+def _queue_entry_ops(ops: list[Op]) -> list[Op] | None:
+    """Queue histories bank in drain-expanded form (the shape
+    ``total_queue`` checks); a crashed drain can't be expanded —
+    skip."""
+    from ..checker.basic import expand_queue_drain_ops
+
+    try:
+        return expand_queue_drain_ops(ops)
+    except ValueError:
+        return None
+
+
+def entries_from_test(test: dict, outcome: dict) -> list[dict]:
+    """The bankable entries of one completed cell."""
+    ops = _client_ops(test.get("history"))
+    if len(ops) < 4:
+        return []
+    model = test.get("model")
+    meta = {"family": outcome.get("family"),
+            "nemesis": outcome.get("nemesis"),
+            "seeded": bool(outcome.get("seeded")),
+            "banked": time.strftime("%Y%m%dT%H%M%S")}
+    entries: list[dict] = []
+    if model is None:
+        # the queue families: multiset semantics, total_queue replay
+        if not any(op.f in ("enqueue", "dequeue", "drain")
+                   for op in ops):
+            return []
+        qops = _queue_entry_ops(ops)
+        if qops is None:
+            return []
+        qops, truncated = _bounded(qops)
+        from ..models import unordered_queue
+
+        n_enq = sum(1 for op in qops
+                    if op.f == "enqueue" and op.type == "invoke")
+        m = unordered_queue(max(1, n_enq) + 1)
+        entries.append({
+            **meta, "routes": "queue",
+            "model": {"name": "unordered-queue",
+                      "capacity": max(1, n_enq) + 1},
+            "valid": None if truncated else outcome.get("valid"),
+            "ops": [_canon_op(o) for o in qops],
+            "n_ops": len(qops), "truncated": truncated,
+            "id": _canonical_id(qops, m)})
+        return entries
+    spec = _model_spec(model)
+    if spec is None:
+        return []
+    demuxed = _demux(ops)
+    groups = list(demuxed.values()) if demuxed else [ops]
+    per_key = demuxed is not None and len(groups) > 1
+    for sub in groups:
+        if len(sub) < 4:
+            continue
+        sub, truncated = _bounded(sub)
+        try:
+            eid = _canonical_id(sub, model)
+        except Exception:  # noqa: BLE001 — an unencodable history
+            continue       # (exotic values) just doesn't bank
+        entries.append({
+            **meta, "routes": "engines", "model": spec,
+            # a demuxed key's verdict is not the cell's: leave the
+            # expectation open and rely on cross-route parity
+            "valid": None if (truncated or per_key)
+            else outcome.get("valid"),
+            "ops": [_canon_op(o) for o in sub],
+            "n_ops": len(sub), "truncated": truncated, "id": eid})
+    return entries
+
+
+# ---------------------------------------------------------------------------
+# the pool
+# ---------------------------------------------------------------------------
+
+
+def load_pool(d: str) -> list[dict]:
+    out: list[dict] = []
+    try:
+        with open(os.path.join(d, POOL)) as f:
+            for line in f:
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    o = json.loads(line)
+                except ValueError:
+                    continue
+                if isinstance(o, dict) and o.get("id"):
+                    out.append(o)
+    except OSError:
+        pass
+    return out
+
+
+def _write_pool(d: str, entries: list[dict]) -> None:
+    tmp = os.path.join(d, POOL + ".tmp")
+    with open(tmp, "w") as f:
+        for e in entries:
+            f.write(json.dumps(e, default=str) + "\n")
+        f.flush()
+        os.fsync(f.fileno())
+    os.replace(tmp, os.path.join(d, POOL))
+
+
+def bank(entries: list[dict], base: str = "store") -> dict:
+    """Append new entries (dedup by canonical id), compact past the
+    pool bound; returns {"banked": n_new, "pool": total}."""
+    d = corpus_dir(base)
+    os.makedirs(d, exist_ok=True)
+    pool = load_pool(d)
+    seen = {e["id"] for e in pool}
+    fresh = []
+    for e in entries:
+        if e["id"] in seen:
+            continue
+        seen.add(e["id"])
+        fresh.append(e)
+        _M_BANKED.inc(family=str(e.get("family")))
+    if fresh:
+        if len(pool) + len(fresh) > POOL_MAX:
+            pool = (pool + fresh)[-POOL_MAX:]
+            _write_pool(d, pool)
+        else:
+            with open(os.path.join(d, POOL), "a") as f:
+                for e in fresh:
+                    f.write(json.dumps(e, default=str) + "\n")
+                f.flush()
+                os.fsync(f.fileno())
+            pool = pool + fresh
+    _M_POOL.set(len(pool))
+    return {"banked": len(fresh), "pool": len(pool)}
+
+
+def bank_cell(test: dict, outcome: dict,
+              base: str = "store") -> dict | None:
+    """Bank one completed campaign cell's history; never raises into
+    the campaign (the caller guards)."""
+    entries = entries_from_test(test, outcome)
+    if not entries:
+        return None
+    out = bank(entries, base=base)
+    log.info("corpus: banked %d/%d entr%s from %s×%s (pool %d)",
+             out["banked"], len(entries),
+             "y" if len(entries) == 1 else "ies",
+             outcome.get("family"), outcome.get("nemesis"),
+             out["pool"])
+    return out
+
+
+# ---------------------------------------------------------------------------
+# the queue replay route
+# ---------------------------------------------------------------------------
+
+
+def replay_queue(ops: list[Op]) -> dict:
+    """The multiset route: the already-drain-expanded history through
+    ``total_queue`` — deterministic, so parity means equality with the
+    banked verdict."""
+    from ..checker.basic import total_queue
+
+    return total_queue().check({}, ops)

@@ -15,11 +15,11 @@ from __future__ import annotations
 import itertools
 import os
 import shutil
-import subprocess
 from dataclasses import dataclass, field
 from typing import Callable, Optional
 
 from .. import generator as gen
+from . import links as links_mod
 from .backend import (ClockSkewNemesis, KillRestartNemesis, LiveBackend,
                       PauseNemesis, PortPartitionNemesis, ProcessDB)
 
@@ -42,11 +42,20 @@ class MatrixNemesis:
     during: Callable[[dict], object]
     #: the healing op run after the time limit (None = nothing)
     final: Optional[dict] = None
-    #: () -> skip reason | None
+    #: () -> skip reason | None (host capability)
     probe: Callable[[], Optional[str]] = field(default=lambda: None)
+    #: (backend) -> skip reason | None (family applicability — e.g.
+    #: per-peer-link grudges need a family whose nodes talk to each
+    #: other at all)
+    applies: Callable[[LiveBackend], Optional[str]] = field(
+        default=lambda backend: None)
 
-    def available(self) -> Optional[str]:
-        return self.probe()
+    def available(self, backend: LiveBackend | None = None
+                  ) -> Optional[str]:
+        reason = self.probe()
+        if reason is None and backend is not None:
+            reason = self.applies(backend)
+        return reason
 
 
 # ---------------------------------------------------------------------------
@@ -61,20 +70,16 @@ def probe_faketime() -> Optional[str]:
 
 
 def probe_iptables() -> Optional[str]:
-    if shutil.which("iptables") is None:
-        return "no `iptables` binary on PATH"
-    if hasattr(os, "geteuid") and os.geteuid() != 0:
-        return "not root: iptables needs CAP_NET_ADMIN"
-    try:
-        r = subprocess.run(["iptables", "-w", "-L", "-n"],
-                           capture_output=True, timeout=10)
-    except (OSError, subprocess.TimeoutExpired) as e:
-        return f"iptables probe failed: {e}"
-    if r.returncode != 0:
-        return ("iptables unusable here: "
-                + (r.stderr or r.stdout).decode("utf-8",
-                                                "replace").strip()[:120])
-    return None
+    return links_mod.IptablesEngine.probe()
+
+
+def _no_peer_links(backend: LiveBackend) -> Optional[str]:
+    """Per-peer-link grudges only apply to families whose nodes talk
+    to each other; everything else has no links to cut."""
+    if getattr(backend, "peer_linked", False):
+        return None
+    return (f"family `{backend.name}` has no inter-node links "
+            f"(not a consensus family)")
 
 
 def probe_faultfs() -> Optional[str]:
@@ -135,6 +140,25 @@ def standard_matrix() -> dict[str, MatrixNemesis]:
                                       o.get("disk_every", 2.0), 1.0),
             final={"type": "info", "f": "clear"},
             probe=probe_faultfs),
+        # per-peer-link grudges (live/links.py): one matrix row per
+        # fault geometry, so each grudge gets its own /campaigns
+        # column and its own verdict per family.  The engine probe
+        # prefers iptables (true DROP) and falls back to a tc htb
+        # choke; degradation needs tc specifically.
+        **{
+            f"link-{gname}": MatrixNemesis(
+                f"link-{gname}",
+                make=lambda b, db, g=g: links_mod.LinkPartitionNemesis(
+                    b, g),
+                during=lambda o: _cadence("start", "stop",
+                                          o.get("part_every", 2.0),
+                                          1.0),
+                final={"type": "info", "f": "stop"},
+                probe=links_mod.probe_degrade
+                if g.mode == "degrade" else links_mod.probe_links,
+                applies=_no_peer_links)
+            for gname, g in links_mod.GRUDGES.items()
+        },
     }
 
 

@@ -31,13 +31,25 @@ class DurableLog:
         """Recovery: yield each complete logged line (decoded,
         newline-stripped).  A torn final line — no trailing newline,
         the crashed-mid-write case — is dropped: it was never acked."""
+        lines, _pos = self.tail(0)
+        yield from lines
+
+    def tail(self, offset: int = 0) -> tuple[list[str], int]:
+        """Complete lines from byte ``offset`` on, plus the offset of
+        the end of the last complete line — so a shared-log reader
+        (the replicated families' per-commit catch-up) scans only the
+        tail instead of re-reading the whole file every call.  The
+        torn-final-line rule is the same as :meth:`replay`'s."""
         if not os.path.exists(self.path):
-            return
+            return [], offset
         with open(self.path, "rb") as f:
+            f.seek(offset)
             data = f.read()
-        complete = data[:data.rfind(b"\n") + 1] if b"\n" in data else b""
-        for raw in complete.splitlines():
-            yield raw.decode("utf-8", "replace")
+        end = data.rfind(b"\n") + 1
+        if end == 0:
+            return [], offset
+        return ([raw.decode("utf-8", "replace")
+                 for raw in data[:end].splitlines()], offset + end)
 
     def open(self) -> "DurableLog":
         """Open the append handle (after replay, before serving)."""

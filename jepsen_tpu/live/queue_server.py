@@ -115,26 +115,53 @@ class Store:
             return 1 if known else 0
 
 
+# -- RESP framing, shared with live/replicated_queue.py ---------------
+
+
+def read_resp_command(rfile) -> list[str] | None:
+    """One RespConn-shaped command: an array of bulk strings."""
+    line = rfile.readline()
+    if not line:
+        return None
+    if not line.startswith(b"*"):
+        raise ValueError(f"bad array header {line!r}")
+    n = int(line[1:].strip())
+    args = []
+    for _ in range(n):
+        hdr = rfile.readline()
+        if not hdr.startswith(b"$"):
+            raise ValueError(f"bad bulk header {hdr!r}")
+        size = int(hdr[1:].strip())
+        data = rfile.read(size + 2)[:-2]
+        args.append(data.decode("utf-8", "replace"))
+    return args
+
+
+def encode_resp_command(args: list[str]) -> bytes:
+    """Re-encode a command for forwarding (the follower->leader
+    proxy)."""
+    out = [f"*{len(args)}\r\n".encode()]
+    for a in args:
+        b = str(a).encode()
+        out.append(f"${len(b)}\r\n".encode() + b + b"\r\n")
+    return b"".join(out)
+
+
+def encode_resp_job(queue: str, jid: str, body: str) -> bytes:
+    """The GETJOB single-job reply: [[queue id body]]."""
+    out = [b"*1\r\n*3\r\n"]
+    for s in (queue, jid, body):
+        b = s.encode()
+        out.append(f"${len(b)}\r\n".encode() + b + b"\r\n")
+    return b"".join(out)
+
+
 class Handler(socketserver.StreamRequestHandler):
     """The RESP framing RespConn emits: arrays of bulk strings in, one
     reply out per command."""
 
     def _read_command(self) -> list[str] | None:
-        line = self.rfile.readline()
-        if not line:
-            return None
-        if not line.startswith(b"*"):
-            raise ValueError(f"bad array header {line!r}")
-        n = int(line[1:].strip())
-        args = []
-        for _ in range(n):
-            hdr = self.rfile.readline()
-            if not hdr.startswith(b"$"):
-                raise ValueError(f"bad bulk header {hdr!r}")
-            size = int(hdr[1:].strip())
-            data = self.rfile.read(size + 2)[:-2]
-            args.append(data.decode("utf-8", "replace"))
-        return args
+        return read_resp_command(self.rfile)
 
     def _send(self, payload: bytes) -> None:
         self.wfile.write(payload)
@@ -169,12 +196,7 @@ class Handler(socketserver.StreamRequestHandler):
                         self._send(b"*-1\r\n")
                     else:
                         jid, body = got
-                        out = [f"*1\r\n*3\r\n".encode()]
-                        for s in (queue, jid, body):
-                            b = s.encode()
-                            out.append(f"${len(b)}\r\n".encode()
-                                       + b + b"\r\n")
-                        self._send(b"".join(out))
+                        self._send(encode_resp_job(queue, jid, body))
                 elif cmd == "ACKJOB" and len(args) >= 2:
                     self._send(f":{store.ackjob(args[1])}\r\n".encode())
                 else:
@@ -197,16 +219,21 @@ class Server(socketserver.ThreadingTCPServer):
 
 
 def main(argv=None) -> None:
-    argv = sys.argv[1:] if argv is None else argv
+    argv = list(sys.argv[1:] if argv is None else argv)
+    host = "127.0.0.1"
+    if "--host" in argv:  # per-node loopback address (live/links.py)
+        i = argv.index("--host")
+        host = argv[i + 1]
+        del argv[i:i + 2]
     if len(argv) not in (2, 3) or (len(argv) == 3
                                    and argv[2] != "volatile"):
-        print("usage: queue_server PORT DATA_DIR [volatile]",
-              file=sys.stderr)
+        print("usage: queue_server PORT DATA_DIR [--host H] "
+              "[volatile]", file=sys.stderr)
         raise SystemExit(2)
     port, data_dir = int(argv[0]), argv[1]
-    srv = Server(("127.0.0.1", port), Handler)
+    srv = Server((host, port), Handler)
     srv.store = Store(data_dir, volatile=len(argv) == 3)
-    print(f"queue_server: listening on 127.0.0.1:{port}", flush=True)
+    print(f"queue_server: listening on {host}:{port}", flush=True)
     srv.serve_forever()
 
 

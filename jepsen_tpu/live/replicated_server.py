@@ -65,7 +65,14 @@ Usage::
 
   python -m jepsen_tpu.live.replicated_server PORT DATA_DIR \
       --id I --peers P1,P2,P3 --oplog PATH [--lease-ms MS] \
-      [volatile] [split-brain]
+      [--host H] [volatile] [split-brain]
+
+``--peers`` entries are ``host:port`` (bare ports mean 127.0.0.1).
+With ``--host`` every node binds its own loopback address and every
+peer request is **source-bound** to it, so the per-peer-link
+partitioner (live/links.py) can cut exactly the (src, dst) pairs a
+grudge names — consensus traffic rides the links, client traffic
+(default 127.0.0.1 source) does not.
 """
 
 from __future__ import annotations
@@ -75,12 +82,43 @@ import random
 import sys
 import threading
 import time
-import urllib.error
 import urllib.parse
-import urllib.request
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
 PREFIX = "/v2/keys/"
+
+
+def http_json(host: str, port: int, path: str, *, method: str = "GET",
+              data: bytes | None = None, timeout: float = 0.5,
+              src: str | None = None,
+              headers: dict | None = None) -> tuple[int, dict]:
+    """One JSON HTTP round trip with an explicit SOURCE address —
+    urllib can't source-bind, and without it every peer packet leaves
+    as 127.0.0.1 and no link rule can tell the peers apart.  Error
+    statuses come back as values (no exception); transport failures
+    raise OSError (ConnectionRefusedError when nothing accepted the
+    bytes — the caller's "definitely didn't happen" case)."""
+    import http.client
+
+    conn = http.client.HTTPConnection(
+        host, port, timeout=timeout,
+        source_address=(src, 0) if src else None)
+    try:
+        try:
+            conn.request(method, path, body=data, headers=headers or {})
+            r = conn.getresponse()
+            body = r.read()
+        except http.client.HTTPException as e:
+            raise OSError(f"http: {e}") from e
+        try:
+            return r.status, json.loads(body or b"{}")
+        except ValueError as e:
+            # a torn/malformed body behind a 200: the peer PROCESSED
+            # the request — the caller must treat it as indeterminate,
+            # never as a clean reply
+            raise OSError(f"malformed reply: {e}") from e
+    finally:
+        conn.close()
 
 #: the fraction of the follower-honored lease a leader trusts for its
 #: own serving — the stale-read window survives only a clock *rate*
@@ -91,15 +129,22 @@ LEADER_MARGIN = 0.5
 class Replica:
     """One replica's state machine + consensus bookkeeping."""
 
-    def __init__(self, node_id: int, peers: list[int], oplog_path: str,
+    #: oplog entry kinds this state machine replays (subclasses — the
+    #: replicated queue — override both this and ``_apply_locked``)
+    _REPLAY_OPS = ("set",)
+
+    def __init__(self, node_id: int, peers: list, oplog_path: str,
                  lease_s: float = 0.7, volatile: bool = False,
-                 split_brain: bool = False):
+                 split_brain: bool = False, host: str = "127.0.0.1"):
         import os
 
         from .oplog import DurableLog
 
         self.id = node_id
-        self.peers = peers  # ports, index == node id; includes self
+        self.host = host  # own address; peer requests source-bind it
+        #: (host, port) per replica, index == node id; includes self
+        self.peers = [p if isinstance(p, tuple) else ("127.0.0.1", p)
+                      for p in peers]
         self.lease_s = lease_s
         self.volatile = volatile
         self.split_brain = split_brain
@@ -119,6 +164,10 @@ class Replica:
         self.log = DurableLog(os.path.dirname(oplog_path) or ".",
                               name=os.path.basename(oplog_path),
                               volatile=volatile)
+        #: how far into the shared oplog this replica has scanned —
+        #: catch-up (which runs per commit, see commit_seq_locked)
+        #: reads only the tail past it, not the whole file
+        self._log_pos = 0
         self._catch_up_locked()
         self.log.open()
         self._stop = threading.Event()
@@ -133,14 +182,17 @@ class Replica:
 
     def _catch_up_locked(self) -> int:
         """Replay every shared-oplog entry past the applied prefix —
-        restart recovery AND gap repair use the same path."""
+        restart recovery AND gap repair use the same path.  Scans only
+        the file tail past ``_log_pos`` (this runs per commit)."""
         applied = 0
-        for line in self.log.replay():
+        lines, self._log_pos = self.log.tail(self._log_pos)
+        for line in lines:
             try:
                 e = json.loads(line)
             except ValueError:
                 continue
-            if e.get("op") == "set" and int(e.get("seq", 0)) > self.seq:
+            if e.get("op") in self._REPLAY_OPS \
+                    and int(e.get("seq", 0)) > self.seq:
                 self._apply_locked(e)
                 applied += 1
         return applied
@@ -156,10 +208,13 @@ class Replica:
     def _majority(self) -> int:
         return len(self.peers) // 2 + 1
 
-    def _peer_get(self, port: int, path: str, timeout: float = 0.4):
-        url = f"http://127.0.0.1:{port}{path}"
-        with urllib.request.urlopen(url, timeout=timeout) as r:
-            return json.loads(r.read() or b"{}")
+    def _peer_get(self, peer: tuple, path: str, timeout: float = 0.4):
+        host, port = peer
+        status, out = http_json(host, port, path, timeout=timeout,
+                                src=self.host)
+        if status >= 400:
+            raise OSError(f"peer {host}:{port} -> {status}")
+        return out
 
     def _election_timeout(self) -> float:
         # staggered by id so replicas don't duel; ~1.5-2.5 leases
@@ -194,12 +249,12 @@ class Replica:
         acks = 1
         with self.lock:
             seq = self.seq
-        for i, port in enumerate(self.peers):
+        for i, peer in enumerate(self.peers):
             if i == self.id:
                 continue
             try:
                 out = self._peer_get(
-                    port, f"/_repl/ping?term={term}&leader={self.id}"
+                    peer, f"/_repl/ping?term={term}&leader={self.id}"
                           f"&seq={seq}")
                 if out.get("granted"):
                     acks += 1
@@ -222,12 +277,12 @@ class Replica:
             term, seq = self.term, self.seq
             self.granted_term = term  # self-vote
         votes = 1
-        for i, port in enumerate(self.peers):
+        for i, peer in enumerate(self.peers):
             if i == self.id:
                 continue
             try:
                 out = self._peer_get(
-                    port,
+                    peer,
                     f"/_repl/vote?term={term}&cand={self.id}&seq={seq}")
                 if out.get("granted"):
                     votes += 1
@@ -361,6 +416,9 @@ class Replica:
         with self.lock:
             if not self.leader_serving():
                 return 503, {"errorCode": 300, "message": "not leader"}
+            # adopt the shared-oplog tail BEFORE the CAS compare and
+            # the seq assignment, so neither reads stale state
+            seq = self.commit_seq_locked()
             if prev is not None:
                 cur = self.state.get(key)
                 if cur is None:
@@ -371,35 +429,62 @@ class Replica:
                     return 412, {"errorCode": 101,
                                  "message": "Compare failed",
                                  "cause": f"[{prev} != {cur}]"}
-            entry = {"op": "set", "seq": self.seq + 1, "term": self.term,
+            entry = {"op": "set", "seq": seq, "term": self.term,
                      "leader": self.id, "k": key, "v": value}
-            # the commit record first (durable before any ack can
-            # exist), then the wire — under the lock: the
-            # linearization point of an acked write is in here
-            self.log.append(json.dumps(entry))
-            acks = 1
-            for i, port in enumerate(self.peers):
-                if i == self.id:
-                    continue
-                try:
-                    data = json.dumps(entry).encode()
-                    req = urllib.request.Request(
-                        f"http://127.0.0.1:{port}/_repl/append",
-                        data=data, method="POST",
-                        headers={"Content-Type": "application/json"})
-                    with urllib.request.urlopen(req, timeout=0.5):
-                        acks += 1
-                except OSError:
-                    pass
-            if acks < self._majority():
+            if not self.commit_locked(entry):
                 # the entry is in the shared log — a successor will
                 # adopt it — but THIS client gets indeterminacy (504,
                 # NOT 503: a 503 means "definitely didn't happen")
                 return 504, {"errorCode": 301, "message": "no quorum"}
-            self._apply_locked(entry)
             return 200, {"action": "compareAndSwap" if prev is not None
                          else "set",
                          "node": {"key": f"/{key}", "value": value}}
+
+    def _replicate_locked(self, entry: dict) -> int:
+        """Fan the entry out to every peer (source-bound, so link
+        grudges bite); returns the ack count, self included."""
+        acks = 1
+        data = json.dumps(entry).encode()
+        for i, (h, p) in enumerate(self.peers):
+            if i == self.id:
+                continue
+            try:
+                status, _ = http_json(
+                    h, p, "/_repl/append", method="POST", data=data,
+                    timeout=0.5, src=self.host,
+                    headers={"Content-Type": "application/json"})
+                if status < 400:
+                    acks += 1
+            except OSError:
+                pass
+        return acks
+
+    def commit_locked(self, entry: dict) -> bool:
+        """The one commit path, shared with the replicated queue: the
+        commit record first (durable before any ack can exist), then
+        the wire, majority required — under the caller's lock: the
+        linearization point of an acked mutation is in here.  False
+        means no quorum — indeterminate, never "didn't happen" (the
+        entry is in the shared log; a successor may adopt it).
+
+        Callers build the entry with ``seq`` = ``self.seq + 1`` under
+        the same lock AFTER :meth:`commit_seq_locked`, which re-reads
+        the shared-oplog tail first: a deposed leader's un-acked
+        append may have landed after this leader's election catch-up,
+        and assigning the same seq to a NEW entry would fork the log
+        (catch-up applies whichever came first and skips the other —
+        an acked write could silently lose)."""
+        self.log.append(json.dumps(entry))
+        if self._replicate_locked(entry) < self._majority():
+            return False
+        self._apply_locked(entry)
+        return True
+
+    def commit_seq_locked(self) -> int:
+        """The next commit's seq, with the shared-oplog tail adopted
+        first (see :meth:`commit_locked`); caller holds the lock."""
+        self._catch_up_locked()
+        return self.seq + 1
 
     def status(self) -> dict:
         with self.lock:
@@ -442,42 +527,30 @@ class Handler(BaseHTTPRequestHandler):
             lid = rep.leader_id
         if lid is None or lid == rep.id:
             return False
-        url = f"http://127.0.0.1:{rep.peers[lid]}{self.path}"
-        req = urllib.request.Request(
-            url, data=body, method=self.command,
-            headers={"X-Repl-Proxied": "1",
-                     "Content-Type": self.headers.get(
-                         "Content-Type") or "application/octet-stream"})
+        host, port = rep.peers[lid]
         try:
-            with urllib.request.urlopen(req, timeout=1.5) as r:
-                self._reply(r.status, json.loads(r.read() or b"{}"))
-                return True
-        except urllib.error.HTTPError as e:
-            try:
-                body = json.loads(e.read() or b"{}")
-            except ValueError:
-                body = {"errorCode": 301, "message": "proxy error"}
-            self._reply(e.code, body)
-            return True
-        except urllib.error.URLError as e:
-            if isinstance(getattr(e, "reason", None),
-                          ConnectionRefusedError):
-                # nothing accepted the forwarded bytes: the op
-                # definitely didn't happen — safe to fall back to the
-                # caller's 503
-                return False
-            # anything else (timeout, reset, ...) may have fired AFTER
-            # the leader processed the op — indeterminate, never
-            # "didn't happen" (a 503 would let the client record :fail
-            # for a write that actually committed: a false violation)
-            self._reply(504, {"errorCode": 301,
-                              "message": "proxy indeterminate"})
+            # source-bound like every peer request: a proxied client op
+            # is inter-node traffic and must ride the same links the
+            # partitioner cuts
+            status, out = http_json(
+                host, port, self.path, method=self.command, data=body,
+                timeout=1.5, src=rep.host,
+                headers={"X-Repl-Proxied": "1",
+                         "Content-Type": self.headers.get(
+                             "Content-Type")
+                         or "application/octet-stream"})
+            self._reply(status, out)
             return True
         except ConnectionRefusedError:
+            # nothing accepted the forwarded bytes: the op definitely
+            # didn't happen — safe to fall back to the caller's 503
             return False
-        except (OSError, ValueError):
-            # includes a malformed 200 body: the leader PROCESSED the
-            # op — indeterminate
+        except OSError:
+            # anything else (timeout, reset, a malformed reply body)
+            # may have fired AFTER the leader processed the op —
+            # indeterminate, never "didn't happen" (a 503 would let
+            # the client record :fail for a write that actually
+            # committed: a false violation)
             self._reply(504, {"errorCode": 301,
                               "message": "proxy indeterminate"})
             return True
@@ -552,11 +625,26 @@ class Server(ThreadingHTTPServer):
     daemon_threads = True
 
 
+def parse_peers(spec: str) -> list[tuple]:
+    """``host:port`` entries (bare ports mean 127.0.0.1)."""
+    peers = []
+    for x in spec.split(","):
+        x = x.strip()
+        if not x:
+            continue
+        if ":" in x:
+            h, p = x.rsplit(":", 1)
+            peers.append((h, int(p)))
+        else:
+            peers.append(("127.0.0.1", int(x)))
+    return peers
+
+
 def main(argv=None) -> None:
     argv = list(sys.argv[1:] if argv is None else argv)
     flags = {"volatile": False, "split-brain": False}
     opts = {"--id": None, "--peers": None, "--oplog": None,
-            "--lease-ms": "700"}
+            "--lease-ms": "700", "--host": "127.0.0.1"}
     pos: list[str] = []
     i = 0
     while i < len(argv):
@@ -572,20 +660,21 @@ def main(argv=None) -> None:
     if len(pos) != 2 or opts["--id"] is None or opts["--peers"] is None \
             or opts["--oplog"] is None:
         print("usage: replicated_server PORT DATA_DIR --id I "
-              "--peers P1,P2,.. --oplog PATH [--lease-ms MS] "
-              "[volatile] [split-brain]", file=sys.stderr)
+              "--peers H1:P1,H2:P2,.. --oplog PATH [--lease-ms MS] "
+              "[--host H] [volatile] [split-brain]", file=sys.stderr)
         raise SystemExit(2)
     port = int(pos[0])
-    peers = [int(x) for x in opts["--peers"].split(",") if x.strip()]
-    rep = Replica(int(opts["--id"]), peers, opts["--oplog"],
+    rep = Replica(int(opts["--id"]), parse_peers(opts["--peers"]),
+                  opts["--oplog"],
                   lease_s=int(opts["--lease-ms"]) / 1000.0,
                   volatile=flags["volatile"],
-                  split_brain=flags["split-brain"])
-    srv = Server(("127.0.0.1", port), Handler)
+                  split_brain=flags["split-brain"],
+                  host=opts["--host"])
+    srv = Server((opts["--host"], port), Handler)
     srv.replica = rep
     rep.start()
     print(f"replicated_server: id={rep.id} listening on "
-          f"127.0.0.1:{port}", flush=True)
+          f"{opts['--host']}:{port}", flush=True)
     srv.serve_forever()
 
 

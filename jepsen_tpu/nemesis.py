@@ -108,6 +108,75 @@ def majorities_ring(nodes: list) -> dict:
 
 
 # ---------------------------------------------------------------------------
+# per-peer-link grudges — directed (src, dst) pairs
+# ---------------------------------------------------------------------------
+#
+# The node->dropped-peers grudges above assume a net layer that can cut
+# whole node pairs.  The live harness's per-link partitioner
+# (live/links.py) works one level lower: a *link* is an ordered
+# ``(src, dst)`` pair meaning "traffic FROM src TO dst is dropped" —
+# the dst side's inbound drop in iptables terms.  Ordered pairs are
+# what make ASYMMETRIC faults (the classic split-brain stager: a
+# leader whose sends are lost while its clients still reach it)
+# expressible at all; the symmetric topologies are just both
+# directions of each cut pair.  Pure functions, unit-tested with no
+# iptables anywhere near them.
+
+
+def grudge_links(grudge: dict) -> set[tuple]:
+    """A node->set-of-dropped-peers grudge as directed links: node n
+    dropping traffic from s is the link (s, n)."""
+    return {(s, n) for n, dropped in grudge.items() for s in dropped}
+
+
+def bidirectional(links: Iterable[tuple]) -> set[tuple]:
+    """Close a link set under direction reversal (symmetric cut)."""
+    out = set()
+    for a, b in links:
+        out.add((a, b))
+        out.add((b, a))
+    return out
+
+
+def isolate_links(nodes: list, victim, *, inbound: bool = True,
+                  outbound: bool = True) -> set[tuple]:
+    """Cut one node's links: ``outbound`` drops victim->peer traffic,
+    ``inbound`` drops peer->victim.  Both on = the symmetric
+    split-one; exactly one on = the one-way asymmetric isolation."""
+    peers = [n for n in nodes if n != victim]
+    links: set[tuple] = set()
+    if outbound:
+        links |= {(victim, p) for p in peers}
+    if inbound:
+        links |= {(p, victim) for p in peers}
+    return links
+
+
+def split_one_links(nodes: list, loner=None) -> set[tuple]:
+    """split-one as links: one node fully cut, both directions."""
+    [loner], _rest = split_one(list(nodes), loner)
+    return isolate_links(nodes, loner)
+
+
+def bridge_links(nodes: list) -> set[tuple]:
+    """bridge as links: halves cut except the bridge node that talks
+    to both sides (majority-with-overlap — each half still reaches a
+    majority THROUGH the bridge)."""
+    return grudge_links(bridge(list(nodes)))
+
+
+def random_halves_links(nodes: list) -> set[tuple]:
+    """Random symmetric halves as links."""
+    return grudge_links(
+        complete_grudge(bisect(random.sample(list(nodes), len(nodes)))))
+
+
+def all_peer_links(nodes: list) -> set[tuple]:
+    """Every ordered peer pair — the degrade-everything target."""
+    return {(a, b) for a in nodes for b in nodes if a != b}
+
+
+# ---------------------------------------------------------------------------
 # partitioners (nemesis.clj:91-149)
 # ---------------------------------------------------------------------------
 

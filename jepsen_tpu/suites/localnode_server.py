@@ -131,16 +131,21 @@ class Server(socketserver.ThreadingTCPServer):
 
 
 def main(argv=None) -> None:
-    argv = sys.argv[1:] if argv is None else argv
+    argv = list(sys.argv[1:] if argv is None else argv)
+    host = "127.0.0.1"
+    if "--host" in argv:  # per-node loopback address (live/links.py)
+        i = argv.index("--host")
+        host = argv[i + 1]
+        del argv[i:i + 2]
     if len(argv) not in (2, 3) or (len(argv) == 3
                                    and argv[2] != "volatile"):
-        print("usage: localnode_server PORT DATA_DIR [volatile]",
-              file=sys.stderr)
+        print("usage: localnode_server PORT DATA_DIR [--host H] "
+              "[volatile]", file=sys.stderr)
         raise SystemExit(2)
     port, data_dir = int(argv[0]), argv[1]
-    srv = Server(("127.0.0.1", port), Handler)
+    srv = Server((host, port), Handler)
     srv.store = Store(data_dir, volatile_lock=len(argv) == 3)
-    print(f"localnode_server: listening on 127.0.0.1:{port}", flush=True)
+    print(f"localnode_server: listening on {host}:{port}", flush=True)
     srv.serve_forever()
 
 

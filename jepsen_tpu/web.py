@@ -107,7 +107,9 @@ async function pollStats() {
         "fleet: " + v("jtpu_stream_runs_open") + " open runs · "
         + "cache hit ratio " + (d.verdict_cache_hit_ratio ?? "n/a")
         + " · " + v("jtpu_shed_total") + " shed · watchdog "
-        + v("jtpu_watchdog_total");
+        + v("jtpu_watchdog_total")
+        + " · corpus " + v("jtpu_corpus_pool_size")
+        + " · rules swept " + v("jtpu_link_rules_swept_total");
     }
   } catch (e) {}
   setTimeout(pollStats, 5000);
@@ -177,13 +179,20 @@ def campaign_html(base: str, cid: str) -> str:
                 body = f"{label}{o.get('valid')}"
                 det = o.get("detection") or {}
                 if det.get("latency_s") is not None:
-                    # streamed = the live verdict flipped mid-run (an
-                    # online cut or the :info lookahead fork);
-                    # finalize = only the stream's close confirmed it
+                    # the detection GRADE: streamed = the live verdict
+                    # flipped mid-run (an online cut or the :info
+                    # lookahead fork); finalize = only the close
+                    # confirmed it (post-hoc marks model-less
+                    # families, whose only close is the batch checker)
                     at = det.get("at") or "streamed"
+                    if det.get("source") == "post-hoc":
+                        at += "/post-hoc"
                     body += f" (detected in {det['latency_s']}s, {at})"
-                elif det.get("at") == "finalize":
-                    body += " (detected at finalize)"
+                elif det.get("at"):
+                    at = det["at"]
+                    if det.get("source") == "post-hoc":
+                        at += "/post-hoc"
+                    body += f" (detected at {at})"
                 if (o.get("watchdog") or {}).get("fired"):
                     body += " [watchdog]"
                 if o.get("attempts", 1) > 1:

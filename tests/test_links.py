@@ -1,0 +1,404 @@
+"""Per-peer-link partitions (jepsen_tpu/live/links.py + the grudge
+math in jepsen_tpu/nemesis.py).
+
+Tier-1 here: the pure grudge-topology math (split-one / bridge /
+isolate-leader / one-way / random-halves produce the expected
+(src, dst) rule sets — no iptables anywhere near these), the address
+scheme, the crash-safe rule journal and its sweep contract (fake rule
+engine — installs/removals recorded, never executed), the
+LinkPartitionNemesis start/heal cycle over the journal, and the
+``--dry-run`` validation of the full family × nemesis × grudge matrix
+(spawns nothing).  A real-engine install/sweep round trip runs where
+the host can actually stage links (iptables or tc), and skips with the
+probe's own reason elsewhere.
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+NODES = ["n1", "n2", "n3"]
+
+
+# ---------------------------------------------------------------------------
+# grudge-topology math — pure functions
+# ---------------------------------------------------------------------------
+
+
+def test_grudge_links_directed_semantics():
+    from jepsen_tpu import nemesis
+
+    # node n dropping traffic FROM s is the link (s, n)
+    grudge = {"n1": {"n3"}, "n3": {"n1"}}
+    assert nemesis.grudge_links(grudge) == {("n3", "n1"), ("n1", "n3")}
+    assert nemesis.grudge_links({}) == set()
+
+
+def test_split_one_links_full_symmetric_cut():
+    from jepsen_tpu import nemesis
+
+    links = nemesis.split_one_links(NODES, "n2")
+    assert links == {("n2", "n1"), ("n2", "n3"),
+                     ("n1", "n2"), ("n3", "n2")}
+    # loner chosen at random still cuts exactly one node fully
+    links = nemesis.split_one_links(NODES)
+    cut = {a for a, _ in links} & {b for _, b in links}
+    [loner] = [n for n in NODES
+               if all(n in (a, b) for a, b in links)]
+    assert len(links) == 4
+    assert loner in cut
+
+
+def test_bridge_links_majority_with_overlap():
+    from jepsen_tpu import nemesis
+
+    # bisect([n1,n2,n3]) -> [n1] | [n2,n3], bridge n2: only n1<->n3 cut
+    links = nemesis.bridge_links(NODES)
+    assert links == {("n1", "n3"), ("n3", "n1")}
+    # 5 nodes: halves [a,b] | [c,d,e], bridge c — every cross-half pair
+    # except those touching the bridge
+    links5 = nemesis.bridge_links(["a", "b", "c", "d", "e"])
+    expected = set()
+    for x in ("a", "b"):
+        for y in ("d", "e"):
+            expected |= {(x, y), (y, x)}
+    assert links5 == expected
+
+
+def test_isolate_links_one_way_asymmetry():
+    from jepsen_tpu import nemesis
+
+    # outbound-only: peers drop traffic FROM the victim; the reverse
+    # direction stays up — the asymmetric split-brain stager
+    out = nemesis.isolate_links(NODES, "n1", inbound=False,
+                                outbound=True)
+    assert out == {("n1", "n2"), ("n1", "n3")}
+    inb = nemesis.isolate_links(NODES, "n1", inbound=True,
+                                outbound=False)
+    assert inb == {("n2", "n1"), ("n3", "n1")}
+    assert nemesis.isolate_links(NODES, "n1") == out | inb
+    # one-way sets are disjoint from their reverses (truly asymmetric)
+    assert not out & {(b, a) for a, b in out}
+
+
+def test_random_halves_links_symmetric_partition():
+    from jepsen_tpu import nemesis
+
+    links = nemesis.random_halves_links(["a", "b", "c", "d"])
+    # 2|2 halves: 4 directed cross links in each direction
+    assert len(links) == 8
+    assert links == {(b, a) for a, b in links}  # symmetric
+    # every node keeps at least one peer it still talks to
+    for n in ("a", "b", "c", "d"):
+        cut_from_n = {d for s, d in links if s == n}
+        assert len(cut_from_n) == 2
+
+
+def test_all_peer_links_and_bidirectional():
+    from jepsen_tpu import nemesis
+
+    assert nemesis.all_peer_links(["x", "y"]) == {("x", "y"),
+                                                  ("y", "x")}
+    assert nemesis.bidirectional({("a", "b")}) == {("a", "b"),
+                                                   ("b", "a")}
+
+
+def test_node_addr_scheme():
+    from jepsen_tpu.live import links
+
+    test = {"nodes": NODES}
+    assert [links.node_addr(test, n) for n in NODES] == \
+        ["127.0.1.1", "127.0.1.2", "127.0.1.3"]
+    assert links.node_addr({"nodes": NODES,
+                            "addr_base": "127.0.2."}, "n2") \
+        == "127.0.2.2"
+
+
+# ---------------------------------------------------------------------------
+# the rule journal — crash-safe, swept
+# ---------------------------------------------------------------------------
+
+
+def test_journal_append_read_clear_and_torn_tail(tmp_path):
+    from jepsen_tpu.live import links
+
+    root = str(tmp_path)
+    assert links.journal_rules(root) == []
+    r1 = {"kind": "link", "src": "127.0.1.1", "dst": "127.0.1.2",
+          "mode": "drop", "engine": "iptables"}
+    r2 = {"kind": "port", "port": 18100, "engine": "iptables"}
+    links.journal_append(root, r1)
+    links.journal_append(root, r2)
+    assert links.journal_rules(root) == [r1, r2]
+    # a torn final line (SIGKILL mid-append) is dropped, not crashed on
+    with open(links.journal_path(root), "a") as f:
+        f.write('{"kind": "link", "src": "127.0')
+    assert links.journal_rules(root) == [r1, r2]
+    links.journal_clear(root)
+    assert links.journal_rules(root) == []
+
+
+class FakeEngine:
+    """Records installs/removals; never touches the host."""
+
+    name = "iptables"
+
+    def __init__(self, fail_remove=False):
+        self.installed = []
+        self.removed = []
+        self.swept = 0
+        self.fail_remove = fail_remove
+
+    def supports(self, mode):
+        return None
+
+    def install(self, rule):
+        self.installed.append(dict(rule))
+
+    def remove(self, rule):
+        self.removed.append(dict(rule))
+        return not self.fail_remove
+
+    def sweep_engine(self):
+        self.swept += 1
+
+
+def test_sweep_removes_journaled_rules_and_counts(tmp_path):
+    from jepsen_tpu.live import links
+    from jepsen_tpu.obs import metrics as obs_metrics
+
+    root = str(tmp_path)
+    eng = FakeEngine()
+    rules = [{"kind": "link", "src": "127.0.1.1", "dst": "127.0.1.2",
+              "mode": "drop", "engine": "iptables"},
+             {"kind": "port", "port": 18100, "engine": "iptables"}]
+    for r in rules:
+        links.journal_append(root, r)
+    before = obs_metrics.REGISTRY.get(
+        "jtpu_link_rules_swept_total").total()
+    assert links.sweep(root, engine=eng) == 2
+    assert eng.removed == rules
+    assert eng.swept == 1
+    assert links.journal_rules(root) == []  # journal cleared
+    assert links.sweep(root, engine=eng) == 0  # idempotent
+    after = obs_metrics.REGISTRY.get(
+        "jtpu_link_rules_swept_total").total()
+    assert after - before == 2
+
+
+def test_sweep_tree_finds_nested_journals(tmp_path):
+    from jepsen_tpu.live import links
+
+    eng_rules = {"kind": "link", "src": "127.0.1.1",
+                 "dst": "127.0.1.3", "mode": "drop",
+                 "engine": "iptables"}
+    roots = [str(tmp_path / "cell-a"), str(tmp_path / "cell-b")]
+    for r in roots:
+        links.journal_append(r, eng_rules)
+    # the removal itself shells out to a missing binary and fails —
+    # the sweep still clears the journals (rules can't exist when the
+    # engine doesn't)
+    assert links.sweep_tree(str(tmp_path)) == 2
+    for r in roots:
+        assert links.journal_rules(r) == []
+
+
+def test_link_partition_nemesis_start_journal_heal(tmp_path):
+    from jepsen_tpu.history import Op
+    from jepsen_tpu.live import links
+    from jepsen_tpu.live.backend import FAMILIES
+
+    backend = FAMILIES["replicated"]
+    test = {"nodes": NODES, "data_root": str(tmp_path)}
+    eng = FakeEngine()
+    nem = links.LinkPartitionNemesis(backend, "bridge", engine=eng)
+    op = Op(process="nemesis", type="info", f="start", value=None)
+    out = nem.invoke(test, op)
+    assert out.type == "info"
+    assert out.value[0] == "links-drop"
+    assert out.value[1] == "bridge"
+    # bridge over [n1,n2,n3]: exactly n1<->n3, both directions, by addr
+    assert sorted((r["src"], r["dst"]) for r in eng.installed) == \
+        [("127.0.1.1", "127.0.1.3"), ("127.0.1.3", "127.0.1.1")]
+    # every installed rule was journaled BEFORE install
+    assert len(links.journal_rules(str(tmp_path))) == 2
+    # second start is a no-op
+    assert nem.invoke(test, op).value == "already-partitioned"
+    # stop heals through the journal sweep
+    out = nem.invoke(test, Op(process="nemesis", type="info",
+                              f="stop", value=None))
+    assert out.value == "links-healed"
+    assert len(eng.removed) == 2
+    assert links.journal_rules(str(tmp_path)) == []
+
+
+def test_isolate_leader_grudge_targets_backend_leader(tmp_path):
+    from jepsen_tpu.history import Op
+    from jepsen_tpu.live import links
+
+    class FakeBackend:
+        name = "fake"
+        peer_linked = True
+
+        def leader(self, test):
+            return "n3"
+
+    eng = FakeEngine()
+    nem = links.LinkPartitionNemesis(FakeBackend(), "isolate-leader",
+                                     engine=eng)
+    test = {"nodes": NODES, "data_root": str(tmp_path)}
+    nem.invoke(test, Op(process="nemesis", type="info", f="start",
+                        value=None))
+    # one-way: peers drop traffic FROM the leader only
+    assert sorted((r["src"], r["dst"]) for r in eng.installed) == \
+        [("127.0.1.3", "127.0.1.1"), ("127.0.1.3", "127.0.1.2")]
+    nem.teardown(test)
+    assert links.journal_rules(str(tmp_path)) == []
+
+
+def test_degrade_grudge_uses_degrade_mode(tmp_path):
+    from jepsen_tpu.history import Op
+    from jepsen_tpu.live import links
+    from jepsen_tpu.live.backend import FAMILIES
+
+    eng = FakeEngine()
+    nem = links.LinkPartitionNemesis(FAMILIES["replicated"], "degrade",
+                                     engine=eng)
+    test = {"nodes": NODES, "data_root": str(tmp_path)}
+    nem.invoke(test, Op(process="nemesis", type="info", f="start",
+                        value=None))
+    assert len(eng.installed) == 6  # every ordered peer pair
+    assert all(r["mode"] == "degrade" for r in eng.installed)
+    nem.teardown(test)
+
+
+# ---------------------------------------------------------------------------
+# real engine round trip — only where the host can stage links
+# ---------------------------------------------------------------------------
+
+
+def test_real_engine_install_block_sweep_heal():
+    import socket
+    import threading
+
+    from jepsen_tpu.live import links
+
+    reason = links.probe_links()
+    if reason is not None:
+        pytest.skip(f"no link rule engine here: {reason}")
+    eng, _ = links.pick_engine()
+    root = "/tmp/jepsen-links-test"
+    links.journal_clear(root)
+    rule = {"kind": "link", "src": "127.0.1.1", "dst": "127.0.1.2",
+            "mode": "drop", "engine": eng.name}
+    srv = socket.socket()
+    srv.bind(("127.0.1.2", 0))
+    srv.listen(2)
+    port = srv.getsockname()[1]
+
+    def accept_loop():
+        while True:
+            try:
+                c, _a = srv.accept()
+                c.close()
+            except OSError:
+                return
+
+    threading.Thread(target=accept_loop, daemon=True).start()
+    try:
+        links.journal_append(root, rule)
+        eng.install(rule)
+        # the cut (src, dst) direction is dead...
+        s = socket.socket()
+        s.bind(("127.0.1.1", 0))
+        s.settimeout(1.0)
+        with pytest.raises(OSError):
+            s.connect(("127.0.1.2", port))
+        s.close()
+        # ...while the client direction (default source) still works
+        socket.create_connection(("127.0.1.2", port),
+                                 timeout=1.0).close()
+        # sweep restores connectivity and clears the journal
+        assert links.sweep(root, engine=eng) == 1
+        s2 = socket.socket()
+        s2.bind(("127.0.1.1", 0))
+        s2.settimeout(2.0)
+        s2.connect(("127.0.1.2", port))
+        s2.close()
+        assert links.journal_rules(root) == []
+    finally:
+        links.sweep(root)
+        srv.close()
+
+
+# ---------------------------------------------------------------------------
+# the full family × nemesis × grudge matrix — dry-run, spawns nothing
+# ---------------------------------------------------------------------------
+
+
+def test_dry_run_validates_family_nemesis_grudge_matrix():
+    from jepsen_tpu.live import links
+    from jepsen_tpu.live.backend import FAMILIES
+    from jepsen_tpu.live.campaign import SEEDED
+    from jepsen_tpu.live.matrix import standard_matrix
+
+    r = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "campaign.py"),
+         "--dry-run", "--json"],
+        capture_output=True, text=True, cwd=REPO, timeout=120)
+    assert r.returncode == 0, r.stderr
+    cells = json.loads(r.stdout)
+    matrix = standard_matrix()
+    base = [c for c in cells if not c["seeded"]]
+    # the full cross product, exactly once per coordinate
+    assert {(c["family"], c["nemesis"]) for c in base} == \
+        {(f, n) for f in FAMILIES for n in matrix}
+    assert len(base) == len(FAMILIES) * len(matrix)
+    # one matrix row per grudge
+    link_rows = [n for n in matrix if n.startswith("link-")]
+    assert set(link_rows) == {f"link-{g}" for g in links.GRUDGES}
+    assert len(link_rows) >= 5
+    by_coord = {(c["family"], c["nemesis"]): c for c in base}
+    engine_reason = links.probe_links()
+    for fname, fam in FAMILIES.items():
+        for n in link_rows:
+            cell = by_coord[(fname, n)]
+            if not fam.peer_linked:
+                # families without inter-node links skip with a reason
+                # naming the gap, not a crash and not a silent run
+                assert cell["skip"] and "no inter-node links" \
+                    in cell["skip"], cell
+            elif engine_reason is not None:
+                assert cell["skip"], cell
+            elif n == "link-degrade":
+                # mode-aware engine pick: degrade can run on tc even
+                # where iptables (drop-only) would win the drop pick
+                assert (cell["skip"] is None) == \
+                    (links.probe_degrade() is None)
+            else:
+                assert cell["skip"] is None, cell
+    # seeded link cells appear exactly where an engine exists
+    seeded = {(c["family"], c["nemesis"]) for c in cells
+              if c["seeded"]}
+    for coord in (("replicated", "link-isolate-leader"),
+                  ("replicated-queue", "link-bridge")):
+        assert coord in SEEDED
+        assert (coord in seeded) == (engine_reason is None)
+    # kill-restart still needs nothing exotic, for every family
+    assert all(by_coord[(f, "kill-restart")]["skip"] is None
+               for f in FAMILIES)
+
+
+def test_render_plan_covers_grudge_columns():
+    from jepsen_tpu.live.campaign import plan, render_plan
+
+    cells = plan()
+    out = render_plan(cells)
+    assert "link-bridge" in out
+    assert "link-isolate-leader" in out
+    assert "replicated-queue" in out and "pgwire" in out

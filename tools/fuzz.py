@@ -13,6 +13,16 @@ Usage:
     python tools/fuzz.py --rounds 200 [--seed 0] [--n-ops 60]
                          [--model cas-register|register|mutex|
                                   unordered-queue|fifo-queue]
+    python tools/fuzz.py --corpus [store/corpus]
+
+``--corpus`` is the campaign->fuzz regression net (live/corpus.py):
+every banked live-campaign history replays through ALL engine routes —
+direct device BFS, decomposed, bucketed, streaming — with
+verdict-parity assertions, a banked-expectation check, and the
+certificate audit; queue (multiset) entries replay through
+``total_queue``.  Exit 1 on any parity break, expectation mismatch, or
+W-code.
+
 Exit code 0 = no divergence; 1 = divergence found (minimal repro printed
 as JSON ops, replayable via --replay FILE).
 """
@@ -180,6 +190,103 @@ def shrink(h: list[Op], model, *, max_passes: int = 8) -> list[Op]:
     return cur
 
 
+def corpus_replay(pool_dir: str, *, audit: bool = True,
+                  max_entries: int | None = None,
+                  budget: int = DEVICE_BUDGET) -> int:
+    """Replay the banked campaign corpus through every engine route.
+
+    Engine entries (register/mutex models) run direct (device BFS),
+    decomposed, bucketed, and streaming; all decided verdicts must be
+    bit-identical to each other AND to the banked expectation (when
+    one was recorded), and every certificate must audit clean.  Queue
+    entries replay deterministically through ``total_queue`` against
+    their banked verdict.  Returns 0 clean / 1 on any failure."""
+    from jepsen_tpu.analyze.audit import audit as audit_fn
+    from jepsen_tpu.decompose.engine import check_opseq_decomposed
+    from jepsen_tpu.live import corpus as corpus_mod
+    from jepsen_tpu.stream import StreamChecker
+
+    entries = corpus_mod.load_pool(pool_dir)
+    if max_entries is not None:
+        entries = entries[:max_entries]
+    if not entries:
+        print(f"corpus: no entries under {pool_dir}")
+        return 0
+    t0 = time.time()
+    failures = unknowns = 0
+    for i, e in enumerate(entries):
+        label = (f"{e.get('family')}×{e.get('nemesis')}"
+                 f"{' seeded' if e.get('seeded') else ''} "
+                 f"[{e['id'][:12]}]")
+        ops = [Op.from_dict(d) for d in e["ops"]]
+        banked = e.get("valid")
+        try:
+            if e.get("routes") == "queue":
+                r = corpus_mod.replay_queue(ops)
+                verdicts = {"total-queue": r["valid"]}
+                results = []
+            else:
+                model = corpus_mod.entry_model(e)
+                s = encode_ops(ops, model.f_codes)
+                direct = lin.search_opseq(s, model, budget=budget)
+                decomposed = check_opseq_decomposed(s, model,
+                                                    witness=True)
+                bucketed = lin.search_batch([s], model, bucket=True,
+                                            budget=budget)[0]
+                sc = StreamChecker(model)
+                for op in ops:
+                    sc.ingest(op)
+                streamed = sc.finalize()
+                verdicts = {"direct": direct["valid"],
+                            "decomposed": decomposed["valid"],
+                            "bucketed": bucketed["valid"],
+                            "streaming": streamed["valid"]}
+                results = [("direct", s, model, direct),
+                           ("decomposed", s, model, decomposed),
+                           ("bucketed", s, model, bucketed),
+                           ("streaming", s, model, streamed)]
+        except Exception as exc:  # noqa: BLE001 — report, keep going
+            print(f"CORPUS FAILURE {label}: replay crashed: "
+                  f"{type(exc).__name__}: {exc}", file=sys.stderr)
+            failures += 1
+            continue
+        decided = {k: v for k, v in verdicts.items()
+                   if v not in ("unknown",)}
+        unknowns += len(verdicts) - len(decided)
+        if len(set(decided.values())) > 1:
+            print(f"CORPUS DIVERGENCE {label}: {verdicts}",
+                  file=sys.stderr)
+            failures += 1
+            continue
+        if banked is not None and decided \
+                and set(decided.values()) != {banked}:
+            print(f"CORPUS REGRESSION {label}: banked verdict "
+                  f"{banked}, engines now say {verdicts}",
+                  file=sys.stderr)
+            failures += 1
+            continue
+        if audit:
+            bad = []
+            for engine, s_, m_, r_ in results:
+                a = audit_fn(s_, m_, r_)
+                if not a["ok"]:
+                    bad.extend((engine, d) for d in a["diagnostics"])
+            if bad:
+                print(f"CORPUS AUDIT FAILURE {label}:",
+                      file=sys.stderr)
+                for engine, d in bad:
+                    print(f"  [{engine}] {d}", file=sys.stderr)
+                failures += 1
+    status = "CLEAN" if failures == 0 else f"{failures} FAILURE(S)"
+    print(f"corpus: {len(entries)} entr"
+          f"{'y' if len(entries) == 1 else 'ies'} replayed through "
+          f"all routes, {status}"
+          + (f" ({unknowns} route verdict(s) unknown under the "
+             f"budget)" if unknowns else "")
+          + f" ({time.time() - t0:.0f}s)")
+    return 1 if failures else 0
+
+
 def replay(path: str, model_name: str) -> int:
     model = MODELS[model_name]()
     ops = [Op.from_dict(d) for d in json.load(open(path))]
@@ -199,12 +306,25 @@ def main() -> int:
     ap.add_argument("--model", default="cas-register",
                     choices=sorted(MODELS))
     ap.add_argument("--replay", metavar="FILE")
+    ap.add_argument("--corpus", nargs="?", const="store/corpus",
+                    default=None, metavar="DIR",
+                    help="Replay the banked live-campaign corpus "
+                         "(live/corpus.py) through all engine routes "
+                         "with verdict-parity + audit assertions; "
+                         "DIR defaults to store/corpus")
+    ap.add_argument("--max-entries", type=int, default=None,
+                    help="Bound the --corpus replay to the first N "
+                         "pool entries")
     ap.add_argument("--out", default="fuzz-repro.json")
     ap.add_argument("--audit", action="store_true",
                     help="Also replay every engine's certificate "
                          "through jepsen_tpu.analyze.audit; any W-code "
                          "fails the run loudly (exit 1)")
     args = ap.parse_args()
+
+    if args.corpus is not None:
+        return corpus_replay(args.corpus,
+                             max_entries=args.max_entries)
 
     if args.replay:
         return replay(args.replay, args.model)

@@ -1236,6 +1236,106 @@ def host_comparators(tiers) -> dict:
     return out
 
 
+def run_hb_probe(out_path: str | None = None) -> dict:
+    """HB-on-vs-off probe over the 10k tiers -> BENCH_hb.json.
+
+    Per tier (10k, 10kuniq, 10k64): the static plan's raw vs pruned
+    config bound (``explain()['hb']``), a budget-capped host-sweep
+    comparison (explored configs / depth reached with the must-order
+    mask on vs off), and — for the decide-fast tier — a traced device
+    probe whose ``device.slice`` spans show the search the pre-pass
+    removed (the PR-10 bench contract: cite spans, not wall-clock
+    alone).  Budgets are env-tunable (BENCH_HB_HOST_CAP,
+    BENCH_HB_DEV_BUDGET, BENCH_HB_TIERS); histories are the tier
+    generators' own, full size.
+    """
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    from jepsen_tpu import obs as _obs
+    from jepsen_tpu.analyze.plan import explain
+    from jepsen_tpu.checker.linear import check_opseq_linear
+    from jepsen_tpu.checker.linearizable import search_batch
+
+    host_cap = int(os.environ.get("BENCH_HB_HOST_CAP", "400000"))
+    dev_budget = int(os.environ.get("BENCH_HB_DEV_BUDGET", "200000"))
+    tier_names = [t for t in os.environ.get(
+        "BENCH_HB_TIERS", "10k,10kuniq,10k64").split(",") if t]
+    _obs.enable(True)
+    out: dict = {"host_cap_configs": host_cap,
+                 "device_budget": dev_budget, "tiers": {}}
+
+    def device_spans():
+        """(count, seconds) over cat="device" spans: device.slice on
+        the single/sharded drivers, bucket.device on the bucketed
+        ladder — the removed-search evidence either way."""
+        sp = [s for s in _obs.recorder(None).spans()
+              if s["cat"] == "device"]
+        return len(sp), round(sum(s["dur"] for s in sp) / 1e6, 3)
+
+    for name in tier_names:
+        seq, model = make_seq(name)
+        row: dict = {"n_ops": len(seq), "model": model.name}
+        plan = explain(seq, model)
+        hb = plan["hb"]
+        row["explain"] = {
+            "raw_bound_log2": plan["config_upper_bound_log2"],
+            "pruned_bound": hb.get("pruned_upper_bound"),
+            "decided": hb.get("decided"),
+            "reason": hb.get("reason"),
+            "must_edges": hb.get("must_edges", 0),
+            "edges": hb.get("edges"),
+            "window": plan["window"],
+            "window_effective": hb.get("window_effective"),
+            "prune_ratio": hb.get("prune_ratio"),
+        }
+        # budget-capped host sweep: with the prune, the same budget
+        # reaches deeper (or decides outright at zero configs)
+        host = {}
+        for flag in (True, False):
+            t0 = time.perf_counter()
+            r = check_opseq_linear(seq, model, max_configs=host_cap,
+                                   lint=False, hb=flag)
+            host["on" if flag else "off"] = {
+                "valid": r["valid"], "configs": r["configs"],
+                "max_depth": r.get("max_depth"),
+                "seconds": round(time.perf_counter() - t0, 3),
+            }
+        row["host_sweep"] = host
+        # traced device probe for the decide-fast class: hb-on
+        # disposes the key before any device work, hb-off rides the
+        # bucketed ladder until the budget — the device.slice span
+        # delta IS the removed search
+        if row["explain"]["decided"] is not None:
+            dev = {}
+            for flag in (True, False):
+                n0, s0 = device_spans()
+                t0 = time.perf_counter()
+                r = search_batch([seq], model, budget=dev_budget,
+                                 bucket=True, lint=False, hb=flag)[0]
+                n1, s1 = device_spans()
+                dev["on" if flag else "off"] = {
+                    "valid": r["valid"], "engine": r.get("engine"),
+                    "configs": int(r.get("configs", 0) or 0),
+                    "device_slices": n1 - n0,
+                    "device_slice_seconds": round(s1 - s0, 3),
+                    "seconds": round(time.perf_counter() - t0, 3),
+                }
+            row["device_probe"] = dev
+        out["tiers"][name] = row
+        print(f"hb-probe {name}: decided={row['explain']['decided']} "
+              f"must_edges={row['explain']['must_edges']} host "
+              f"on/off configs "
+              f"{host['on']['configs']}/{host['off']['configs']}",
+              file=sys.stderr)
+    path = out_path or os.path.join(REPO, "BENCH_hb.json")
+    _obs.write_trace(os.path.join(REPO, "BENCH_trace_hb.json"))
+    out["trace"] = "BENCH_trace_hb.json (device.slice / hb.prepass "
+    out["trace"] += "spans)"
+    with open(path, "w") as f:
+        json.dump(out, f, indent=1, default=str)
+    print(f"hb-probe -> {path}")
+    return out
+
+
 def main():
     global _BEST, _BEST_PRIO, _BEST_TIER, _PROBE
 
@@ -1656,7 +1756,12 @@ def main():
 
 
 if __name__ == "__main__":
-    if "--stream-tier" in sys.argv:
+    if "--hb-probe" in sys.argv:
+        # the happens-before pre-pass probe (ISSUE 12): decided-fast
+        # fraction and pruned-vs-raw bounds over the 10k tiers ->
+        # BENCH_hb.json, spans in BENCH_trace_hb.json
+        run_hb_probe()
+    elif "--stream-tier" in sys.argv:
         # the streaming tier (jepsen_tpu/stream/bench.py): time-to-
         # first-verdict, violation-detection latency, sustained
         # multiplexed ingest -> BENCH_stream.json.  Host-only (the

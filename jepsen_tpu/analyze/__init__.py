@@ -61,6 +61,14 @@ from .audit import (  # noqa: F401
     audit,
     audit_enabled,
 )
+from .hb import (  # noqa: F401
+    HBAnalysis,
+    analyze_hb,
+    hb_dispose,
+    hb_enabled,
+    hb_fold_states,
+    maybe_hb,
+)
 from .lint import (  # noqa: F401
     Diagnostic,
     HistoryLintError,

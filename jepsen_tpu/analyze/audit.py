@@ -33,6 +33,9 @@ W004 model step rejects a witness transition (the linearization is not
 W005 stitched witness violates cross-cell precedence (the decomposed
      merge interleaved two cells against the parent history's real-time
      order)
+W006 HB-cycle certificate fails independent validation (an edge is
+     unjustified, the chain does not close, or a precondition of the
+     unique-writes block algebra does not hold on this history)
 ==== =================================================================
 
 ``audit(history, model, result)`` never raises on a bad certificate —
@@ -54,6 +57,7 @@ AUDIT_CODES = {
     "W003": "witness violates real-time order",
     "W004": "model step rejects a witness transition",
     "W005": "stitched witness violates cross-cell precedence",
+    "W006": "HB-cycle certificate fails independent validation",
 }
 
 
@@ -173,6 +177,174 @@ def _audit_witness(seq: OpSeq, model, result: dict, diags: list) -> None:
         state = ns
 
 
+def _audit_hb_cycle(seq: OpSeq, model, result: dict,
+                    diags: list) -> None:
+    """Independently re-justify an HB-cycle certificate (analyze/hb.py)
+    edge by edge — sharing no code with the solver that emitted it.
+
+    The certificate claims a cycle of FORCED order: each edge must hold
+    in every valid linearization, and the chain must close.  Edge
+    kinds:
+
+      rt    ret[src] < inv[dst] (real time; self-evident)
+      rf    src is THE unique write of value v, dst an :ok read of v
+      ww    src's value-block must wholly precede dst's, witnessed by
+            ``via=[a, b]`` — a in src's block, b in dst's block,
+            ret[a] < inv[b] (block contiguity under unique writes)
+      init  src is an :ok read of the initial value (never written),
+            dst a member of an anchored write block
+
+    Preconditions re-checked here (W006 when violated): register-family
+    model, no cas rows, unique non-NIL non-init writes for every value
+    the certificate touches, anchored blocks for ww edges.
+    """
+    from ..models import R_CAS, R_READ, R_WRITE
+
+    cyc = result["hb_cycle"]
+    n = len(seq)
+
+    def bad(msg, index=None):
+        diags.append(Diagnostic("W006", "error", msg, index=index))
+
+    if not isinstance(cyc, (list, tuple)) or len(cyc) < 2:
+        bad("hb_cycle must be a chain of at least two edges")
+        return
+    name = getattr(model, "name", "")
+    multi = name == "multi-register"
+    if name not in ("register", "cas-register", "multi-register"):
+        bad(f"model {name!r} is outside the unique-writes block "
+            f"algebra the certificate relies on")
+        return
+    f = [int(x) for x in seq.f]
+    if any(x == R_CAS for x in f) and name == "cas-register":
+        bad("history contains cas ops: writes are not unique and the "
+            "block algebra does not apply")
+        return
+    inv = [int(x) for x in seq.inv]
+    ret = [int(x) for x in seq.ret]
+    ok = [bool(x) for x in seq.ok]
+    key = [int(x) for x in seq.v1] if multi else [0] * n
+    val = [int(x) for x in (seq.v2 if multi else seq.v1)]
+    init_of = (lambda k: int(model.init[k])
+               if 0 <= k < model.state_width else None) if multi \
+        else (lambda k: int(model.init[0]))
+
+    # value -> write rows, for uniqueness + membership checks
+    writes: dict = {}
+    for i in range(n):
+        if f[i] == R_WRITE:
+            writes.setdefault((key[i], val[i]), []).append(i)
+
+    def block_of(i):
+        """(key, value) block of a row, or None when the row cannot
+        belong to one (NIL value, foreign op)."""
+        if f[i] not in (R_READ, R_WRITE):
+            return None
+        from ..history import NIL
+
+        if val[i] == NIL:
+            return None
+        return (key[i], val[i])
+
+    def block_sound(b, index):
+        """Unique, non-init, anchored write block."""
+        from ..history import NIL
+
+        ws = writes.get(b, [])
+        if len(ws) != 1:
+            bad(f"value {b[1]} has {len(ws)} writes — block reasoning "
+                f"needs exactly one", index=index)
+            return False
+        if b[1] == NIL or b[1] == init_of(b[0]):
+            bad(f"value {b[1]} collides with NIL/initial value — "
+                f"blocks do not apply", index=index)
+            return False
+        w = ws[0]
+        if not ok[w] and not any(
+                f[i] == R_READ and ok[i] and block_of(i) == b
+                for i in range(n)):
+            bad(f"block of value {b[1]} is not anchored (crashed "
+                f"write, no :ok read): it need not linearize at all",
+                index=index)
+            return False
+        return True
+
+    rows_ok = True
+    for e in cyc:
+        for fld in ("src", "dst"):
+            r = e.get(fld)
+            if not isinstance(r, int) or isinstance(r, bool) \
+                    or not 0 <= r < n:
+                diags.append(Diagnostic(
+                    "W001", "error",
+                    f"hb_cycle edge references row {r!r}, not a row "
+                    f"of this {n}-op history"))
+                rows_ok = False
+    if not rows_ok:
+        return
+    for i, e in enumerate(cyc):
+        nxt = cyc[(i + 1) % len(cyc)]
+        src, dst, kind = e["src"], e["dst"], e.get("kind")
+        if dst != nxt["src"]:
+            bad(f"edge {i} ends at row {dst} but edge "
+                f"{(i + 1) % len(cyc)} starts at row {nxt['src']} — "
+                f"the chain does not close", index=dst)
+        if kind == "rt":
+            if not ret[src] < inv[dst]:
+                bad(f"rt edge {src}->{dst} unjustified: row {src} did "
+                    f"not return before row {dst} invoked", index=src)
+        elif kind == "rf":
+            b = block_of(dst)
+            if f[dst] != R_READ or not ok[dst] or b is None:
+                bad(f"rf edge {src}->{dst}: row {dst} is not an :ok "
+                    f"read of a concrete value", index=dst)
+            elif not block_sound(b, src):
+                pass
+            elif writes[b][0] != src:
+                bad(f"rf edge {src}->{dst}: row {src} is not the "
+                    f"write of value {b[1]}", index=src)
+        elif kind == "ww":
+            via = e.get("via") or (src, dst)
+            a, b2 = int(via[0]), int(via[1])
+            bs, bd = block_of(src), block_of(dst)
+            if bs is None or bd is None or bs == bd:
+                bad(f"ww edge {src}->{dst}: rows are not members of "
+                    f"two distinct value blocks", index=src)
+                continue
+            if not (block_sound(bs, src) and block_sound(bd, dst)):
+                continue
+            if block_of(a) != bs or block_of(b2) != bd or \
+                    (f[a] == R_READ and not ok[a]) or \
+                    (f[b2] == R_READ and not ok[b2]):
+                bad(f"ww edge {src}->{dst}: via pair ({a},{b2}) does "
+                    f"not witness these blocks", index=src)
+            elif not ret[a] < inv[b2]:
+                bad(f"ww edge {src}->{dst}: via pair ({a},{b2}) is "
+                    f"not a real-time edge", index=a)
+        elif kind == "init":
+            iv = init_of(key[src])
+            from ..history import NIL
+
+            if f[src] != R_READ or not ok[src] or iv is None \
+                    or iv == NIL or val[src] != iv:
+                bad(f"init edge {src}->{dst}: row {src} is not an "
+                    f":ok read of the initial value", index=src)
+                continue
+            if writes.get((key[src], iv)):
+                bad(f"init edge {src}->{dst}: the initial value "
+                    f"{iv} is re-written, so init reads are not "
+                    f"forced first", index=src)
+                continue
+            bd = block_of(dst)
+            if bd is None or bd[0] != key[src] or bd not in writes \
+                    or not block_sound(bd, dst):
+                bad(f"init edge {src}->{dst}: row {dst} is not a "
+                    f"member of an anchored write block on the same "
+                    f"key", index=dst)
+        else:
+            bad(f"edge {i} has unknown kind {kind!r}", index=src)
+
+
 def audit(history, model, result: dict) -> dict:
     """Audit one engine result's certificate.  Returns::
 
@@ -210,15 +382,18 @@ def audit(history, model, result: dict) -> dict:
             _audit_witness(seq, model, result, diags)
     elif v is False:
         frontier = result.get("final_ops")
-        if frontier is None:
+        if result.get("hb_cycle") is not None:
+            out["checked"] = "hb_cycle"
+            _audit_hb_cycle(seq, model, result, diags)
+        elif frontier is None:
             out["checked"] = "frontier_dropped"
             reason = result.get("frontier_dropped")
             if reason is None:
                 diags.append(Diagnostic(
                     "W002", "error",
-                    "invalid verdict carries neither `final_ops` nor a "
-                    "`frontier_dropped` reason — the certificate "
-                    "contract requires one of the two"))
+                    "invalid verdict carries neither `final_ops`, an "
+                    "`hb_cycle`, nor a `frontier_dropped` reason — the "
+                    "certificate contract requires one of the three"))
             else:
                 out["frontier_dropped"] = reason
         else:

@@ -1,0 +1,907 @@
+"""Happens-before constraint analysis — the static order-solver.
+
+One cheap host pass over a history, BEFORE any search, that builds the
+forced-order (happens-before) structure the engines otherwise rediscover
+config by config:
+
+  * **real time** — ``ret[i] < inv[j]`` forces i before j (the interval
+    order every engine already enforces natively);
+  * **read-from** — under unique writes, an :ok read of value v forces
+    the (single) write of v before it;
+  * **block order** — unique-writes register semantics make each value's
+    ops a contiguous *block* in any linearization (between w(v) and a
+    read of v no other write may land), so ANY real-time edge between
+    members of two blocks orients the whole blocks — Gibbons & Korach's
+    cluster argument, the reason atomic-register histories decide in
+    O(n log n) instead of exponentially;
+  * **init order** — a read of the initial value must precede every
+    write (unique writes never re-create the initial value).
+
+Three passes consume that structure:
+
+**Decide-fast.**  A cycle among forced edges is an immediate ``invalid``
+verdict carrying an *HB-cycle certificate* — an op-level edge list the
+independent audit (analyze/audit.py, W006) re-justifies edge by edge
+without re-running this solver.  For all-:ok read/write histories the
+interval pass decides *completely*: acyclic block spans + clean
+read-from structure yield ``valid`` with a constructive linearization
+witness (cluster topological order, blocks emitted contiguously,
+NIL reads re-inserted by real time), self-verified by model replay
+before it is ever emitted — a wrong verdict is structurally impossible,
+only a missed decision is.  Multi-register histories decide per key and
+stitch the witness through ``partition.merge_linearizations``
+(Herlihy–Wing locality).
+
+**Constraint-propagate.**  Partially-decided histories (crashed rows,
+cas ops out of the decidable class) still yield forced edges — read-from
+off anchored crashed writes, block order between anchored clusters —
+saturated against real time so only edges real time does NOT already
+imply are kept.
+
+**Prune.**  The forced edges, plus a *canonical-order* relation over
+concurrent same-value reads (two reads of the same value on the same
+register are state-transparent and interchangeable; when both inv and
+ret are ordered the exchange is always legal, so restricting the search
+to inv-canonical orders preserves the verdict — the sleep-set-flavored
+commutativity prune of Parsimonious Optimal DPOR, arXiv:2405.11128,
+done statically), are exported as a must-order predecessor map.  The
+host engines mask candidates whose must-predecessors are not yet
+linearized; the batch scheduler disposes decided keys before they ever
+reach the device.
+
+Soundness invariants (what keeps this verdict-identical by
+construction):
+
+  * decide-``valid`` only ever fires after the constructed witness
+    replays clean against the model AND real time;
+  * decide-``invalid`` only ever fires on independently re-checkable
+    evidence (a forced-edge cycle, or an :ok read of a value no write
+    and no initial state can produce);
+  * must-order edges are either *forced* (hold in every valid
+    linearization) or *canonical* (every valid linearization can be
+    exchanged into one that satisfies them), so masking them can never
+    flip a verdict;
+  * anything outside the gates returns "undecided" and the engines run
+    exactly as before.
+
+Knobs: ``hb=False`` per call on every wired engine, or
+``JEPSEN_TPU_HB=0`` fleet-wide (default ON).
+"""
+
+from __future__ import annotations
+
+import bisect
+import os
+import threading
+from dataclasses import dataclass, field
+
+import numpy as np
+
+from ..history import INF_RET, NIL, OpSeq
+from ..models import R_CAS, R_READ, R_WRITE, ModelSpec
+from ..obs.metrics import REGISTRY
+
+_M_PREPASS = REGISTRY.counter(
+    "jtpu_hb_prepass_total",
+    "HB pre-pass outcomes (decided_valid/decided_invalid/undecided/"
+    "skipped)", ("outcome",))
+_M_EDGES = REGISTRY.counter(
+    "jtpu_hb_edges_total",
+    "Forced/canonical HB edges inferred beyond real time, by kind",
+    ("kind",))
+_M_RATIO = REGISTRY.gauge(
+    "jtpu_hb_prune_ratio",
+    "pruned/raw config-bound ratio of the most recent HB pre-pass "
+    "(0 = decided without search)")
+_M_FOLDS = REGISTRY.counter(
+    "jtpu_hb_fold_total",
+    "Streamed/decomposed segment folds answered by the HB interval "
+    "pass")
+
+#: cap on enumerated inferred edges — the prune degrades gracefully
+#: (fewer mask edges) instead of going quadratic on pathological
+#: cluster structures
+EDGE_CAP_FACTOR = 4
+EDGE_CAP_MIN = 256
+
+#: NIL (unknown-value) reads are re-inserted into the constructed
+#: witness one linear scan each; past this many the decision is ceded
+#: to the engines rather than going quadratic
+NIL_INSERT_CAP = 512
+
+#: instates a segment fold will run the per-instate interval pass for
+#: before ceding to the generic fold
+FOLD_INSTATE_CAP = 8
+#: distinct reachable out-states the fold will build witness chains for
+FOLD_WITNESS_STATES = 8
+
+
+def hb_enabled() -> bool:
+    """The fleet knob: on unless JEPSEN_TPU_HB=0/false/off/no."""
+    return os.environ.get("JEPSEN_TPU_HB", "").strip().lower() not in (
+        "0", "false", "off", "no")
+
+
+def resolve_hb(flag: bool | None) -> bool:
+    return hb_enabled() if flag is None else bool(flag)
+
+
+@dataclass
+class HBAnalysis:
+    """The pre-pass output one engine entry consumes."""
+
+    n: int
+    applies: bool
+    #: engine-style result dict (verdict + certificate) or None
+    decided: dict | None
+    #: row -> tuple of must-predecessor rows (beyond real time)
+    must_pred: dict = field(default_factory=dict)
+    #: json-able summary for result["hb"] / plan["hb"]
+    stats: dict = field(default_factory=dict)
+
+
+# ---------------------------------------------------------------------------
+# Cluster scan — the one structure every pass reads
+# ---------------------------------------------------------------------------
+
+
+def _family(model: ModelSpec) -> str | None:
+    if model.name in ("register", "cas-register"):
+        return "register"
+    if model.name == "multi-register":
+        return "multi"
+    return None
+
+
+class _Cluster:
+    """One value's block on one key: the (unique) write plus the :ok
+    reads of that value.  ``anchored`` = the block must appear in every
+    linearization (ok write, or a crashed write some :ok read saw)."""
+
+    __slots__ = ("val", "write", "write_ok", "ok_reads", "s", "e")
+
+    def __init__(self, val: int, write: int, write_ok: bool):
+        self.val = val
+        self.write = write
+        self.write_ok = write_ok
+        self.ok_reads: list[int] = []
+
+    @property
+    def anchored(self) -> bool:
+        return self.write_ok or bool(self.ok_reads)
+
+    def members(self) -> list[int]:
+        return [self.write, *self.ok_reads]
+
+
+class _KeyScan:
+    __slots__ = ("key", "init_val", "clusters", "init_reads",
+                 "nil_reads", "impossible", "tainted", "crashed_reads",
+                 "read_classes")
+
+    def __init__(self, key: int, init_val: int):
+        self.key = key
+        self.init_val = init_val
+        self.clusters: dict[int, _Cluster] = {}   # val -> cluster
+        self.init_reads: list[int] = []           # :ok reads of init
+        self.nil_reads: list[int] = []            # :ok reads of NIL
+        self.impossible: list[int] = []           # :ok reads, no writer
+        self.tainted = False                      # no rf/block inference
+        self.crashed_reads: list[int] = []
+        #: value-class -> rows (ok+crashed reads), for the canonical
+        #: read-read exchange chains; NIL reads class under key NIL
+        self.read_classes: dict[int, list[int]] = {}
+
+
+class _Scan:
+    __slots__ = ("keys", "all_ok", "has_cas", "n")
+
+    def __init__(self):
+        self.keys: dict[int, _KeyScan] = {}
+        self.all_ok = True
+        self.has_cas = False
+        self.n = 0
+
+
+def _scan(seq: OpSeq, model: ModelSpec) -> _Scan | None:
+    """One O(n) pass building per-key cluster structure; None when the
+    model family is out of scope or an unencodable row appears."""
+    fam = _family(model)
+    if fam is None:
+        return None
+    n = len(seq)
+    f = np.asarray(seq.f)
+    v1 = np.asarray(seq.v1)
+    v2 = np.asarray(seq.v2)
+    ok = np.asarray(seq.ok, dtype=bool)
+
+    sc = _Scan()
+    sc.n = n
+    sc.all_ok = bool(ok.all())
+    if bool((f == R_CAS).any()) and model.name == "cas-register":
+        # a cas both reads and writes: the unique-writes block algebra
+        # (rf/ww/init edges, decide-fast) does not apply — but the
+        # canonical same-value read-order exchange still does (reads
+        # are state-transparent whatever writes them), so the scan
+        # keeps collecting read classes and taints everything else
+        sc.has_cas = True
+
+    if fam == "multi":
+        keys = v1
+        vals = v2
+        if bool((keys == NIL).any()):
+            return None  # un-keyed row: the model rejects it anyway
+        init_of = {int(k): int(model.init[int(k)])
+                   if 0 <= int(k) < model.state_width else 0
+                   for k in np.unique(keys)}
+    else:
+        keys = np.zeros(n, dtype=np.int64)
+        vals = v1
+        init_of = {0: int(model.init[0])}
+
+    for i in range(n):
+        k = int(keys[i])
+        ks = sc.keys.get(k)
+        if ks is None:
+            ks = sc.keys[k] = _KeyScan(k, init_of.get(k, 0))
+        fi = int(f[i])
+        val = int(vals[i])
+        if fi == R_WRITE:
+            if val == NIL or val == ks.init_val or val in ks.clusters:
+                ks.tainted = True  # NIL/init/duplicate write: no algebra
+                if val in ks.clusters:
+                    pass
+            if val not in ks.clusters:
+                ks.clusters[val] = _Cluster(val, i, bool(ok[i]))
+        elif fi == R_READ:
+            if val == NIL:
+                (ks.nil_reads if ok[i] else ks.crashed_reads).append(i)
+                ks.read_classes.setdefault(NIL, []).append(i)
+            else:
+                ks.read_classes.setdefault(val, []).append(i)
+                if not ok[i]:
+                    ks.crashed_reads.append(i)
+                elif val == ks.init_val:
+                    ks.init_reads.append(i)
+        elif fi == R_CAS and sc.has_cas:
+            continue  # canon-only mode: cas rows carry no read class
+        else:
+            return None  # foreign op code: out of scope
+    if sc.has_cas:
+        for ks in sc.keys.values():
+            ks.tainted = True
+        return sc
+    # second half: attach ok reads to clusters / find impossible reads
+    for ks in sc.keys.values():
+        for val, rows in ks.read_classes.items():
+            if val == NIL or val == ks.init_val:
+                continue
+            cl = ks.clusters.get(val)
+            for i in rows:
+                if not ok[i]:
+                    continue
+                if cl is None:
+                    ks.impossible.append(i)
+                else:
+                    cl.ok_reads.append(i)
+        if ks.init_val != NIL and ks.init_val in ks.clusters:
+            # a write re-creates the initial value: init reads lose
+            # their "before every write" force
+            ks.tainted = True
+    return sc
+
+
+# ---------------------------------------------------------------------------
+# Forced-edge checks (complete for the forced-edge system; see module doc)
+# ---------------------------------------------------------------------------
+
+
+def _edge(src: int, dst: int, kind: str, via=None) -> dict:
+    e = {"src": int(src), "dst": int(dst), "kind": kind}
+    if via is not None:
+        e["via"] = [int(via[0]), int(via[1])]
+    return e
+
+
+def _spans(ks: _KeyScan) -> list[tuple[int, int, _Cluster]]:
+    """(s, e, cluster) for each ANCHORED cluster: s = min member
+    return, e = max member invocation.  An edge u -> v (block u wholly
+    before block v) is forced iff s(u) < e(v)."""
+    inv, ret = _ranks()
+    out = []
+    for cl in ks.clusters.values():
+        if not cl.anchored:
+            continue
+        mem = cl.members()
+        s = min(int(ret[i]) for i in mem)
+        e = max(int(inv[i]) for i in mem)
+        cl.s, cl.e = s, e
+        out.append((s, e, cl))
+    return out
+
+
+# per-THREAD rank views set for the duration of one analysis (stream
+# folds and campaign cells analyze concurrently on worker threads, so
+# plain module globals would clobber each other)
+_TLS = threading.local()
+
+
+def _ranks():
+    return _TLS.inv, _TLS.ret
+
+
+def _find_cycle(seq: OpSeq, sc: _Scan) -> list[dict] | None:
+    """Complete cycle search over the forced-edge system (rt + rf +
+    block + init), per key.  Returns an op-level edge cycle or None.
+
+    Completeness: real time alone is acyclic (an interval order); a
+    forced cycle therefore visits >= 1 inferred edge, inferred edges
+    connect cluster members of ONE key, and rt is numerically
+    transitive — so every cycle projects to (a) an intra-cluster
+    read-before-its-write, (b) an init-read block inversion, or (c) a
+    2-cycle between anchored block spans (a longer span cycle always
+    contains a 2-cycle: take the min-s cluster on the cycle)."""
+    inv, ret = _ranks()
+    for ks in sc.keys.values():
+        if ks.tainted:
+            continue
+        # (a) a read real-time-before its own (unique) write
+        for cl in ks.clusters.values():
+            w = cl.write
+            for r in cl.ok_reads:
+                if ret[r] < inv[w]:
+                    return [_edge(w, r, "rf"), _edge(r, w, "rt")]
+        spans = _spans(ks)
+        # (b) init reads are forced before every anchored write; a
+        # cluster member real-time-before an init read inverts that
+        if ks.init_reads:
+            ri_by_inv = max(ks.init_reads, key=lambda i: inv[i])
+            for s, _e, cl in spans:
+                if s < inv[ri_by_inv]:
+                    x = min(cl.members(), key=lambda i: ret[i])
+                    ri = next(i for i in ks.init_reads
+                              if ret[x] < inv[i])
+                    cyc = []
+                    if x != cl.write:
+                        cyc.append(_edge(cl.write, x, "rf"))
+                    cyc.append(_edge(x, ri, "rt"))
+                    cyc.append(_edge(ri, cl.write, "init"))
+                    return cyc
+        # (c) overlapping anchored block spans: blocks each forced
+        # wholly before the other.  Sweep in s order; for the current
+        # span find a previous one with s(prev) < e(cur) and
+        # e(prev) > s(cur) via a prefix-max over the s-sorted list.
+        spans.sort(key=lambda t: t[0])
+        pref: list[tuple[int, _Cluster]] = []  # (prefix max e, argmax)
+        ss = []
+        for s, e, cl in spans:
+            if pref:
+                # rightmost previous span with s(prev) < e(cur)
+                hi = bisect.bisect_left(ss, e)
+                if hi > 0 and pref[hi - 1][0] > s:
+                    u = pref[hi - 1][1]
+                    # concrete member witnesses for both directions
+                    a1 = min(u.members(), key=lambda i: ret[i])
+                    b1 = next(i for i in cl.members()
+                              if ret[a1] < inv[i])
+                    a2 = min(cl.members(), key=lambda i: ret[i])
+                    b2 = next(i for i in u.members()
+                              if ret[a2] < inv[i])
+                    return [_edge(a1, b1, "ww", via=(a1, b1)),
+                            _edge(b1, a1, "ww", via=(a2, b2))]
+            best = max(pref[-1][0], e) if pref else e
+            pref.append((best, cl if not pref or e >= pref[-1][0]
+                         else pref[-1][1]))
+            ss.append(s)
+    return None
+
+
+# ---------------------------------------------------------------------------
+# Decide-valid: the Gibbons–Korach interval construction
+# ---------------------------------------------------------------------------
+
+
+def _topo_clusters(spans: list[tuple[int, int, _Cluster]]
+                   ) -> list[_Cluster] | None:
+    """Topological order of anchored blocks under `u -> v iff
+    s(u) < e(v)`, O(C log C) via lazy heaps.  None when no source
+    exists (a cycle — callers treat it as undecided; the cycle pass
+    already ran)."""
+    import heapq
+
+    C = len(spans)
+    if C <= 1:
+        return [cl for _s, _e, cl in spans]
+    hs = [(s, i) for i, (s, _e, _c) in enumerate(spans)]
+    he = [(e, i) for i, (_s, e, _c) in enumerate(spans)]
+    heapq.heapify(hs)
+    heapq.heapify(he)
+    done = [False] * C
+    out: list[_Cluster] = []
+    INF = INF_RET + 1
+    for _ in range(C):
+        while hs and done[hs[0][1]]:
+            heapq.heappop(hs)
+        while he and done[he[0][1]]:
+            heapq.heappop(he)
+        s1, u1 = hs[0]
+        # second-min s: pop the head, peek the next live entry, push
+        # the head back — O(log C), not a scan
+        heapq.heappop(hs)
+        while hs and done[hs[0][1]]:
+            heapq.heappop(hs)
+        s2 = hs[0][0] if hs else INF
+        heapq.heappush(hs, (s1, u1))
+        e1, v1 = he[0]
+        pick = None
+        if v1 != u1 and e1 <= s1:
+            pick = v1
+        elif v1 == u1 and e1 <= s2:
+            pick = v1
+        elif v1 != u1 and spans[u1][1] <= s2:
+            pick = u1
+        if pick is None:
+            return None
+        done[pick] = True
+        out.append(spans[pick][2])
+    return out
+
+
+def _insert_by_rt(order: list[int], rows: list[int]) -> list[int] | None:
+    """Insert NIL (state-transparent) reads into an rt-consistent
+    order: each goes right after its last rt predecessor.  None past
+    the work cap."""
+    if not rows:
+        return order
+    if len(rows) > NIL_INSERT_CAP:
+        return None
+    inv, ret = _ranks()
+    for x in sorted(rows, key=lambda i: inv[i]):
+        pos = 0
+        for j, y in enumerate(order):
+            if ret[y] < inv[x]:
+                pos = j + 1
+        order.insert(pos, x)
+    return order
+
+
+def _gk_key_order(ks: _KeyScan) -> list[int] | None:
+    """Constructive linearization of ONE all-:ok key that already
+    passed the cycle checks: init reads, then blocks in topological
+    order (write first, reads by invocation), NIL reads re-inserted by
+    real time.  None = cede to the engines."""
+    inv, _ret = _ranks()
+    spans = _spans(ks)
+    topo = _topo_clusters(sorted(spans, key=lambda t: t[0]))
+    if topo is None:
+        return None
+    order: list[int] = sorted(ks.init_reads, key=lambda i: inv[i])
+    for cl in topo:
+        order.append(cl.write)
+        order.extend(sorted(cl.ok_reads, key=lambda i: inv[i]))
+    return _insert_by_rt(order, ks.nil_reads)
+
+
+def _verify_witness(seq: OpSeq, model: ModelSpec,
+                    order: list[int]) -> bool:
+    """Self-check before any decide-valid leaves this module: the
+    witness covers every :ok row once, respects real time, and replays
+    through the model."""
+    n = len(seq)
+    ok = np.asarray(seq.ok, dtype=bool)
+    if sorted(order) != sorted(int(i) for i in range(n) if ok[i]):
+        return False
+    inv = [int(x) for x in seq.inv]
+    ret = [int(x) for x in seq.ret]
+    max_inv = -1
+    for r in order:
+        if ret[r] < max_inv:
+            return False
+        max_inv = max(max_inv, inv[r])
+    state = model.init
+    pystep = model.pystep
+    f = seq.f
+    v1 = seq.v1
+    v2 = seq.v2
+    for r in order:
+        state = pystep(state, int(f[r]), int(v1[r]), int(v2[r]))
+        if state is None:
+            return False
+    return True
+
+
+# ---------------------------------------------------------------------------
+# Must-order edges (the prune)
+# ---------------------------------------------------------------------------
+
+
+def _forced_edges(sc: _Scan, cap: int) -> list[tuple[int, int, str]]:
+    """rf / block / init edges NOT already implied by real time,
+    budget-capped."""
+    inv, ret = _ranks()
+    out: list[tuple[int, int, str]] = []
+
+    def rt(a: int, b: int) -> bool:
+        return ret[a] < inv[b]
+
+    for ks in sc.keys.values():
+        if ks.tainted:
+            continue
+        spans = _spans(ks)
+        for _s, _e, cl in spans:
+            for r in cl.ok_reads:
+                if not rt(cl.write, r):
+                    out.append((cl.write, r, "rf"))
+                    if len(out) >= cap:
+                        return out
+        # init reads precede every anchored write
+        for ri in ks.init_reads:
+            for _s, _e, cl in spans:
+                if not rt(ri, cl.write):
+                    out.append((ri, cl.write, "init"))
+                    if len(out) >= cap:
+                        return out
+        # block order: pairs u -> v forced one way only (both ways is
+        # a cycle, found by the cycle pass before this runs).  The
+        # pair scan is work-bounded too: rt-implied pairs cost budget
+        # without emitting, so a pathological cluster structure cannot
+        # go quadratic
+        spans.sort(key=lambda t: t[0])
+        budget = 8 * cap
+        for j, (s_v, e_v, cv) in enumerate(spans):
+            for (s_u, e_u, cu) in spans:
+                if s_u >= e_v or budget <= 0:
+                    break
+                budget -= 1
+                if cu is cv or s_v < e_u:
+                    continue  # self, or mutual (cycle pass territory)
+                # u wholly before v: reads of u precede w(v)
+                if not rt(cu.write, cv.write):
+                    out.append((cu.write, cv.write, "ww"))
+                for r in cu.ok_reads:
+                    if not rt(r, cv.write):
+                        out.append((r, cv.write, "ww"))
+                if len(out) >= cap:
+                    return out
+            if budget <= 0:
+                break
+    return out
+
+
+def _canon_edges(sc: _Scan, cap: int) -> list[tuple[int, int, str]]:
+    """Canonical-order chains over same-key same-value reads: a
+    staircase (inv AND ret both non-decreasing) is exchange-safe, so
+    forcing it loses no linearization — but masks the frontier's
+    read-permutation blowup."""
+    inv, ret = _ranks()
+    out: list[tuple[int, int, str]] = []
+    for ks in sc.keys.values():
+        for _val, rows in ks.read_classes.items():
+            if len(rows) < 2:
+                continue
+            chain = sorted(rows, key=lambda i: (inv[i], i))
+            prev = chain[0]
+            for nxt in chain[1:]:
+                if ret[nxt] >= ret[prev]:
+                    if not ret[prev] < inv[nxt]:  # rt gives it anyway
+                        out.append((prev, nxt, "canon"))
+                        if len(out) >= cap:
+                            return out
+                    prev = nxt
+    return out
+
+
+def _window_effective(seq: OpSeq, edges) -> tuple[int, int]:
+    """(raw, effective) window bounds — the effective one recomputed
+    with must-order edges removed from each position's freedom span;
+    the basis of the pruned config bound."""
+    ok = np.asarray(seq.ok, dtype=bool)
+    det_rows = np.nonzero(ok)[0]
+    nd = len(det_rows)
+    if nd == 0:
+        return 1, 1
+    pos_of = {int(r): p for p, r in enumerate(det_rows)}
+    det_inv = np.asarray(seq.inv, dtype=np.int64)[det_rows]
+    det_ret = np.asarray(seq.ret, dtype=np.int64)[det_rows]
+    upper = np.searchsorted(det_inv, det_ret, side="left")
+    spans = (upper - np.arange(nd)).astype(np.int64)
+    raw = max(1, int(spans.max()))
+    for (src, dst, _k) in edges:
+        ps, pd = pos_of.get(src), pos_of.get(dst)
+        if ps is None or pd is None or ps >= pd:
+            continue
+        # dst can no longer linearize while src (at ps) is the first
+        # unlinearized op: one slot of ps's span freedom is gone
+        if pd < int(upper[ps]):
+            spans[ps] -= 1
+    return raw, max(1, int(spans.max()))
+
+
+# ---------------------------------------------------------------------------
+# The pre-pass
+# ---------------------------------------------------------------------------
+
+
+def _decided_result(valid, *, certificate: dict, stats: dict) -> dict:
+    stats["pruned_upper_bound"] = 0
+    stats["prune_ratio"] = 0.0
+    out = {"valid": valid, "configs": 0, "max_depth": 0,
+           "engine": "hb-decide"}
+    out.update(certificate)
+    out["hb"] = stats
+    return out
+
+
+def analyze_hb(seq: OpSeq, model: ModelSpec, *,
+               canon: bool = True) -> HBAnalysis:
+    """The full pre-pass.  Never raises on in-scope inputs; anything
+    out of scope comes back ``applies=False`` and undecided."""
+    n = len(seq)
+    stats = {"applies": False, "decided": None, "reason": None,
+             "edges": {"rf": 0, "ww": 0, "init": 0, "canon": 0},
+             "must_edges": 0}
+    hb = HBAnalysis(n=n, applies=False, decided=None, stats=stats)
+    if n == 0:
+        stats["reason"] = "empty history"
+        return hb
+    sc = _scan(seq, model)
+    if sc is None:
+        stats["reason"] = f"model {model.name!r} out of scope"
+        return hb
+    if sc.has_cas:
+        stats["reason"] = ("cas ops present (no unique-writes "
+                          "algebra; canonical read-order only)")
+    hb.applies = True
+    stats["applies"] = True
+    stats["keys"] = len(sc.keys)
+    stats["clusters"] = sum(len(ks.clusters) for ks in sc.keys.values())
+
+    _TLS.inv = [int(x) for x in seq.inv]
+    _TLS.ret = [int(x) for x in seq.ret]
+    try:
+        # ---- decide-fast: impossible reads --------------------------
+        impossible = sorted(r for ks in sc.keys.values()
+                            for r in ks.impossible)
+        if impossible:
+            stats["decided"] = False
+            stats["reason"] = "impossible-read"
+            hb.decided = _decided_result(
+                False, certificate={"final_ops": impossible},
+                stats=stats)
+            return hb
+
+        # ---- decide-fast: forced-edge cycle -------------------------
+        cyc = _find_cycle(seq, sc)
+        if cyc is not None:
+            stats["decided"] = False
+            stats["reason"] = "hb-cycle"
+            hb.decided = _decided_result(
+                False, certificate={"hb_cycle": cyc}, stats=stats)
+            return hb
+
+        # ---- decide-fast: full interval decision (all-:ok class) ----
+        if sc.all_ok and all(not ks.tainted for ks in sc.keys.values()):
+            orders = []
+            for ks in sc.keys.values():
+                o = _gk_key_order(ks)
+                if o is None:
+                    orders = None
+                    break
+                orders.append(o)
+            if orders is not None:
+                if len(orders) == 1:
+                    order = orders[0]
+                else:
+                    from ..decompose.partition import \
+                        merge_linearizations
+
+                    order = merge_linearizations(seq, orders)
+                if order is not None and \
+                        _verify_witness(seq, model, order):
+                    stats["decided"] = True
+                    stats["reason"] = "gk-interval"
+                    hb.decided = _decided_result(
+                        True,
+                        certificate={
+                            "linearization": [int(r) for r in order],
+                            "max_depth": len(order)},
+                        stats=stats)
+                    return hb
+
+        # ---- undecided: emit the prune ------------------------------
+        cap = max(EDGE_CAP_MIN, EDGE_CAP_FACTOR * n)
+        edges = _forced_edges(sc, cap)
+        if canon:
+            edges += _canon_edges(sc, max(0, cap - len(edges)))
+        for (_s, _d, k) in edges:
+            stats["edges"][k] += 1
+        stats["must_edges"] = len(edges)
+        must: dict[int, list[int]] = {}
+        for (src, dst, _k) in edges:
+            must.setdefault(int(dst), []).append(int(src))
+        hb.must_pred = {d: tuple(sorted(set(s)))
+                        for d, s in must.items()}
+        w_raw, w_eff = _window_effective(seq, edges)
+        ok = np.asarray(seq.ok, dtype=bool)
+        nd = int(ok.sum())
+        raw = (nd + 1) << (max(0, w_raw - 1) + (n - nd))
+        pruned = min((nd + 1) << (max(0, w_eff - 1) + (n - nd)), raw)
+        stats["window_effective"] = w_eff
+        stats["pruned_upper_bound"] = pruned
+        stats["prune_ratio"] = (round(pruned / raw, 6) if raw
+                                else None)
+        return hb
+    finally:
+        _TLS.inv = _TLS.ret = None
+
+
+def maybe_hb(seq: OpSeq, model: ModelSpec,
+             flag: bool | None = None) -> HBAnalysis | None:
+    """The engines' shared pre-pass preamble: resolve the three-state
+    flag (None follows JEPSEN_TPU_HB, default on), run the analysis
+    under an ``obs`` span, and feed the ``jtpu_hb_*`` metrics.  ONE
+    home for the policy, mirroring ``lint.maybe_lint``."""
+    if not resolve_hb(flag) or len(seq) == 0:
+        return None
+    from .. import obs
+
+    with obs.span("hb.prepass", cat="analyze", rows=len(seq)):
+        hb = analyze_hb(seq, model)
+    if not hb.applies:
+        _M_PREPASS.inc(outcome="skipped")
+        return hb
+    if hb.decided is not None:
+        _M_PREPASS.inc(outcome="decided_valid"
+                       if hb.decided["valid"] else "decided_invalid")
+        _M_RATIO.set(0.0)
+    else:
+        _M_PREPASS.inc(outcome="undecided")
+        _M_RATIO.set(hb.stats.get("prune_ratio") or 1.0)
+        for k, v in hb.stats["edges"].items():
+            if v:
+                _M_EDGES.inc(v, kind=k)
+    return hb
+
+
+def hb_dispose(seq: OpSeq, model: ModelSpec,
+               flag: bool | None = True) -> dict | None:
+    """Decide-fast only — the per-key disposal the batch schedulers
+    run next to the greedy witness.  Returns a full engine-style result
+    dict (certificate included) or None when the key must be searched."""
+    hbres = maybe_hb(seq, model, flag)
+    if hbres is not None and hbres.decided is not None:
+        return dict(hbres.decided)
+    return None
+
+
+def attach(result: dict, hb: HBAnalysis | None) -> dict:
+    """Record the pre-pass summary on an engine result (undecided
+    histories only; decided ones already carry it)."""
+    if hb is not None and hb.applies and "hb" not in result:
+        result["hb"] = hb.stats
+    return result
+
+
+# ---------------------------------------------------------------------------
+# Plan integration (analyze/plan.py's explain() consumes this)
+# ---------------------------------------------------------------------------
+
+
+def plan_block(seq: OpSeq, model: ModelSpec, raw_bound: int,
+               n_crash: int, window: int) -> dict:
+    """The static ``hb`` block for explain(): decidability, inferred
+    edge counts, and the pruned config bound next to the raw one.
+    Pure description — the analysis already computed the bounds, and
+    describing a plan must not touch the live ``jtpu_hb_prune_ratio``
+    gauge (that tracks pre-passes that actually ran)."""
+    hb = analyze_hb(seq, model)
+    st = dict(hb.stats)
+    st["enabled"] = hb_enabled()
+    if "pruned_upper_bound" not in st:
+        st["pruned_upper_bound"] = raw_bound
+        st["prune_ratio"] = 1.0
+    return st
+
+
+# ---------------------------------------------------------------------------
+# Streamed / decomposed segment folds
+# ---------------------------------------------------------------------------
+
+
+def hb_fold_states(sseq: OpSeq, model: ModelSpec, instates, *,
+                   witness: bool = False):
+    """Answer one crash-free segment fold with the interval pass:
+    the set of reachable final states from ``instates`` — the value of
+    a can-be-last block per instate — without the level-synchronous
+    sweep.  Returns ``states`` (or ``(states, wit)`` with
+    ``witness=True``, ``wit`` mapping each out-state to
+    ``(in_state, row_chain)``), or None when the segment is outside
+    the decidable class (the caller falls back to the generic fold).
+    Exact by construction: witnesses (when requested) replay clean or
+    the fold cedes."""
+    from dataclasses import replace as _dc_replace
+
+    if _family(model) != "register":
+        return None
+    n = len(sseq)
+    instates = [tuple(int(x) for x in s) for s in instates]
+    if not instates or len(instates) > FOLD_INSTATE_CAP:
+        return None
+    if n and not bool(np.asarray(sseq.ok, dtype=bool).all()):
+        return None
+    states: set = set()
+    wit: dict | None = {} if witness else None
+    for ins in instates:
+        m = _dc_replace(model, init=ins)
+        sc = _scan(sseq, m)
+        if sc is None or sc.has_cas or \
+                any(ks.tainted for ks in sc.keys.values()):
+            return None
+        _TLS.inv = [int(x) for x in sseq.inv]
+        _TLS.ret = [int(x) for x in sseq.ret]
+        try:
+            if any(ks.impossible for ks in sc.keys.values()) or \
+                    _find_cycle(sseq, sc) is not None:
+                continue  # no linearization from this instate
+            ks = sc.keys.get(0)
+            if ks is None:  # empty segment
+                states.add(ins)
+                if wit is not None:
+                    wit.setdefault(ins, (ins, []))
+                continue
+            spans = _spans(ks)
+            if not spans:
+                # no writes: the state cannot move
+                order = _gk_key_order(ks)
+                if order is None or \
+                        not _verify_witness(sseq, m, order):
+                    return None
+                states.add(ins)
+                if wit is not None:
+                    wit.setdefault(ins, (ins, [int(r) for r in order]))
+                continue
+            # can-be-last blocks: no outgoing span edge
+            e_sorted = sorted(e for s, e, _c in spans)
+            lasts = []
+            for s, e, cl in spans:
+                e_max = e_sorted[-1] if e_sorted[-1] != e \
+                    else (e_sorted[-2] if len(e_sorted) > 1 else -1)
+                if s >= e_max:
+                    lasts.append(cl)
+            if not lasts:
+                return None  # acyclic spans always have a sink
+            if len(lasts) > FOLD_WITNESS_STATES:
+                # many reachable out-states: cede the WHOLE fold —
+                # a truncated state set would be a wrong frontier
+                # (and would poison the shared segment cache)
+                return None
+            # every can-be-last block contributes exactly one
+            # out-state; each gets a constructed, verified order or
+            # the whole fold cedes — the state set is exact or absent,
+            # never truncated
+            for cl in lasts:
+                st = (int(cl.val),)
+                others = [(s, e, c) for s, e, c in spans if c is not cl]
+                topo = _topo_clusters(sorted(others,
+                                             key=lambda t: t[0]))
+                if topo is None:
+                    return None
+                _inv = _TLS.inv
+                order = sorted(ks.init_reads, key=lambda i: _inv[i])
+                for c in [*topo, cl]:
+                    order.append(c.write)
+                    order.extend(sorted(c.ok_reads,
+                                        key=lambda i: _inv[i]))
+                order = _insert_by_rt(order, ks.nil_reads)
+                if order is None or \
+                        not _verify_witness(sseq, m, order):
+                    return None
+                states.add(st)
+                if wit is not None:
+                    wit.setdefault(st, (ins, [int(r) for r in order]))
+        finally:
+            _TLS.inv = _TLS.ret = None
+    _M_FOLDS.inc()
+    if witness:
+        return states, wit
+    return states

@@ -239,6 +239,34 @@ def stream_plan(seq: OpSeq, model: ModelSpec, *,
     }
 
 
+def independent_keys(seq: OpSeq, model: ModelSpec):
+    """Detect a jepsen.independent ``[k v]`` composite history encoded
+    under a single-register model — the shape every keyed live family
+    (pgwire, replicated, kv) records.
+
+    ``encode_ops``'s default lanes split a pair value across (v1, v2),
+    so a register WRITE row carrying a second lane can only be a keyed
+    write (a plain register write never uses v2; cas rows legitimately
+    do and are ignored here).  Returns the sorted key list when
+    detected, else None.  Consumers (``explain``, the analyze CLI) use
+    it to report the per-key demux route — the route
+    ``independent.checker`` and the stream checker's independent mode
+    actually execute — instead of mis-reading key lanes as values.
+    """
+    if model.name not in ("register", "cas-register"):
+        return None
+    f = np.asarray(seq.f)
+    writes = f == R_WRITE
+    if not bool(writes.any()):
+        return None
+    v2 = np.asarray(seq.v2)
+    if not bool((v2[writes] != NIL).all()):
+        return None
+    v1 = np.asarray(seq.v1)
+    keyed = np.isin(f, (R_READ, R_WRITE)) & (v1 != NIL)
+    return sorted(int(k) for k in np.unique(v1[keyed]))
+
+
 def schedule_weight(seq: OpSeq) -> int:
     """The cell schedulers' cost proxy (largest-first ordering in
     decompose/schedule.py's host pool and device batch).
@@ -349,8 +377,27 @@ def explain(history, model: ModelSpec, *,
     ub_log2 = (max(0, es.window - 1) + es.n_crash)
     upper = (es.n_det + 1) << ub_log2
 
+    from .hb import plan_block
+
+    # keyed-composite gate (the live pgwire/replicated/kv families):
+    # a [k v] history under a register model routes per key — every
+    # whole-history prediction below would mis-read key lanes as
+    # values, so the plan says so instead of falling through
+    ind = independent_keys(seq, model)
+    independent = {"detected": ind is not None}
+    if ind is not None:
+        independent.update({
+            "keys": len(ind),
+            "route": "per-key demux (independent.checker post-hoc; "
+                     "stream independent mode live)",
+            "note": "whole-history dims/decomposition/hb predictions "
+                    "below do not apply to a keyed composite — demux "
+                    "first, then explain each key's subhistory",
+        })
+
     return {
         "model": model.name,
+        "independent": independent,
         "n_rows": len(seq),
         "n_det": es.n_det,
         "n_crash": es.n_crash,
@@ -367,12 +414,14 @@ def explain(history, model: ModelSpec, *,
         "config_upper_bound": upper,
         "config_upper_bound_log2": round(
             ub_log2 + float(np.log2(max(1, es.n_det + 1))), 2),
+        "hb": plan_block(seq, model, upper, es.n_crash, es.window),
         "decompositions": _decompositions(seq, model),
         "streaming": stream_plan(seq, model),
     }
 
 
-def explain_batch(seqs: list[OpSeq], model: ModelSpec) -> dict:
+def explain_batch(seqs: list[OpSeq], model: ModelSpec, *,
+                  hb: bool | None = None) -> dict:
     """The static plan for a BATCH: per-key routing plus the bucketed
     scheduler's exact bucket assignment (checker/bucket.py's
     ``plan_buckets`` over the same keys, merged to the same cap).
@@ -397,9 +446,22 @@ def explain_batch(seqs: list[OpSeq], model: ModelSpec) -> dict:
     greedy = [i for i in range(len(seqs))
               if lin.greedy_witness(seqs[i], model)]
     greedy_set = set(greedy)
+    # the HB pre-pass disposes decided keys next to the greedy witness
+    # (checker/bucket.py's prep stage) — mirror the split exactly,
+    # including the per-call flag resolution, so the predicted
+    # per-bucket dims match the scheduler's under any hb setting
+    from .hb import analyze_hb, resolve_hb
+
+    hb_set: set[int] = set()
+    if resolve_hb(hb):
+        for i in range(len(seqs)):
+            if i not in greedy_set and \
+                    analyze_hb(seqs[i], model).decided is not None:
+                hb_set.add(i)
+    disposed = greedy_set | hb_set
     buckets = []
     for idxs in plans:
-        run = [i for i in idxs if i not in greedy_set]
+        run = [i for i in idxs if i not in disposed]
         dims = (lin.batch_dims([ess[i] for i in run], model, frontier=32)
                 if run else None)
         useful = sum(ess[i].n_det + ess[i].n_crash for i in run)
@@ -421,10 +483,15 @@ def explain_batch(seqs: list[OpSeq], model: ModelSpec) -> dict:
         "n_buckets": len(plans),
         "bucketing": _enabled,
         "greedy": len(greedy),
+        "hb_decided": len(hb_set),
         "hard": len(hard),
         "hard_keys": hard,
         "buckets": buckets,
     }
+
+
+def _log2(x) -> float:
+    return round(float(np.log2(max(1, int(x or 0)))), 1)
 
 
 def render_plan(plan: dict, *, batch: bool = False) -> str:
@@ -434,6 +501,7 @@ def render_plan(plan: dict, *, batch: bool = False) -> str:
         lines.append(f"batch plan: {plan['n_keys']} keys -> "
                      f"{plan['n_buckets']} bucket(s), "
                      f"{plan['greedy']} greedy-disposed, "
+                     f"{plan.get('hb_decided', 0)} hb-decided, "
                      f"{plan['hard']} host-fallback")
         for b, bk in enumerate(plan["buckets"]):
             dims = bk["dims"]
@@ -471,6 +539,27 @@ def render_plan(plan: dict, *, batch: bool = False) -> str:
         + "; quiescence "
         + (f"applies ({qc['segments']} segments)" if qc["applies"]
            else "n/a"))
+    ind = plan.get("independent")
+    if ind and ind.get("detected"):
+        lines.append(
+            f"  KEYED COMPOSITE: {ind['keys']} independent key(s) — "
+            f"engines route {ind['route']}; whole-history predictions "
+            f"below are the un-demuxed counterfactual")
+    hb = plan.get("hb")
+    if hb:
+        if not hb.get("applies"):
+            line = f"n/a ({hb.get('reason')})"
+        elif hb.get("decided") is not None:
+            line = (f"DECIDES this history "
+                    f"({'valid' if hb['decided'] else 'invalid'} via "
+                    f"{hb.get('reason')}; no search needed)")
+        else:
+            line = (f"undecided; {hb.get('must_edges', 0)} must-order "
+                    f"edge(s) {hb.get('edges')}, pruned bound "
+                    f"~2^{_log2(hb.get('pruned_upper_bound', 0))} of "
+                    f"raw ~2^{_log2(plan.get('config_upper_bound', 0))}"
+                    f" (ratio {hb.get('prune_ratio')})")
+        lines.append("  happens-before: " + line)
     st = plan.get("streaming")
     if st:
         lines.append(

@@ -28,6 +28,25 @@ S005 error    a Nemesis ``invoke`` returns a completion whose type is
               not ``info`` (core.py asserts this at runtime)
 ==== ======== ==========================================================
 
+B-codes (``jepsen_tpu/live/`` backends; same gate, same suppression):
+
+==== ======== ==========================================================
+B001 error    a direct ``LiveBackend`` subclass is missing a protocol
+              member (``name``/``server_argv``/``workload``) — the
+              campaign runner would crash mid-matrix instead of at lint
+              time
+B002 error    broad/bare ``except`` anywhere in a live module whose
+              handler unconditionally completes as ``:fail`` — a crash
+              against a REAL process is indeterminate (the op may have
+              applied before the connection died) and must become
+              ``:info``
+B003 error    a function writes a file and then ``os.replace``/
+              ``os.rename``\\ s it without an ``fsync`` in between —
+              the crash-safe journal contract (live/links.py,
+              live/corpus.py) is durable-BEFORE-rename; a torn rename
+              after a crash silently loses the journal
+==== ======== ==========================================================
+
 False-positive escape hatch: a line containing ``suite-lint: ok``
 suppresses findings anchored on it (use sparingly, with a comment saying
 why the pattern is sound).
@@ -50,7 +69,15 @@ SUITE_CODES = {
     "S003": "broad except unconditionally converting a crash to :fail",
     "S004": "setup/teardown (open/close) pairing",
     "S005": "nemesis completions must be :info",
+    "B001": "LiveBackend subclass missing a protocol member",
+    "B002": "broad except in a live module swallowing a crash to :fail",
+    "B003": "file written and renamed without fsync in between",
 }
+
+#: the LiveBackend protocol members a concrete family must provide
+#: (live/backend.py raises NotImplementedError for the first two; a
+#: family without them dies mid-campaign, not at lint time)
+LIVE_PROTOCOL = ("server_argv", "workload")
 
 
 def _base_names(cls: ast.ClassDef) -> list[str]:
@@ -298,18 +325,213 @@ def lint_source(src: str, filename: str = "<string>"
     return diags
 
 
+def _fn_calls(fn: ast.FunctionDef) -> list[ast.Call]:
+    out = []
+    for node in ast.walk(fn):
+        if isinstance(node, ast.Call):
+            out.append(node)
+    return out
+
+
+def _call_name(c: ast.Call) -> str:
+    try:
+        return ast.unparse(c.func)
+    except Exception:  # noqa: BLE001 — exotic callee exprs
+        return ""
+
+
+def lint_live_source(src: str, filename: str = "<string>"
+                     ) -> list[Diagnostic]:
+    """B-code lint for one ``jepsen_tpu/live/`` module (run on top of
+    :func:`lint_source`, whose Client/Nemesis S-codes apply to live
+    wire shims unchanged)."""
+    diags: list[Diagnostic] = []
+    try:
+        tree = ast.parse(src, filename=filename)
+    except SyntaxError as e:
+        return [Diagnostic("B001", "error",
+                           f"{filename}: does not parse: {e}",
+                           index=e.lineno)]
+    lines = src.splitlines()
+
+    def suppressed(lineno: int | None) -> bool:
+        if lineno is None or not 1 <= lineno <= len(lines):
+            return False
+        return "suite-lint: ok" in lines[lineno - 1]
+
+    def add(code, msg, lineno):
+        if not suppressed(lineno):
+            diags.append(Diagnostic(code, "error",
+                                    f"{filename}:{lineno}: {msg}",
+                                    index=lineno))
+
+    classes = [n for n in ast.walk(tree) if isinstance(n, ast.ClassDef)]
+
+    # --- B001: LiveBackend protocol conformance ----------------------
+    # A class that SETS a family `name` declares itself a concrete
+    # campaign family: it must define (or inherit through an in-file
+    # base chain) the protocol members LiveBackend only raises for.
+    # Classes without `name` are abstract intermediates (e.g. the
+    # replicated consensus core) and are exempt; chains through bases
+    # defined in other modules are unprovable here and skipped.
+    by_name = {c.name: c for c in classes}
+
+    def own(cls):
+        members = {m.name for m in cls.body
+                   if isinstance(m, (ast.FunctionDef,
+                                     ast.AsyncFunctionDef))}
+        assigns = {t.id for m in cls.body if isinstance(m, ast.Assign)
+                   for t in m.targets if isinstance(t, ast.Name)}
+        assigns |= {m.target.id for m in cls.body
+                    if isinstance(m, ast.AnnAssign)
+                    and isinstance(m.target, ast.Name)
+                    and m.value is not None}
+        return members, assigns
+
+    def chain_has(cls, member: str):
+        """True / False / None (= unprovable) walking in-file bases,
+        stopping at LiveBackend (whose defs just raise)."""
+        seen = set()
+        stack = [cls]
+        unprovable = False
+        while stack:
+            c = stack.pop()
+            if c.name in seen:
+                continue
+            seen.add(c.name)
+            if c.name != "LiveBackend" and member in own(c)[0]:
+                return True
+            for b in _base_names(c):
+                leaf = b.split(".")[-1]
+                if leaf == "LiveBackend":
+                    continue
+                if leaf in by_name:
+                    stack.append(by_name[leaf])
+                else:
+                    unprovable = True
+        return None if unprovable else False
+
+    def is_backend(cls) -> bool:
+        seen = set()
+        stack = [cls]
+        while stack:
+            c = stack.pop()
+            if c.name in seen:
+                continue
+            seen.add(c.name)
+            for b in _base_names(c):
+                leaf = b.split(".")[-1]
+                if leaf == "LiveBackend":
+                    return True
+                if leaf in by_name:
+                    stack.append(by_name[leaf])
+        return False
+
+    for cls in classes:
+        if not is_backend(cls):
+            continue
+        members, assigns = own(cls)
+        if "name" not in assigns:
+            if all(m in members for m in LIVE_PROTOCOL):
+                add("B001",
+                    f"{cls.name} implements the LiveBackend protocol "
+                    f"but does not set `name` — campaign cell keys "
+                    f"would collide on '?'", cls.lineno)
+            continue  # no name: an abstract intermediate
+        for req in LIVE_PROTOCOL:
+            if chain_has(cls, req) is False:
+                add("B001",
+                    f"{cls.name} subclasses LiveBackend but neither "
+                    f"defines nor inherits {req}() — the campaign "
+                    f"runner would raise NotImplementedError "
+                    f"mid-matrix", cls.lineno)
+
+    # --- B002: crash swallowed into :fail anywhere in a live module --
+    # The S003 beat covers *Client.invoke; live modules also complete
+    # ops in helpers and ported shims, where the same conversion is the
+    # same lie (a crash against a real process may have applied).
+    client_invokes = set()
+    for cls in classes:
+        bases = _base_names(cls)
+        is_client = any(b.endswith("Client") for b in bases) or (
+            cls.name.endswith("Client") and not bases)
+        if is_client:
+            for m in cls.body:
+                if isinstance(m, ast.FunctionDef) and \
+                        m.name == "invoke":
+                    client_invokes.add(id(m))
+    for fn in [n for n in ast.walk(tree)
+               if isinstance(n, ast.FunctionDef)]:
+        if id(fn) in client_invokes:
+            continue  # S003's beat — don't double-report
+        for handler in [n for n in ast.walk(fn)
+                        if isinstance(n, ast.ExceptHandler)]:
+            if not _is_broad(handler) or _handler_raises(handler):
+                continue
+            for ret in _handler_unguarded_returns(handler):
+                if _return_type_consts(ret) == {"fail"}:
+                    add("B002",
+                        f"{fn.name}() unconditionally converts a "
+                        f"broad-except crash to :fail — against a real "
+                        f"process the op may have applied; complete as "
+                        f":info or guard on the exception", ret.lineno)
+
+    # --- B003: rename without fsync ----------------------------------
+    # The journal contract (live/links.py rules.jsonl, live/corpus.py
+    # pool.jsonl, oplog.py): bytes are durable BEFORE the rename
+    # publishes them.  Flag any function that opens a file for writing
+    # and renames/replaces one without an os.fsync between.
+    for fn in [n for n in ast.walk(tree)
+               if isinstance(n, ast.FunctionDef)]:
+        calls = _fn_calls(fn)
+        renames = [c for c in calls
+                   if _call_name(c) in ("os.replace", "os.rename")]
+        if not renames:
+            continue
+        writes = []
+        for c in calls:
+            if _call_name(c) != "open" or len(c.args) < 2:
+                continue
+            mode = c.args[1]
+            if isinstance(mode, ast.Constant) and \
+                    isinstance(mode.value, str) and \
+                    ("w" in mode.value or "a" in mode.value):
+                writes.append(c)
+        if not writes:
+            continue
+        fsyncs = [c for c in calls if _call_name(c) == "os.fsync"]
+        for rn in renames:
+            covered = any(w.lineno < f.lineno < rn.lineno
+                          for w in writes for f in fsyncs)
+            if not covered:
+                add("B003",
+                    f"{fn.name}() writes a file and then "
+                    f"{_call_name(rn)}()s without an os.fsync in "
+                    f"between — a crash can publish a torn or empty "
+                    f"journal (durable-before-rename contract)",
+                    rn.lineno)
+    return diags
+
+
 def lint_file(path: str | Path) -> list[Diagnostic]:
     p = Path(path)
-    return lint_source(p.read_text(), filename=str(p))
+    src = p.read_text()
+    diags = lint_source(src, filename=str(p))
+    if p.parent.name == "live":
+        diags = diags + lint_live_source(src, filename=str(p))
+    return diags
 
 
 def lint_paths(paths: Sequence[str | Path] | None = None
                ) -> dict[str, list[Diagnostic]]:
     """Lint suite files.  ``paths`` may mix files and directories;
-    default: the bundled ``jepsen_tpu/suites``.  Returns
-    {filename: diagnostics} for files with findings only."""
+    default: the bundled ``jepsen_tpu/suites`` AND ``jepsen_tpu/live``
+    (files under a ``live`` directory additionally get the B-code
+    backend lint).  Returns {filename: diagnostics} for files with
+    findings only."""
     if not paths:
-        paths = [Path(__file__).resolve().parent.parent / "suites"]
+        pkg = Path(__file__).resolve().parent.parent
+        paths = [pkg / "suites", pkg / "live"]
     files: list[Path] = []
     for p in paths:
         p = Path(p)

@@ -140,7 +140,8 @@ def plan_buckets(keys: list[tuple[int, int, int]],
 
 
 def search_batch_bucketed(seqs: list[OpSeq], model: ModelSpec, *,
-                          budget: int = 2_000_000) -> list[dict]:
+                          budget: int = 2_000_000,
+                          hb: bool | None = None) -> list[dict]:
     """Bucketed drop-in for `search_batch`'s ladder path.
 
     Per-key results are exactly what the underlying engines report
@@ -152,7 +153,9 @@ def search_batch_bucketed(seqs: list[OpSeq], model: ModelSpec, *,
     that bucketing actually cut wasted padded work.
     """
     from . import linearizable as lin
+    from ..analyze.hb import hb_dispose, resolve_hb
 
+    hb = resolve_hb(hb)
     n = len(seqs)
     t_start = time.perf_counter()
     kc0 = lin.kernel_cache_stats()
@@ -167,7 +170,7 @@ def search_batch_bucketed(seqs: list[OpSeq], model: ModelSpec, *,
     plans = [[fit[p] for p in grp] for grp in plans]
 
     stats: dict = {"n_keys": n, "n_buckets": len(plans), "buckets": [],
-                   "greedy": 0, "hard": len(hard)}
+                   "greedy": 0, "hard": len(hard), "hb_decided": 0}
 
     def prep(idxs: list[int]):
         """Host stage for one bucket: greedy-witness disposal, then
@@ -191,7 +194,15 @@ def search_batch_bucketed(seqs: list[OpSeq], model: ModelSpec, *,
                                 "linearization":
                                     lin.greedy_linearization(s)}
                 else:
-                    run.append(i)
+                    r = hb_dispose(s, model) if hb else None
+                    if r is not None:
+                        # HB-decided next to the greedy disposal: the
+                        # key never pads into the bucket's dims, never
+                        # costs a device config (explain_batch mirrors
+                        # this split exactly)
+                        ready[i] = r
+                    else:
+                        run.append(i)
             if not run:
                 _M_BUCKET_S.observe(time.perf_counter() - t_prep,
                                     stage="prep")
@@ -218,7 +229,10 @@ def search_batch_bucketed(seqs: list[OpSeq], model: ModelSpec, *,
                     fut = ex.submit(prep, plans[b + 1])
                 for i, r in ready.items():
                     results[i] = r
-                stats["greedy"] += len(ready)
+                n_hb = sum(1 for r in ready.values()
+                           if r.get("engine") == "hb-decide")
+                stats["hb_decided"] += n_hb
+                stats["greedy"] += len(ready) - n_hb
                 t0 = time.perf_counter()
                 if run:
                     with obs.span("bucket.device", cat="device",
@@ -266,7 +280,7 @@ def search_batch_bucketed(seqs: list[OpSeq], model: ModelSpec, *,
                               "linearization": lin.greedy_linearization(s)}
                 stats["greedy"] += 1
                 continue
-            r = check_opseq_linear(seqs[i], model, lint=False)
+            r = check_opseq_linear(seqs[i], model, lint=False, hb=hb)
             r["engine"] = "host-linear(fallback)"
             results[i] = r
     # the single-fused-batch counterfactual over the SAME device-ridden

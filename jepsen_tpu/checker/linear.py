@@ -93,7 +93,8 @@ def check_opseq_linear(seq: OpSeq, model: ModelSpec, *,
                        decompose: bool = False,
                        decompose_cache=None,
                        lint: bool | None = None,
-                       audit: bool | None = None) -> dict:
+                       audit: bool | None = None,
+                       hb: bool | None = None) -> dict:
     """Exact linearizability check.  Returns a knossos-style map
     {"valid": True|False|"unknown", "configs": n, "max_depth": d, ...};
     on invalid, ``final_ops`` holds the un-linearizable candidate rows at
@@ -131,14 +132,27 @@ def check_opseq_linear(seq: OpSeq, model: ModelSpec, *,
     over the OpSeq first — on by default (None follows JEPSEN_TPU_LINT);
     errors raise :class:`~jepsen_tpu.analyze.HistoryLintError`.
     ``audit`` replays the emitted certificate through the independent
-    audit pass (analyze/audit.py; None follows JEPSEN_TPU_AUDIT)."""
+    audit pass (analyze/audit.py; None follows JEPSEN_TPU_AUDIT).
+    ``hb`` runs the happens-before pre-pass (analyze/hb.py; None
+    follows JEPSEN_TPU_HB, default on): decided histories return
+    immediately with an audited certificate and zero explored configs;
+    undecided ones sweep under the must-order candidate mask —
+    verdict-identical either way."""
     from ..analyze.audit import maybe_audit
+    from ..analyze.hb import attach, maybe_hb
     from ..analyze.lint import maybe_lint
 
     maybe_lint(seq, model, lint)
 
+    hbres = None
+    if not decompose and resume_from is None:
+        hbres = maybe_hb(seq, model, hb)
+
     def finish(out: dict) -> dict:
-        return maybe_audit(seq, model, out, audit)
+        return maybe_audit(seq, model, attach(out, hbres), audit)
+
+    if hbres is not None and hbres.decided is not None:
+        return maybe_audit(seq, model, dict(hbres.decided), audit)
     if decompose:
         if checkpoint_path or resume_from:
             # the decomposed funnel has no serialized level-set to
@@ -154,20 +168,20 @@ def check_opseq_linear(seq: OpSeq, model: ModelSpec, *,
             return check_opseq_linear(s, model, max_configs=max_configs,
                                       deadline=deadline, cancel=cancel,
                                       witness_cap=witness_cap,
-                                      lint=False)
+                                      lint=False, hb=hb)
 
         def _sub(s, m, *, max_configs=max_configs, deadline=deadline):
             return check_opseq_linear(s, m, max_configs=max_configs,
                                       deadline=deadline, cancel=cancel,
                                       witness_cap=witness_cap,
-                                      lint=False)
+                                      lint=False, hb=hb)
 
         return check_opseq_decomposed(seq, model, cache=decompose_cache,
                                       direct=_direct, sub_check=_sub,
                                       sub_max_configs=max_configs,
                                       deadline=deadline, lint=False,
                                       witness=witness_cap > 0,
-                                      audit=audit)
+                                      audit=audit, hb=hb)
     es = encode_search(seq)
     n_det, n_crash, W = es.n_det, es.n_crash, es.window
     if n_det == 0 and n_crash == 0:
@@ -194,6 +208,30 @@ def check_opseq_linear(seq: OpSeq, model: ModelSpec, *,
     pystep = model.pystep
     INF = int(INF32)
 
+    # must-order mask (HB pre-pass): per det position / crash index,
+    # the det-position preds (checked against (p, win) in the frame)
+    # and the crash-index preds (a bitmask checked against each cmask
+    # at expansion time — frames are crash-set-independent)
+    mp_det: dict[int, tuple] = {}
+    mp_crash: dict[int, tuple] = {}
+    if hbres is not None and hbres.must_pred:
+        det_pos_of = {int(r): p for p, r in enumerate(det_rows)}
+        crash_of = {int(r): c for c, r in enumerate(crash_rows)}
+        for dst, srcs in hbres.must_pred.items():
+            dp = tuple(det_pos_of[s] for s in srcs if s in det_pos_of)
+            cp = 0
+            for s in srcs:
+                c = crash_of.get(s)
+                if c is not None:
+                    cp |= 1 << c
+            if not dp and not cp:
+                continue
+            if dst in det_pos_of:
+                mp_det[det_pos_of[dst]] = (dp, cp)
+            else:
+                mp_crash[crash_of[dst]] = (dp, cp)
+    _NO_PRED = ((), 0)
+
     frames: dict[tuple, _Frame] = {}
 
     def frame(p: int, win: int) -> _Frame:
@@ -219,6 +257,9 @@ def check_opseq_linear(seq: OpSeq, model: ModelSpec, *,
                 m1_at = i
             elif r < m2:
                 m2 = r
+        def det_done(q: int) -> bool:
+            return q < p or (q - p < W and (win >> (q - p)) & 1)
+
         det_cands = []
         for i in range(hi - p):
             if (win >> i) & 1:
@@ -226,9 +267,19 @@ def check_opseq_linear(seq: OpSeq, model: ModelSpec, *,
             j = p + i
             excl = m2 if i == m1_at else m1
             if det_inv[j] < excl:
-                det_cands.append((i, det_f[j], det_v1[j], det_v2[j]))
-        crash_cands = [(c, crash_f[c], crash_v1[c], crash_v2[c])
-                       for c in range(n_crash) if crash_inv[c] < m1]
+                dp, cp = mp_det.get(j, _NO_PRED)
+                if dp and not all(det_done(q) for q in dp):
+                    continue  # a must-predecessor det is unlinearized
+                det_cands.append((i, det_f[j], det_v1[j], det_v2[j],
+                                  cp))
+        crash_cands = []
+        for c in range(n_crash):
+            if crash_inv[c] < m1:
+                dp, cp = mp_crash.get(c, _NO_PRED)
+                if dp and not all(det_done(q) for q in dp):
+                    continue
+                crash_cands.append((c, crash_f[c], crash_v1[c],
+                                    crash_v2[c], cp))
         fr = _Frame(det_cands, crash_cands,
                     p + bin(win).count("1") >= n_det)
         frames[(p, win)] = fr
@@ -332,9 +383,11 @@ def check_opseq_linear(seq: OpSeq, model: ModelSpec, *,
                                "max_depth": depth, "info": why})
             (p, win, state), cmask = work.pop()
             fr = frame(p, win)
-            for c, f, v1, v2 in fr.crash:
+            for c, f, v1, v2, cp in fr.crash:
                 if (cmask >> c) & 1:
                     continue
+                if cp & ~cmask:
+                    continue  # a must-predecessor crash op is missing
                 ns = pystep(state, f, v1, v2)
                 if ns is None:
                     continue
@@ -362,13 +415,15 @@ def check_opseq_linear(seq: OpSeq, model: ModelSpec, *,
         nxt: dict[tuple, list[int]] = {}
         for (p, win, state), ac in level.items():
             fr = frame(p, win)
-            for i, f, v1, v2 in fr.det:
+            for i, f, v1, v2, cp in fr.det:
                 ns = pystep(state, f, v1, v2)
                 if ns is None:
                     continue
                 p2, win2 = _advance(p, win, i, n_det)
                 nk = (p2, win2, ns)
                 for cmask in ac:
+                    if cp & ~cmask:
+                        continue  # must-predecessor crash op missing
                     configs += 1
                     if insert(nxt, nk, cmask):
                         remember(nk, cmask, int(det_rows[p + i]),

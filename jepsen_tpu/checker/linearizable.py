@@ -836,7 +836,10 @@ def build_sharded_search_step_fn(model: ModelSpec, dims: SearchDims,
 
     dims.frontier is the PER-DEVICE frontier width.
     """
-    from jax import shard_map
+    try:
+        from jax import shard_map
+    except ImportError:  # pre-0.4.35 jax: the experimental home
+        from jax.experimental.shard_map import shard_map
     from jax.sharding import PartitionSpec as P
 
     K = dims.k
@@ -1005,8 +1008,14 @@ def build_sharded_search_step_fn(model: ModelSpec, dims: SearchDims,
 
     specs = (P(),) * 15
     carry_in = (P(axis), P(axis), P(), P(), P(), P(), P())
-    return shard_map(step_device, mesh=mesh, in_specs=specs + carry_in,
-                     out_specs=carry_in, check_vma=False)
+    try:
+        return shard_map(step_device, mesh=mesh,
+                         in_specs=specs + carry_in,
+                         out_specs=carry_in, check_vma=False)
+    except TypeError:  # pre-0.4.35 jax spells the knob check_rep
+        return shard_map(step_device, mesh=mesh,
+                         in_specs=specs + carry_in,
+                         out_specs=carry_in, check_rep=False)
 
 
 def _trailing_ones(w):
@@ -1978,7 +1987,8 @@ def check_competition(seq: OpSeq, model: ModelSpec, *,
                       budget: int = 20_000_000,
                       max_configs: int = 50_000_000,
                       lint: bool | None = None,
-                      audit: bool | None = None) -> dict:
+                      audit: bool | None = None,
+                      hb: bool | None = None) -> dict:
     """Race the exact host checkers against the device BFS search; the
     first conclusive verdict wins and retires the losers.
 
@@ -2044,7 +2054,7 @@ def check_competition(seq: OpSeq, model: ModelSpec, *,
     def wgl_leg():
         try:
             r = seqmod.check_opseq(seq, model, max_configs=max_configs,
-                                   cancel=done, lint=False)
+                                   cancel=done, lint=False, hb=hb)
         except Exception:  # noqa: BLE001 — loser errors must not win
             return
         submit(r, "competition(host-wgl)")
@@ -2056,7 +2066,7 @@ def check_competition(seq: OpSeq, model: ModelSpec, *,
             r = check_opseq_linear(seq, model, max_configs=max_configs,
                                    cancel=done,
                                    witness_cap=DEFAULT_WITNESS_CAP,
-                                   lint=False)
+                                   lint=False, hb=hb)
         except Exception:  # noqa: BLE001
             return
         submit(r, "competition(host-linear)")
@@ -2448,7 +2458,8 @@ def search_batch(seqs: list[OpSeq], model: ModelSpec, *,
                  decompose_cache=None,
                  bucket: bool | None = None,
                  lint: bool | None = None,
-                 audit: bool | None = None) -> list[dict]:
+                 audit: bool | None = None,
+                 hb: bool | None = None) -> list[dict]:
     """Check a batch of independent per-key histories in one device call.
 
     This is the TPU analog of jepsen.independent's bounded-pmap over
@@ -2480,9 +2491,18 @@ def search_batch(seqs: list[OpSeq], model: ModelSpec, *,
     survive bucket padding/reordering because row indices always index
     the key's OWN OpSeq.  ``audit`` replays every key's certificate
     (None follows JEPSEN_TPU_AUDIT).
+
+    ``hb`` (None follows JEPSEN_TPU_HB, default on) runs the
+    happens-before pre-pass (analyze/hb.py) per key: statically decided
+    keys are disposed host-side with certificates — right next to the
+    greedy-witness disposal, and before any device padding is sized —
+    so they never cost a device config at all.
     """
     if not seqs:
         return []
+    from ..analyze.hb import resolve_hb
+
+    hb = resolve_hb(hb)
     if audit is None:
         from ..analyze.audit import audit_enabled
 
@@ -2506,7 +2526,7 @@ def search_batch(seqs: list[OpSeq], model: ModelSpec, *,
     if decompose:
         return _audit_batch(seqs, model, _search_batch_decomposed(
             seqs, model, budget=budget, dims=dims, sharding=sharding,
-            cache=decompose_cache, bucket=bucket), audit)
+            cache=decompose_cache, bucket=bucket, hb=hb), audit)
     if bucket is None and sharding is None and dims is None \
             and len(seqs) > 1:
         from .bucket import bucketing_enabled
@@ -2517,18 +2537,26 @@ def search_batch(seqs: list[OpSeq], model: ModelSpec, *,
 
         return _audit_batch(seqs, model,
                             search_batch_bucketed(seqs, model,
-                                                  budget=budget), audit)
+                                                  budget=budget,
+                                                  hb=hb), audit)
     # greedy completion-order witnesses dispose of well-behaved keys
-    # host-side in O(n); only contentious keys ride to the device
+    # host-side in O(n), and the HB pre-pass disposes statically
+    # decided keys next to them; only contentious keys ride the device
+    from ..analyze.hb import hb_dispose
+
     results_by_idx: dict = {}
     rest = []
     for i, s in enumerate(seqs):
+        r = None
         if greedy_witness(s, model):
-            results_by_idx[i] = {"valid": True, "configs": s.n_must,
-                                 "max_depth": s.n_must,
-                                 "engine": "greedy-witness",
-                                 "linearization":
-                                     greedy_linearization(s)}
+            r = {"valid": True, "configs": s.n_must,
+                 "max_depth": s.n_must,
+                 "engine": "greedy-witness",
+                 "linearization": greedy_linearization(s)}
+        elif hb:
+            r = hb_dispose(s, model)
+        if r is not None:
+            results_by_idx[i] = r
         else:
             rest.append(i)
     if not rest:
@@ -2538,7 +2566,7 @@ def search_batch(seqs: list[OpSeq], model: ModelSpec, *,
     if results_by_idx:
         sub = search_batch([seqs[i] for i in rest], model, budget=budget,
                            dims=dims, sharding=sharding, bucket=False,
-                           lint=False, audit=False)
+                           lint=False, audit=False, hb=False)
         for i, r in zip(rest, sub):
             results_by_idx[i] = r
         return _audit_batch(seqs, model,
@@ -2555,7 +2583,7 @@ def search_batch(seqs: list[OpSeq], model: ModelSpec, *,
         out = []
         for i, s in enumerate(seqs):
             if i in hard:
-                r = check_opseq_linear(s, model, lint=False)
+                r = check_opseq_linear(s, model, lint=False, hb=hb)
                 r["engine"] = "host-linear(fallback)"
                 out.append(r)
             else:
@@ -2765,7 +2793,8 @@ def _search_batch_ladder(seqs: list[OpSeq], esps: list[EncodedSearch],
 
 def _search_batch_decomposed(seqs: list[OpSeq], model: ModelSpec, *,
                              budget: int, dims, sharding,
-                             cache, bucket=None) -> list[dict]:
+                             cache, bucket=None,
+                             hb: bool | None = None) -> list[dict]:
     """Cache + dedup front-end for `search_batch` (decompose=True).
 
     Exact by construction: a canonical-hash collision means the two
@@ -2801,7 +2830,7 @@ def _search_batch_decomposed(seqs: list[OpSeq], model: ModelSpec, *,
     if todo:
         sub = search_batch([seqs[i] for i in todo], model, budget=budget,
                            dims=dims, sharding=sharding, bucket=bucket,
-                           lint=False)
+                           lint=False, hb=hb)
         for i, r in zip(todo, sub):
             results[i] = r
             if r.get("valid") in (True, False):
@@ -2813,7 +2842,7 @@ def _search_batch_decomposed(seqs: list[OpSeq], model: ModelSpec, *,
         The audit pass replays the copy against ITS history, keeping
         this transfer falsifiable."""
         for field in ("linearization", "final_ops", "witness_dropped",
-                      "frontier_dropped"):
+                      "frontier_dropped", "hb_cycle"):
             if field in src:
                 v = src[field]
                 dst[field] = list(v) if isinstance(v, list) else v
@@ -2950,8 +2979,15 @@ class Linearizable:
                  lint: bool | None = None,
                  explain: bool | None = None,
                  audit: bool | None = None,
-                 shrink: bool | None = None):
+                 shrink: bool | None = None,
+                 hb: bool | None = None):
         self.model = model
+        # ``hb`` runs the happens-before pre-pass (analyze/hb.py) in
+        # front of every host route: statically decided histories skip
+        # the search entirely, undecided ones search under the
+        # must-order mask.  None follows JEPSEN_TPU_HB (default on;
+        # the CLI's --no-hb sets it to 0).
+        self.hb = hb
         self.budget = budget
         self.host_threshold = host_threshold
         self.witness_threshold = witness_threshold
@@ -3079,13 +3115,14 @@ class Linearizable:
                     return seqmod.check_opseq(s, m,
                                               max_configs=max_configs,
                                               deadline=deadline,
-                                              lint=False)
+                                              lint=False, hb=self.hb)
             # lint=False: this checker already linted (or deliberately
             # skipped) at its own boundary in check()
             out = check_opseq_decomposed(
                 seq, model, cache=cache,
                 sub_max_configs=self.budget,  # the user's sizing knob
                 sub_check=sub_check, lint=False, witness=True,
+                hb=self.hb,
                 direct=lambda s: self._check_direct(test, s, model, opts))
             if out["valid"] is False and "report_file" not in out:
                 # the direct fallback renders its own report; a verdict
@@ -3102,7 +3139,8 @@ class Linearizable:
                     and len(seq) <= self.host_threshold)):
             # lint=False throughout _check_direct: check() linted (or
             # deliberately skipped) at the checker boundary already
-            out = seqmod.check_opseq(seq, model, lint=False)
+            out = seqmod.check_opseq(seq, model, lint=False,
+                                     hb=self.hb)
             out["engine"] = "host-oracle"
             if out["valid"] is False:
                 self._render_failure(test, seq, out, opts, model)
@@ -3116,7 +3154,7 @@ class Linearizable:
             # fuzzers — leave it off and keep level-local memory)
             out = check_opseq_linear(seq, model,
                                      witness_cap=DEFAULT_WITNESS_CAP,
-                                     lint=False)
+                                     lint=False, hb=self.hb)
             out["engine"] = "host-linear"
             if out["valid"] is False:
                 self._render_failure(test, seq, out, opts, model)
@@ -3129,7 +3167,7 @@ class Linearizable:
             # thread costs one core and wins exactly the histories a DFS
             # lucky-dives (deep valid ones); the device wins sweeps.
             out = check_competition(seq, model, budget=self.budget,
-                                    lint=False)
+                                    lint=False, hb=self.hb)
         else:
             out = search_opseq(seq, model, budget=self.budget,
                                lint=False)

@@ -63,7 +63,8 @@ def check_opseq(seq: OpSeq, model: ModelSpec, *,
                 decompose: bool = False,
                 decompose_cache=None,
                 lint: bool | None = None,
-                audit: bool | None = None) -> dict:
+                audit: bool | None = None,
+                hb: bool | None = None) -> dict:
     """Run the DFS over a columnar OpSeq.  Returns a knossos-style map:
 
     valid        True | False | "unknown"
@@ -96,14 +97,26 @@ def check_opseq(seq: OpSeq, model: ModelSpec, *,
     :class:`~jepsen_tpu.analyze.HistoryLintError` instead of feeding a
     malformed history to the search.  Verdict-identical on well-formed
     histories (tests/test_analyze.py's differential fuzz).
+    ``hb`` runs the happens-before pre-pass (analyze/hb.py; None
+    follows JEPSEN_TPU_HB, default on): statically decided histories
+    return immediately with an audited certificate and zero explored
+    configs, and undecided ones search under the must-order mask —
+    verdict-identical either way.
     """
     from ..analyze.audit import maybe_audit
+    from ..analyze.hb import attach, maybe_hb
     from ..analyze.lint import maybe_lint
 
     maybe_lint(seq, model, lint)
 
     def finish(out: dict) -> dict:
-        return maybe_audit(seq, model, out, audit)
+        return maybe_audit(seq, model, attach(out, hbres), audit)
+
+    hbres = None
+    if not decompose:
+        hbres = maybe_hb(seq, model, hb)
+        if hbres is not None and hbres.decided is not None:
+            return finish(dict(hbres.decided))
 
     if decompose:
         from ..decompose.engine import check_opseq_decomposed
@@ -111,12 +124,14 @@ def check_opseq(seq: OpSeq, model: ModelSpec, *,
         def _direct(s):
             return check_opseq(s, model, max_configs=max_configs,
                                deadline=deadline, cancel=cancel,
-                               order_seed=order_seed, lint=False)
+                               order_seed=order_seed, lint=False,
+                               hb=hb)
 
         def _sub(s, m, *, max_configs=max_configs, deadline=deadline):
             return check_opseq(s, m, max_configs=max_configs,
                                deadline=deadline, cancel=cancel,
-                               order_seed=order_seed, lint=False)
+                               order_seed=order_seed, lint=False,
+                               hb=hb)
 
         # the entry seq was linted above (when enabled); cells/segments
         # are engine-derived projections, so re-linting them would only
@@ -127,7 +142,8 @@ def check_opseq(seq: OpSeq, model: ModelSpec, *,
                                       direct=_direct, sub_check=_sub,
                                       sub_max_configs=max_configs,
                                       deadline=deadline, lint=False,
-                                      witness=True, audit=audit)
+                                      witness=True, audit=audit,
+                                      hb=hb)
     import random as _random
     import time
     n = len(seq)
@@ -145,6 +161,17 @@ def check_opseq(seq: OpSeq, model: ModelSpec, *,
     v1 = [int(x) for x in seq.v1]
     v2 = [int(x) for x in seq.v2]
     pystep = model.pystep
+
+    # must-order mask (HB pre-pass): op j may linearize only once every
+    # must-predecessor is in the linearized set — forced edges hold in
+    # every valid linearization, canonical edges lose none
+    preds = [0] * n
+    if hbres is not None and hbres.must_pred:
+        for dst, srcs in hbres.must_pred.items():
+            pm = 0
+            for s_ in srcs:
+                pm |= 1 << s_
+            preds[dst] = pm
 
     visited: set = set()
     configs = 0
@@ -233,6 +260,8 @@ def check_opseq(seq: OpSeq, model: ModelSpec, *,
             excl = m2 if rets[idx] == m1 and m1_count == 1 else m1
             if inv[j2] >= excl:
                 continue
+            if preds[j2] & ~mask:
+                continue  # a must-predecessor is not yet linearized
             new_state = pystep(state, f[j2], v1[j2], v2[j2])
             if new_state is None:
                 continue

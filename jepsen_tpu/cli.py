@@ -108,6 +108,13 @@ def add_test_opts(p: argparse.ArgumentParser) -> None:
                         "(jepsen_tpu.analyze) that runs in front of "
                         "every linearizability check.  Sets "
                         "JEPSEN_TPU_LINT=0 fleet-wide.")
+    p.add_argument("--no-hb", action="store_true", default=False,
+                   help="Disable the happens-before pre-pass "
+                        "(jepsen_tpu.analyze.hb) that statically "
+                        "decides or prunes linearizability searches "
+                        "before any engine runs.  Sets JEPSEN_TPU_HB=0 "
+                        "fleet-wide; default on, verdict-identical "
+                        "either way.")
     p.add_argument("--audit", action="store_true", default=False,
                    help="Independently audit every verdict's "
                         "certificate (jepsen_tpu.analyze.audit): a "
@@ -198,6 +205,9 @@ def test_opt_fn(parsed: argparse.Namespace) -> dict:
     if opts.pop("no_lint", False):
         os.environ["JEPSEN_TPU_LINT"] = "0"
         opts["no_lint"] = True
+    if opts.pop("no_hb", False):
+        os.environ["JEPSEN_TPU_HB"] = "0"
+        opts["no_hb"] = True
     if opts.pop("audit", False):
         # like --lin-decompose/--explain: suites construct their own
         # checkers, so the audit opt-in travels by env var

@@ -51,7 +51,7 @@ class _DirectUndecided(Exception):
         self.result = result
 
 
-def _make_default_sub_check(witness: bool):
+def _make_default_sub_check(witness: bool, hb: bool | None = None):
     from ..checker.linear import check_opseq_linear
 
     cap = DEFAULT_WITNESS_CAP if witness else 0
@@ -59,10 +59,12 @@ def _make_default_sub_check(witness: bool):
     def sub_check(sseq, smodel, *, max_configs, deadline):
         # lint=False: cells/segments are engine-derived projections
         # whose invariants subseq preserves by construction (the entry
-        # seq was linted at the decomposed checker's own boundary)
+        # seq was linted at the decomposed checker's own boundary).
+        # hb rides through: cells and final segments get their own
+        # happens-before pre-pass (decide-fast + must-order mask)
         return check_opseq_linear(sseq, smodel, max_configs=max_configs,
                                   deadline=deadline, witness_cap=cap,
-                                  lint=False)
+                                  lint=False, hb=hb)
 
     return sub_check
 
@@ -205,7 +207,8 @@ def check_opseq_decomposed(seq: OpSeq, model: ModelSpec, *,
                            n_procs: int | None = None,
                            lint: bool | None = None,
                            witness: bool = False,
-                           audit: bool | None = None) -> dict:
+                           audit: bool | None = None,
+                           hb: bool | None = None) -> dict:
     """Check ``seq`` via decomposition; verdict-identical to ``direct``.
 
     cache       VerdictCache, a jsonl path, or None (no caching)
@@ -246,10 +249,13 @@ def check_opseq_decomposed(seq: OpSeq, model: ModelSpec, *,
                             merge_linearizations, value_block_witness)
 
     maybe_lint(seq, model, lint)
+    from ..analyze.hb import hb_fold_states, resolve_hb
+
+    hb_on = resolve_hb(hb)
     if isinstance(cache, str):
         cache = VerdictCache(cache)
     if sub_check is None:
-        sub_check = _make_default_sub_check(witness)
+        sub_check = _make_default_sub_check(witness, hb=hb)
     stats = {"cells": 0, "segments": 0, "cache_hits": 0,
              "cache_misses": 0, "configs_searched": 0, "methods": []}
     methods: set = set()
@@ -400,10 +406,21 @@ def check_opseq_decomposed(seq: OpSeq, model: ModelSpec, *,
             elif chains is not None:
                 with obs.span("segment.fold", cat="fold",
                               rows=len(rows)):
-                    states, wit = segment_states(
+                    # HB interval fold first: the decidable class
+                    # answers the fold in O(n log n) with the same
+                    # exact state set (and witness chains) the
+                    # level-synchronous sweep would produce
+                    hbout = hb_fold_states(
                         sseq, cell_model, states,
-                        max_configs=sub_max_configs,
-                        deadline=deadline, witness=True)
+                        witness=True) if hb_on else None
+                    if hbout is not None:
+                        states, wit = hbout
+                        methods.add("hb-fold")
+                    else:
+                        states, wit = segment_states(
+                            sseq, cell_model, states,
+                            max_configs=sub_max_configs,
+                            deadline=deadline, witness=True)
                 if cache is not None:
                     cache.put_states(skey, ren.encode_states(states))
                 if wit is None:
@@ -417,9 +434,16 @@ def check_opseq_decomposed(seq: OpSeq, model: ModelSpec, *,
             else:
                 with obs.span("segment.fold", cat="fold",
                               rows=len(rows)):
-                    states = segment_states(sseq, cell_model, states,
-                                            max_configs=sub_max_configs,
-                                            deadline=deadline)
+                    hbout = hb_fold_states(
+                        sseq, cell_model, states) if hb_on else None
+                    if hbout is not None:
+                        states = hbout
+                        methods.add("hb-fold")
+                    else:
+                        states = segment_states(
+                            sseq, cell_model, states,
+                            max_configs=sub_max_configs,
+                            deadline=deadline)
                 if cache is not None:
                     cache.put_states(skey, ren.encode_states(states))
             if not states:

@@ -314,6 +314,18 @@ def _declare(reg: Registry) -> None:
     reg.counter("jtpu_campaign_cells_total",
                 "Campaign cells finished, by status",
                 ("status",))
+    reg.counter("jtpu_hb_prepass_total",
+                "HB pre-pass outcomes (decided_valid/decided_invalid/"
+                "undecided/skipped)", ("outcome",))
+    reg.counter("jtpu_hb_edges_total",
+                "Forced/canonical HB edges inferred beyond real time, "
+                "by kind", ("kind",))
+    reg.counter("jtpu_hb_fold_total",
+                "Streamed/decomposed segment folds answered by the HB "
+                "interval pass")
+    reg.gauge("jtpu_hb_prune_ratio",
+              "pruned/raw config-bound ratio of the most recent HB "
+              "pre-pass (0 = decided without search)")
     reg.gauge("jtpu_stream_runs_open",
               "Streaming runs currently open in this process")
     reg.histogram("jtpu_fold_seconds",

@@ -199,11 +199,19 @@ class StreamChecker:
                  info_lookahead: int | None = None,
                  device_budget: int = 2_000_000,
                  live_path: str | None = None,
-                 run_id: str | None = None):
+                 run_id: str | None = None,
+                 hb: bool | None = None):
+        from ..analyze.hb import resolve_hb
         from ..analyze.plan import STREAM_INFO_LOOKAHEAD
         from ..decompose.cache import VerdictCache
 
         self.model = model
+        #: happens-before pre-pass (analyze/hb.py): closed crash-free
+        #: segments in the decidable register class fold through the
+        #: O(n log n) interval pass instead of the level sweep, and
+        #: finalize's sub-searches inherit the same flag so streamed
+        #: results stay bit-identical to the post-hoc engines
+        self.hb = resolve_hb(hb)
         if isinstance(cache, str):
             cache = VerdictCache(cache)
         self.cache = cache
@@ -258,7 +266,7 @@ class StreamChecker:
         self._finalized: dict | None = None
         self._seq: OpSeq | None = None
         self._stats = {"segments": 0, "configs_searched": 0,
-                       "routes": {"host": 0, "device": 0},
+                       "routes": {"host": 0, "device": 0, "hb": 0},
                        "checked_rows": 0, "lookahead_checks": 0}
         self._methods: set = set()
         self._drops = {"witness": None, "frontier": None}
@@ -551,11 +559,31 @@ class StreamChecker:
         from ..analyze.plan import segment_fold_route
         from ..history import max_concurrency
 
+        wit = None
+        states = None
+        if self.hb:
+            from ..analyze.hb import hb_fold_states
+
+            out = hb_fold_states(sseq, self._cell_model, cell.states,
+                                 witness=cell.chains is not None)
+            if out is not None:
+                if cell.chains is not None:
+                    states, wit = out
+                else:
+                    states = out
+                self._stats["routes"]["hb"] += 1
+                _M_FOLDED.inc(route="hb")
+                self._methods.add("hb-fold")
+                if self.cache is not None:
+                    self.cache.put_states(skey,
+                                          ren.encode_states(states))
+                    self._cstats["inserts"] += 1
+                self._commit_fold(cell, retained, states, wit,
+                                  chains_known=True)
+                return
         route = segment_fold_route(len(sseq), max_concurrency(sseq),
                                    self._cell_model,
                                    host_fold_max=self.host_fold_max)
-        wit = None
-        states = None
         if route == "device":
             from .device import device_fold_states
 
@@ -1080,7 +1108,8 @@ class StreamChecker:
         cseq = _rows_opseq(c.rows, self._enc, value_lane=False)
         r = check_opseq_linear(cseq, self._cell_model,
                                witness_cap=DEFAULT_WITNESS_CAP
-                               if self.witness else 0, lint=False)
+                               if self.witness else 0, lint=False,
+                               hb=self.hb)
         self._stats["configs_searched"] += int(r.get("configs", 0) or 0)
         v = r.get("valid", "unknown")
         return v, r.get("linearization"), \
@@ -1095,7 +1124,8 @@ class StreamChecker:
         self._methods.add("direct")
         r = check_opseq_linear(self._seq, self.model,
                                witness_cap=DEFAULT_WITNESS_CAP
-                               if self.witness else 0, lint=False)
+                               if self.witness else 0, lint=False,
+                               hb=self.hb)
         self._stats["configs_searched"] += int(r.get("configs", 0) or 0)
         if self.cache is not None and wkey is not None \
                 and r.get("valid") in (True, False):
@@ -1111,7 +1141,8 @@ class StreamChecker:
         def sub(sseq, smodel, *, max_configs):
             return check_opseq_linear(sseq, smodel,
                                       max_configs=max_configs,
-                                      witness_cap=cap, lint=False)
+                                      witness_cap=cap, lint=False,
+                                      hb=self.hb)
 
         return sub
 

@@ -254,6 +254,12 @@ def result_block(result: dict) -> str:
     elif result.get("witness_dropped"):
         rows.append(("certificate",
                      f"witness dropped: {result['witness_dropped']}"))
+    if result.get("hb_cycle") is not None:
+        cyc = result["hb_cycle"]
+        rows.append(("certificate",
+                     f"HB cycle, {len(cyc)} forced edge(s): "
+                     + " -> ".join(str(e.get("src")) for e in cyc[:6])
+                     + " -> ..."))
     if result.get("final_ops") is not None:
         rows.append(("blocking frontier",
                      f"{len(result['final_ops'])} ops "
@@ -261,6 +267,17 @@ def result_block(result: dict) -> str:
     elif result.get("frontier_dropped"):
         rows.append(("blocking frontier",
                      f"dropped: {result['frontier_dropped']}"))
+    hbs = result.get("hb")
+    if isinstance(hbs, dict) and hbs.get("applies"):
+        if hbs.get("decided") is not None:
+            rows.append(("happens-before",
+                         f"decided statically ({hbs.get('reason')}, "
+                         f"no search)"))
+        else:
+            rows.append(("happens-before",
+                         f"{hbs.get('must_edges', 0)} must-order "
+                         f"edge(s) pruned the search "
+                         f"{hbs.get('edges')}"))
     a = result.get("audit")
     if a:
         rows.append(("audit", "ok (checked %s)" % a.get("checked")
@@ -326,7 +343,8 @@ def result_block(result: dict) -> str:
 
 #: nested result fields worth a panel of their own
 _EVIDENCE = ("linearization", "witness_dropped", "final_ops",
-             "frontier_dropped", "explain", "audit", "shrink")
+             "frontier_dropped", "hb_cycle", "explain", "audit",
+             "shrink")
 
 
 def _evidence_results(result: dict, *, max_depth: int = 5,

@@ -586,6 +586,112 @@ def test_explain_multi_register_key_partition():
     assert r["decompose"]["cells"] == kp["cells"]
 
 
+# ---------------------------------------------------------------------------
+# plan gates for the live families added since PR 7 (replicated,
+# replicated-queue, pgwire) — regression pins so explain() routes them
+# instead of falling through to defaults
+# ---------------------------------------------------------------------------
+
+
+def _v2_style_history(keyed=False, with_cas=False):
+    """A replicated/pgwire-shaped history: cas_register(MISSING=-1)
+    semantics — reads of a missing row return -1, unique writes."""
+    h = [invoke_op(0, "read", (7, None) if keyed else -1),
+         ok_op(0, "read", (7, -1) if keyed else -1),
+         invoke_op(1, "write", (7, 5) if keyed else 5),
+         ok_op(1, "write", (7, 5) if keyed else 5),
+         invoke_op(0, "read", (7, 5) if keyed else 5),
+         ok_op(0, "read", (7, 5) if keyed else 5),
+         invoke_op(2, "write", (9, 8) if keyed else 8),
+         ok_op(2, "write", (9, 8) if keyed else 8)]
+    if with_cas:
+        h += [invoke_op(1, "cas", (8, 11)), ok_op(1, "cas", (8, 11))]
+    return h
+
+
+def test_plan_routes_replicated_family():
+    """cas_register(-1) — the replicated/pgwire model with MISSING
+    reads.  Unique-writes all-:ok histories must hit the value-block
+    AND hb decide-fast gates (not fall through to a raw search), and
+    the prediction must match a real engine run."""
+    m = cas_register(-1)
+    seq = encode_ops(_v2_style_history(), m.f_codes)
+    plan = explain(seq, m)
+    assert not plan["independent"]["detected"]
+    assert plan["decompositions"]["value_blocks"]["applies"]
+    assert plan["hb"]["applies"]
+    assert plan["hb"]["decided"] is True
+    assert plan["hb"]["reason"] == "gk-interval"
+    st = plan["streaming"]
+    assert st["device_eligible"] is True  # register family state-pins
+    r = check_opseq(seq, m)
+    assert r["valid"] is True
+
+    # cas rows take the history out of the unique-writes algebra: the
+    # hb gate must say so (decide-fast off, canonical read-order only)
+    seq2 = encode_ops(_v2_style_history(with_cas=True), m.f_codes)
+    plan2 = explain(seq2, m)
+    assert plan2["hb"]["decided"] is None
+    assert "cas" in plan2["hb"]["reason"]
+    assert plan2["hb"]["edges"]["rf"] == 0
+
+
+def test_plan_routes_pgwire_independent_composite():
+    """The pgwire/kv campaign records jepsen.independent [k v]
+    histories; under the register model the whole-history plan used to
+    mis-read key lanes as values.  explain() must flag the composite
+    and name the per-key demux route."""
+    m = cas_register(-1)
+    seq = encode_ops(_v2_style_history(keyed=True), m.f_codes)
+    plan = explain(seq, m)
+    ind = plan["independent"]
+    assert ind["detected"] is True
+    assert ind["keys"] == 2  # keys 7 and 9
+    assert "demux" in ind["route"]
+    from jepsen_tpu.analyze.plan import render_plan
+
+    assert "KEYED COMPOSITE" in render_plan(plan)
+    # an un-keyed history must not trip the gate
+    plain = explain(encode_ops(_v2_style_history(), m.f_codes), m)
+    assert plain["independent"] == {"detected": False}
+
+
+def test_plan_routes_replicated_queue_family():
+    """unordered-queue (the replicated-queue/disque multiset model):
+    every register-only gate must decline WITH a reason, the hb pass
+    must report itself out of scope, and segment folds must never
+    predict the device state-pinning route."""
+    from jepsen_tpu.analyze.plan import segment_fold_route
+    from jepsen_tpu.models import unordered_queue
+
+    m = unordered_queue(8)
+    h = []
+    for i in range(4):
+        h += [invoke_op(i % 2, "enqueue", i + 1),
+              ok_op(i % 2, "enqueue", i + 1)]
+    for i in range(4):
+        h += [invoke_op(i % 2, "dequeue", i + 1),
+              ok_op(i % 2, "dequeue", i + 1)]
+    seq = encode_ops(h, m.f_codes)
+    plan = explain(seq, m)
+    dec = plan["decompositions"]
+    assert dec["key_partition"]["applies"] is False
+    assert "multi-register" in dec["key_partition"]["reason"]
+    assert dec["value_blocks"]["applies"] is False
+    assert "single register" in dec["value_blocks"]["reason"]
+    assert plan["hb"]["applies"] is False
+    assert "out of scope" in plan["hb"]["reason"]
+    st = plan["streaming"]
+    assert st["device_eligible"] is False
+    assert st["routes"]["device"] == 0
+    # the fold router must pin queue folds to host at ANY size: the
+    # pseudo-op state pinning trick needs a single-value register
+    assert segment_fold_route(10_000, 40, m) == "host"
+    assert segment_fold_route(10_000, 40, m, host_fold_max=0) == "host"
+    r = check_opseq(seq, m)
+    assert r["valid"] is True
+
+
 def test_analyze_end_to_end_and_render():
     from jepsen_tpu.analyze.plan import render_plan
 

@@ -175,6 +175,50 @@ def test_corpus_replay_parity_on_bounded_seeded_pool(tmp_path):
     assert rc == 0
 
 
+def test_corpus_replay_runs_hb_leg_on_decidable_entries(tmp_path):
+    """A banked unique-writes register history is inside the HB
+    solver's decide-fast class: the replay must run the HB leg (not
+    vacuously skip it), its verdict must join the parity set, and the
+    whole replay must come back clean — the satellite's regression
+    teeth for the static order-solver."""
+    import fuzz as fuzz_tool
+
+    from jepsen_tpu.analyze.hb import hb_dispose
+    from jepsen_tpu.history import Op, encode_ops
+    from jepsen_tpu.live import corpus
+    from jepsen_tpu.models import register
+    from jepsen_tpu.synth import register_history, swap_read_values
+
+    rng = random.Random(31)
+    m = register(0)
+    good = register_history(rng, n_ops=20, n_procs=3, overlap=3,
+                            crash_p=0.0, cas=False, unique_writes=True)
+    bad = swap_read_values(random.Random(32), register_history(
+        random.Random(33), n_ops=20, n_procs=3, overlap=3, crash_p=0.0,
+        cas=False, unique_writes=True))
+    corpus.bank_cell({"model": m, "history": good},
+                     {"family": "register", "nemesis": "none",
+                      "valid": True}, base=str(tmp_path))
+    corpus.bank_cell({"model": m, "history": bad},
+                     {"family": "register", "nemesis": "none",
+                      "valid": False}, base=str(tmp_path))
+    d = corpus.corpus_dir(str(tmp_path))
+    pool = corpus.load_pool(d)
+    assert len(pool) == 2
+    # the solver really decides these entries (invalid one by cycle)
+    decided = []
+    for e in pool:
+        model = corpus.entry_model(e)
+        s = encode_ops([Op.from_dict(x) for x in e["ops"]],
+                       model.f_codes)
+        r = hb_dispose(s, model)
+        assert r is not None, "entry left the decide-fast class"
+        decided.append(r)
+    assert {r["valid"] for r in decided} == {True, False}
+    assert any("hb_cycle" in r or "final_ops" in r for r in decided)
+    assert fuzz_tool.corpus_replay(d) == 0
+
+
 def test_corpus_replay_catches_banked_verdict_regression(tmp_path):
     """The net has teeth: an entry whose banked expectation disagrees
     with what the engines say fails the replay loudly."""

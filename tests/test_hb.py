@@ -1,0 +1,573 @@
+"""Happens-before constraint analysis (jepsen_tpu/analyze/hb.py).
+
+The verdict-identity acceptance: a 300+-history differential fuzz —
+crashes, cas ops, mutations, multi-register — through the host engines
+with the pre-pass on vs off, a stride through the batch/decomposed/
+streaming routes, audit on everywhere.  Plus the decide-fast
+certificates (GK witness, HB-cycle) validated and tamper-tested
+(W006), the fold fast-path against ``segment_states``, the must-order
+prune's measured config reduction, and the plan/metrics surfaces.
+"""
+
+import os
+import random
+import sys
+
+import numpy as np
+import pytest
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+from jepsen_tpu import synth  # noqa: E402
+from jepsen_tpu.analyze.audit import AuditError, audit, maybe_audit  # noqa: E402
+from jepsen_tpu.analyze.hb import (  # noqa: E402
+    analyze_hb,
+    hb_dispose,
+    hb_fold_states,
+    maybe_hb,
+)
+from jepsen_tpu.checker.linear import check_opseq_linear  # noqa: E402
+from jepsen_tpu.checker.linearizable import search_batch  # noqa: E402
+from jepsen_tpu.checker.seq import check_opseq  # noqa: E402
+from jepsen_tpu.history import (  # noqa: E402
+    Op,
+    encode_ops,
+    info_op,
+    invoke_op,
+    ok_op,
+)
+from jepsen_tpu.models import (  # noqa: E402
+    cas_register,
+    multi_register,
+    register,
+)
+
+# ---------------------------------------------------------------------------
+# decide-fast
+# ---------------------------------------------------------------------------
+
+
+def test_decides_valid_unique_writes_with_audited_witness():
+    rng = random.Random(1)
+    m = register(0)
+    h = synth.register_history(rng, n_ops=80, n_procs=4, overlap=6,
+                               crash_p=0.0, cas=False,
+                               unique_writes=True)
+    s = encode_ops(h, m.f_codes)
+    hb = analyze_hb(s, m)
+    assert hb.decided is not None and hb.decided["valid"] is True
+    assert hb.decided["configs"] == 0
+    assert hb.stats["reason"] == "gk-interval"
+    a = audit(s, m, hb.decided)
+    assert a["ok"] and a["checked"] == "linearization"
+    # the engines agree and return the same decision with zero search
+    r = check_opseq(s, m)
+    assert r["valid"] is True and r["configs"] == 0
+    assert r["engine"] == "hb-decide"
+
+
+def test_decides_invalid_block_order_with_cycle_certificate():
+    rng = random.Random(2)
+    m = register(0)
+    h = synth.register_history(rng, n_ops=80, n_procs=4, overlap=6,
+                               crash_p=0.0, cas=False,
+                               unique_writes=True)
+    h = synth.swap_read_values(rng, h)
+    s = encode_ops(h, m.f_codes)
+    hb = analyze_hb(s, m)
+    assert hb.decided is not None and hb.decided["valid"] is False
+    cyc = hb.decided["hb_cycle"]
+    assert len(cyc) >= 2
+    # op-level chain: consecutive edges share the op, and it closes
+    for i, e in enumerate(cyc):
+        assert e["dst"] == cyc[(i + 1) % len(cyc)]["src"]
+        assert e["kind"] in ("rt", "rf", "ww", "init")
+    a = audit(s, m, hb.decided)
+    assert a["ok"] and a["checked"] == "hb_cycle"
+    assert check_opseq(s, m, hb=False)["valid"] is False
+
+
+def test_decides_impossible_read_with_frontier():
+    m = register(0)
+    h = [invoke_op(0, "write", 5), ok_op(0, "write", 5),
+         invoke_op(1, "read", 9), ok_op(1, "read", 9)]
+    s = encode_ops(h, m.f_codes)
+    hb = analyze_hb(s, m)
+    assert hb.decided is not None and hb.decided["valid"] is False
+    assert hb.decided["final_ops"] == [1]
+    assert audit(s, m, hb.decided)["ok"]
+
+
+def test_crash_cycle_decided_with_info_rows():
+    """A forced-order cycle through a CRASHED write still decides: the
+    :ok read anchors the crashed write's block, the rf edge is forced,
+    and the read returned before the write invoked."""
+    m = register(0)
+    h = [invoke_op(1, "read", 7), ok_op(1, "read", 7),
+         invoke_op(0, "write", 7), info_op(0, "write", 7)]
+    s = encode_ops(h, m.f_codes)
+    hb = analyze_hb(s, m)
+    assert hb.decided is not None and hb.decided["valid"] is False
+    kinds = [e["kind"] for e in hb.decided["hb_cycle"]]
+    assert kinds == ["rf", "rt"]
+    assert audit(s, m, hb.decided)["ok"]
+    assert check_opseq(s, m, hb=False)["valid"] is False
+
+
+def test_multi_register_decides_per_key_and_stitches():
+    m = multi_register(3)
+    h = []
+    v = 1
+    for p in range(3):
+        for _ in range(5):
+            h.append(invoke_op(p, "write", (p, v)))
+            h.append(ok_op(p, "write", (p, v)))
+            v += 1
+    s = encode_ops(h, m.f_codes)
+    hb = analyze_hb(s, m)
+    assert hb.decided is not None and hb.decided["valid"] is True
+    assert len(hb.decided["linearization"]) == len(s)
+    assert audit(s, m, hb.decided)["ok"]
+
+
+def test_cas_and_foreign_models_are_out_of_scope():
+    from jepsen_tpu.models import mutex
+
+    m = cas_register()
+    h = [invoke_op(0, "write", 1), ok_op(0, "write", 1),
+         invoke_op(0, "cas", (1, 2)), ok_op(0, "cas", (1, 2))]
+    s = encode_ops(h, m.f_codes)
+    hb = analyze_hb(s, m)
+    # cas histories never decide fast, but the canonical read-order
+    # prune still applies (reads are state-transparent under cas too)
+    assert hb.decided is None and hb.applies
+    assert "cas" in hb.stats["reason"]
+    assert hb.stats["edges"]["rf"] == hb.stats["edges"]["ww"] == 0
+    mm = mutex()
+    h2 = [invoke_op(0, "acquire"), ok_op(0, "acquire")]
+    s2 = encode_ops(h2, mm.f_codes)
+    assert not analyze_hb(s2, mm).applies
+
+
+# ---------------------------------------------------------------------------
+# tampered certificates fail the independent audit (W006)
+# ---------------------------------------------------------------------------
+
+
+def _cycle_case():
+    rng = random.Random(5)
+    m = register(0)
+    h = synth.swap_read_values(rng, synth.register_history(
+        rng, n_ops=60, n_procs=4, overlap=5, crash_p=0.0, cas=False,
+        unique_writes=True))
+    s = encode_ops(h, m.f_codes)
+    hb = analyze_hb(s, m)
+    assert hb.decided is not None and "hb_cycle" in hb.decided
+    return s, m, hb.decided
+
+
+def test_tampered_cycle_fails_audit():
+    s, m, res = _cycle_case()
+    # 1: break the chain
+    bad = dict(res)
+    bad["hb_cycle"] = [dict(e) for e in res["hb_cycle"]]
+    bad["hb_cycle"][0] = {**bad["hb_cycle"][0],
+                          "dst": (bad["hb_cycle"][0]["dst"] + 1)
+                          % len(s)}
+    a = audit(s, m, bad)
+    assert not a["ok"] and "W006" in a["codes"]
+    # 2: out-of-range row
+    bad2 = dict(res)
+    bad2["hb_cycle"] = [{**res["hb_cycle"][0], "src": len(s) + 5},
+                        *res["hb_cycle"][1:]]
+    a2 = audit(s, m, bad2)
+    assert not a2["ok"] and "W001" in a2["codes"]
+    # 3: claim an unjustified rt edge
+    bad3 = dict(res)
+    bad3["hb_cycle"] = [{**e, "kind": "rt"} for e in res["hb_cycle"]]
+    a3 = audit(s, m, bad3)
+    assert not a3["ok"] and "W006" in a3["codes"]
+    # maybe_audit raises loudly on the tamper
+    with pytest.raises(AuditError):
+        maybe_audit(s, m, bad3, True)
+
+
+def test_cycle_certificate_rejected_when_preconditions_fail():
+    """A structurally-plausible cycle over a history with DUPLICATE
+    writes must not audit: the block algebra's unique-writes
+    precondition is re-checked independently."""
+    m = register(0)
+    h = [invoke_op(0, "write", 5), ok_op(0, "write", 5),
+         invoke_op(1, "write", 5), ok_op(1, "write", 5),
+         invoke_op(0, "read", 5), ok_op(0, "read", 5)]
+    s = encode_ops(h, m.f_codes)
+    fake = {"valid": False, "configs": 0,
+            "hb_cycle": [{"src": 0, "dst": 2, "kind": "rf"},
+                         {"src": 2, "dst": 0, "kind": "rt"}]}
+    a = audit(s, m, fake)
+    assert not a["ok"] and "W006" in a["codes"]
+
+
+# ---------------------------------------------------------------------------
+# the acceptance fuzz: 300+ histories, every route, audit on
+# ---------------------------------------------------------------------------
+
+
+def _fuzz_histories(n):
+    """(model, history) spanning the decidable class and well outside
+    it: crashes, cas, duplicate values, mutations, multi-register."""
+    out = []
+    i = 0
+    while len(out) < n:
+        rng = random.Random(100_000 + i)
+        i += 1
+        kind = rng.randrange(4)
+        if kind == 3:
+            m = multi_register(3)
+            h = []
+            state = {k: 0 for k in range(3)}
+            nxt = 1
+            open_ops = {}
+            for _ in range(rng.randrange(8, 30)):
+                p = rng.randrange(3)
+                if p in open_ops:
+                    op = open_ops.pop(p)
+                    h.append((info_op if rng.random() < 0.08 else
+                              ok_op)(p, op.f, op.value))
+                else:
+                    k = rng.randrange(3)
+                    if rng.random() < 0.5:
+                        v = nxt if rng.random() < 0.8 \
+                            else rng.randrange(3)
+                        nxt += 1
+                        op = invoke_op(p, "write", (k, v))
+                        state[k] = v
+                    else:
+                        v = state[k] if rng.random() < 0.8 \
+                            else rng.randrange(5)
+                        op = invoke_op(p, "read", (k, v))
+                    h.append(op)
+                    open_ops[p] = op
+            for p, op in open_ops.items():
+                h.append(ok_op(p, op.f, op.value))
+            out.append((m, h))
+            continue
+        m = register(0) if kind == 0 else cas_register()
+        h = synth.register_history(
+            rng, n_ops=rng.randrange(8, 40),
+            n_procs=rng.randrange(2, 6), overlap=rng.randrange(1, 6),
+            crash_p=rng.choice([0.0, 0.0, 0.1, 0.3]),
+            cas=(kind == 1 and rng.random() < 0.5), max_crashes=8,
+            unique_writes=rng.random() < 0.5,
+            n_values=rng.choice([2, 3, 8]))
+        if rng.random() < 0.5:
+            h = synth.mutate(rng, h)
+        out.append((m, h))
+    return out
+
+
+def test_differential_fuzz_all_routes_verdict_identical():
+    from jepsen_tpu.decompose.engine import check_opseq_decomposed
+    from jepsen_tpu.stream import StreamChecker
+
+    cases = _fuzz_histories(310)
+    decided = masked = routed = 0
+    for idx, (m, h) in enumerate(cases):
+        try:
+            s = encode_ops(h, m.f_codes)
+        except Exception:  # noqa: BLE001 — encode errors: lint's beat
+            continue
+        on = check_opseq(s, m, max_configs=250_000, lint=False,
+                         hb=True)
+        off = check_opseq(s, m, max_configs=250_000, lint=False,
+                          hb=False)
+        lin_on = check_opseq_linear(s, m, max_configs=250_000,
+                                    lint=False, hb=True,
+                                    witness_cap=200_000)
+        lin_off = check_opseq_linear(s, m, max_configs=250_000,
+                                     lint=False, hb=False,
+                                     witness_cap=200_000)
+        rs = [on, off, lin_on, lin_off]
+        if idx % 6 == 0:
+            rs.append(search_batch([s], m, budget=250_000, lint=False,
+                                   bucket=True)[0])
+            rs.append(check_opseq_decomposed(s, m,
+                                             sub_max_configs=250_000,
+                                             lint=False, witness=True))
+            sc = StreamChecker(m)
+            for op in h:
+                sc.ingest(op)
+            rs.append(sc.finalize())
+            routed += 1
+        vs = {r["valid"] for r in rs if r["valid"] != "unknown"}
+        assert len(vs) <= 1, (idx, [r["valid"] for r in rs],
+                              [op.to_dict() for op in h])
+        for r in (on, lin_on):
+            a = audit(s, m, r)
+            assert a["ok"], (idx, a["diagnostics"], r)
+        if on.get("engine") == "hb-decide":
+            decided += 1
+        if (on.get("hb") or {}).get("must_edges"):
+            masked += 1
+    # the fuzz must actually exercise the machinery
+    assert decided >= 60, decided
+    assert masked >= 40, masked
+    assert routed >= 50, routed
+
+
+# ---------------------------------------------------------------------------
+# segment-fold fast path
+# ---------------------------------------------------------------------------
+
+
+def test_fold_states_match_segment_sweep():
+    from jepsen_tpu.decompose.engine import segment_states
+
+    rounds = checked = 0
+    for i in range(120):
+        rng = random.Random(40_000 + i)
+        m = register(rng.randrange(0, 3))
+        h = synth.register_history(
+            rng, n_ops=rng.randrange(4, 22),
+            n_procs=rng.randrange(2, 5), overlap=rng.randrange(1, 5),
+            crash_p=0.0, cas=False, unique_writes=rng.random() < 0.7)
+        if rng.random() < 0.4:
+            h = synth.mutate(rng, h)
+        try:
+            s = encode_ops(h, m.f_codes)
+        except Exception:  # noqa: BLE001
+            continue
+        if len(s) == 0 or not bool(np.asarray(s.ok).all()):
+            continue
+        insts = [tuple(m.init)]
+        if rng.random() < 0.5:
+            insts.append((rng.randrange(0, 4),))
+        rounds += 1
+        out = hb_fold_states(s, m, insts, witness=rng.random() < 0.5)
+        if out is None:
+            continue
+        states = out[0] if isinstance(out, tuple) else out
+        ref = segment_states(s, m, insts, max_configs=3_000_000)
+        assert states == ref, (i, states, ref)
+        if isinstance(out, tuple) and out[1] is not None:
+            # every reachable out-state carries a chain from a real
+            # instate (exactness guard)
+            assert set(out[1]) == states
+        checked += 1
+    assert checked >= 12, (rounds, checked)
+
+
+def test_fold_cedes_rather_than_truncating_states():
+    """Review regression: a segment with MORE reachable out-states
+    than the witness cap must cede to the generic fold, never return
+    a truncated state set (a wrong frontier would also poison the
+    shared segment cache)."""
+    from jepsen_tpu.decompose.engine import segment_states
+    from jepsen_tpu.stream import StreamChecker
+
+    m = register(0)
+    h = []
+    for v in range(1, 13):  # 12 fully-concurrent writes: 12 out-states
+        h.append(invoke_op(v, "write", v))
+    for v in range(1, 13):
+        h.append(ok_op(v, "write", v))
+    seg = encode_ops(h, m.f_codes)
+    out = hb_fold_states(seg, m, [(0,)], witness=True)
+    ref = segment_states(seg, m, [(0,)])
+    assert len(ref) == 12
+    assert out is None or out[0] == ref
+    # end to end: the streamed verdict must match the direct engine
+    h2 = list(h) + [invoke_op(0, "read", 9), ok_op(0, "read", 9)]
+    sc = StreamChecker(m)
+    for op in h2:
+        sc.ingest(op)
+    r = sc.finalize()
+    assert r["valid"] is True
+    assert check_opseq(encode_ops(h2, m.f_codes), m,
+                       hb=False)["valid"] is True
+
+
+def test_hb_false_reaches_decomposed_folds():
+    """Review regression: the per-call opt-out must travel through the
+    decomposed route — hb=False may not ride the env default into the
+    engine's segment folds."""
+    rng = random.Random(11)
+    m = register(0)
+    h = synth.register_history(rng, n_ops=40, n_procs=3, overlap=2,
+                               quiesce_every=5, crash_p=0.0, cas=False,
+                               unique_writes=True)
+    s = encode_ops(h, m.f_codes)
+    on = check_opseq_linear(s, m, decompose=True, hb=True, lint=False)
+    off = check_opseq_linear(s, m, decompose=True, hb=False,
+                             lint=False)
+    assert on["valid"] == off["valid"]
+    assert "hb-fold" not in off["decompose"]["methods"]
+
+
+def test_stream_fold_rides_hb_route():
+    from jepsen_tpu.stream import StreamChecker
+
+    rng = random.Random(77)
+    m = register(0)
+    h = synth.register_history(rng, n_ops=60, n_procs=3, overlap=2,
+                               quiesce_every=6, crash_p=0.0, cas=False,
+                               unique_writes=True)
+    sc = StreamChecker(m)
+    for op in h:
+        sc.ingest(op)
+    r = sc.finalize()
+    assert r["valid"] == check_opseq(encode_ops(h, m.f_codes), m,
+                                     hb=False)["valid"]
+    assert r["stream"]["routes"]["hb"] >= 1
+    assert "hb-fold" in r["stream"]["methods"]
+    # off switch: no hb route, same verdict
+    sc2 = StreamChecker(m, hb=False)
+    for op in h:
+        sc2.ingest(op)
+    r2 = sc2.finalize()
+    assert r2["valid"] == r["valid"]
+    assert r2["stream"]["routes"]["hb"] == 0
+
+
+# ---------------------------------------------------------------------------
+# the prune
+# ---------------------------------------------------------------------------
+
+
+def _read_storm(n_readers=8, reads_each=4):
+    """Concurrent same-value reads around sequential writes: the
+    read-permutation blowup the canonical-order chains collapse.  A
+    final impossible-tail keeps the greedy witness and the decide-fast
+    class out (duplicate writes), so the sweep really runs."""
+    h = [invoke_op(0, "write", 1), ok_op(0, "write", 1)]
+    # duplicate write of 1 -> outside the unique-writes decide class
+    h += [invoke_op(0, "write", 1), ok_op(0, "write", 1)]
+    for r in range(reads_each):
+        for p in range(1, n_readers + 1):
+            h.append(invoke_op(p, "read", 1))
+        for p in range(1, n_readers + 1):
+            h.append(ok_op(p, "read", 1))
+    h += [invoke_op(0, "write", 2), ok_op(0, "write", 2),
+          invoke_op(1, "read", 1), ok_op(1, "read", 1)]  # stale: invalid
+    return h
+
+
+def test_must_order_prune_reduces_explored_configs():
+    m = register(0)
+    h = _read_storm()
+    s = encode_ops(h, m.f_codes)
+    on = check_opseq_linear(s, m, lint=False, hb=True)
+    off = check_opseq_linear(s, m, lint=False, hb=False)
+    assert on["valid"] == off["valid"] is False
+    assert on["hb"]["must_edges"] > 0
+    assert on["configs"] < off["configs"], (on["configs"],
+                                            off["configs"])
+    # the DFS oracle masks too
+    d_on = check_opseq(s, m, lint=False, hb=True)
+    d_off = check_opseq(s, m, lint=False, hb=False)
+    assert d_on["valid"] == d_off["valid"] is False
+    assert d_on["configs"] <= d_off["configs"]
+
+
+def test_plan_reports_pruned_bound_and_decidability():
+    from jepsen_tpu.analyze.plan import explain, render_plan
+
+    m = register(0)
+    s = encode_ops(_read_storm(), m.f_codes)
+    plan = explain(s, m)
+    hb = plan["hb"]
+    assert hb["applies"] and hb["decided"] is None
+    assert hb["must_edges"] > 0
+    assert hb["pruned_upper_bound"] < plan["config_upper_bound"]
+    assert 0 < hb["prune_ratio"] < 1
+    assert "happens-before" in render_plan(plan)
+
+    rng = random.Random(9)
+    h2 = synth.register_history(rng, n_ops=40, n_procs=3, overlap=3,
+                                crash_p=0.0, cas=False,
+                                unique_writes=True)
+    plan2 = explain(encode_ops(h2, m.f_codes), m)
+    assert plan2["hb"]["decided"] is True
+    assert plan2["hb"]["pruned_upper_bound"] == 0
+    assert plan2["hb"]["prune_ratio"] == 0.0
+
+
+# ---------------------------------------------------------------------------
+# batch disposal + knobs + metrics
+# ---------------------------------------------------------------------------
+
+
+def test_search_batch_disposes_decided_keys():
+    from jepsen_tpu.analyze.plan import explain_batch
+
+    m = register(0)
+    seqs = []
+    # invalid unique-writes keys (greedy fails, hb decides) + storm
+    # keys that must actually search
+    for i in range(4):
+        rng = random.Random(200 + i)
+        h = synth.swap_read_values(rng, synth.register_history(
+            rng, n_ops=24, n_procs=3, overlap=4, crash_p=0.0,
+            cas=False, unique_writes=True))
+        seqs.append(encode_ops(h, m.f_codes))
+    seqs.append(encode_ops(_read_storm(4, 2), m.f_codes))
+    res = search_batch(seqs, m, budget=200_000, bucket=True)
+    assert [r["valid"] for r in res[:4]] == [False] * 4
+    assert all(r["engine"] == "hb-decide" for r in res[:4])
+    stats = next((r.get("bucket_batch") for r in res
+                  if r.get("bucket_batch")), None)
+    if stats is not None:
+        plan = explain_batch(seqs, m)
+        assert plan["hb_decided"] == stats["hb_decided"] == 4
+    # audit rides the batch exit for hb-decided keys too
+    res2 = search_batch(seqs, m, budget=200_000, bucket=True,
+                        audit=True)
+    assert [r["valid"] for r in res2[:4]] == [False] * 4
+
+
+def test_env_knob_disables_prepass(monkeypatch):
+    m = register(0)
+    rng = random.Random(3)
+    h = synth.register_history(rng, n_ops=30, n_procs=3, overlap=3,
+                               crash_p=0.0, cas=False,
+                               unique_writes=True)
+    s = encode_ops(h, m.f_codes)
+    monkeypatch.setenv("JEPSEN_TPU_HB", "0")
+    assert maybe_hb(s, m, None) is None
+    r = check_opseq(s, m)
+    assert r.get("engine") != "hb-decide"
+    monkeypatch.setenv("JEPSEN_TPU_HB", "1")
+    assert maybe_hb(s, m, None) is not None
+    assert check_opseq(s, m)["engine"] == "hb-decide"
+
+
+def test_hb_metrics_exported():
+    from jepsen_tpu.obs import metrics as obs_metrics
+
+    reg = obs_metrics.REGISTRY
+    before = reg.get("jtpu_hb_prepass_total").total()
+    m = register(0)
+    rng = random.Random(4)
+    h = synth.register_history(rng, n_ops=24, n_procs=3, overlap=3,
+                               crash_p=0.0, cas=False,
+                               unique_writes=True)
+    s = encode_ops(h, m.f_codes)
+    assert hb_dispose(s, m) is not None
+    assert reg.get("jtpu_hb_prepass_total").total() == before + 1
+    assert reg.get("jtpu_hb_prepass_total").value(
+        outcome="decided_valid") >= 1
+    # prune ratio gauge: decided -> 0; the family shows on /metrics
+    assert reg.get("jtpu_hb_prune_ratio").value() == 0.0
+    text = obs_metrics.render()
+    assert "jtpu_hb_prepass_total" in text
+    assert "jtpu_hb_prune_ratio" in text
+
+
+def test_result_panel_renders_hb_evidence():
+    from jepsen_tpu.web import result_block
+
+    s, m, res = _cycle_case()
+    html = result_block(res)
+    assert "HB cycle" in html
+    assert "hb-decide" in html

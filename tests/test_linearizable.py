@@ -443,8 +443,8 @@ def test_search_batch_mixed_difficulty_compaction():
     assert [r["valid"] for r in got] == want
     assert all(r["engine"] in
                ("device-batch", "device-batch(pallas)",
-                "greedy-witness", "device-bfs", "device-bfs(pallas)",
-                "trivial")
+                "greedy-witness", "hb-decide", "device-bfs",
+                "device-bfs(pallas)", "trivial")
                for r in got)
     # at least the corrupted keys must have ridden the device
     assert sum(r["engine"].startswith("device-batch")

@@ -21,6 +21,7 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(
 
 from jepsen_tpu.analyze.suites import (  # noqa: E402
     SUITE_CODES,
+    lint_live_source,
     lint_paths,
     lint_source,
 )
@@ -196,8 +197,142 @@ def test_suppression_comment():
 
 
 def test_codes_documented():
-    for code in ("S001", "S002", "S003", "S004", "S005"):
+    for code in ("S001", "S002", "S003", "S004", "S005",
+                 "B001", "B002", "B003"):
         assert code in SUITE_CODES
+
+
+# ---------------------------------------------------------------------------
+# B-codes: live backend protocol (jepsen_tpu/live/)
+# ---------------------------------------------------------------------------
+
+
+def test_b001_concrete_backend_missing_protocol_member():
+    src = (
+        "class BrokenBackend(LiveBackend):\n"
+        "    name = 'broken'\n"
+        "    def workload(self, opts):\n"
+        "        return {}\n")
+    assert "B001" in codes(lint_live_source(src, "f.py"), "error")
+
+
+def test_b001_abstract_intermediate_is_exempt():
+    # the replicated consensus core pattern: no `name`, protocol left
+    # to concrete families — and the family inheriting through it is
+    # clean when the chain provides everything
+    src = (
+        "class ConsensusBackend(LiveBackend):\n"
+        "    def health_check(self, test, node):\n"
+        "        pass\n"
+        "class FamBackend(ConsensusBackend):\n"
+        "    name = 'fam'\n"
+        "    def server_argv(self, test, node):\n"
+        "        return []\n"
+        "    def workload(self, opts):\n"
+        "        return {}\n")
+    assert lint_live_source(src, "f.py") == []
+
+
+def test_b001_annotated_name_and_async_members_recognized():
+    # review regression: `name: str = 'fam'` (AnnAssign) and async
+    # protocol members must count as provided
+    src = (
+        "class FamBackend(LiveBackend):\n"
+        "    name: str = 'fam'\n"
+        "    def server_argv(self, test, node):\n"
+        "        return []\n"
+        "    async def workload(self, opts):\n"
+        "        return {}\n")
+    assert lint_live_source(src, "f.py") == []
+    # a bare annotation with no value is NOT a name assignment
+    src2 = (
+        "class ShyBackend(LiveBackend):\n"
+        "    name: str\n"
+        "    def server_argv(self, test, node):\n"
+        "        return []\n"
+        "    def workload(self, opts):\n"
+        "        return {}\n")
+    assert "B001" in codes(lint_live_source(src2, "f.py"), "error")
+
+
+def test_b001_unnamed_but_complete_backend_flagged():
+    src = (
+        "class ShyBackend(LiveBackend):\n"
+        "    def server_argv(self, test, node):\n"
+        "        return []\n"
+        "    def workload(self, opts):\n"
+        "        return {}\n")
+    diags = lint_live_source(src, "f.py")
+    assert "B001" in codes(diags, "error")
+    assert "name" in diags[0].message
+
+
+def test_b002_live_helper_swallows_crash_to_fail():
+    src = (
+        "class Shim:\n"
+        "    def fetch(self, op):\n"
+        "        try:\n"
+        "            return do(op)\n"
+        "        except Exception:\n"
+        "            return replace(op, type='fail')\n")
+    assert "B002" in codes(lint_live_source(src, "f.py"), "error")
+    # a guarded / re-raising handler stays clean
+    src_ok = src.replace("            return replace(op, type='fail')\n",
+                         "            if op.f == 'read':\n"
+                         "                return replace(op, "
+                         "type='fail')\n"
+                         "            raise\n")
+    assert lint_live_source(src_ok, "f.py") == []
+
+
+def test_b002_does_not_double_report_client_invoke():
+    # *Client.invoke is S003's beat (lint_source); the live lint must
+    # not duplicate the finding
+    src = (
+        "class FooClient(Client):\n"
+        "    def invoke(self, test, op):\n"
+        "        try:\n"
+        "            return replace(op, type='ok')\n"
+        "        except Exception:\n"
+        "            return replace(op, type='fail')\n")
+    assert "B002" not in codes(lint_live_source(src, "f.py"))
+    assert "S003" in codes(lint_source(src, "f.py"), "error")
+
+
+def test_b003_rename_without_fsync():
+    src = (
+        "import os\n"
+        "def save(path, data):\n"
+        "    tmp = path + '.tmp'\n"
+        "    with open(tmp, 'w') as f:\n"
+        "        f.write(data)\n"
+        "    os.replace(tmp, path)\n")
+    assert "B003" in codes(lint_live_source(src, "f.py"), "error")
+    src_ok = (
+        "import os\n"
+        "def save(path, data):\n"
+        "    tmp = path + '.tmp'\n"
+        "    with open(tmp, 'w') as f:\n"
+        "        f.write(data)\n"
+        "        f.flush()\n"
+        "        os.fsync(f.fileno())\n"
+        "    os.replace(tmp, path)\n")
+    assert lint_live_source(src_ok, "f.py") == []
+    # read-only opens next to a rename are not journal writes
+    src_ro = (
+        "import os\n"
+        "def rotate(path):\n"
+        "    with open(path, 'r') as f:\n"
+        "        f.read()\n"
+        "    os.rename(path, path + '.old')\n")
+    assert lint_live_source(src_ro, "f.py") == []
+
+
+def test_bundled_live_backends_are_clean():
+    findings = lint_paths([os.path.join(REPO, "jepsen_tpu", "live")])
+    errors = [d for ds in findings.values() for d in ds
+              if d.severity == "error"]
+    assert errors == [], "\n".join(d.message for d in errors)
 
 
 # ---------------------------------------------------------------------------

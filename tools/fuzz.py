@@ -196,12 +196,18 @@ def corpus_replay(pool_dir: str, *, audit: bool = True,
     """Replay the banked campaign corpus through every engine route.
 
     Engine entries (register/mutex models) run direct (device BFS),
-    decomposed, bucketed, and streaming; all decided verdicts must be
-    bit-identical to each other AND to the banked expectation (when
-    one was recorded), and every certificate must audit clean.  Queue
-    entries replay deterministically through ``total_queue`` against
-    their banked verdict.  Returns 0 clean / 1 on any failure."""
+    decomposed, bucketed, and streaming — plus the HB pre-pass
+    (analyze/hb.py): every banked history replays through the static
+    order-solver, and when it decides fast its verdict joins the
+    parity set and its certificate (GK witness or HB-cycle) goes
+    through the independent audit like any engine's.  All decided
+    verdicts must be bit-identical to each other AND to the banked
+    expectation (when one was recorded), and every certificate must
+    audit clean.  Queue entries replay deterministically through
+    ``total_queue`` against their banked verdict.  Returns 0 clean /
+    1 on any failure."""
     from jepsen_tpu.analyze.audit import audit as audit_fn
+    from jepsen_tpu.analyze.hb import hb_dispose
     from jepsen_tpu.decompose.engine import check_opseq_decomposed
     from jepsen_tpu.live import corpus as corpus_mod
     from jepsen_tpu.stream import StreamChecker
@@ -213,7 +219,7 @@ def corpus_replay(pool_dir: str, *, audit: bool = True,
         print(f"corpus: no entries under {pool_dir}")
         return 0
     t0 = time.time()
-    failures = unknowns = 0
+    failures = unknowns = hb_decided = 0
     for i, e in enumerate(entries):
         label = (f"{e.get('family')}×{e.get('nemesis')}"
                  f"{' seeded' if e.get('seeded') else ''} "
@@ -245,6 +251,14 @@ def corpus_replay(pool_dir: str, *, audit: bool = True,
                            ("decomposed", s, model, decomposed),
                            ("bucketed", s, model, bucketed),
                            ("streaming", s, model, streamed)]
+                hbr = hb_dispose(s, model)
+                if hbr is not None:
+                    # the static solver decided this banked history
+                    # outright: its verdict must match every engine's,
+                    # and its certificate must audit like theirs
+                    hb_decided += 1
+                    verdicts["hb"] = hbr["valid"]
+                    results.append(("hb", s, model, hbr))
         except Exception as exc:  # noqa: BLE001 — report, keep going
             print(f"CORPUS FAILURE {label}: replay crashed: "
                   f"{type(exc).__name__}: {exc}", file=sys.stderr)
@@ -281,6 +295,8 @@ def corpus_replay(pool_dir: str, *, audit: bool = True,
     print(f"corpus: {len(entries)} entr"
           f"{'y' if len(entries) == 1 else 'ies'} replayed through "
           f"all routes, {status}"
+          + (f" ({hb_decided} decided fast by the HB pre-pass, "
+             f"parity+audit checked)" if hb_decided else "")
           + (f" ({unknowns} route verdict(s) unknown under the "
              f"budget)" if unknowns else "")
           + f" ({time.time() - t0:.0f}s)")

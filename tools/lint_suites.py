@@ -1,9 +1,13 @@
 #!/usr/bin/env python
 """Standalone suite protocol lint — jepsen_tpu.analyze.suites as a CLI.
 
-    python tools/lint_suites.py                  # lint bundled suites
+    python tools/lint_suites.py        # lint bundled suites AND live/
     python tools/lint_suites.py path/to/suite.py another_dir/
     python tools/lint_suites.py --json           # machine-readable
+
+Files under a ``live/`` directory additionally get the B-code backend
+lint (LiveBackend protocol conformance, crash-to-:fail swallowing,
+fsync-before-rename journal ordering).
 
 Exit code 0 when no ERROR-severity findings (warnings don't fail the
 run), 1 otherwise.  The same check gates CI through
@@ -26,15 +30,15 @@ from jepsen_tpu.analyze.suites import SUITE_CODES, lint_paths  # noqa: E402
 
 def main(argv=None) -> int:
     p = argparse.ArgumentParser(
-        description="AST protocol lint over jepsen suites "
-                    "(S-codes; see docs/analyze.md)")
+        description="AST protocol lint over jepsen suites and live "
+                    "backends (S-codes + B-codes; see docs/analyze.md)")
     p.add_argument("paths", nargs="*",
-                   help="suite files or directories "
-                        "(default: jepsen_tpu/suites)")
+                   help="suite files or directories (default: "
+                        "jepsen_tpu/suites + jepsen_tpu/live)")
     p.add_argument("--json", action="store_true", dest="as_json",
                    help="machine-readable output")
     p.add_argument("--codes", action="store_true",
-                   help="list the S-codes and exit")
+                   help="list the S-/B-codes and exit")
     opts = p.parse_args(argv)
     if opts.codes:
         for code, desc in sorted(SUITE_CODES.items()):

@@ -1236,6 +1236,82 @@ def host_comparators(tiers) -> dict:
     return out
 
 
+def _hb_probe_queue_tier() -> dict:
+    """The constraint-compiler (analyze/constraints.py) leg of the
+    probe: decided-fast fraction over a random queue-history sample
+    (valid + corrupted, unordered + FIFO), and the streamed total-queue
+    fold's detection latency on a synthetic lost-acked-enqueue history
+    (events from the lost ack to the verdict flip — the metric the
+    queue campaign cells now record per cell)."""
+    import random as _random
+
+    from jepsen_tpu.analyze.constraints import analyze_constraints
+    from jepsen_tpu.history import encode_ops, info_op, invoke_op, ok_op
+    from jepsen_tpu.models import fifo_queue, unordered_queue
+    from jepsen_tpu.stream.checker import TotalFoldStream
+    from jepsen_tpu.synth import (
+        corrupt_dequeue,
+        sim_queue_history,
+        swap_dequeues,
+    )
+
+    n_hist = int(os.environ.get("BENCH_HB_QUEUE_N", "60"))
+    decided = 0
+    t0 = time.perf_counter()
+    for i in range(n_hist):
+        rng = _random.Random(7000 + i)
+        fifo = i % 2 == 1
+        model = (fifo_queue if fifo else unordered_queue)(33)
+        h = sim_queue_history(rng, 28, 4,
+                              crash_p=rng.choice([0.0, 0.0, 0.2]),
+                              fifo=fifo)
+        if rng.random() < 0.5:
+            h = (swap_dequeues if rng.random() < 0.5
+                 else corrupt_dequeue)(rng, h)
+        s = encode_ops(h, model.f_codes)
+        if analyze_constraints(s, model).decided is not None:
+            decided += 1
+    prepass_s = time.perf_counter() - t0
+
+    # streamed lost-ack detection: N acked enqueues, one lost, drain
+    # short at 3/4 of the stream — the flip must land AT the drain
+    n_jobs = 200
+    sink = TotalFoldStream("total-queue")
+    t1 = time.perf_counter()
+    ev = 0
+    for j in range(n_jobs):
+        sink.ingest(invoke_op(j % 4, "enqueue", j))
+        sink.ingest(ok_op(j % 4, "enqueue", j))
+        ev += 2
+    sink.ingest(info_op("nemesis", "start", None))
+    ev += 1
+    sink.ingest(invoke_op(0, "drain", None))
+    sink.ingest(ok_op(0, "drain", [j for j in range(n_jobs) if j != 17]))
+    ev += 2
+    flip_event = sink.verdict()["invalid_event"]
+    for j in range(40):  # post-flip traffic the flip did not wait for
+        sink.ingest(invoke_op(1, "enqueue", n_jobs + j))
+        sink.ingest(ok_op(1, "enqueue", n_jobs + j))
+        ev += 2
+    final = sink.finalize()
+    stream_s = time.perf_counter() - t1
+    return {
+        "n_histories": n_hist,
+        "decided_fast": decided,
+        "decided_fraction": round(decided / n_hist, 3),
+        "prepass_seconds": round(prepass_s, 3),
+        "streamed": {
+            "events": ev,
+            "invalid_event": flip_event,
+            "events_before_finalize": ev - (flip_event or 0),
+            "final_valid": final.get("valid"),
+            "evidence_kind": (final.get("queue_evidence")
+                              or {}).get("kind"),
+            "seconds": round(stream_s, 3),
+        },
+    }
+
+
 def run_hb_probe(out_path: str | None = None) -> dict:
     """HB-on-vs-off probe over the 10k tiers -> BENCH_hb.json.
 
@@ -1326,6 +1402,7 @@ def run_hb_probe(out_path: str | None = None) -> dict:
               f"on/off configs "
               f"{host['on']['configs']}/{host['off']['configs']}",
               file=sys.stderr)
+    out["tiers"]["queue"] = _hb_probe_queue_tier()
     path = out_path or os.path.join(REPO, "BENCH_hb.json")
     _obs.write_trace(os.path.join(REPO, "BENCH_trace_hb.json"))
     out["trace"] = "BENCH_trace_hb.json (device.slice / hb.prepass "

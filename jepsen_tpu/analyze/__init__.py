@@ -29,6 +29,15 @@ validate on the cheap host before paying for accelerated search):
     ``tools/lint_suites.py`` is the standalone CLI;
     ``tests/test_suite_lint.py`` gates the bundled suites in tier-1.
 
+  * :mod:`jepsen_tpu.analyze.constraints` — model-generic constraint
+    compiler.  The non-register half of the static prepass slot
+    (``hb.maybe_hb`` dispatches by model family): queue families get
+    enqueue->dequeue read-from edges, FIFO must-order, and decide-fast
+    certificates (W007/W008 — lost-acked-enqueue, duplicate delivery,
+    FIFO inversion); locks get acquire/release alternation sweeps;
+    event-level multiset analysis backs the streaming total-queue fold
+    route and the Q-code history lint.
+
 Two further passes close the loop on the *output* side (ISSUE 4 —
 proof-carrying verdicts):
 
@@ -60,6 +69,15 @@ from .audit import (  # noqa: F401
     AuditError,
     audit,
     audit_enabled,
+    audit_events,
+)
+from .constraints import (  # noqa: F401
+    MultisetFold,
+    analyze_constraints,
+    analyze_prepass,
+    analyze_queue_events,
+    analyze_set_events,
+    family_of,
 )
 from .hb import (  # noqa: F401
     HBAnalysis,

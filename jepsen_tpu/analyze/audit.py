@@ -58,6 +58,11 @@ AUDIT_CODES = {
     "W004": "model step rejects a witness transition",
     "W005": "stitched witness violates cross-cell precedence",
     "W006": "HB-cycle certificate fails independent validation",
+    "W007": "queue/set multiset evidence fails independent validation "
+            "(lost-acked-enqueue / unexpected-dequeue rows unjustified)",
+    "W008": "queue order certificate fails independent validation "
+            "(duplicate-delivery or FIFO-inversion/rf-cycle edges "
+            "unjustified)",
 }
 
 
@@ -345,6 +350,316 @@ def _audit_hb_cycle(seq: OpSeq, model, result: dict,
             bad(f"edge {i} has unknown kind {kind!r}", index=src)
 
 
+def _queue_fs(model) -> tuple[int, int]:
+    from ..models import Q_DEQ, Q_ENQ
+
+    return Q_ENQ, Q_DEQ
+
+
+def _audit_queue_order(seq: OpSeq, model, result: dict,
+                       diags: list) -> None:
+    """Independently re-justify a queue ORDER certificate
+    (analyze/constraints.py) — ``queue_cycle`` (rf/rt/fifo forced-edge
+    chain) or ``queue_dup`` (duplicate delivery) — sharing no code
+    with the compiler that emitted it.  W008 on any unjustified edge,
+    open chain, or incomplete row set."""
+    name = getattr(model, "name", "") or ""
+
+    def bad(msg, index=None):
+        diags.append(Diagnostic("W008", "error", msg, index=index))
+
+    if not (name.startswith("unordered-queue-")
+            or name.startswith("fifo-queue-")):
+        bad(f"model {name!r} is outside the queue multiset algebra "
+            f"the certificate relies on")
+        return
+    Q_ENQ, Q_DEQ = _queue_fs(model)
+    n = len(seq)
+    f = [int(x) for x in seq.f]
+    v1 = [int(x) for x in seq.v1]
+    ok = [bool(x) for x in seq.ok]
+    inv = [int(x) for x in seq.inv]
+    ret = [int(x) for x in seq.ret]
+    from ..history import NIL
+
+    enq_of: dict = {}
+    deq_ok_of: dict = {}
+    for i in range(n):
+        if v1[i] == NIL:
+            continue
+        if f[i] == Q_ENQ:
+            enq_of.setdefault(v1[i], []).append(i)
+        elif f[i] == Q_DEQ and ok[i]:
+            deq_ok_of.setdefault(v1[i], []).append(i)
+
+    dup = result.get("queue_dup")
+    if dup is not None:
+        deqs = sorted(int(r) for r in dup.get("dequeues", ()))
+        enqs = sorted(int(r) for r in dup.get("enqueues", ()))
+        if any(not 0 <= r < n for r in (*deqs, *enqs)):
+            diags.append(Diagnostic(
+                "W001", "error",
+                f"queue_dup references a row outside this {n}-op "
+                f"history"))
+            return
+        if not deqs:
+            bad("queue_dup names no dequeue rows")
+            return
+        val = v1[deqs[0]]
+        if deqs != sorted(deq_ok_of.get(val, ())):
+            bad(f"queue_dup dequeue rows are not exactly the :ok "
+                f"dequeues of value {val}", index=deqs[0])
+        elif enqs != sorted(enq_of.get(val, ())):
+            bad(f"queue_dup enqueue rows are not exactly the enqueue "
+                f"rows of value {val}", index=deqs[0])
+        elif len(deqs) <= len(enqs):
+            bad(f"value {val} has {len(enqs)} enqueue row(s) for "
+                f"{len(deqs)} :ok dequeue(s) — no duplicate delivery",
+                index=deqs[0])
+        return
+
+    cyc = result.get("queue_cycle")
+    if not isinstance(cyc, (list, tuple)) or len(cyc) < 2:
+        bad("queue_cycle must be a chain of at least two edges")
+        return
+    for e in cyc:
+        for fld in ("src", "dst"):
+            r = e.get(fld)
+            if not isinstance(r, int) or isinstance(r, bool) \
+                    or not 0 <= r < n:
+                diags.append(Diagnostic(
+                    "W001", "error",
+                    f"queue_cycle edge references row {r!r}, not a row "
+                    f"of this {n}-op history"))
+                return
+    for i, e in enumerate(cyc):
+        nxt = cyc[(i + 1) % len(cyc)]
+        src, dst, kind = e["src"], e["dst"], e.get("kind")
+        if dst != nxt["src"]:
+            bad(f"edge {i} ends at row {dst} but edge "
+                f"{(i + 1) % len(cyc)} starts at row {nxt['src']} — "
+                f"the chain does not close", index=dst)
+        if kind == "rt":
+            if not ret[src] < inv[dst]:
+                bad(f"rt edge {src}->{dst} unjustified: row {src} did "
+                    f"not return before row {dst} invoked", index=src)
+        elif kind == "rf":
+            val = v1[dst]
+            if f[dst] != Q_DEQ or not ok[dst] or val == NIL:
+                bad(f"rf edge {src}->{dst}: row {dst} is not an :ok "
+                    f"dequeue of a concrete value", index=dst)
+            elif enq_of.get(val, []) != [src]:
+                bad(f"rf edge {src}->{dst}: row {src} is not the "
+                    f"unique enqueue of value {val}", index=src)
+        elif kind == "fifo":
+            if not name.startswith("fifo-queue-"):
+                bad(f"fifo edge {src}->{dst} on non-FIFO model "
+                    f"{name!r}", index=src)
+                continue
+            via = e.get("via") or ()
+            if len(via) != 2:
+                bad(f"fifo edge {src}->{dst} carries no enqueue "
+                    f"witness pair", index=src)
+                continue
+            ei, ej = int(via[0]), int(via[1])
+            if not (0 <= ei < n and 0 <= ej < n):
+                diags.append(Diagnostic(
+                    "W001", "error",
+                    f"fifo edge via pair ({ei},{ej}) is outside this "
+                    f"{n}-op history"))
+                continue
+            vi, vj = v1[src], v1[dst]
+            if f[src] != Q_DEQ or not ok[src] or f[dst] != Q_DEQ \
+                    or not ok[dst] or vi == NIL or vj == NIL \
+                    or vi == vj:
+                bad(f"fifo edge {src}->{dst}: rows are not :ok "
+                    f"dequeues of two distinct values", index=src)
+            elif enq_of.get(vi, []) != [ei] \
+                    or enq_of.get(vj, []) != [ej]:
+                bad(f"fifo edge {src}->{dst}: via pair ({ei},{ej}) is "
+                    f"not the unique enqueues of values {vi}/{vj}",
+                    index=ei)
+            elif not ret[ei] < inv[ej]:
+                bad(f"fifo edge {src}->{dst}: enqueue {ei} did not "
+                    f"return before enqueue {ej} invoked — FIFO forces "
+                    f"nothing", index=ei)
+        else:
+            bad(f"edge {i} has unknown kind {kind!r}", index=src)
+
+
+def _audit_queue_evidence_seq(seq: OpSeq, model, result: dict,
+                              diags: list) -> None:
+    """W007 over an OpSeq-level ``queue_evidence`` certificate: each
+    named row must be an :ok dequeue whose value no enqueue row (of any
+    status) could have produced."""
+    ev = result.get("queue_evidence") or {}
+    Q_ENQ, Q_DEQ = _queue_fs(model)
+    n = len(seq)
+    f = [int(x) for x in seq.f]
+    v1 = [int(x) for x in seq.v1]
+    ok = [bool(x) for x in seq.ok]
+    from ..history import NIL
+
+    enq_vals = {v1[i] for i in range(n) if f[i] == Q_ENQ}
+    if ev.get("kind") != "unexpected-dequeue":
+        diags.append(Diagnostic(
+            "W007", "error",
+            f"OpSeq queue evidence of kind {ev.get('kind')!r} is not "
+            f"independently checkable (expected unexpected-dequeue)"))
+        return
+    rows = ev.get("rows") or ()
+    if not rows:
+        diags.append(Diagnostic(
+            "W007", "error", "queue_evidence names no rows"))
+        return
+    for r in rows:
+        if not isinstance(r, int) or isinstance(r, bool) \
+                or not 0 <= r < n:
+            diags.append(Diagnostic(
+                "W001", "error",
+                f"queue_evidence references row {r!r}, not a row of "
+                f"this {n}-op history"))
+            continue
+        if f[r] != Q_DEQ or not ok[r] or v1[r] == NIL:
+            diags.append(Diagnostic(
+                "W007", "error",
+                f"row {r} is not an :ok dequeue of a concrete value",
+                index=r))
+        elif v1[r] in enq_vals:
+            diags.append(Diagnostic(
+                "W007", "error",
+                f"row {r} dequeues value {v1[r]}, which some enqueue "
+                f"row could have produced — not unexpected", index=r))
+
+
+def _audit_multiset_evidence(ops, result: dict, diags: list) -> None:
+    """W007 over EVENT-level multiset evidence (the streamed
+    total-queue/set fold's certificate): re-derive lost / unexpected
+    from the raw history — independently of both the fold and the
+    post-hoc checker — and check every named event row justifies the
+    claimed kind."""
+    ev = result.get("queue_evidence") or {}
+    kind = ev.get("kind")
+    rows = list(ev.get("rows") or ())
+    n = len(ops)
+
+    def bad(msg, index=None):
+        diags.append(Diagnostic("W007", "error", msg, index=index))
+
+    if not rows:
+        bad("multiset evidence names no rows")
+        return
+    for r in rows:
+        if not isinstance(r, int) or isinstance(r, bool) \
+                or not 0 <= r < n:
+            diags.append(Diagnostic(
+                "W001", "error",
+                f"multiset evidence references event {r!r}, not an "
+                f"event of this {n}-event history"))
+            return
+    from collections import Counter
+
+    attempts: set = set()
+    acked: Counter = Counter()      # :ok enqueues per value
+    delivered: Counter = Counter()  # :ok dequeues/drained per value
+    last_read: set | None = None
+    for op in ops:
+        if not isinstance(op.process, int):
+            continue
+        if op.type == "invoke" and op.f in ("enqueue", "add"):
+            attempts.add(op.value)
+        elif op.type == "ok" and op.f == "enqueue":
+            acked[op.value] += 1
+        elif op.type == "ok" and op.f == "dequeue":
+            delivered[op.value] += 1
+        elif op.type == "ok" and op.f == "drain" \
+                and isinstance(op.value, (list, tuple)):
+            delivered.update(op.value)
+        elif op.type == "ok" and op.f == "read":
+            last_read = set(op.value or ())
+    if kind == "unexpected-dequeue":
+        for r in rows:
+            op = ops[r]
+            if op.type != "ok" or op.f not in ("dequeue", "drain"):
+                bad(f"event {r} is not an :ok dequeue/drain", index=r)
+                continue
+            got = op.value if op.f == "dequeue" \
+                else list(op.value or ())
+            vals = got if isinstance(got, list) else [got]
+            if all(v in attempts for v in vals):
+                bad(f"event {r}'s value(s) were all attempted by some "
+                    f"enqueue — not unexpected", index=r)
+    elif kind == "lost-acked-enqueue":
+        for r in rows:
+            op = ops[r]
+            if op.type != "ok" or op.f != "enqueue":
+                bad(f"event {r} is not an :ok enqueue", index=r)
+            elif delivered[op.value] >= acked[op.value]:
+                # multiset semantics, as the checker counts: a value
+                # is lost only while its acked copies outnumber its
+                # delivered ones (a duplicate payload with one copy
+                # delivered and one lost IS lost)
+                bad(f"event {r}'s value {op.value!r} was delivered as "
+                    f"often as it was acked — not lost", index=r)
+    elif kind == "unexpected-member":
+        if last_read is None:
+            bad("unexpected-member evidence on a history with no :ok "
+                "read")
+            return
+        if not (last_read - attempts):
+            bad("every member of the final read was attempted by some "
+                "add — not unexpected")
+    elif kind == "lost-acked-add":
+        if last_read is None:
+            bad("lost-acked-add evidence on a history with no :ok read")
+            return
+        for r in rows:
+            op = ops[r]
+            if op.type != "ok" or op.f != "add":
+                bad(f"event {r} is not an :ok add", index=r)
+            elif op.value in last_read:
+                bad(f"event {r}'s value {op.value!r} appears in the "
+                    f"final read — not lost", index=r)
+    else:
+        bad(f"unknown multiset evidence kind {kind!r}")
+
+
+def audit_events(history, result: dict) -> dict:
+    """Audit one MODEL-LESS (event-level, multiset-semantics) result —
+    the streamed total-queue/set fold's certificate contract.  Same
+    return shape as :func:`audit`.  Lenient where the multiset
+    checkers themselves carry no certificate: an invalid verdict with
+    no ``queue_evidence`` is reported as unchecked, not failed."""
+    ops = list(history or ())
+    diags: list[Diagnostic] = []
+    out: dict = {"ok": True, "checked": "undecided", "codes": [],
+                 "diagnostics": diags, "witness_ops": None}
+    if result.get("valid") is False:
+        if result.get("queue_evidence") is not None:
+            out["checked"] = "queue_evidence"
+            _audit_multiset_evidence(ops, result, diags)
+        else:
+            out["checked"] = "no_certificate"
+    elif result.get("valid") is True:
+        out["checked"] = "multiset"
+    out["codes"] = sorted({d.code for d in diags})
+    out["ok"] = not diags
+    return out
+
+
+def maybe_audit_events(history, result: dict,
+                       audit_flag: bool | None = None) -> dict:
+    """The event-level twin of :func:`maybe_audit` (the streamed fold's
+    postamble): same opt-in, same attach-and-raise policy."""
+    if not (audit_flag if audit_flag is not None else audit_enabled()):
+        return result
+    a = audit_events(history, result)
+    result["audit"] = _summary(a)
+    if not a["ok"]:
+        raise AuditError(a)
+    return result
+
+
 def audit(history, model, result: dict) -> dict:
     """Audit one engine result's certificate.  Returns::
 
@@ -357,6 +672,10 @@ def audit(history, model, result: dict) -> dict:
     range-checked), or ``"undecided"``.  Never raises on a bad
     certificate — :func:`maybe_audit` applies the raising policy.
     """
+    if model is None:
+        # model-less (multiset-semantics) result: the event-level
+        # audit owns it — there is no OpSeq encoding to replay
+        return audit_events(history, result)
     seq = _as_seq(history, model)
     diags: list[Diagnostic] = []
     v = result.get("valid")
@@ -385,6 +704,13 @@ def audit(history, model, result: dict) -> dict:
         if result.get("hb_cycle") is not None:
             out["checked"] = "hb_cycle"
             _audit_hb_cycle(seq, model, result, diags)
+        elif result.get("queue_cycle") is not None \
+                or result.get("queue_dup") is not None:
+            out["checked"] = "queue_order"
+            _audit_queue_order(seq, model, result, diags)
+        elif result.get("queue_evidence") is not None:
+            out["checked"] = "queue_evidence"
+            _audit_queue_evidence_seq(seq, model, result, diags)
         elif frontier is None:
             out["checked"] = "frontier_dropped"
             reason = result.get("frontier_dropped")

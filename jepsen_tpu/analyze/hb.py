@@ -740,9 +740,21 @@ def maybe_hb(seq: OpSeq, model: ModelSpec,
     """The engines' shared pre-pass preamble: resolve the three-state
     flag (None follows JEPSEN_TPU_HB, default on), run the analysis
     under an ``obs`` span, and feed the ``jtpu_hb_*`` metrics.  ONE
-    home for the policy, mirroring ``lint.maybe_lint``."""
+    home for the policy, mirroring ``lint.maybe_lint``.
+
+    This is the unified prepass SLOT: register-family models run the
+    HB order-solver below; queue/lock families dispatch to the
+    model-generic constraint compiler (analyze/constraints.py), which
+    returns the same :class:`HBAnalysis` shape — every consumer of
+    this function (host DFS mask, linear frame mask, batch disposal,
+    decomposed and streamed sub-searches) gets both solvers' verdicts
+    and must-order edges with no extra wiring."""
     if not resolve_hb(flag) or len(seq) == 0:
         return None
+    from .constraints import family_of, maybe_constraints
+
+    if family_of(model) is not None:
+        return maybe_constraints(seq, model)
     from .. import obs
 
     with obs.span("hb.prepass", cat="analyze", rows=len(seq)):
@@ -767,7 +779,10 @@ def hb_dispose(seq: OpSeq, model: ModelSpec,
                flag: bool | None = True) -> dict | None:
     """Decide-fast only — the per-key disposal the batch schedulers
     run next to the greedy witness.  Returns a full engine-style result
-    dict (certificate included) or None when the key must be searched."""
+    dict (certificate included) or None when the key must be searched.
+    Dispatches through the unified prepass, so queue/lock-family keys
+    dispose on constraint-compiler verdicts the same way register keys
+    dispose on HB verdicts."""
     hbres = maybe_hb(seq, model, flag)
     if hbres is not None and hbres.decided is not None:
         return dict(hbres.decided)
@@ -776,9 +791,14 @@ def hb_dispose(seq: OpSeq, model: ModelSpec,
 
 def attach(result: dict, hb: HBAnalysis | None) -> dict:
     """Record the pre-pass summary on an engine result (undecided
-    histories only; decided ones already carry it)."""
-    if hb is not None and hb.applies and "hb" not in result:
-        result["hb"] = hb.stats
+    histories only; decided ones already carry it).  HB-solver stats
+    land under ``result["hb"]``, constraint-compiler stats under
+    ``result["constraints"]`` — the key names the solver."""
+    if hb is not None and hb.applies:
+        key = "constraints" if hb.stats.get("solver") == "constraints" \
+            else "hb"
+        if key not in result:
+            result[key] = hb.stats
     return result
 
 

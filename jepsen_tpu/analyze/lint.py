@@ -23,10 +23,22 @@ H005 error    value not encodable by ValueEncoder (unhashable)
 H006 warning  ok completion's value conflicts with the invocation's
 H007 error    OpSeq column shape mismatch
 M001 error    op ``f`` unknown to the model's f_codes
+Q001 error    ack of a job no :ok dequeue/claim ever delivered
+Q002 error    double-ack: the same job acked :ok twice
+Q003 warning* :ok dequeue (or drained element) of a value no enqueue
+              ever attempted
 ==== ======== ==========================================================
 
 (*) engines re-index events positionally, so a stale ``op.index`` cannot
 change a verdict — it only misleads humans reading reports.
+
+Severity of the Q (queue-history) codes follows checker semantics:
+``Q003`` is exactly the violation the multiset checkers
+(``checker.basic.queue``/``total_queue``) exist to JUDGE, so lint must
+not preempt the verdict — it warns.  ``Q001``/``Q002`` describe
+claim/ack protocol streams no checker consumes (the checkers ignore
+``ack``/``claim`` ops entirely), so a malformed ack stream is a
+recording defect that would otherwise vanish silently — they error.
 
 The event-level scan (:func:`scan_events`) is a single O(n) pass that
 also collects the facts the plan explainer (analyze/plan.py) reads:
@@ -63,7 +75,16 @@ ERROR_CODES = {
     "H006": "ok completion value conflicts with the invocation value",
     "H007": "OpSeq column shape mismatch",
     "M001": "op f unknown to the model",
+    "Q001": "ack of a job no :ok dequeue/claim ever delivered",
+    "Q002": "double-ack: the same job acked :ok twice",
+    "Q003": ":ok dequeue of a value no enqueue ever attempted",
 }
+
+#: the queue-history lint family (docstring table) — runnable on its
+#: own via ``scan_events(history, codes=QUEUE_CODES)``, which is how
+#: the multiset checkers (checker/basic.py) wire it on by default
+#: without dragging the pairing codes into their permissive contract
+QUEUE_CODES = ("Q001", "Q002", "Q003")
 
 
 @dataclass(frozen=True)
@@ -192,6 +213,75 @@ def _encodable(value) -> bool:
     return True
 
 
+def _hashable(v) -> bool:
+    try:
+        hash(v)
+    except TypeError:
+        return False
+    return True
+
+
+def _q_scan(op, i: int, t: str, want: set, diags: list,
+            attempts: set, claimed: set, acked: set,
+            flagged: set) -> None:
+    """The queue-history (Q-code) checks for one client event.
+
+    ``enqueue`` invokes register attempts; :ok ``dequeue``/``claim``
+    completions (and :ok ``drain`` elements) register deliveries and
+    trip Q003 on values no enqueue ever attempted; ``ack`` ops trip
+    Q001 (ack-without-claim) at their invoke and Q002 (double-ack) at
+    their :ok completion.  Unhashable values are H005's beat, not
+    ours."""
+    f, v = op.f, op.value
+    if f == "enqueue":
+        if t == INVOKE and _hashable(v):
+            attempts.add(v)
+        return
+    if f in ("dequeue", "claim"):
+        if t == OK and _hashable(v):
+            claimed.add(v)
+            if "Q003" in want and f == "dequeue" \
+                    and v is not None and v not in attempts \
+                    and v not in flagged:
+                flagged.add(v)
+                diags.append(Diagnostic(
+                    "Q003", "warning",
+                    f":ok dequeue of {v!r} at event {i}, a value no "
+                    f"enqueue ever attempted (the multiset checker "
+                    f"will judge it unexpected)",
+                    index=i, process=op.process, f=f))
+        return
+    if f == "drain" and t == OK and isinstance(v, (list, tuple)):
+        for element in v:
+            if _hashable(element):
+                claimed.add(element)
+                if "Q003" in want and element not in attempts \
+                        and element not in flagged:
+                    flagged.add(element)
+                    diags.append(Diagnostic(
+                        "Q003", "warning",
+                        f":ok drain at event {i} delivered "
+                        f"{element!r}, a value no enqueue ever "
+                        f"attempted", index=i, process=op.process,
+                        f=f))
+        return
+    if f == "ack" and _hashable(v):
+        if t == INVOKE and "Q001" in want and v not in claimed:
+            diags.append(Diagnostic(
+                "Q001", "error",
+                f"ack of {v!r} at event {i} but no :ok dequeue/claim "
+                f"ever delivered it (ack-without-claim: the recorded "
+                f"protocol stream is inconsistent)",
+                index=i, process=op.process, f=f))
+        elif t == OK and "Q002" in want:
+            if v in acked:
+                diags.append(Diagnostic(
+                    "Q002", "error",
+                    f"double-ack of {v!r} at event {i} (already acked "
+                    f":ok earlier)", index=i, process=op.process, f=f))
+            acked.add(v)
+
+
 def scan_events(history: Sequence, model=None, *,
                 codes: Sequence[str] | None = None) -> HistoryScan:
     """The single O(n) event-level pass.
@@ -212,6 +302,12 @@ def scan_events(history: Sequence, model=None, *,
     last_index: int | None = None
     indices_flagged = False
     diags = sc.diagnostics
+    # queue-history lint state (Q-codes; all O(1) per event)
+    q_want = bool(want & {"Q001", "Q002", "Q003"})
+    q_attempts: set = set()   # enqueue-invoke values
+    q_claimed: set = set()    # values an :ok dequeue/claim delivered
+    q_acked: set = set()      # values :ok acked
+    q_flagged: set = set()    # one Q003 per value is plenty
 
     for i, op in enumerate(history):
         sc.n_events += 1
@@ -255,6 +351,10 @@ def scan_events(history: Sequence, model=None, *,
             # — both the invocation and the completion are :info), so
             # pairing/model rules apply to client processes only
             continue
+
+        if q_want:
+            _q_scan(op, i, t, want, diags, q_attempts, q_claimed,
+                    q_acked, q_flagged)
 
         if t == INVOKE:
             prev = open_by_process.get(op.process)

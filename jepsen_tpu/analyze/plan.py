@@ -377,6 +377,7 @@ def explain(history, model: ModelSpec, *,
     ub_log2 = (max(0, es.window - 1) + es.n_crash)
     upper = (es.n_det + 1) << ub_log2
 
+    from .constraints import plan_block as constraints_block
     from .hb import plan_block
 
     # keyed-composite gate (the live pgwire/replicated/kv families):
@@ -415,6 +416,7 @@ def explain(history, model: ModelSpec, *,
         "config_upper_bound_log2": round(
             ub_log2 + float(np.log2(max(1, es.n_det + 1))), 2),
         "hb": plan_block(seq, model, upper, es.n_crash, es.window),
+        "constraints": constraints_block(seq, model),
         "decompositions": _decompositions(seq, model),
         "streaming": stream_plan(seq, model),
     }
@@ -446,19 +448,27 @@ def explain_batch(seqs: list[OpSeq], model: ModelSpec, *,
     greedy = [i for i in range(len(seqs))
               if lin.greedy_witness(seqs[i], model)]
     greedy_set = set(greedy)
-    # the HB pre-pass disposes decided keys next to the greedy witness
-    # (checker/bucket.py's prep stage) — mirror the split exactly,
-    # including the per-call flag resolution, so the predicted
-    # per-bucket dims match the scheduler's under any hb setting
-    from .hb import analyze_hb, resolve_hb
+    # the static prepass disposes decided keys next to the greedy
+    # witness (checker/bucket.py's prep stage) — mirror the split
+    # exactly, including the per-call flag resolution AND the solver
+    # dispatch (HB for registers, the constraint compiler for
+    # queue/lock families), so the predicted per-bucket dims match the
+    # scheduler's under any hb setting
+    from .constraints import analyze_prepass
+    from .hb import resolve_hb
 
     hb_set: set[int] = set()
+    constraint_set: set[int] = set()
     if resolve_hb(hb):
         for i in range(len(seqs)):
-            if i not in greedy_set and \
-                    analyze_hb(seqs[i], model).decided is not None:
-                hb_set.add(i)
-    disposed = greedy_set | hb_set
+            if i in greedy_set:
+                continue
+            a = analyze_prepass(seqs[i], model)
+            if a.decided is not None:
+                (constraint_set
+                 if a.stats.get("solver") == "constraints"
+                 else hb_set).add(i)
+    disposed = greedy_set | hb_set | constraint_set
     buckets = []
     for idxs in plans:
         run = [i for i in idxs if i not in disposed]
@@ -484,6 +494,7 @@ def explain_batch(seqs: list[OpSeq], model: ModelSpec, *,
         "bucketing": _enabled,
         "greedy": len(greedy),
         "hb_decided": len(hb_set),
+        "constraint_decided": len(constraint_set),
         "hard": len(hard),
         "hard_keys": hard,
         "buckets": buckets,
@@ -502,6 +513,8 @@ def render_plan(plan: dict, *, batch: bool = False) -> str:
                      f"{plan['n_buckets']} bucket(s), "
                      f"{plan['greedy']} greedy-disposed, "
                      f"{plan.get('hb_decided', 0)} hb-decided, "
+                     f"{plan.get('constraint_decided', 0)} "
+                     f"constraint-decided, "
                      f"{plan['hard']} host-fallback")
         for b, bk in enumerate(plan["buckets"]):
             dims = bk["dims"]
@@ -560,6 +573,19 @@ def render_plan(plan: dict, *, batch: bool = False) -> str:
                     f"raw ~2^{_log2(plan.get('config_upper_bound', 0))}"
                     f" (ratio {hb.get('prune_ratio')})")
         lines.append("  happens-before: " + line)
+    cs = plan.get("constraints")
+    if cs and cs.get("applies"):
+        if cs.get("decided") is not None:
+            line = (f"DECIDES this history "
+                    f"({'valid' if cs['decided'] else 'invalid'} via "
+                    f"{cs.get('reason')}; no search needed)")
+        else:
+            line = (f"undecided; {cs.get('must_edges', 0)} must-order "
+                    f"edge(s) {cs.get('edges')}")
+        sf = cs.get("stream_fold") or {}
+        if sf.get("eligible"):
+            line += f"; streamed fold route: {sf.get('route')}"
+        lines.append(f"  constraints[{cs.get('family')}]: " + line)
     st = plan.get("streaming")
     if st:
         lines.append(

@@ -175,6 +175,81 @@ def shrink_invalid(seq: OpSeq, model: ModelSpec, *,
     return out
 
 
+def shrink_invalid_events(ops: list, check, *,
+                          max_checks: int = 200) -> dict:
+    """ddmin an EVENT-LEVEL invalid history down to a minimal failing
+    subhistory — the bank-time corpus shrinker (live/corpus.py).
+
+    Events group into removal *units* (an invoke plus its same-process
+    completion; orphan events are their own unit), so every candidate
+    stays a well-formed history.  ``check(ops) -> bool`` answers
+    "still invalid" — the multiset checker for queue entries, a
+    bounded engine for model entries — and a removal is kept only
+    while it says True, so the chain starts and ends at a
+    machine-confirmed invalid history (the same contract as
+    :func:`shrink_invalid`).  Returns::
+
+        {"ops": minimal event list, "n_from": units, "n_to": units,
+         "checks": n, "minimal": 1-minimality proven}
+    """
+    # unit grouping: invoke -> [invoke, next same-process event]
+    units: list[list[int]] = []
+    open_of: dict = {}
+    for i, op in enumerate(ops):
+        if op.type == "invoke":
+            open_of[op.process] = len(units)
+            units.append([i])
+        else:
+            u = open_of.pop(op.process, None)
+            if u is None:
+                units.append([i])
+            else:
+                units[u].append(i)
+
+    def build(kept: list[int]) -> list:
+        rows = sorted(i for u in kept for i in units[u])
+        return [ops[i] for i in rows]
+
+    checks = 0
+
+    def still_invalid(kept: list[int]) -> bool:
+        nonlocal checks
+        checks += 1
+        try:
+            return bool(check(build(kept)))
+        except Exception:  # noqa: BLE001 — a crashing candidate is
+            return False   # not a confirmed-invalid one
+
+    kept = list(range(len(units)))
+    out = {"ops": list(ops), "n_from": len(units), "n_to": len(units),
+           "checks": 0, "minimal": False}
+    if not kept or not still_invalid(kept):
+        out["checks"] = checks
+        return out
+
+    chunk = max(1, len(kept) // 2)
+    minimal = False
+    while checks < max_checks:
+        i = 0
+        removed = False
+        while i < len(kept) and checks < max_checks:
+            cand = kept[:i] + kept[i + chunk:]
+            if cand and still_invalid(cand):
+                kept = cand
+                removed = True
+            else:
+                i += chunk
+        if chunk == 1:
+            if not removed:
+                minimal = True
+                break
+        else:
+            chunk = max(1, chunk // 2)
+    out.update({"ops": build(kept), "n_to": len(kept),
+                "checks": checks, "minimal": minimal})
+    return out
+
+
 def shrink_summary(seq: OpSeq, shrunk: dict) -> dict:
     """The JSON/report-ready form of a shrink outcome: the stats plus
     the core rendered as op dicts (the "6-op story") when the OpSeq

@@ -30,6 +30,30 @@ def fraction(a: int, b: int):
     return a / b if b else 1
 
 
+def queue_lint(history) -> list[dict]:
+    """The Q-code history lint (analyze/lint.py), wired on by default
+    into the multiset queue checkers exactly as the H-codes are wired
+    into the search engines: Q001/Q002 (a malformed claim/ack stream no
+    checker would otherwise notice) raise
+    :class:`~jepsen_tpu.analyze.HistoryLintError`; Q003 (the multiset
+    checker's own verdict territory) rides the result as
+    ``lint_warnings``.  ``JEPSEN_TPU_LINT=0`` disables, same knob as
+    everywhere."""
+    from ..analyze.lint import (
+        QUEUE_CODES,
+        HistoryLintError,
+        lint_enabled,
+        scan_events,
+    )
+
+    if not lint_enabled():
+        return []
+    diags = scan_events(history, codes=QUEUE_CODES).diagnostics
+    if any(d.severity == "error" for d in diags):
+        raise HistoryLintError(diags)
+    return [d.to_dict() for d in diags]
+
+
 class Inconsistent:
     """Host-model inconsistency marker (knossos.model/inconsistent)."""
 
@@ -98,7 +122,9 @@ class QueueChecker(Checker):
         self.model = model
 
     def check(self, test, history, opts=None):
+        warnings = queue_lint(history)
         model = self.model or test.get("model") or UnorderedQueue()
+        out = None
         for op in history:
             take = (is_invoke(op) if op.f == "enqueue"
                     else is_ok(op) if op.f == "dequeue" else False)
@@ -106,9 +132,14 @@ class QueueChecker(Checker):
                 continue
             model = model.step(op)
             if isinstance(model, Inconsistent):
-                return {"valid": False, "error": model.msg}
-        return {"valid": True,
-                "final_queue": getattr(model, "contents", None)}
+                out = {"valid": False, "error": model.msg}
+                break
+        if out is None:
+            out = {"valid": True,
+                   "final_queue": getattr(model, "contents", None)}
+        if warnings:
+            out["lint_warnings"] = warnings
+        return out
 
 
 def queue(model=None) -> Checker:
@@ -335,6 +366,7 @@ def expand_queue_drain_ops(history) -> list:
 
 class TotalQueueChecker(Checker):
     def check(self, test, history, opts=None):
+        warnings = queue_lint(history)
         history = expand_queue_drain_ops(history)
         attempts = Counter(op.value for op in history
                            if is_invoke(op) and op.f == "enqueue")
@@ -354,7 +386,7 @@ class TotalQueueChecker(Checker):
             return sum(ms.values())
 
         n_att = total(attempts)
-        return {
+        out = {
             "valid": not lost and not unexpected,
             "lost": dict(lost),
             "unexpected": dict(unexpected),
@@ -366,6 +398,9 @@ class TotalQueueChecker(Checker):
             "lost_frac": fraction(total(lost), n_att),
             "recovered_frac": fraction(total(recovered), n_att),
         }
+        if warnings:
+            out["lint_warnings"] = warnings
+        return out
 
 
 def total_queue() -> Checker:

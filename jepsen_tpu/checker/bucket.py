@@ -170,7 +170,8 @@ def search_batch_bucketed(seqs: list[OpSeq], model: ModelSpec, *,
     plans = [[fit[p] for p in grp] for grp in plans]
 
     stats: dict = {"n_keys": n, "n_buckets": len(plans), "buckets": [],
-                   "greedy": 0, "hard": len(hard), "hb_decided": 0}
+                   "greedy": 0, "hard": len(hard), "hb_decided": 0,
+                   "constraint_decided": 0}
 
     def prep(idxs: list[int]):
         """Host stage for one bucket: greedy-witness disposal, then
@@ -231,8 +232,11 @@ def search_batch_bucketed(seqs: list[OpSeq], model: ModelSpec, *,
                     results[i] = r
                 n_hb = sum(1 for r in ready.values()
                            if r.get("engine") == "hb-decide")
+                n_cs = sum(1 for r in ready.values()
+                           if r.get("engine") == "constraint-decide")
                 stats["hb_decided"] += n_hb
-                stats["greedy"] += len(ready) - n_hb
+                stats["constraint_decided"] += n_cs
+                stats["greedy"] += len(ready) - n_hb - n_cs
                 t0 = time.perf_counter()
                 if run:
                     with obs.span("bucket.device", cat="device",

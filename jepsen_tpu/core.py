@@ -492,7 +492,8 @@ def prepare_test(test: dict) -> dict:
         # the streaming incremental checker (stream/checker.py): an op
         # sink next to the stream linter, folding quiescence segments
         # as they close so the verdict is live while workers still run.
-        # Needs the model; tests without one stay post-hoc only.
+        # Model-less multiset workloads (the queue families) get the
+        # total-queue fold route instead; anything else stays post-hoc.
         model = test.get("model")
         if model is not None:
             from .stream.checker import StreamChecker
@@ -517,6 +518,21 @@ def prepare_test(test: dict) -> dict:
             test["__stream_check__"] = StreamChecker(
                 model, async_folds=True, cache=cache, live_path=live,
                 info_lookahead=la,
+                run_id=f"{test.get('name')}/{test['start_time']}"
+                if test.get("name") else None)
+        elif test.get("stream_fold") in ("total-queue", "set"):
+            # the model-less multiset families (queue,
+            # replicated-queue): the incremental total-queue/set fold
+            # (stream/checker.py's TotalFoldStream) — the live verdict
+            # flips at the deciding event (an unexpected delivery, a
+            # short final drain) instead of waiting for the post-hoc
+            # checker, and finalize stays bit-identical to it
+            from .stream.checker import TotalFoldStream
+
+            live = store.path(test, "live.json") if test.get("name") \
+                else None
+            test["__stream_check__"] = TotalFoldStream(
+                test["stream_fold"], live_path=live,
                 run_id=f"{test.get('name')}/{test['start_time']}"
                 if test.get("name") else None)
         else:

@@ -145,6 +145,10 @@ class LiveBackend:
         }
         if w.get("model") is not None:
             test["model"] = w["model"]
+        if w.get("stream_fold"):
+            # model-less families declare their streaming fold route
+            # (core.prepare_test installs the matching sink)
+            test.setdefault("stream_fold", w["stream_fold"])
         test["__workload__"] = w
         return test
 
@@ -547,7 +551,12 @@ class QueueBackend(LiveBackend):
                                    gen.queue()),
             "final_generator": gen.each(lambda: gen.once(
                 {"type": "invoke", "f": "drain", "value": None})),
-            "model": None,  # multiset semantics: post-hoc checker only
+            "model": None,  # multiset semantics: no per-op model
+            # the streaming total-queue fold route: the live verdict
+            # flips at the deciding event (stream/checker.py's
+            # TotalFoldStream); the post-hoc total_queue stays the
+            # authoritative cross-check
+            "stream_fold": "total-queue",
             "concurrency": opts.get("concurrency", 4),
             "checker": checker_mod.compose({
                 "queue": basic.total_queue(),
@@ -769,7 +778,11 @@ class ReplicatedQueueBackend(ConsensusBackend):
                                    gen.queue()),
             "final_generator": gen.each(lambda: gen.once(
                 {"type": "invoke", "f": "drain", "value": None})),
-            "model": None,  # multiset semantics: post-hoc checker only
+            "model": None,  # multiset semantics: no per-op model
+            # streamed lost-ack detection: the bridge-election seeded
+            # cell's short final drain flips the live verdict at the
+            # drain event, grading detection.at="streamed"
+            "stream_fold": "total-queue",
             "concurrency": opts.get("concurrency", 4),
             "checker": checker_mod.compose({
                 "queue": basic.total_queue(),

@@ -81,7 +81,9 @@ SEEDED = {
     # redelivery-under-partition: volatile replicas under the bridge
     # grudge — a cut-off replica wins an election through the overlap
     # node (completeness-free elections) and serves a pending set
-    # missing acked ADDJOBs; the final drain comes up short (lost)
+    # missing acked ADDJOBs; the final drain comes up short (lost),
+    # and the total-queue fold flips the live verdict AT the drain
+    # event (detection.at="streamed", W007-auditable evidence)
     ("replicated-queue", "link-bridge"): {
         "rqueue_volatile": True, "part_every": 2.0, "lease_ms": 400,
         "rate": 20, "concurrency": 4, "time_limit": 12},
@@ -170,16 +172,20 @@ def _detection(test: dict, nemesis: str) -> dict | None:
     fault and the event where the streaming checker flipped to
     invalid — the metric ROADMAP's streaming phase 2 asks to measure on
     real crashes.  ``at`` labels *when* the verdict landed:
-    ``"streamed"`` (mid-stream — an online cut, or the bounded `:info`
-    lookahead fork on crash-seeded cells) vs ``"finalize"`` (only the
-    stream's close confirmed it)."""
+    ``"streamed"`` (mid-stream — an online cut, the bounded `:info`
+    lookahead fork on crash-seeded cells, or the total-queue fold's
+    unexpected-delivery/short-drain flip on the model-less queue
+    families) vs ``"finalize"`` (only the stream's close confirmed
+    it).  The old blanket model-less exemption is gone: queue cells
+    stream through the total-queue fold route (stream/checker.py's
+    TotalFoldStream) and grade like everyone else; the post-hoc
+    fallback below only fires when streaming was off entirely."""
     hist = test.get("history") or []
     sres = test.get("stream_results")
     if not isinstance(sres, dict):
-        # no streamed verdict to grade (model-less families — the
-        # queue multiset checkers run post-hoc only): when the final
-        # verdict is invalid, the detection still gets recorded and
-        # graded — latency against the end of the history, labelled
+        # no streamed verdict to grade at all (streaming disabled):
+        # when the final verdict is invalid, the detection still gets
+        # recorded — latency against the end of the history, labelled
         # finalize with the post-hoc source so the /campaigns grading
         # stays honest about WHEN the verdict could have landed
         if (test.get("results") or {}).get("valid") is not False:
@@ -202,6 +208,8 @@ def _detection(test: dict, nemesis: str) -> dict | None:
         at = "finalize"
     out = {"invalid_event": inv, "at": at,
            "first_verdict_event": st.get("first_verdict_event")}
+    if st.get("family"):
+        out["fold"] = st["family"]
     return _detection_latency(out, hist, inv, nemesis)
 
 
@@ -516,6 +524,15 @@ def run_cell(cell: dict, opts: dict) -> dict:
             k: v for k, v in summ.items()
             if k in ("witness_ops", "witness_dropped", "final_ops",
                      "frontier_ops", "frontier_dropped")}
+        ev = sres.get("queue_evidence")
+        if isinstance(ev, dict):
+            # the streamed multiset evidence (W007-audited): what was
+            # lost/unexpected, visible straight from cells.jsonl
+            out["certificate"]["queue_evidence"] = {
+                "kind": ev.get("kind"),
+                "values": list(ev.get("values") or ())[:16]}
+        if summ.get("audit") is not None:
+            out["stream_audit"] = summ["audit"]
     out["detection"] = _detection(test, cell["nemesis"])
     out["recovery"] = _recovery(test)
     out["phases"] = _phase_times(test, cell["nemesis"])
@@ -648,6 +665,20 @@ def run_campaign(opts: dict | None = None,
     by_status: dict = {}
     for o in outcomes:
         by_status[o["status"]] = by_status.get(o["status"], 0) + 1
+    # streamed-vs-finalize detection, PER FAMILY: the grading question
+    # "which families still only detect at finalize?" answered straight
+    # from campaign.json instead of by re-reading every cell line
+    det_by_family: dict = {}
+    for o in outcomes:
+        det = o.get("detection")
+        fam = o.get("family")
+        if not isinstance(det, dict) or not fam:
+            continue
+        row = det_by_family.setdefault(fam,
+                                       {"streamed": 0, "finalize": 0})
+        at = det.get("at")
+        if at in row:
+            row[at] += 1
     record = {
         "id": os.path.basename(d),
         "started": opts.get("campaign_id") or os.path.basename(d),
@@ -662,6 +693,7 @@ def run_campaign(opts: dict | None = None,
             "streamed_detections": sum(
                 1 for o in outcomes
                 if (o.get("detection") or {}).get("at") == "streamed"),
+            "detection_by_family": det_by_family,
             "audited_ok": sum(1 for o in outcomes
                               if (o.get("audit") or {}).get("ok")),
         },

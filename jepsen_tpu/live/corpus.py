@@ -53,6 +53,14 @@ POOL = "pool.jsonl"
 MAX_OPS = 240
 #: pool bound: past it the oldest entries compact away
 POOL_MAX = 512
+#: bank-time ddmin budget (engine calls per banked invalid entry) —
+#: shrinking happens once at bank time, so the repro every later
+#: replay and every human reads is already minimal
+SHRINK_MAX_CHECKS = 160
+#: the engine re-check budget per ddmin candidate (model entries)
+SHRINK_MAX_CONFIGS = 120_000
+#: entries at or under this many ops are already a story — skip ddmin
+SHRINK_SKIP_OPS = 10
 
 _M_BANKED = obs_metrics.REGISTRY.counter(
     "jtpu_corpus_entries_total",
@@ -194,6 +202,7 @@ def entries_from_test(test: dict, outcome: dict) -> list[dict]:
             "ops": [_canon_op(o) for o in qops],
             "n_ops": len(qops), "truncated": truncated,
             "id": _canonical_id(qops, m)})
+        attach_minimal(entries[-1], qops)
         return entries
     spec = _model_spec(model)
     if spec is None:
@@ -217,7 +226,60 @@ def entries_from_test(test: dict, outcome: dict) -> list[dict]:
             else outcome.get("valid"),
             "ops": [_canon_op(o) for o in sub],
             "n_ops": len(sub), "truncated": truncated, "id": eid})
+        attach_minimal(entries[-1], sub)
     return entries
+
+
+# ---------------------------------------------------------------------------
+# bank-time shrinking (corpus-driven ddmin)
+# ---------------------------------------------------------------------------
+
+
+def _still_invalid_check(entry: dict):
+    """The per-route "still invalid" oracle the bank-time ddmin
+    re-validates every removal against — the multiset checker for
+    queue entries (deterministic), a bounded engine for model
+    entries."""
+    if entry.get("routes") == "queue":
+        return lambda ops: replay_queue(ops).get("valid") is False
+    model = entry_model(entry)
+
+    def check(ops):
+        from ..checker.seq import check_opseq
+
+        seq = encode_ops(ops, model.f_codes)
+        return check_opseq(seq, model, max_configs=SHRINK_MAX_CONFIGS,
+                           lint=False).get("valid") is False
+
+    return check
+
+
+def attach_minimal(entry: dict, ops: list[Op]) -> None:
+    """Bank-time corpus shrinking: ddmin a banked-invalid entry's
+    history to a minimal repro, stored ALONGSIDE the full history
+    (``entry["minimal"]``) so ``tools/fuzz.py --corpus`` can assert
+    the minimal repro still reproduces the verdict and a human reads
+    a 6-op story, not a 240-op dump.  Bounded budget; entries already
+    at ``SHRINK_SKIP_OPS`` ops or fewer are left alone."""
+    if entry.get("valid") is not False or len(ops) <= SHRINK_SKIP_OPS:
+        return
+    from ..analyze.shrink import shrink_invalid_events
+
+    try:
+        out = shrink_invalid_events(ops, _still_invalid_check(entry),
+                                    max_checks=SHRINK_MAX_CHECKS)
+    except Exception:  # noqa: BLE001 — shrinking never blocks banking
+        log.warning("corpus: bank-time shrink failed", exc_info=True)
+        return
+    mops = out["ops"]
+    if len(mops) >= len(ops) or len(mops) == 0:
+        return  # nothing removed (or the re-check couldn't reproduce)
+    entry["minimal"] = {
+        "ops": [_canon_op(o) for o in mops],
+        "n_ops": len(mops),
+        "checks": out["checks"],
+        "one_minimal": bool(out["minimal"]),
+    }
 
 
 # ---------------------------------------------------------------------------

@@ -1149,3 +1149,181 @@ class StreamChecker:
     def close(self) -> None:
         """Stop the fold worker without finalizing (abandoned stream)."""
         self._drain_folds()
+
+
+class TotalFoldStream:
+    """The total-queue (and set) fold route — streaming verdicts for
+    the MODEL-LESS multiset families.
+
+    The queue campaign families (``queue``, ``replicated-queue``)
+    carry no ModelSpec: their post-hoc verdict is
+    ``checker.basic.total_queue``'s multiset reduction, so until this
+    class existed their cells could only ever grade
+    ``detection.at="finalize"``.  This sink runs the constraint
+    compiler's incremental edge form (:class:`analyze.constraints.
+    MultisetFold`) per ingested event and flips the LIVE verdict the
+    moment monotone evidence lands:
+
+      * an :ok dequeue (or drained element) of a value no enqueue ever
+        attempted — flagged at that event;
+      * acked enqueues missing from every delivery once a drain has
+        been observed at a point with no client op pending (the
+        "drain-quiescent" cut — the lost-ack flip lands when the final
+        drain returns short, mid-history, not at teardown).
+
+    The mid-stream flip is *provisional* (a pathological suffix could
+    re-attempt a value or deliver a missing one); :meth:`finalize`
+    always recomputes the verdict with the post-hoc checker itself —
+    ``total_queue`` for queues, ``set_checker`` for sets — so the
+    final verdict is bit-identical to the post-hoc route by
+    construction, and detection is only ever graded when finalize
+    confirms.  Invalid finals carry a ``queue_evidence`` certificate
+    (event rows) the independent audit re-justifies (W007).
+    """
+
+    def __init__(self, family: str = "total-queue", *,
+                 live_path: str | None = None,
+                 run_id: str | None = None):
+        from ..analyze.constraints import MultisetFold
+
+        self.family = family
+        self.fold = MultisetFold(family)
+        self.live_path = live_path
+        self.run_id = run_id
+        self._lock = threading.RLock()
+        self._events = 0
+        self._ops: list[Op] = []
+        self._rows = 0
+        self._invalid: dict | None = None
+        self._invalid_event: int | None = None
+        self._first_verdict_event: int | None = None
+        self._finalized: dict | None = None
+        self._live_last = (0, 0.0)
+        self._live_lock = threading.Lock()
+
+    def ingest(self, op: Op) -> None:
+        with self._lock:
+            if self._finalized is not None:
+                raise RuntimeError("stream already finalized")
+            i = self._events
+            self._events += 1
+            _M_INGESTED.inc()
+            if not isinstance(op.process, int):
+                return
+            self._ops.append(op)
+            if op.type != INVOKE:
+                self._rows += 1
+                if self._first_verdict_event is None:
+                    self._first_verdict_event = i
+            flip = self.fold.step(op, len(self._ops) - 1)
+            if flip is not None and self._invalid is None:
+                self._invalid = flip
+                self._invalid_event = i
+        self._maybe_write_live()
+
+    def verdict(self) -> dict:
+        with self._lock:
+            if self._invalid is not None:
+                status = "invalid"
+            elif self._first_verdict_event is not None:
+                status = "valid-so-far"
+            else:
+                status = "open"
+            return {
+                "status": status,
+                "run": self.run_id,
+                "family": self.family,
+                "events": self._events,
+                "rows": self._rows,
+                "first_verdict_event": self._first_verdict_event,
+                "invalid_event": self._invalid_event,
+                "violation": dict(self._invalid)
+                if self._invalid else None,
+            }
+
+    def _maybe_write_live(self, force: bool = False,
+                          final: dict | None = None) -> None:
+        if self.live_path is None:
+            return
+        with self._live_lock:
+            ev, t = self._live_last
+            now = time.monotonic()
+            if not force and (self._events - ev < _LIVE_EVERY
+                              or now - t < _LIVE_MIN_S):
+                return
+            self._live_last = (self._events, now)
+            snap = self.verdict()
+            if final is not None:
+                snap["final"] = final
+            tmp = self.live_path + ".tmp"
+            try:
+                os.makedirs(os.path.dirname(self.live_path) or ".",
+                            exist_ok=True)
+                with open(tmp, "w") as f:
+                    json.dump(snap, f, default=str)
+                os.replace(tmp, self.live_path)
+            except OSError:
+                log.debug("stream: live snapshot write failed",
+                          exc_info=True)
+
+    def finalize(self, *, audit: bool | None = None) -> dict:
+        """Close the stream: the POST-HOC checker's verdict over
+        exactly the recorded client ops (bit-identical to the
+        authoritative route), plus the streamed detection stats and —
+        on invalid — the W007-auditable evidence certificate."""
+        from ..analyze.audit import maybe_audit_events
+        from ..analyze.constraints import (
+            analyze_queue_events,
+            analyze_set_events,
+        )
+        from ..checker import basic
+
+        with self._lock:
+            if self._finalized is not None:
+                return self._finalized
+            ops = list(self._ops)
+            with obs.span("stream.finalize", cat="check",
+                          run=self.run_id, family=self.family):
+                if self.family == "set":
+                    checker = basic.set_checker()
+                    evidence = analyze_set_events(ops)
+                else:
+                    checker = basic.total_queue()
+                    evidence = analyze_queue_events(ops)
+                try:
+                    post = checker.check({}, ops)
+                except Exception as e:  # noqa: BLE001 — same contract
+                    # as check_safe: a checker crash (e.g. a crashed
+                    # drain the expansion rejects) is unknown, never
+                    # a stream crash
+                    post = {"valid": "unknown",
+                            "error": f"{type(e).__name__}: {e}"}
+            out = dict(post)
+            out["engine"] = f"stream({self.family})"
+            out["stream"] = {
+                "family": self.family,
+                "events": self._events,
+                "rows": self._rows,
+                "segments": 1,
+                "routes": {self.family: 1},
+                "first_verdict_event": self._first_verdict_event,
+                "invalid_event": self._invalid_event
+                if out.get("valid") is False else None,
+                "edges": evidence.get("edges"),
+            }
+            if out.get("valid") is False:
+                # the RECOMPUTED full-history evidence, not the
+                # provisional flip's: a mid-stream flip may have named
+                # values a later drain delivered, and the certificate
+                # must justify the FINAL verdict (W007 audits it)
+                ev = evidence.get("evidence") or self._invalid
+                if ev is not None:
+                    out["queue_evidence"] = dict(ev)
+            out = maybe_audit_events(ops, out, audit)
+            self._finalized = out
+        self._maybe_write_live(force=True, final={
+            "valid": out.get("valid"), "engine": out.get("engine")})
+        return out
+
+    def close(self) -> None:
+        """Nothing to stop (no fold worker); kept for sink parity."""

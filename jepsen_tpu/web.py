@@ -260,6 +260,24 @@ def result_block(result: dict) -> str:
                      f"HB cycle, {len(cyc)} forced edge(s): "
                      + " -> ".join(str(e.get("src")) for e in cyc[:6])
                      + " -> ..."))
+    if result.get("queue_cycle") is not None:
+        cyc = result["queue_cycle"]
+        rows.append(("certificate",
+                     f"queue order cycle, {len(cyc)} forced edge(s): "
+                     + " -> ".join(f"{e.get('src')}[{e.get('kind')}]"
+                                   for e in cyc[:6])))
+    if result.get("queue_dup") is not None:
+        dup = result["queue_dup"]
+        rows.append(("certificate",
+                     f"duplicate delivery: {len(dup.get('dequeues', ()))}"
+                     f" dequeue(s) over "
+                     f"{len(dup.get('enqueues', ()))} enqueue row(s)"))
+    qe = result.get("queue_evidence")
+    if isinstance(qe, dict):
+        rows.append(("certificate",
+                     f"{qe.get('kind')}: values "
+                     f"{qe.get('values', [])[:6]} at event(s) "
+                     f"{qe.get('rows', [])[:6]}"))
     if result.get("final_ops") is not None:
         rows.append(("blocking frontier",
                      f"{len(result['final_ops'])} ops "
@@ -278,6 +296,18 @@ def result_block(result: dict) -> str:
                          f"{hbs.get('must_edges', 0)} must-order "
                          f"edge(s) pruned the search "
                          f"{hbs.get('edges')}"))
+    cs = result.get("constraints")
+    if isinstance(cs, dict) and cs.get("applies"):
+        if cs.get("decided") is not None:
+            rows.append(("constraints",
+                         f"[{cs.get('family')}] decided statically "
+                         f"({cs.get('reason')}, no search)"))
+        else:
+            rows.append(("constraints",
+                         f"[{cs.get('family')}] "
+                         f"{cs.get('must_edges', 0)} must-order "
+                         f"edge(s) pruned the search "
+                         f"{cs.get('edges')}"))
     a = result.get("audit")
     if a:
         rows.append(("audit", "ok (checked %s)" % a.get("checked")
@@ -343,7 +373,8 @@ def result_block(result: dict) -> str:
 
 #: nested result fields worth a panel of their own
 _EVIDENCE = ("linearization", "witness_dropped", "final_ops",
-             "frontier_dropped", "hb_cycle", "explain", "audit",
+             "frontier_dropped", "hb_cycle", "queue_cycle",
+             "queue_dup", "queue_evidence", "explain", "audit",
              "shrink")
 
 

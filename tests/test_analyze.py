@@ -730,3 +730,89 @@ def test_analyze_cli_module(tmp_path):
         f.write(json.dumps({"process": 0, "type": "ok", "f": "write",
                             "value": 1}) + "\n")
     assert main([str(bad)]) == 1
+
+
+# ---------------------------------------------------------------------------
+# Q-codes: the queue-history lint family (analyze/lint.py + the
+# multiset checkers' on-by-default wiring in checker/basic.py)
+# ---------------------------------------------------------------------------
+
+
+def _qops(*specs):
+    from jepsen_tpu.history import info_op, invoke_op, ok_op
+
+    mk = {"invoke": invoke_op, "ok": ok_op, "info": info_op}
+    return [mk[t](p, f, v) for t, p, f, v in specs]
+
+
+def test_q001_ack_without_claim_is_an_error():
+    h = _qops(("invoke", 0, "enqueue", 1), ("ok", 0, "enqueue", 1),
+              ("invoke", 1, "ack", 1), ("ok", 1, "ack", 1))
+    codes = [d.code for d in scan_events(h).diagnostics]
+    assert "Q001" in codes
+    # wired on by default: the multiset checker raises
+    from jepsen_tpu.analyze.lint import HistoryLintError
+    from jepsen_tpu.checker import basic
+
+    with pytest.raises(HistoryLintError):
+        basic.total_queue().check({}, h)
+
+
+def test_q002_double_ack_is_an_error():
+    h = _qops(("invoke", 0, "enqueue", 1), ("ok", 0, "enqueue", 1),
+              ("invoke", 1, "dequeue", None), ("ok", 1, "dequeue", 1),
+              ("invoke", 1, "ack", 1), ("ok", 1, "ack", 1),
+              ("invoke", 1, "ack", 1), ("ok", 1, "ack", 1))
+    diags = scan_events(h).diagnostics
+    assert [d.code for d in diags].count("Q002") == 1
+    assert all(d.code != "Q001" for d in diags)  # claimed first: legal
+
+
+def test_q003_unexpected_dequeue_warns_but_checker_judges():
+    from jepsen_tpu.checker import basic
+
+    h = _qops(("invoke", 1, "dequeue", None), ("ok", 1, "dequeue", 7))
+    diags = scan_events(h).diagnostics
+    q3 = [d for d in diags if d.code == "Q003"]
+    assert q3 and q3[0].severity == "warning"
+    # the checker still returns its own verdict, warnings attached
+    out = basic.total_queue().check({}, h)
+    assert out["valid"] is False
+    assert any(d["code"] == "Q003" for d in out["lint_warnings"])
+    out2 = basic.queue().check({}, h)
+    assert out2["valid"] is False
+    assert any(d["code"] == "Q003" for d in out2["lint_warnings"])
+
+
+def test_q003_drained_element_never_enqueued_warns():
+    h = _qops(("invoke", 0, "drain", None), ("ok", 0, "drain", [5]))
+    codes = [d.code for d in scan_events(h).diagnostics]
+    assert "Q003" in codes
+
+
+def test_q_codes_respect_the_lint_knob(monkeypatch):
+    from jepsen_tpu.checker import basic
+
+    h = _qops(("invoke", 1, "ack", 9), ("ok", 1, "ack", 9))
+    monkeypatch.setenv("JEPSEN_TPU_LINT", "0")
+    out = basic.total_queue().check({}, h)  # must not raise
+    assert "lint_warnings" not in out
+
+
+def test_clean_queue_history_has_no_q_codes():
+    h = _qops(("invoke", 0, "enqueue", 1), ("ok", 0, "enqueue", 1),
+              ("invoke", 1, "dequeue", None), ("ok", 1, "dequeue", 1),
+              ("invoke", 1, "ack", 1), ("ok", 1, "ack", 1),
+              ("invoke", 2, "drain", None), ("ok", 2, "drain", []))
+    assert not [d for d in scan_events(h).diagnostics
+                if d.code.startswith("Q")]
+    from jepsen_tpu.checker import basic
+
+    assert "lint_warnings" not in basic.total_queue().check({}, h)
+
+
+def test_q_codes_documented():
+    from jepsen_tpu.analyze.lint import ERROR_CODES, QUEUE_CODES
+
+    for code in QUEUE_CODES:
+        assert code in ERROR_CODES

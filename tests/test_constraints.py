@@ -1,0 +1,695 @@
+"""The model-generic constraint compiler (jepsen_tpu/analyze/
+constraints.py).
+
+The verdict-identity acceptance: a 280-history differential fuzz —
+queue (unordered + FIFO), lock, and event-level multiset histories —
+through the constraint prepass vs the engines / the basic multiset
+checkers on every route, audit on.  Plus the decide-fast certificates
+(W007/W008) validated and tamper-tested, the streamed total-queue fold
+route (the seeded replicated-queue acceptance scenario, synthetic),
+batch disposal + explain_batch mirroring, and the must-order prune.
+"""
+
+import os
+import random
+import sys
+
+import pytest
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+from jepsen_tpu import synth  # noqa: E402
+from jepsen_tpu.analyze.audit import audit, audit_events  # noqa: E402
+from jepsen_tpu.analyze.constraints import (  # noqa: E402
+    MultisetFold,
+    analyze_constraints,
+    analyze_prepass,
+    analyze_queue_events,
+    analyze_set_events,
+    family_of,
+)
+from jepsen_tpu.checker import basic  # noqa: E402
+from jepsen_tpu.checker.linear import check_opseq_linear  # noqa: E402
+from jepsen_tpu.checker.seq import check_opseq  # noqa: E402
+from jepsen_tpu.history import (  # noqa: E402
+    Op,
+    encode_ops,
+    info_op,
+    invoke_op,
+    ok_op,
+)
+from jepsen_tpu.models import (  # noqa: E402
+    fifo_queue,
+    mutex,
+    register,
+    unordered_queue,
+)
+
+
+def ops(*specs):
+    mk = {"invoke": invoke_op, "ok": ok_op, "info": info_op}
+    return [mk[t](p, f, v) for t, p, f, v in specs]
+
+
+def _queue_history(i: int, *, fifo: bool):
+    rng = random.Random(9000 + i)
+    h = synth.sim_queue_history(rng, 26, 4,
+                                crash_p=rng.choice([0.0, 0.0, 0.2]),
+                                fifo=fifo)
+    if rng.random() < 0.5:
+        h = (synth.swap_dequeues if rng.random() < 0.5
+             else synth.corrupt_dequeue)(rng, h)
+    return h
+
+
+# ---------------------------------------------------------------------------
+# differential fuzz: queue + lock OpSeq histories through every route
+# ---------------------------------------------------------------------------
+
+
+def test_queue_differential_fuzz_all_routes():
+    """120 queue histories: the prepass-decided verdict must equal the
+    prepass-off engine's, the prepass-on engines must stay
+    verdict-identical, and every decided certificate must audit clean
+    (maybe_audit raises inside the engines with audit=True)."""
+    decided = 0
+    for i in range(120):
+        fifo = i % 2 == 1
+        model = (fifo_queue if fifo else unordered_queue)(33)
+        h = _queue_history(i, fifo=fifo)
+        s = encode_ops(h, model.f_codes)
+        ref = check_opseq(s, model, hb=False, lint=False,
+                          max_configs=200_000)
+        a = analyze_constraints(s, model)
+        if a.decided is not None:
+            decided += 1
+            assert a.decided["valid"] == ref["valid"], \
+                (i, a.stats, ref["valid"])
+            au = audit(s, model, a.decided)
+            assert au["ok"], (i, [str(d) for d in au["diagnostics"]])
+        r = check_opseq(s, model, lint=False, max_configs=200_000,
+                        audit=True)
+        if ref["valid"] != "unknown" and r["valid"] != "unknown":
+            assert r["valid"] == ref["valid"], i
+        if i % 6 == 0:
+            r2 = check_opseq_linear(s, model, lint=False,
+                                    max_configs=200_000, audit=True,
+                                    witness_cap=100_000)
+            if ref["valid"] != "unknown" and r2["valid"] != "unknown":
+                assert r2["valid"] == ref["valid"], i
+    # the class this compiler exists for actually decides
+    assert decided >= 30
+
+
+def test_mutex_differential_fuzz():
+    for i in range(60):
+        rng = random.Random(5000 + i)
+        model = mutex()
+        h = synth.sim_mutex_history(rng, 22, 4,
+                                    crash_p=rng.choice([0.0, 0.0, 0.2]))
+        if rng.random() < 0.5:
+            h = synth.mutate(rng, h)
+        s = encode_ops(h, model.f_codes)
+        ref = check_opseq(s, model, hb=False, lint=False,
+                          max_configs=200_000)
+        a = analyze_constraints(s, model)
+        if a.decided is not None:
+            assert a.decided["valid"] == ref["valid"], (i, a.stats)
+            assert audit(s, model, a.decided)["ok"], i
+        r = check_opseq(s, model, lint=False, max_configs=200_000,
+                        audit=True)
+        if ref["valid"] != "unknown" and r["valid"] != "unknown":
+            assert r["valid"] == ref["valid"], i
+
+
+def test_multiset_event_differential():
+    """100 event-level histories: analyze_queue_events must agree with
+    total_queue exactly, and its evidence must audit (W007)."""
+    for i in range(100):
+        h = _queue_history(1000 + i, fifo=False)
+        post = basic.total_queue().check({}, h)
+        ca = analyze_queue_events(h)
+        assert ca["valid"] == post["valid"], i
+        if ca["valid"] is False:
+            assert ca["evidence"] is not None, i
+            a = audit_events(h, {"valid": False,
+                                 "queue_evidence": ca["evidence"]})
+            assert a["ok"], (i, [str(d) for d in a["diagnostics"]])
+
+
+def test_set_event_differential():
+    rng = random.Random(3)
+    for i in range(24):
+        rng = random.Random(400 + i)
+        n = rng.randrange(4, 16)
+        adds = list(range(n))
+        h = []
+        seen = []
+        for v in adds:
+            h.append(invoke_op(0, "add", v))
+            if rng.random() < 0.15:
+                h.append(info_op(0, "add", v))
+                if rng.random() < 0.5:
+                    seen.append(v)
+            else:
+                h.append(ok_op(0, "add", v))
+                seen.append(v)
+        if rng.random() < 0.4 and seen:
+            seen.remove(rng.choice(seen))  # lose one
+        if rng.random() < 0.3:
+            seen.append(999)  # unexpected member
+        h.append(invoke_op(1, "read", None))
+        h.append(ok_op(1, "read", list(seen)))
+        post = basic.set_checker().check({}, h)
+        ca = analyze_set_events(h)
+        assert ca["valid"] == post["valid"], i
+        if ca["valid"] is False:
+            a = audit_events(h, {"valid": False,
+                                 "queue_evidence": ca["evidence"]})
+            assert a["ok"], (i, [str(d) for d in a["diagnostics"]])
+
+
+# ---------------------------------------------------------------------------
+# decide-fast certificates
+# ---------------------------------------------------------------------------
+
+
+def test_duplicate_delivery_decided_with_w008_certificate():
+    model = unordered_queue(8)
+    h = ops(("invoke", 0, "enqueue", 1), ("ok", 0, "enqueue", 1),
+            ("invoke", 1, "dequeue", None), ("ok", 1, "dequeue", 1),
+            ("invoke", 1, "dequeue", None), ("ok", 1, "dequeue", 1))
+    s = encode_ops(h, model.f_codes)
+    a = analyze_constraints(s, model)
+    assert a.decided is not None and a.decided["valid"] is False
+    assert a.stats["reason"] == "duplicate-delivery"
+    assert "queue_dup" in a.decided
+    au = audit(s, model, a.decided)
+    assert au["ok"] and au["checked"] == "queue_order"
+    assert check_opseq(s, model, hb=False)["valid"] is False
+    assert check_opseq(s, model)["engine"] == "constraint-decide"
+
+
+def test_fifo_inversion_decided_with_w008_certificate():
+    model = fifo_queue(8)
+    h = ops(("invoke", 0, "enqueue", 1), ("ok", 0, "enqueue", 1),
+            ("invoke", 0, "enqueue", 2), ("ok", 0, "enqueue", 2),
+            ("invoke", 1, "dequeue", None), ("ok", 1, "dequeue", 2),
+            ("invoke", 1, "dequeue", None), ("ok", 1, "dequeue", 1))
+    s = encode_ops(h, model.f_codes)
+    a = analyze_constraints(s, model)
+    assert a.decided is not None and a.decided["valid"] is False
+    assert a.stats["reason"] == "fifo-inversion"
+    cyc = a.decided["queue_cycle"]
+    assert [e["kind"] for e in cyc] == ["fifo", "rt"]
+    for i, e in enumerate(cyc):
+        assert e["dst"] == cyc[(i + 1) % len(cyc)]["src"]
+    au = audit(s, model, a.decided)
+    assert au["ok"], [str(d) for d in au["diagnostics"]]
+    assert check_opseq(s, model, hb=False)["valid"] is False
+
+
+def test_impossible_dequeue_decided_with_w007_certificate():
+    model = unordered_queue(8)
+    h = ops(("invoke", 1, "dequeue", None), ("ok", 1, "dequeue", 7))
+    s = encode_ops(h, model.f_codes)
+    a = analyze_constraints(s, model)
+    assert a.decided is not None and a.decided["valid"] is False
+    assert a.stats["reason"] == "impossible-dequeue"
+    au = audit(s, model, a.decided)
+    assert au["ok"] and au["checked"] == "queue_evidence"
+    assert check_opseq(s, model, hb=False)["valid"] is False
+
+
+def test_rf_cycle_decided():
+    model = unordered_queue(8)
+    # dequeue returns 1 and completes BEFORE the only enqueue of 1
+    # invokes: the read-from edge closes a cycle with real time
+    h = ops(("invoke", 1, "dequeue", None), ("ok", 1, "dequeue", 1),
+            ("invoke", 0, "enqueue", 1), ("ok", 0, "enqueue", 1),
+            ("invoke", 1, "dequeue", None), ("ok", 1, "dequeue", 1))
+    s = encode_ops(h, model.f_codes)
+    a = analyze_constraints(s, model)
+    assert a.decided is not None and a.decided["valid"] is False
+    assert a.stats["reason"] in ("rf-cycle", "duplicate-delivery")
+    assert audit(s, model, a.decided)["ok"]
+    assert check_opseq(s, model, hb=False)["valid"] is False
+
+
+def test_decide_valid_constructive_witness():
+    model = unordered_queue(33)
+    rng = random.Random(11)
+    h = synth.sim_queue_history(rng, 24, 4, crash_p=0.0)
+    s = encode_ops(h, model.f_codes)
+    a = analyze_constraints(s, model)
+    assert a.decided is not None and a.decided["valid"] is True
+    assert a.stats["reason"] == "completion-schedule"
+    au = audit(s, model, a.decided)
+    assert au["ok"] and au["checked"] == "linearization"
+    r = check_opseq(s, model)
+    assert r["valid"] is True and r["configs"] == 0
+    assert r["engine"] == "constraint-decide"
+
+
+def test_lock_overhold_decided():
+    model = mutex()
+    h = ops(("invoke", 0, "acquire", None), ("ok", 0, "acquire", None),
+            ("invoke", 1, "acquire", None), ("ok", 1, "acquire", None))
+    s = encode_ops(h, model.f_codes)
+    a = analyze_constraints(s, model)
+    assert a.decided is not None and a.decided["valid"] is False
+    assert a.stats["reason"] == "lock-overhold"
+    assert audit(s, model, a.decided)["ok"]
+    assert check_opseq(s, model, hb=False)["valid"] is False
+
+
+def test_lock_release_unheld_decided():
+    model = mutex()
+    h = ops(("invoke", 0, "release", None), ("ok", 0, "release", None))
+    s = encode_ops(h, model.f_codes)
+    a = analyze_constraints(s, model)
+    assert a.decided is not None and a.decided["valid"] is False
+    assert a.stats["reason"] == "release-unheld"
+    assert check_opseq(s, model, hb=False)["valid"] is False
+
+
+def test_nonempty_init_state_is_out_of_scope():
+    """A segment fold's carried state seeds the queue/lock: the
+    empty-start algebra must cede rather than mis-decide."""
+    from dataclasses import replace as _r
+
+    from jepsen_tpu.models import Q_EMPTY
+
+    model = unordered_queue(4)
+    seeded = _r(model, init=(5, Q_EMPTY, Q_EMPTY, Q_EMPTY))
+    h = ops(("invoke", 1, "dequeue", None), ("ok", 1, "dequeue", 5))
+    s = encode_ops(h, seeded.f_codes)
+    a = analyze_constraints(s, seeded)
+    assert not a.applies and a.decided is None
+    # and the engine (with the prepass on) gets the right answer
+    assert check_opseq(s, seeded)["valid"] is True
+    locked = _r(mutex(), init=(1,))
+    h2 = ops(("invoke", 0, "release", None), ("ok", 0, "release", None))
+    s2 = encode_ops(h2, locked.f_codes)
+    a2 = analyze_constraints(s2, locked)
+    assert not a2.applies
+    assert check_opseq(s2, locked)["valid"] is True
+
+
+# ---------------------------------------------------------------------------
+# tamper tests: W007 / W008
+# ---------------------------------------------------------------------------
+
+
+def test_w008_tampered_dup_certificate():
+    model = unordered_queue(8)
+    h = ops(("invoke", 0, "enqueue", 1), ("ok", 0, "enqueue", 1),
+            ("invoke", 1, "dequeue", None), ("ok", 1, "dequeue", 1),
+            ("invoke", 1, "dequeue", None), ("ok", 1, "dequeue", 1))
+    s = encode_ops(h, model.f_codes)
+    a = analyze_constraints(s, model)
+    cert = dict(a.decided)
+    # drop a dequeue row: the set is no longer complete
+    cert["queue_dup"] = {"dequeues": cert["queue_dup"]["dequeues"][:1],
+                         "enqueues": cert["queue_dup"]["enqueues"]}
+    au = audit(s, model, cert)
+    assert not au["ok"] and "W008" in au["codes"]
+    # out-of-range row -> W001
+    cert2 = dict(a.decided)
+    cert2["queue_dup"] = {"dequeues": [99], "enqueues": []}
+    au2 = audit(s, model, cert2)
+    assert not au2["ok"] and "W001" in au2["codes"]
+
+
+def test_w008_tampered_fifo_certificate():
+    model = fifo_queue(8)
+    h = ops(("invoke", 0, "enqueue", 1), ("ok", 0, "enqueue", 1),
+            ("invoke", 0, "enqueue", 2), ("ok", 0, "enqueue", 2),
+            ("invoke", 1, "dequeue", None), ("ok", 1, "dequeue", 2),
+            ("invoke", 1, "dequeue", None), ("ok", 1, "dequeue", 1))
+    s = encode_ops(h, model.f_codes)
+    a = analyze_constraints(s, model)
+    cyc = [dict(e) for e in a.decided["queue_cycle"]]
+    # swap the via pair: the enqueue order no longer justifies FIFO
+    fifo_edge = next(e for e in cyc if e["kind"] == "fifo")
+    fifo_edge["via"] = list(reversed(fifo_edge["via"]))
+    au = audit(s, model, {"valid": False, "queue_cycle": cyc})
+    assert not au["ok"] and "W008" in au["codes"]
+    # break the chain
+    cyc2 = [dict(e) for e in a.decided["queue_cycle"]]
+    cyc2[0]["dst"] = cyc2[0]["src"]
+    au2 = audit(s, model, {"valid": False, "queue_cycle": cyc2})
+    assert not au2["ok"] and "W008" in au2["codes"]
+
+
+def test_w007_tampered_event_evidence():
+    h = ops(("invoke", 0, "enqueue", 1), ("ok", 0, "enqueue", 1),
+            ("invoke", 1, "dequeue", None), ("ok", 1, "dequeue", 1),
+            ("invoke", 0, "enqueue", 2), ("ok", 0, "enqueue", 2),
+            ("invoke", 2, "drain", None), ("ok", 2, "drain", []))
+    # value 2 is genuinely lost; claim value 1's enqueue instead
+    bad = {"valid": False,
+           "queue_evidence": {"family": "queue",
+                              "kind": "lost-acked-enqueue",
+                              "rows": [1], "values": ["1"]}}
+    a = audit_events(h, bad)
+    assert not a["ok"] and "W007" in a["codes"]
+    good = {"valid": False,
+            "queue_evidence": {"family": "queue",
+                               "kind": "lost-acked-enqueue",
+                               "rows": [5], "values": ["2"]}}
+    assert audit_events(h, good)["ok"]
+    # wrong kind on the same rows
+    wrong = {"valid": False,
+             "queue_evidence": {"family": "queue",
+                                "kind": "unexpected-dequeue",
+                                "rows": [5]}}
+    assert not audit_events(h, wrong)["ok"]
+
+
+def test_w007_tampered_opseq_evidence():
+    model = unordered_queue(8)
+    h = ops(("invoke", 0, "enqueue", 3), ("ok", 0, "enqueue", 3),
+            ("invoke", 1, "dequeue", None), ("ok", 1, "dequeue", 7))
+    s = encode_ops(h, model.f_codes)
+    a = analyze_constraints(s, model)
+    cert = dict(a.decided)
+    # point the evidence at the legal enqueue row instead
+    cert["queue_evidence"] = {"family": "queue",
+                              "kind": "unexpected-dequeue", "rows": [0]}
+    del cert["final_ops"]
+    au = audit(s, model, cert)
+    assert not au["ok"] and "W007" in au["codes"]
+
+
+# ---------------------------------------------------------------------------
+# the prune + batch disposal mirror
+# ---------------------------------------------------------------------------
+
+
+def test_undecided_queue_emits_rf_edges_and_stays_identical():
+    model = unordered_queue(33)
+    rng = random.Random(21)
+    # crashes push the history out of the decide-valid class but keep
+    # the rf edges: the engines must agree under the mask
+    for i in range(12):
+        rng = random.Random(600 + i)
+        h = synth.sim_queue_history(rng, 24, 4, crash_p=0.3)
+        s = encode_ops(h, model.f_codes)
+        a = analyze_constraints(s, model)
+        if a.decided is not None:
+            continue
+        ref = check_opseq(s, model, hb=False, lint=False,
+                          max_configs=200_000)
+        r = check_opseq(s, model, lint=False, max_configs=200_000)
+        if "unknown" not in (ref["valid"], r["valid"]):
+            assert r["valid"] == ref["valid"], i
+        if a.must_pred:
+            assert a.stats["must_edges"] > 0
+
+
+def test_batch_disposal_and_explain_batch_mirror():
+    from jepsen_tpu.analyze.plan import explain_batch
+    from jepsen_tpu.checker.linearizable import search_batch
+
+    model = unordered_queue(33)
+    seqs = []
+    for i in range(6):
+        rng = random.Random(700 + i)
+        h = synth.sim_queue_history(rng, 20, 4, crash_p=0.0)
+        if i % 2:
+            h = synth.corrupt_dequeue(rng, h)
+        seqs.append(encode_ops(h, model.f_codes))
+    rs = search_batch(seqs, model, bucket=True, budget=100_000,
+                      lint=False)
+    n_cd = sum(1 for r in rs if r.get("engine") == "constraint-decide")
+    assert n_cd >= 1
+    stats = rs[0].get("bucket_batch")
+    plan = explain_batch(seqs, model)
+    assert plan["constraint_decided"] == n_cd if stats is None else True
+    if stats is not None:
+        assert stats["constraint_decided"] == \
+            plan["constraint_decided"]
+        assert stats["hb_decided"] == plan["hb_decided"] == 0
+
+
+def test_explain_constraints_block():
+    from jepsen_tpu.analyze.plan import explain, render_plan
+
+    model = unordered_queue(33)
+    rng = random.Random(31)
+    h = synth.sim_queue_history(rng, 20, 4)
+    plan = explain(h, model)
+    cs = plan["constraints"]
+    assert cs["applies"] and cs["family"] == "queue"
+    assert cs["stream_fold"] == {"eligible": True,
+                                 "route": "total-queue"}
+    assert "constraints[queue]" in render_plan(plan)
+    # register models keep the hb block and an explicit n/a here
+    rplan = explain(synth.sim_register_history(random.Random(1),
+                                               cas=False),
+                    register(0))
+    assert rplan["constraints"]["applies"] is False
+
+
+# ---------------------------------------------------------------------------
+# the streamed total-queue fold route
+# ---------------------------------------------------------------------------
+
+
+def _feed(sink, hist, op):
+    hist.append(op)
+    sink.ingest(op)
+
+
+def test_total_fold_stream_unexpected_flips_mid_stream():
+    from jepsen_tpu.stream.checker import TotalFoldStream
+
+    sink = TotalFoldStream("total-queue")
+    hist = []
+    _feed(sink, hist, invoke_op(0, "enqueue", 1))
+    _feed(sink, hist, ok_op(0, "enqueue", 1))
+    _feed(sink, hist, invoke_op(1, "dequeue", None))
+    _feed(sink, hist, ok_op(1, "dequeue", 777))
+    assert sink.verdict()["status"] == "invalid"
+    flip_at = sink.verdict()["invalid_event"]
+    _feed(sink, hist, invoke_op(1, "dequeue", None))
+    _feed(sink, hist, ok_op(1, "dequeue", 1))
+    final = sink.finalize(audit=True)
+    assert final["valid"] is False
+    assert final["stream"]["invalid_event"] == flip_at == 3
+    assert final["queue_evidence"]["kind"] == "unexpected-dequeue"
+    assert final["audit"]["ok"]
+    # bit-identical to the post-hoc multiset checker
+    assert basic.total_queue().check({}, hist)["valid"] is False
+
+
+def test_total_fold_stream_valid_history_stays_valid():
+    from jepsen_tpu.stream.checker import TotalFoldStream
+
+    sink = TotalFoldStream("total-queue")
+    hist = []
+    for j in range(8):
+        _feed(sink, hist, invoke_op(0, "enqueue", j))
+        _feed(sink, hist, ok_op(0, "enqueue", j))
+    _feed(sink, hist, invoke_op(1, "drain", None))
+    _feed(sink, hist, ok_op(1, "drain", list(range(8))))
+    assert sink.verdict()["status"] == "valid-so-far"
+    final = sink.finalize(audit=True)
+    assert final["valid"] is True
+    assert final["stream"]["invalid_event"] is None
+    assert basic.total_queue().check({}, hist)["valid"] is True
+
+
+def test_total_fold_stream_set_family():
+    from jepsen_tpu.stream.checker import TotalFoldStream
+
+    sink = TotalFoldStream("set")
+    hist = []
+    for j in range(4):
+        _feed(sink, hist, invoke_op(0, "add", j))
+        _feed(sink, hist, ok_op(0, "add", j))
+    _feed(sink, hist, invoke_op(1, "read", None))
+    _feed(sink, hist, ok_op(1, "read", [0, 1, 3]))  # 2 lost
+    assert sink.verdict()["status"] == "invalid"
+    final = sink.finalize(audit=True)
+    assert final["valid"] is False
+    assert final["queue_evidence"]["kind"] == "lost-acked-add"
+    assert final["audit"]["ok"]
+
+
+def test_seeded_replicated_queue_cell_grades_streamed():
+    """The acceptance scenario, synthetic: a bridge-election
+    lost-acked-enqueue history (acked ADDJOBs missing from the final
+    drain) through the fold sink + the campaign's detection grader —
+    detection.at == "streamed" with recorded latency, final verdict
+    bit-identical to the post-hoc multiset checker, W007 certificate
+    passing analyze/audit.py."""
+    from dataclasses import replace as _r
+
+    from jepsen_tpu.live.campaign import _detection
+    from jepsen_tpu.stream.checker import TotalFoldStream
+
+    sink = TotalFoldStream("total-queue")
+    hist = []
+    t = 0
+
+    def tfeed(op):
+        nonlocal t
+        t += 100_000_000
+        _feed(sink, hist, _r(op, time=t))
+
+    for j in range(30):
+        tfeed(invoke_op(j % 4, "enqueue", j))
+        tfeed(ok_op(j % 4, "enqueue", j))
+    # the bridge grudge lands (link partition nemesis journals :info)
+    tfeed(info_op("nemesis", "start", None))
+    tfeed(info_op("nemesis", "start", ["n1", "n2"]))
+    # a cut-off replica wins the election; the final drain comes short
+    tfeed(invoke_op(0, "drain", None))
+    tfeed(ok_op(0, "drain", [j for j in range(30) if j not in (4, 9)]))
+    final = sink.finalize(audit=True)
+    post = basic.total_queue().check({}, [op for op in hist
+                                          if isinstance(op.process,
+                                                        int)])
+    assert final["valid"] is False and post["valid"] is False
+    assert sorted(post["lost"]) == [4, 9]
+    assert final["queue_evidence"]["kind"] == "lost-acked-enqueue"
+    assert final["audit"]["ok"]  # the W007 certificate passes audit
+    test = {"history": hist, "stream_results": final, "results": post}
+    det = _detection(test, "link-bridge")
+    assert det["at"] == "streamed"
+    assert det["fold"] == "total-queue"
+    assert det["invalid_event"] == len(hist) - 1 - 0  # the drain event
+    assert det["latency_events"] >= 0 and "latency_s" in det
+    assert det["fault_event"] < det["invalid_event"]
+
+
+def test_multiset_fold_lost_waits_for_drain_quiescence():
+    fold = MultisetFold("total-queue")
+    i = 0
+
+    def step(op):
+        nonlocal i
+        out = fold.step(op, i)
+        i += 1
+        return out
+
+    assert step(invoke_op(0, "enqueue", 1)) is None
+    assert step(ok_op(0, "enqueue", 1)) is None
+    # no drain yet: a missing value is NOT lost mid-run
+    assert step(invoke_op(1, "enqueue", 2)) is None
+    assert step(ok_op(1, "enqueue", 2)) is None
+    assert step(invoke_op(0, "drain", None)) is None
+    flip = step(ok_op(0, "drain", [1]))
+    assert flip is not None and flip["kind"] == "lost-acked-enqueue"
+    assert flip["values"] == ["2"]
+
+
+def test_prepare_test_installs_fold_sink():
+    from jepsen_tpu import core
+
+    test = core.prepare_test({"stream": True,
+                              "stream_fold": "total-queue"})
+    sink = test.get("__stream_check__")
+    assert sink is not None
+    assert type(sink).__name__ == "TotalFoldStream"
+    sink.close()
+    # model-less with no fold route: post-hoc only, as before
+    test2 = core.prepare_test({"stream": True})
+    assert test2.get("__stream_check__") is None
+
+
+def test_queue_backends_declare_fold_route():
+    from jepsen_tpu.live.backend import FAMILIES
+
+    for fam in ("queue", "replicated-queue"):
+        w = FAMILIES[fam].workload({})
+        assert w.get("stream_fold") == "total-queue", fam
+        t = FAMILIES[fam].build_test({"data_root": "/tmp/x"})
+        assert t.get("stream_fold") == "total-queue", fam
+
+
+def test_family_dispatch():
+    assert family_of(unordered_queue(8)) == "queue"
+    assert family_of(fifo_queue(8)) == "fifo-queue"
+    assert family_of(mutex()) == "lock"
+    assert family_of(register(0)) is None
+    # analyze_prepass routes registers to the HB solver
+    rng = random.Random(2)
+    h = synth.register_history(rng, n_ops=20, n_procs=3, cas=False,
+                               unique_writes=True)
+    s = encode_ops(h, register(0).f_codes)
+    a = analyze_prepass(s, register(0))
+    assert a.stats.get("solver") != "constraints"
+
+
+def test_multiset_fold_no_false_flip_after_drain():
+    """An enqueue acked AFTER a drain must not be flagged lost at its
+    own completion (the lost rule runs only AT drain events)."""
+    from jepsen_tpu.stream.checker import TotalFoldStream
+
+    sink = TotalFoldStream("total-queue")
+    hist = []
+    _feed(sink, hist, invoke_op(0, "drain", None))
+    _feed(sink, hist, ok_op(0, "drain", []))
+    _feed(sink, hist, invoke_op(1, "enqueue", 1))
+    _feed(sink, hist, ok_op(1, "enqueue", 1))
+    assert sink.verdict()["status"] != "invalid"
+    _feed(sink, hist, invoke_op(1, "dequeue", None))
+    _feed(sink, hist, ok_op(1, "dequeue", 1))
+    final = sink.finalize(audit=True)
+    assert final["valid"] is True
+    assert basic.total_queue().check({}, hist)["valid"] is True
+    # same for the set family: an add acked after the read is not lost
+    sink2 = TotalFoldStream("set")
+    h2 = []
+    _feed(sink2, h2, invoke_op(0, "add", 1))
+    _feed(sink2, h2, ok_op(0, "add", 1))
+    _feed(sink2, h2, invoke_op(1, "read", None))
+    _feed(sink2, h2, ok_op(1, "read", [1]))
+    _feed(sink2, h2, invoke_op(0, "add", 2))
+    _feed(sink2, h2, ok_op(0, "add", 2))
+    assert sink2.verdict()["status"] != "invalid"
+
+
+def test_total_fold_final_certificate_matches_final_verdict():
+    """A stale provisional flip (a value a LATER drain delivered) must
+    not leak into the final certificate: finalize recomputes the
+    evidence against the whole history, and the W007 audit passes."""
+    from jepsen_tpu.stream.checker import TotalFoldStream
+
+    sink = TotalFoldStream("total-queue")
+    hist = []
+    _feed(sink, hist, invoke_op(0, "enqueue", 1))
+    _feed(sink, hist, ok_op(0, "enqueue", 1))
+    _feed(sink, hist, invoke_op(1, "enqueue", 2))
+    _feed(sink, hist, ok_op(1, "enqueue", 2))
+    # first drain comes up empty at a quiescent point: provisional
+    # flip names BOTH values
+    _feed(sink, hist, invoke_op(0, "drain", None))
+    _feed(sink, hist, ok_op(0, "drain", []))
+    assert sink.verdict()["status"] == "invalid"
+    # a second drain delivers value 1: only value 2 is really lost
+    _feed(sink, hist, invoke_op(1, "drain", None))
+    _feed(sink, hist, ok_op(1, "drain", [1]))
+    final = sink.finalize(audit=True)  # audit raises on a bad cert
+    assert final["valid"] is False
+    assert final["queue_evidence"]["values"] == ["2"]
+    assert final["audit"]["ok"]
+
+
+def test_w007_duplicate_payload_lost_uses_counts():
+    """Multiset semantics: a payload enqueued :ok twice with one copy
+    delivered is still lost — the audit must count, not set-check."""
+    h = ops(("invoke", 0, "enqueue", 1), ("ok", 0, "enqueue", 1),
+            ("invoke", 1, "enqueue", 1), ("ok", 1, "enqueue", 1),
+            ("invoke", 2, "dequeue", None), ("ok", 2, "dequeue", 1),
+            ("invoke", 0, "drain", None), ("ok", 0, "drain", []))
+    post = basic.total_queue().check({}, h)
+    ca = analyze_queue_events(h)
+    assert post["valid"] is False and ca["valid"] is False
+    a = audit_events(h, {"valid": False,
+                         "queue_evidence": ca["evidence"]})
+    assert a["ok"], [str(d) for d in a["diagnostics"]]

@@ -257,3 +257,120 @@ def test_queue_replay_catches_lost_enqueue(tmp_path):
 
     assert fuzz_tool.corpus_replay(
         corpus.corpus_dir(str(tmp_path))) == 0  # invalid == banked
+
+
+# ---------------------------------------------------------------------------
+# bank-time shrinking: the ddmin minimal repro alongside the full entry
+# ---------------------------------------------------------------------------
+
+
+def _lost_queue_history(n_jobs=14, lost=(3,)):
+    from jepsen_tpu.history import invoke_op, ok_op
+
+    h = []
+    for j in range(n_jobs):
+        h.append(invoke_op(j % 3, "enqueue", j))
+        h.append(ok_op(j % 3, "enqueue", j))
+    h.append(invoke_op(0, "drain", None))
+    h.append(ok_op(0, "drain",
+                   [j for j in range(n_jobs) if j not in lost]))
+    return h
+
+
+def test_bank_time_ddmin_attaches_minimal_repro(tmp_path):
+    from jepsen_tpu.history import Op
+    from jepsen_tpu.live import corpus
+
+    h = _lost_queue_history()
+    out = corpus.bank_cell(
+        {"model": None, "history": h},
+        {"family": "queue", "nemesis": "link-bridge", "valid": False},
+        base=str(tmp_path))
+    assert out["banked"] == 1
+    entry = corpus.load_pool(corpus.corpus_dir(str(tmp_path)))[0]
+    assert entry["valid"] is False
+    mi = entry.get("minimal")
+    assert mi is not None
+    assert mi["n_ops"] < entry["n_ops"]
+    # the minimal repro still reproduces the verdict on its route
+    mops = [Op.from_dict(d) for d in mi["ops"]]
+    assert corpus.replay_queue(mops)["valid"] is False
+    # and it is tiny: the lost enqueue pair plus the drain pair
+    assert mi["n_ops"] <= 6
+
+
+def test_bank_time_ddmin_skips_small_and_valid_entries(tmp_path):
+    from jepsen_tpu.history import invoke_op, ok_op
+    from jepsen_tpu.live import corpus
+
+    # invalid but already <= 10 ops: left alone
+    h = [invoke_op(0, "enqueue", 1), ok_op(0, "enqueue", 1),
+         invoke_op(0, "drain", None), ok_op(0, "drain", [])]
+    corpus.bank_cell(
+        {"model": None, "history": h},
+        {"family": "queue", "nemesis": "x", "valid": False},
+        base=str(tmp_path))
+    # valid and long: no shrink either
+    h2 = _lost_queue_history(lost=())
+    corpus.bank_cell(
+        {"model": None, "history": h2},
+        {"family": "queue", "nemesis": "x", "valid": True},
+        base=str(tmp_path))
+    pool = corpus.load_pool(corpus.corpus_dir(str(tmp_path)))
+    assert all("minimal" not in e for e in pool)
+
+
+def test_bank_time_ddmin_engine_route(tmp_path):
+    from jepsen_tpu.history import Op, encode_ops
+    from jepsen_tpu.live import corpus
+    from jepsen_tpu.models import register
+    from jepsen_tpu.synth import corrupt_read, register_history
+
+    rng = random.Random(7)
+    h = register_history(rng, n_ops=24, n_procs=3, cas=False,
+                         unique_writes=True)
+    h = corrupt_read(rng, h, at=0.5)
+    out = corpus.bank_cell(
+        {"model": register(0), "history": h},
+        {"family": "kv", "nemesis": "kill-restart", "valid": False},
+        base=str(tmp_path))
+    assert out["banked"] == 1
+    entry = corpus.load_pool(corpus.corpus_dir(str(tmp_path)))[0]
+    mi = entry.get("minimal")
+    assert mi is not None and mi["n_ops"] < entry["n_ops"]
+    from jepsen_tpu.checker.seq import check_opseq
+
+    mops = [Op.from_dict(d) for d in mi["ops"]]
+    s = encode_ops(mops, register(0).f_codes)
+    assert check_opseq(s, register(0),
+                       max_configs=200_000)["valid"] is False
+
+
+def test_corpus_replay_asserts_minimal_repro(tmp_path):
+    """fuzz --corpus teeth: a minimal repro that no longer reproduces
+    fails the replay."""
+    import json
+
+    import fuzz
+    from jepsen_tpu.live import corpus
+
+    h = _lost_queue_history()
+    corpus.bank_cell(
+        {"model": None, "history": h},
+        {"family": "queue", "nemesis": "link-bridge", "valid": False},
+        base=str(tmp_path))
+    d = corpus.corpus_dir(str(tmp_path))
+    assert fuzz.corpus_replay(d) == 0
+    # tamper: make the stored minimal repro a VALID history
+    pool = corpus.load_pool(d)
+    pool[0]["minimal"]["ops"] = [
+        {"process": 0, "type": "invoke", "f": "enqueue", "value": 1},
+        {"process": 0, "type": "ok", "f": "enqueue", "value": 1},
+        {"process": 1, "type": "invoke", "f": "dequeue",
+         "value": None},
+        {"process": 1, "type": "ok", "f": "dequeue", "value": 1},
+    ]
+    with open(os.path.join(d, "pool.jsonl"), "w") as f:
+        for e in pool:
+            f.write(json.dumps(e) + "\n")
+    assert fuzz.corpus_replay(d) == 1

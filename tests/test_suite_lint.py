@@ -447,3 +447,71 @@ def test_fixed_suites_stay_clean(fname):
     errors = [d for ds in findings.values() for d in ds
               if d.severity == "error"]
     assert errors == []
+
+
+# ---------------------------------------------------------------------------
+# tools/lint_suites.py --json exit-code coverage (B fixtures): the CLI
+# contract CI and scripts depend on — 1 on any error-severity finding,
+# 0 on warning-only/clean, with the finding visible in the JSON payload
+# ---------------------------------------------------------------------------
+
+
+def _run_lint_json(*paths):
+    out = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "lint_suites.py"),
+         "--json", *map(str, paths)],
+        capture_output=True, text=True, cwd=REPO)
+    return out.returncode, json.loads(out.stdout)
+
+
+def test_lint_suites_json_exit_1_on_b_code_fixture(tmp_path):
+    live = tmp_path / "live"
+    live.mkdir()
+    bad = live / "bad_backend.py"
+    bad.write_text(
+        "import os\n"
+        "def journal(path, line):\n"
+        "    tmp = path + '.tmp'\n"
+        "    with open(tmp, 'w') as f:\n"
+        "        f.write(line)\n"
+        "    os.replace(tmp, path)\n")
+    rc, payload = _run_lint_json(bad)
+    assert rc == 1
+    assert payload["errors"] >= 1
+    found = {d["code"] for ds in payload["files"].values() for d in ds}
+    assert "B003" in found
+
+
+def test_lint_suites_json_exit_0_on_clean_live_fixture(tmp_path):
+    live = tmp_path / "live"
+    live.mkdir()
+    clean = live / "ok_backend.py"
+    clean.write_text(
+        "import os\n"
+        "def journal(path, line):\n"
+        "    tmp = path + '.tmp'\n"
+        "    with open(tmp, 'w') as f:\n"
+        "        f.write(line)\n"
+        "        f.flush()\n"
+        "        os.fsync(f.fileno())\n"
+        "    os.replace(tmp, path)\n")
+    rc, payload = _run_lint_json(clean)
+    assert rc == 0
+    assert payload["errors"] == 0
+
+
+def test_lint_suites_json_exit_1_on_b002_fixture(tmp_path):
+    live = tmp_path / "live"
+    live.mkdir()
+    bad = live / "swallow_backend.py"
+    bad.write_text(
+        "from dataclasses import replace\n"
+        "def probe(op):\n"
+        "    try:\n"
+        "        return do(op)\n"
+        "    except Exception:\n"
+        "        return replace(op, type='fail')\n")
+    rc, payload = _run_lint_json(bad)
+    assert rc == 1
+    found = {d["code"] for ds in payload["files"].values() for d in ds}
+    assert "B002" in found

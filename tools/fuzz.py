@@ -230,6 +230,25 @@ def corpus_replay(pool_dir: str, *, audit: bool = True,
             if e.get("routes") == "queue":
                 r = corpus_mod.replay_queue(ops)
                 verdicts = {"total-queue": r["valid"]}
+                # the static constraint compiler's event-level multiset
+                # analysis joins the parity set: same verdict, with
+                # W007-auditable evidence rows on invalid
+                from jepsen_tpu.analyze.constraints import \
+                    analyze_queue_events
+
+                ca = analyze_queue_events(ops)
+                verdicts["constraints"] = ca["valid"]
+                if ca["valid"] is False and ca.get("evidence"):
+                    from jepsen_tpu.analyze.audit import audit_events
+
+                    a = audit_events(ops, {
+                        "valid": False, "queue_evidence": ca["evidence"]})
+                    if not a["ok"]:
+                        print(f"CORPUS AUDIT FAILURE {label}: "
+                              f"{[str(d) for d in a['diagnostics']]}",
+                              file=sys.stderr)
+                        failures += 1
+                        continue
                 results = []
             else:
                 model = corpus_mod.entry_model(e)
@@ -279,6 +298,34 @@ def corpus_replay(pool_dir: str, *, audit: bool = True,
                   file=sys.stderr)
             failures += 1
             continue
+        mi = e.get("minimal")
+        if mi:
+            # bank-time ddmin contract: the stored minimal repro must
+            # still reproduce the invalid verdict on its route — a
+            # minimal core that stopped failing is a checker (or
+            # shrinker) regression
+            mops = [Op.from_dict(d) for d in mi["ops"]]
+            try:
+                if e.get("routes") == "queue":
+                    mv = corpus_mod.replay_queue(mops)["valid"]
+                else:
+                    m2 = corpus_mod.entry_model(e)
+                    ms = encode_ops(mops, m2.f_codes)
+                    mv = oracle.check_opseq(
+                        ms, m2, max_configs=ORACLE_CAP)["valid"]
+            except Exception as exc:  # noqa: BLE001
+                print(f"CORPUS MINIMAL FAILURE {label}: replay "
+                      f"crashed: {type(exc).__name__}: {exc}",
+                      file=sys.stderr)
+                failures += 1
+                continue
+            if mv is not False:
+                print(f"CORPUS MINIMAL FAILURE {label}: the banked "
+                      f"{mi['n_ops']}-op minimal repro no longer "
+                      f"reproduces invalid (got {mv!r})",
+                      file=sys.stderr)
+                failures += 1
+                continue
         if audit:
             bad = []
             for engine, s_, m_, r_ in results:

@@ -79,6 +79,12 @@ from .constraints import (  # noqa: F401
     analyze_set_events,
     family_of,
 )
+from .dpor import (  # noqa: F401
+    SleepSets,
+    dpor_enabled,
+    duplicate_op_edges,
+    resolve_dpor,
+)
 from .hb import (  # noqa: F401
     HBAnalysis,
     analyze_hb,

@@ -1,0 +1,406 @@
+"""Dynamic partial-order reduction — the runtime half of state-space
+reduction, phase 2.
+
+PR 12/13 built the STATIC half: the happens-before order-solver
+(analyze/hb.py) and the model-generic constraint compiler
+(analyze/constraints.py) decide easy histories outright and hand the
+engines a must-order prune computed once before any search.  This
+module adds the three reductions that live AT runtime, in the spirit of
+Parsimonious Optimal DPOR (arXiv:2405.11128) — bound what commuting
+operations can do instead of enumerating their interleavings — and of
+GPUexplore's cheap on-chip filtering (arXiv:1801.05857):
+
+**Duplicate-op canonical edges** (static in cost, dynamic in reach):
+two rows with IDENTICAL content — same ``(f, v1, v2)`` and the same
+``ok`` flag — are fully interchangeable in any linearization, because
+swapping their LABELS leaves the op-content sequence unchanged.  When
+additionally their intervals form a staircase (``inv_a <= inv_b`` and
+``ret_a <= ret_b``), forcing a before b is exchange-safe:
+
+    Given any valid linearization with b at position i and a at a
+    later position j, relabel: a takes position i, b takes j.  Model
+    legality is untouched (identical content, identical sequence).
+    Real time holds too: a at i needs ``ret_a >= max_inv(before i)``,
+    which follows from a's own validity at j (``ret_a >= max_inv
+    (before j) >= max_inv(before i)``); b at j needs ``ret_b >=
+    max_inv'(before j)``, and the swap only replaced ``inv_b`` by the
+    smaller ``inv_a`` among those, so ``ret_b >= ret_a >= max_inv
+    (before j) >= max_inv'(before j)``.  Intermediate positions only
+    see constraints relax.
+
+  This is sound for EVERY model family (content equality is
+  model-agnostic) and covers exactly what the HB solver's canonical
+  read-order cannot: duplicate writes and cas rows on ``tainted``
+  (non-unique-writes) keys, duplicate enqueues, duplicate mutex
+  acquires.  The edges merge into the same must-order predecessor map
+  the engines already consume — host DFS, `linear` frames, and (new
+  in this PR) the device kernels' ``expand_mask`` planes.
+
+**Dynamic sleep sets** (the host DFS): at each configuration, after a
+candidate's subtree is fully explored, later siblings carry it in a
+*sleep set* — provided the pair COMMUTES at the concrete state
+(``step(step(s,a),b) == step(step(s,b),a)``, both-illegal counting as
+equal).  A sleeping op is skipped as the immediate next linearization:
+its continuation was already covered through the sibling explored
+first (state equality from commutation makes the coverage exact, and
+coverage is state-based, so it propagates).  Sleep sets compose with
+the visited memo through a per-state ANTICHAIN of sleep masks: a
+revisit is skipped only when some prior visit explored with a SUBSET
+sleep set — the classic state-caching fix (Godefroid), the same
+subset-antichain trick `checker/linear.py` uses for crash masks.
+Observed commutativity is tested at runtime against the model's own
+``pystep`` (memoized; reads and identical rows short-circuit
+statically), so cas/mutex pairs prune exactly where their concrete
+states allow.
+
+**Canonical-state frontier dedup** lives in
+``decompose/canonical.py`` (:func:`~jepsen_tpu.decompose.canonical.
+dead_value_cutoffs`) and in the engines: register-family states whose
+value no remaining op compares against are observation-equivalent, so
+they rewrite to one DEAD token and collapse in the level dedup —
+symmetric interleavings that differ only in which dead value they
+left behind merge BEFORE expansion instead of being expanded apart.
+
+Knob family: default ON; ``dpor=False`` per call on every wired
+engine, ``JEPSEN_TPU_DPOR=0`` fleet-wide, ``--no-dpor`` on the CLI.
+Verdict-identical by construction: duplicate-op edges are exchange-
+safe, sleep sets only skip covered work, and the dead-token rewrite
+is an exact bisimulation quotient — proven by the all-route
+differential fuzz in tests/test_dpor.py.
+"""
+
+from __future__ import annotations
+
+import os
+
+import numpy as np
+
+from ..history import OpSeq
+from ..models import R_READ, ModelSpec
+from ..obs.metrics import REGISTRY
+
+_M_SLEEP = REGISTRY.counter(
+    "jtpu_dpor_sleep_prunes_total",
+    "Host-DFS candidates skipped because they were sleeping "
+    "(covered by an already-explored commuting sibling)")
+_M_DEDUP = REGISTRY.counter(
+    "jtpu_dpor_dedup_total",
+    "Canonical-state frontier dedup events, by site/kind "
+    "(rewrite = a successor state collapsed onto the dead token; "
+    "hit = a rewritten config merged with an existing frontier row)",
+    ("site", "event"))
+_M_MASK = REGISTRY.counter(
+    "jtpu_dpor_mask_total",
+    "Must-order mask effects, by site (lanes/candidates killed on "
+    "host frames and the DFS; masked rows shipped to device planes)",
+    ("site",))
+_M_EDGES = REGISTRY.counter(
+    "jtpu_dpor_dup_edges_total",
+    "Duplicate-op canonical must-order edges inferred")
+
+#: per-dst cap on duplicate-op chain edges (mirrors hb.EDGE_CAP_*)
+DUP_EDGE_CAP_FACTOR = 2
+DUP_EDGE_CAP_MIN = 128
+
+#: sleep-set bookkeeping caps: masks past this popcount stop growing
+#: (a truncated sleep set prunes less, never wrongly)
+SLEEP_SCAN_CAP = 24
+#: commute-memo bound — beyond it the memo resets (correctness
+#: unaffected; the test is deterministic per (state, a, b))
+COMMUTE_MEMO_CAP = 200_000
+
+
+def dpor_enabled() -> bool:
+    """The fleet knob: on unless JEPSEN_TPU_DPOR=0/false/off/no."""
+    return os.environ.get("JEPSEN_TPU_DPOR", "").strip().lower() not in (
+        "0", "false", "off", "no")
+
+
+def resolve_dpor(flag: bool | None) -> bool:
+    return dpor_enabled() if flag is None else bool(flag)
+
+
+# ---------------------------------------------------------------------------
+# Duplicate-op canonical edges
+# ---------------------------------------------------------------------------
+
+
+def duplicate_op_edges(seq: OpSeq, cap: int | None = None
+                       ) -> list[tuple[int, int, str]]:
+    """Staircase chains over identical-content rows, as must-order
+    edges ``(src, dst, "dup")`` — src forced before dst, exchange-safe
+    by the label-swap argument in the module docstring.
+
+    Rows group by ``(f, v1, v2, ok)``; each group is chained exactly
+    like hb._canon_edges: sorted by invocation, consecutive members
+    whose returns also do not decrease get an edge (rt-implied pairs
+    are skipped — the engines enforce real time natively).  Crashed
+    duplicates all share ``ret = +inf``, so the whole group chains.
+    """
+    n = len(seq)
+    if n < 2:
+        return []
+    if cap is None:
+        cap = max(DUP_EDGE_CAP_MIN, DUP_EDGE_CAP_FACTOR * n)
+    f = np.asarray(seq.f)
+    v1 = np.asarray(seq.v1)
+    v2 = np.asarray(seq.v2)
+    ok = np.asarray(seq.ok, dtype=bool)
+    inv = [int(x) for x in seq.inv]
+    ret = [int(x) for x in seq.ret]
+    groups: dict[tuple, list[int]] = {}
+    for i in range(n):
+        groups.setdefault(
+            (int(f[i]), int(v1[i]), int(v2[i]), bool(ok[i])),
+            []).append(i)
+    out: list[tuple[int, int, str]] = []
+    for rows in groups.values():
+        if len(rows) < 2:
+            continue
+        chain = sorted(rows, key=lambda i: (inv[i], i))
+        prev = chain[0]
+        for nxt in chain[1:]:
+            if ret[nxt] >= ret[prev]:
+                if not ret[prev] < inv[nxt]:  # rt gives it anyway
+                    out.append((prev, nxt, "dup"))
+                    if len(out) >= cap:
+                        return out
+                prev = nxt
+    return out
+
+
+def merge_dup_edges(seq: OpSeq, model: ModelSpec, hb,
+                    flag: bool | None = None):
+    """Merge duplicate-op edges into an :class:`~jepsen_tpu.analyze.
+    hb.HBAnalysis`'s must-order predecessor map — the unified prepass
+    transport every consumer (host DFS, linear frames, batch disposal,
+    device planes) already reads.  No-op when dpor is off, the history
+    is decided, or no duplicate rows exist.  Returns ``hb`` (mutated in
+    place) for chaining."""
+    if hb is None or hb.decided is not None or not resolve_dpor(flag):
+        return hb
+    edges = duplicate_op_edges(seq)
+    st = hb.stats.setdefault("dpor", {})
+    st["dup_edges"] = len(edges)
+    st["enabled"] = True
+    if not edges:
+        return hb
+    _M_EDGES.inc(len(edges))
+    must = {d: list(s) for d, s in hb.must_pred.items()}
+    for (src, dst, _k) in edges:
+        must.setdefault(int(dst), []).append(int(src))
+    hb.must_pred = {d: tuple(sorted(set(s))) for d, s in must.items()}
+    hb.applies = True
+    return hb
+
+
+# ---------------------------------------------------------------------------
+# Dynamic sleep sets (host DFS)
+# ---------------------------------------------------------------------------
+
+
+class SleepSets:
+    """Commutation oracle + sleep-mask bookkeeping for one DFS run.
+
+    ``commutes(state, a, b)`` tests the two rows' transitions at one
+    concrete state: both orders produce the same outcome (the same
+    state, or both illegal).  Static short-circuits: two plain reads
+    are state-transparent (always commute), identical-content rows
+    trivially commute.  Everything else runs the model's ``pystep``
+    four ways, memoized per (state, a, b).
+    """
+
+    __slots__ = ("_f", "_v1", "_v2", "_pystep", "_read", "_ident",
+                 "_memo", "prunes")
+
+    def __init__(self, seq: OpSeq, model: ModelSpec):
+        self._f = [int(x) for x in seq.f]
+        self._v1 = [int(x) for x in seq.v1]
+        self._v2 = [int(x) for x in seq.v2]
+        self._pystep = model.pystep
+        fam = model.name in ("register", "cas-register",
+                             "multi-register")
+        # state-transparent rows: plain reads never change state and
+        # their legality ignores the other read
+        self._read = [fam and fi == R_READ for fi in self._f]
+        self._ident = {}
+        for i in range(len(self._f)):
+            self._ident.setdefault(
+                (self._f[i], self._v1[i], self._v2[i]), []).append(i)
+        self._memo: dict = {}
+        self.prunes = 0
+
+    def commutes(self, state, a: int, b: int) -> bool:
+        if self._read[a] and self._read[b]:
+            return True
+        if (self._f[a], self._v1[a], self._v2[a]) == \
+                (self._f[b], self._v1[b], self._v2[b]):
+            return True
+        if a > b:
+            a, b = b, a
+        key = (state, a, b)
+        r = self._memo.get(key)
+        if r is not None:
+            return r
+        step = self._pystep
+        sa = step(state, self._f[a], self._v1[a], self._v2[a])
+        sb = step(state, self._f[b], self._v1[b], self._v2[b])
+        sab = step(sa, self._f[b], self._v1[b], self._v2[b]) \
+            if sa is not None else None
+        sba = step(sb, self._f[a], self._v1[a], self._v2[a]) \
+            if sb is not None else None
+        r = sab == sba
+        if len(self._memo) > COMMUTE_MEMO_CAP:
+            self._memo.clear()
+        self._memo[key] = r
+        return r
+
+    def child_sleep(self, state, taken: int, base: int) -> int:
+        """The sleep mask a child inherits after linearizing ``taken``:
+        members of ``base`` (parent sleep + siblings explored first)
+        that commute with ``taken`` at the parent state.  Scan is
+        popcount-capped — truncation only weakens the prune."""
+        out = 0
+        scanned = 0
+        z = base
+        while z and scanned < SLEEP_SCAN_CAP:
+            bit = z & -z
+            z ^= bit
+            scanned += 1
+            if self.commutes(state, bit.bit_length() - 1, taken):
+                out |= bit
+        return out
+
+    def record_prune(self, n: int = 1) -> None:
+        self.prunes += n
+        _M_SLEEP.inc(n)
+
+
+def sleep_visit(visited: dict, key, sleep: int) -> int | None:
+    """Sleep-aware visited check — the state-caching fix for sleep
+    sets (Godefroid), in its tight *missing-transitions* form.
+
+    ``visited[key]`` holds ONE sleep mask: the intersection of every
+    sleep set the state was expanded under (what is still guaranteed
+    unexplored from it).  An arrival with sleep ``Z``:
+
+      * first visit — record ``Z``, return 0 (expand everything not
+        in ``Z``);
+      * stored ``Z1 ⊆ Z`` — every transition this arrival would take
+        was already taken: covered, return None (skip);
+      * otherwise — only ``missing = Z1 \\ Z`` was never taken from
+        this state: return it (the caller expands ONLY those
+        transitions) and shrink the stored mask to ``Z1 ∩ Z``.  Each
+        re-expansion strictly shrinks the stored mask, so a state
+        re-expands at most popcount-of-mask times, and only over its
+        previously-sleeping transitions.
+
+    With dpor off every sleep is 0 and this degenerates to the plain
+    visited set (one visit, never again)."""
+    z1 = visited.get(key)
+    if z1 is None:
+        visited[key] = sleep
+        return 0
+    if z1 & ~sleep == 0:  # z1 ⊆ sleep: prior visits covered more
+        return None
+    missing = z1 & ~sleep
+    visited[key] = z1 & sleep
+    return missing
+
+
+# ---------------------------------------------------------------------------
+# Plan integration (analyze/plan.py's explain() consumes this)
+# ---------------------------------------------------------------------------
+
+
+def plan_block(seq: OpSeq, model: ModelSpec, raw_bound: int,
+               hb_analysis=None) -> dict:
+    """The static ``dpor`` block for explain(): what the dynamic layer
+    would do — duplicate-op edge count, device-mask coverage once those
+    edges join the HB map, the dead-value dedup's predicted hit-rate,
+    and a sleep-set size bound from static commutation density.  Pure
+    description: nothing here touches the live counters."""
+    from ..decompose.canonical import dead_value_cutoffs
+    from .hb import _TLS, _window_effective, analyze_hb
+
+    n = len(seq)
+    out: dict = {"enabled": dpor_enabled(), "applies": n > 0}
+    edges = duplicate_op_edges(seq) if n else []
+    out["dup_edges"] = len(edges)
+
+    # device-mask coverage: rows carrying >= 1 must-order predecessor
+    # once HB edges and duplicate-op edges merge (exactly the rows the
+    # device planes will mask).  analyze_hb, not maybe_hb: describing
+    # a plan must not feed the live prepass metrics (hb.plan_block's
+    # rule).  ``hb_analysis`` lets explain()/explain_batch share one
+    # solve instead of re-running it per block.
+    hb = (hb_analysis if hb_analysis is not None
+          else analyze_hb(seq, model)) if n else None
+    must = dict(hb.must_pred) if hb is not None else {}
+    for (s, d, _k) in edges:
+        must.setdefault(int(d), ())
+    out["masked_rows"] = len(must)
+    out["mask_coverage"] = round(len(must) / n, 4) if n else 0.0
+
+    # dead-value dedup: the fraction of possible register states whose
+    # value dies before the history ends — the dedup hit-rate proxy
+    # (a state is collapsible for the whole suffix past its cutoff)
+    dv = dead_value_cutoffs(seq, model)
+    if dv is None:
+        out["dedup"] = {"applies": False}
+    else:
+        n_det = int(np.asarray(seq.ok, dtype=bool).sum())
+        vals = [c for c in dv.cutoffs.values()]
+        dead = [c for c in vals if c < n_det]
+        out["dedup"] = {
+            "applies": True,
+            "values": len(vals),
+            "dead_values": len(dead),
+            "hit_rate_prediction": round(
+                sum(max(0, n_det - c) for c in dead)
+                / max(1, n_det * max(1, len(vals))), 4),
+        }
+
+    # sleep-set size bound: max simultaneously-open state-transparent
+    # rows (reads) — the static floor of what the dynamic sets carry
+    fam = model.name in ("register", "cas-register", "multi-register")
+    if fam and n:
+        f = np.asarray(seq.f)
+        reads = np.nonzero(f == R_READ)[0]
+        events = []
+        for i in reads:
+            events.append((int(seq.inv[i]), 1))
+            events.append((int(seq.ret[i]), -1))
+        events.sort()
+        cur = peak = 0
+        for _t, d in events:
+            cur += d
+            peak = max(peak, cur)
+        out["sleep_set_bound"] = peak
+    else:
+        out["sleep_set_bound"] = 0
+
+    # pruned-vs-raw bound with the dup edges included (hb reports its
+    # own bound; this one adds what the dynamic layer's static edges
+    # buy on top)
+    if edges and hb is not None and hb.applies and n:
+        _TLS.inv = [int(x) for x in seq.inv]
+        _TLS.ret = [int(x) for x in seq.ret]
+        try:
+            all_edges = edges + [
+                (s, d, "hb") for d, ss in hb.must_pred.items()
+                for s in ss]
+            _w_raw, w_eff = _window_effective(seq, all_edges)
+        finally:
+            _TLS.inv = _TLS.ret = None
+        ok = np.asarray(seq.ok, dtype=bool)
+        nd = int(ok.sum())
+        pruned = min((nd + 1) << (max(0, w_eff - 1) + (n - nd)),
+                     raw_bound)
+        out["pruned_upper_bound"] = pruned
+        out["prune_ratio"] = (round(pruned / raw_bound, 6)
+                              if raw_bound else None)
+    else:
+        out["pruned_upper_bound"] = raw_bound
+        out["prune_ratio"] = 1.0
+    return out

@@ -736,7 +736,8 @@ def analyze_hb(seq: OpSeq, model: ModelSpec, *,
 
 
 def maybe_hb(seq: OpSeq, model: ModelSpec,
-             flag: bool | None = None) -> HBAnalysis | None:
+             flag: bool | None = None,
+             dpor: bool | None = None) -> HBAnalysis | None:
     """The engines' shared pre-pass preamble: resolve the three-state
     flag (None follows JEPSEN_TPU_HB, default on), run the analysis
     under an ``obs`` span, and feed the ``jtpu_hb_*`` metrics.  ONE
@@ -752,13 +753,19 @@ def maybe_hb(seq: OpSeq, model: ModelSpec,
     if not resolve_hb(flag) or len(seq) == 0:
         return None
     from .constraints import family_of, maybe_constraints
+    from .dpor import merge_dup_edges
 
     if family_of(model) is not None:
-        return maybe_constraints(seq, model)
+        # the dynamic layer's duplicate-op edges are model-agnostic
+        # (label-swap symmetry), so the constraint-compiler families
+        # get them through the same transport
+        return merge_dup_edges(seq, model,
+                               maybe_constraints(seq, model), dpor)
     from .. import obs
 
     with obs.span("hb.prepass", cat="analyze", rows=len(seq)):
         hb = analyze_hb(seq, model)
+    merge_dup_edges(seq, model, hb, dpor)
     if not hb.applies:
         _M_PREPASS.inc(outcome="skipped")
         return hb
@@ -808,13 +815,14 @@ def attach(result: dict, hb: HBAnalysis | None) -> dict:
 
 
 def plan_block(seq: OpSeq, model: ModelSpec, raw_bound: int,
-               n_crash: int, window: int) -> dict:
+               n_crash: int, window: int, hb_analysis=None) -> dict:
     """The static ``hb`` block for explain(): decidability, inferred
     edge counts, and the pruned config bound next to the raw one.
     Pure description — the analysis already computed the bounds, and
     describing a plan must not touch the live ``jtpu_hb_prune_ratio``
-    gauge (that tracks pre-passes that actually ran)."""
-    hb = analyze_hb(seq, model)
+    gauge (that tracks pre-passes that actually ran).  ``hb_analysis``
+    lets the caller share one solve across plan blocks."""
+    hb = hb_analysis if hb_analysis is not None else analyze_hb(seq, model)
     st = dict(hb.stats)
     st["enabled"] = hb_enabled()
     if "pruned_upper_bound" not in st:

@@ -378,7 +378,11 @@ def explain(history, model: ModelSpec, *,
     upper = (es.n_det + 1) << ub_log2
 
     from .constraints import plan_block as constraints_block
-    from .hb import plan_block
+    from .dpor import plan_block as dpor_block
+    from .hb import analyze_hb, plan_block
+
+    # one HB solve shared by the hb and dpor blocks below
+    hbres = analyze_hb(seq, model) if len(seq) else None
 
     # keyed-composite gate (the live pgwire/replicated/kv families):
     # a [k v] history under a register model routes per key — every
@@ -415,8 +419,10 @@ def explain(history, model: ModelSpec, *,
         "config_upper_bound": upper,
         "config_upper_bound_log2": round(
             ub_log2 + float(np.log2(max(1, es.n_det + 1))), 2),
-        "hb": plan_block(seq, model, upper, es.n_crash, es.window),
+        "hb": plan_block(seq, model, upper, es.n_crash, es.window,
+                         hb_analysis=hbres),
         "constraints": constraints_block(seq, model),
+        "dpor": dpor_block(seq, model, upper, hb_analysis=hbres),
         "decompositions": _decompositions(seq, model),
         "streaming": stream_plan(seq, model),
     }
@@ -454,21 +460,59 @@ def explain_batch(seqs: list[OpSeq], model: ModelSpec, *,
     # dispatch (HB for registers, the constraint compiler for
     # queue/lock families), so the predicted per-bucket dims match the
     # scheduler's under any hb setting
-    from .constraints import analyze_prepass
+    from .constraints import analyze_prepass, family_of
     from .hb import resolve_hb
 
     hb_set: set[int] = set()
     constraint_set: set[int] = set()
+    # HB-solver analyses kept for the dpor block below (one solve per
+    # key, not one per block); constraint-family analyses don't fit
+    # its HBAnalysis shape and are cheap for it to skip
+    analyses: dict[int, object] = {}
+    hb_solver = family_of(model) is None
     if resolve_hb(hb):
         for i in range(len(seqs)):
             if i in greedy_set:
                 continue
             a = analyze_prepass(seqs[i], model)
+            if hb_solver:
+                analyses[i] = a
             if a.decided is not None:
                 (constraint_set
                  if a.stats.get("solver") == "constraints"
                  else hb_set).add(i)
     disposed = greedy_set | hb_set | constraint_set
+    # the dpor block, batch form — SAME primitive as explain()'s
+    # (dpor.plan_block per undecided key), aggregated: what the device
+    # planes will mask, what the dead-value dedup should collapse, and
+    # the sleep-set bound the host legs would carry
+    from .dpor import plan_block as dpor_block
+
+    dpor_keys = [i for i in range(len(seqs)) if i not in disposed]
+    per_key = [dpor_block(seqs[i], model,
+                          (ess[i].n_det + 1)
+                          << (max(0, ess[i].window - 1)
+                              + ess[i].n_crash),
+                          hb_analysis=analyses.get(i))
+               for i in dpor_keys]
+    dedup_rates = [b["dedup"].get("hit_rate_prediction", 0.0)
+                   for b in per_key if b["dedup"].get("applies")]
+    dpor_plan = {
+        "enabled": per_key[0]["enabled"] if per_key else True,
+        "keys": len(dpor_keys),
+        "masked_keys": sum(1 for b in per_key if b["masked_rows"]),
+        "dedup_keys": sum(1 for b in per_key
+                          if b["dedup"].get("applies")),
+        "dup_edges": sum(b["dup_edges"] for b in per_key),
+        "mask_coverage": (round(sum(b["mask_coverage"]
+                                    for b in per_key)
+                                / len(per_key), 4) if per_key else 0.0),
+        "dedup_hit_rate_prediction": (round(sum(dedup_rates)
+                                            / len(dedup_rates), 4)
+                                      if dedup_rates else 0.0),
+        "sleep_set_bound": max((b["sleep_set_bound"]
+                                for b in per_key), default=0),
+    }
     buckets = []
     for idxs in plans:
         run = [i for i in idxs if i not in disposed]
@@ -497,6 +541,7 @@ def explain_batch(seqs: list[OpSeq], model: ModelSpec, *,
         "constraint_decided": len(constraint_set),
         "hard": len(hard),
         "hard_keys": hard,
+        "dpor": dpor_plan,
         "buckets": buckets,
     }
 
@@ -516,6 +561,16 @@ def render_plan(plan: dict, *, batch: bool = False) -> str:
                      f"{plan.get('constraint_decided', 0)} "
                      f"constraint-decided, "
                      f"{plan['hard']} host-fallback")
+        dp = plan.get("dpor")
+        if dp:
+            lines.append(
+                f"  dpor: {'on' if dp.get('enabled') else 'OFF'}; "
+                f"{dp.get('masked_keys', 0)}/{dp.get('keys', 0)} keys "
+                f"device-masked ({dp.get('dup_edges', 0)} dup edges), "
+                f"{dp.get('dedup_keys', 0)} dedup-eligible "
+                f"(predicted hit-rate "
+                f"{dp.get('dedup_hit_rate_prediction')}), sleep-set "
+                f"bound {dp.get('sleep_set_bound')}")
         for b, bk in enumerate(plan["buckets"]):
             dims = bk["dims"]
             eff = bk["padding_efficiency"]
@@ -586,6 +641,21 @@ def render_plan(plan: dict, *, batch: bool = False) -> str:
         if sf.get("eligible"):
             line += f"; streamed fold route: {sf.get('route')}"
         lines.append(f"  constraints[{cs.get('family')}]: " + line)
+    dp = plan.get("dpor")
+    if dp:
+        dd = dp.get("dedup", {})
+        lines.append(
+            f"  dpor: {'on' if dp.get('enabled') else 'OFF'}; "
+            f"{dp.get('dup_edges', 0)} duplicate-op edge(s), "
+            f"device-mask coverage {dp.get('mask_coverage')} "
+            f"({dp.get('masked_rows', 0)} rows), dedup "
+            + (f"applies ({dd.get('dead_values')}/{dd.get('values')} "
+               f"values die; predicted hit-rate "
+               f"{dd.get('hit_rate_prediction')})"
+               if dd.get("applies") else "n/a")
+            + f", sleep-set bound {dp.get('sleep_set_bound')}, "
+              f"pruned bound ~2^"
+              f"{_log2(dp.get('pruned_upper_bound', 0))}")
     st = plan.get("streaming")
     if st:
         lines.append(

@@ -141,7 +141,8 @@ def plan_buckets(keys: list[tuple[int, int, int]],
 
 def search_batch_bucketed(seqs: list[OpSeq], model: ModelSpec, *,
                           budget: int = 2_000_000,
-                          hb: bool | None = None) -> list[dict]:
+                          hb: bool | None = None,
+                          dpor: bool | None = None) -> list[dict]:
     """Bucketed drop-in for `search_batch`'s ladder path.
 
     Per-key results are exactly what the underlying engines report
@@ -153,9 +154,11 @@ def search_batch_bucketed(seqs: list[OpSeq], model: ModelSpec, *,
     that bucketing actually cut wasted padded work.
     """
     from . import linearizable as lin
-    from ..analyze.hb import hb_dispose, resolve_hb
+    from ..analyze.dpor import resolve_dpor
+    from ..analyze.hb import maybe_hb, resolve_hb
 
     hb = resolve_hb(hb)
+    dpor_on = resolve_dpor(dpor)
     n = len(seqs)
     t_start = time.perf_counter()
     kc0 = lin.kernel_cache_stats()
@@ -183,6 +186,7 @@ def search_batch_bucketed(seqs: list[OpSeq], model: ModelSpec, *,
         with obs.span("bucket.prep", cat="host", keys=len(idxs)):
             ready: dict[int, dict] = {}
             run: list[int] = []
+            run_mask: dict[int, dict | None] = {}
             for i in idxs:
                 s = seqs[i]
                 if lin.greedy_witness(s, model):
@@ -195,7 +199,14 @@ def search_batch_bucketed(seqs: list[OpSeq], model: ModelSpec, *,
                                 "linearization":
                                     lin.greedy_linearization(s)}
                 else:
-                    r = hb_dispose(s, model) if hb else None
+                    r = mp = None
+                    if hb:
+                        hbres = maybe_hb(s, model, True, dpor)
+                        if hbres is not None and \
+                                hbres.decided is not None:
+                            r = dict(hbres.decided)
+                        elif hbres is not None and hbres.must_pred:
+                            mp = hbres.must_pred
                     if r is not None:
                         # HB-decided next to the greedy disposal: the
                         # key never pads into the bucket's dims, never
@@ -204,14 +215,29 @@ def search_batch_bucketed(seqs: list[OpSeq], model: ModelSpec, *,
                         ready[i] = r
                     else:
                         run.append(i)
+                        run_mask[i] = mp
             if not run:
                 _M_BUCKET_S.observe(time.perf_counter() - t_prep,
                                     stage="prep")
                 return ready, run, None, None
             dims = lin.batch_dims([ess[i] for i in run], model,
                                   frontier=32)
+            if dpor_on:
+                # thread the undecided keys' must-order maps into the
+                # encodings as device planes + the dead-value table —
+                # the bucket's ladder reads the flags off the padded
+                # encodings and builds the masked kernel.  Buckets in
+                # the pallas regime drop the optional prune and keep
+                # the fused kernel instead (engine priority).
+                for i in run:
+                    lin.attach_reductions(ess[i], seqs[i], model,
+                                          run_mask.get(i), dedup=True)
+                    lin._strip_reductions_for_pallas(ess[i], model,
+                                                     dims)
+            dead_pad = lin.batch_dead_pad([ess[i] for i in run])
             esps = [lin.pad_search(ess[i], dims.n_det_pad,
-                                   dims.n_crash_pad) for i in run]
+                                   dims.n_crash_pad,
+                                   dead_pad=dead_pad) for i in run]
         _M_BUCKET_S.observe(time.perf_counter() - t_prep, stage="prep")
         return ready, run, dims, esps
 
@@ -284,7 +310,8 @@ def search_batch_bucketed(seqs: list[OpSeq], model: ModelSpec, *,
                               "linearization": lin.greedy_linearization(s)}
                 stats["greedy"] += 1
                 continue
-            r = check_opseq_linear(seqs[i], model, lint=False, hb=hb)
+            r = check_opseq_linear(seqs[i], model, lint=False, hb=hb,
+                                   dpor=dpor)
             r["engine"] = "host-linear(fallback)"
             results[i] = r
     # the single-fused-batch counterfactual over the SAME device-ridden

@@ -94,7 +94,8 @@ def check_opseq_linear(seq: OpSeq, model: ModelSpec, *,
                        decompose_cache=None,
                        lint: bool | None = None,
                        audit: bool | None = None,
-                       hb: bool | None = None) -> dict:
+                       hb: bool | None = None,
+                       dpor: bool | None = None) -> dict:
     """Exact linearizability check.  Returns a knossos-style map
     {"valid": True|False|"unknown", "configs": n, "max_depth": d, ...};
     on invalid, ``final_ops`` holds the un-linearizable candidate rows at
@@ -137,18 +138,28 @@ def check_opseq_linear(seq: OpSeq, model: ModelSpec, *,
     follows JEPSEN_TPU_HB, default on): decided histories return
     immediately with an audited certificate and zero explored configs;
     undecided ones sweep under the must-order candidate mask —
-    verdict-identical either way."""
+    verdict-identical either way.  ``dpor`` (None follows
+    JEPSEN_TPU_DPOR, default on) enables the dynamic layer
+    (analyze/dpor.py): duplicate-op canonical edges join the
+    must-order mask, and register states holding observation-dead
+    values collapse onto the canonical token
+    (decompose/canonical.py's quotient) so symmetric level rows merge
+    in the dominance dedup — verdict-identical by construction."""
     from ..analyze.audit import maybe_audit
+    from ..analyze.dpor import _M_DEDUP, _M_MASK, resolve_dpor
     from ..analyze.hb import attach, maybe_hb
     from ..analyze.lint import maybe_lint
 
     maybe_lint(seq, model, lint)
 
+    dpor_stats: dict | None = None
     hbres = None
     if not decompose and resume_from is None:
-        hbres = maybe_hb(seq, model, hb)
+        hbres = maybe_hb(seq, model, hb, dpor)
 
     def finish(out: dict) -> dict:
+        if dpor_stats is not None:
+            out.setdefault("dpor", dpor_stats)
         return maybe_audit(seq, model, attach(out, hbres), audit)
 
     if hbres is not None and hbres.decided is not None:
@@ -168,20 +179,20 @@ def check_opseq_linear(seq: OpSeq, model: ModelSpec, *,
             return check_opseq_linear(s, model, max_configs=max_configs,
                                       deadline=deadline, cancel=cancel,
                                       witness_cap=witness_cap,
-                                      lint=False, hb=hb)
+                                      lint=False, hb=hb, dpor=dpor)
 
         def _sub(s, m, *, max_configs=max_configs, deadline=deadline):
             return check_opseq_linear(s, m, max_configs=max_configs,
                                       deadline=deadline, cancel=cancel,
                                       witness_cap=witness_cap,
-                                      lint=False, hb=hb)
+                                      lint=False, hb=hb, dpor=dpor)
 
         return check_opseq_decomposed(seq, model, cache=decompose_cache,
                                       direct=_direct, sub_check=_sub,
                                       sub_max_configs=max_configs,
                                       deadline=deadline, lint=False,
                                       witness=witness_cap > 0,
-                                      audit=audit, hb=hb)
+                                      audit=audit, hb=hb, dpor=dpor)
     es = encode_search(seq)
     n_det, n_crash, W = es.n_det, es.n_crash, es.window
     if n_det == 0 and n_crash == 0:
@@ -207,6 +218,40 @@ def check_opseq_linear(seq: OpSeq, model: ModelSpec, *,
 
     pystep = model.pystep
     INF = int(INF32)
+
+    # dead-value quotient (decompose/canonical.py): successor states
+    # whose value no un-linearized row compares against rewrite onto
+    # the canonical token, so symmetric rows merge in the level dict.
+    # The coarse prefix-cutoff rule is used here (exactly the device
+    # kernels' rule): a value is dead at prefix p once every det row
+    # comparing it sits at a position < p and no crashed row compares
+    # it at all.
+    dead_cut: dict | None = None
+    dead_tok = 0
+    if resolve_dpor(dpor):
+        from ..decompose.canonical import dead_value_cutoffs
+
+        dv = dead_value_cutoffs(seq, model)
+        if dv is not None:
+            # per-VALUE cutoff in det-position space (the sweep's p)
+            dead_cut = dv.cutoffs
+            dead_tok = dv.token
+        dpor_stats = {"enabled": True, "dedup_rewrites": 0,
+                      "dedup_hits": 0, "mask_lanes_killed": 0,
+                      "dedup": dead_cut is not None}
+
+    from ..history import NIL as _NIL
+
+    def canon_state(ns: tuple, p: int) -> tuple:
+        """Rewrite an observation-dead successor state to the token.
+        NIL states never fold (a crashed cas may compare NIL at any
+        future point — decompose/canonical.py's rule)."""
+        v = ns[0]
+        if v == dead_tok or v == _NIL or p < dead_cut.get(v, 0):
+            return ns
+        dpor_stats["dedup_rewrites"] += 1
+        _M_DEDUP.inc(site="host-linear", event="rewrite")
+        return (dead_tok,)
 
     # must-order mask (HB pre-pass): per det position / crash index,
     # the det-position preds (checked against (p, win) in the frame)
@@ -269,7 +314,11 @@ def check_opseq_linear(seq: OpSeq, model: ModelSpec, *,
             if det_inv[j] < excl:
                 dp, cp = mp_det.get(j, _NO_PRED)
                 if dp and not all(det_done(q) for q in dp):
-                    continue  # a must-predecessor det is unlinearized
+                    # a must-predecessor det is unlinearized
+                    if dpor_stats is not None:
+                        dpor_stats["mask_lanes_killed"] += 1
+                        _M_MASK.inc(site="host-frame")
+                    continue
                 det_cands.append((i, det_f[j], det_v1[j], det_v2[j],
                                   cp))
         crash_cands = []
@@ -277,6 +326,9 @@ def check_opseq_linear(seq: OpSeq, model: ModelSpec, *,
             if crash_inv[c] < m1:
                 dp, cp = mp_crash.get(c, _NO_PRED)
                 if dp and not all(det_done(q) for q in dp):
+                    if dpor_stats is not None:
+                        dpor_stats["mask_lanes_killed"] += 1
+                        _M_MASK.inc(site="host-frame")
                     continue
                 crash_cands.append((c, crash_f[c], crash_v1[c],
                                     crash_v2[c], cp))
@@ -392,12 +444,17 @@ def check_opseq_linear(seq: OpSeq, model: ModelSpec, *,
                 if ns is None:
                     continue
                 configs += 1
+                if dead_cut is not None:
+                    ns = canon_state(ns, p)
                 nk = (p, win, ns)
                 ncm = cmask | (1 << c)
                 if insert(level, nk, ncm):
                     remember(nk, ncm, int(crash_rows[c]),
                              (p, win, state), cmask)
                     work.append((nk, ncm))
+                elif dead_cut is not None and ns[0] == dead_tok:
+                    dpor_stats["dedup_hits"] += 1
+                    _M_DEDUP.inc(site="host-linear", event="hit")
 
         # --- goal test -------------------------------------------------
         for (p, win, _s), ac in level.items():
@@ -420,6 +477,12 @@ def check_opseq_linear(seq: OpSeq, model: ModelSpec, *,
                 if ns is None:
                     continue
                 p2, win2 = _advance(p, win, i, n_det)
+                if dead_cut is not None:
+                    # p2, not p: the advanced prefix has strictly more
+                    # comparers behind it, so more values are provably
+                    # dead — still exact (every det position < p2 is
+                    # linearized by construction)
+                    ns = canon_state(ns, p2)
                 nk = (p2, win2, ns)
                 for cmask in ac:
                     if cp & ~cmask:
@@ -428,6 +491,9 @@ def check_opseq_linear(seq: OpSeq, model: ModelSpec, *,
                     if insert(nxt, nk, cmask):
                         remember(nk, cmask, int(det_rows[p + i]),
                                  (p, win, state), cmask)
+                    elif dead_cut is not None and ns[0] == dead_tok:
+                        dpor_stats["dedup_hits"] += 1
+                        _M_DEDUP.inc(site="host-linear", event="hit")
             why = over_budget()
             if why:
                 return finish({"valid": "unknown", "configs": configs,

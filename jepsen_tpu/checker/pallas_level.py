@@ -52,6 +52,17 @@ Eligibility: F <= 64, W <= 64, NC <= 64, state_width <= 4, and a model
 whose ``jstep`` is elementwise (register / cas-register / mutex /
 noop).  Wider rungs fall back to the XLA kernel — the pallas engine
 exists for the narrow, depth-dominated regime that floors on op count.
+
+Phase-2 reductions (the device must-order mask and the dead-value
+dedup rewrite) also route to the XLA kernel: the mask's per-lane
+linearized-predecessor test costs ~W predicated plane ops per
+predecessor slot on unpacked planes (there is no cheap batched
+win[q - p] gather without a 3-D reduce Mosaic dislikes), which would
+triple exactly the op count this kernel exists to eliminate — while on
+the XLA kernel the same test is a handful of fused gathers.  So
+``eligible`` declines ``masked``/``dedup`` searches and `get_kernel`
+builds the XLA step for them; the step signature still carries the
+reduction planes (ignored) so every driver stays signature-uniform.
 """
 
 from __future__ import annotations
@@ -81,8 +92,13 @@ SAFE_MODELS = frozenset({"register", "cas-register", "mutex", "noop"})
  _CNT0, _CFG0, _MD0, _OVF0) = range(12)
 
 
-def eligible(model, dims) -> bool:
-    return (model.name in SAFE_MODELS
+def eligible(model, dims, *, masked: bool = False,
+             dedup: bool = False) -> bool:
+    # masked/dedup searches run the XLA kernel (see module doc): the
+    # reduction checks are matmul-hostile on unpacked planes and would
+    # triple the per-level op count this kernel exists to eliminate
+    return (not masked and not dedup
+            and model.name in SAFE_MODELS
             and dims.frontier <= 64
             and dims.window <= 64
             and dims.n_crash_pad <= 64
@@ -112,9 +128,19 @@ def _iota(n, axis, shape):
     return lax.broadcasted_iota(jnp.int32, shape, axis)
 
 
-def build_pallas_step_fn(model, dims, *, interpret: bool = False):
+def build_pallas_step_fn(model, dims, *, interpret: bool = False,
+                         masked: bool = False):
     """Build a slice-step function with `build_search_step_fn`'s exact
-    signature, backed by one pallas_call running the whole level loop."""
+    signature, backed by one pallas_call running the whole level loop.
+
+    ``masked`` is accepted for get_kernel symmetry but must be False —
+    masked searches are not pallas-eligible (module doc); the step
+    still ACCEPTS the reduction-plane arguments and ignores them, so
+    drivers and differential tests stay signature-uniform."""
+    if masked:
+        raise ValueError("masked searches are not pallas-eligible; "
+                         "build the XLA kernel instead (see "
+                         "pallas_level.eligible)")
     F = dims.frontier
     W = dims.window
     NC = dims.n_crash_pad
@@ -491,9 +517,16 @@ def build_pallas_step_fn(model, dims, *, interpret: bool = False):
     )
 
     def step(det_f, det_v1, det_v2, det_inv, det_ret, sfx_min,
-             crash_f, crash_v1, crash_v2, crash_inv, n_det, n_crash,
+             crash_f, crash_v1, crash_v2, crash_inv, det_mpred,
+             det_cpredw, crash_mpred, crash_cpredw, dead_from,
+             n_det, n_crash, dead_lo, dead_tok,
              budget, lvl_cap, bail,
              frontier, count, status, configs, max_depth, ovf):
+        # det_mpred..dead_tok: phase-2 reduction planes, part of the
+        # shared step signature; unmasked/undeduped by eligibility, so
+        # they are deliberately unused here
+        del det_mpred, det_cpredw, crash_mpred, crash_cpredw
+        del dead_from, dead_lo, dead_tok
         # ---- XLA boundary: unpack packed words to planes ----------
         win = ((frontier[:, 1 + w_word] >> w_bit) & 1).astype(jnp.int32)
         crash = ((frontier[:, 1 + WW + c_word] >> c_bit)

@@ -64,7 +64,8 @@ def check_opseq(seq: OpSeq, model: ModelSpec, *,
                 decompose_cache=None,
                 lint: bool | None = None,
                 audit: bool | None = None,
-                hb: bool | None = None) -> dict:
+                hb: bool | None = None,
+                dpor: bool | None = None) -> dict:
     """Run the DFS over a columnar OpSeq.  Returns a knossos-style map:
 
     valid        True | False | "unknown"
@@ -102,19 +103,33 @@ def check_opseq(seq: OpSeq, model: ModelSpec, *,
     return immediately with an audited certificate and zero explored
     configs, and undecided ones search under the must-order mask —
     verdict-identical either way.
+    ``dpor`` enables the dynamic partial-order reduction layer
+    (analyze/dpor.py; None follows JEPSEN_TPU_DPOR, default on):
+    duplicate-op canonical edges join the must-order mask, explored
+    siblings that commute at the concrete state become *sleep sets*
+    pruning covered interleavings, and register states holding a value
+    no remaining op compares against collapse onto one dead token
+    (decompose/canonical.py's quotient) so symmetric interleavings
+    dedup in the visited memo — verdict-identical by construction.
     """
     from ..analyze.audit import maybe_audit
+    from ..analyze.dpor import (SleepSets, resolve_dpor, sleep_visit,
+                                _M_DEDUP, _M_MASK)
     from ..analyze.hb import attach, maybe_hb
     from ..analyze.lint import maybe_lint
 
     maybe_lint(seq, model, lint)
 
+    dpor_stats: dict | None = None
+
     def finish(out: dict) -> dict:
+        if dpor_stats is not None:
+            out.setdefault("dpor", dpor_stats)
         return maybe_audit(seq, model, attach(out, hbres), audit)
 
     hbres = None
     if not decompose:
-        hbres = maybe_hb(seq, model, hb)
+        hbres = maybe_hb(seq, model, hb, dpor)
         if hbres is not None and hbres.decided is not None:
             return finish(dict(hbres.decided))
 
@@ -125,13 +140,13 @@ def check_opseq(seq: OpSeq, model: ModelSpec, *,
             return check_opseq(s, model, max_configs=max_configs,
                                deadline=deadline, cancel=cancel,
                                order_seed=order_seed, lint=False,
-                               hb=hb)
+                               hb=hb, dpor=dpor)
 
         def _sub(s, m, *, max_configs=max_configs, deadline=deadline):
             return check_opseq(s, m, max_configs=max_configs,
                                deadline=deadline, cancel=cancel,
                                order_seed=order_seed, lint=False,
-                               hb=hb)
+                               hb=hb, dpor=dpor)
 
         # the entry seq was linted above (when enabled); cells/segments
         # are engine-derived projections, so re-linting them would only
@@ -173,25 +188,60 @@ def check_opseq(seq: OpSeq, model: ModelSpec, *,
                 pm |= 1 << s_
             preds[dst] = pm
 
-    visited: set = set()
+    # dynamic layer (analyze/dpor.py): sleep sets over observed
+    # commutativity + the dead-value state quotient
+    dpor_on = resolve_dpor(dpor)
+    sleep_sets = None
+    cmp_masks = None
+    dead_tok = 0
+    if dpor_on and n:
+        from ..decompose.canonical import comparison_row_masks
+        from ..history import NIL as _NIL
+
+        sleep_sets = SleepSets(seq, model)
+        cm = comparison_row_masks(seq, model)
+        if cm is not None:
+            cmp_masks, _dv = cm
+            dead_tok = _dv.token
+        dpor_stats = {"enabled": True, "sleep_prunes": 0,
+                      "dedup_rewrites": 0, "dedup_hits": 0,
+                      "mask_skips": 0}
+
+    # visited maps (mask, state) -> the intersection of the sleep
+    # masks it was expanded under (dpor off: always 0, degenerating
+    # to the plain visited set — see dpor.sleep_visit)
+    visited: dict = {}
     configs = 0
     max_depth = -1
     best_frontier: list[int] = []
     best_keys: list[tuple] = []
 
-    # DFS stack entries: (mask, state); parent_of records (op, parent_key)
-    # so the linearization is rebuilt by walking parents on success.
+    def covered(key, sleep: int) -> bool:
+        """Read-only pre-push peek (the pop does the recording
+        visit)."""
+        z1 = visited.get(key)
+        return z1 is not None and z1 & ~sleep == 0
+
+    # DFS stack entries: (mask, state, sleep); parent_of records
+    # (op, parent_key) so the linearization is rebuilt by walking
+    # parents on success.
     init = model.init
-    stack: list[tuple[int, tuple]] = [(0, init)]
+    stack: list[tuple[int, tuple, int]] = [(0, init, 0)]
     parent_of: dict[tuple[int, tuple], Optional[tuple]] = {(0, init): None}
 
     while stack:
-        mask, state = stack.pop()
+        mask, state, sleep = stack.pop()
         key = (mask, state)
-        if key in visited:
+        first_visit = key not in visited
+        missing = sleep_visit(visited, key, sleep)
+        if missing is None:
             continue
-        visited.add(key)
-        configs += 1
+        if first_visit:
+            # revisits expand ONLY `missing` (previously-sleeping)
+            # transitions — bounded clean-up, not new configurations;
+            # counting them would make a dpor run look more expensive
+            # than the exploration it saved
+            configs += 1
         if configs > max_configs:
             return finish({"valid": "unknown", "configs": configs,
                            "max_depth": max_depth,
@@ -255,21 +305,74 @@ def check_opseq(seq: OpSeq, model: ModelSpec, *,
         if order_seed is not None:
             order = list(order)
             _random.Random(order_seed ^ hash(key)).shuffle(order)
+        pushes: list[tuple[int, tuple]] = []
+        explorable = 0  # candidates past excl+preds (prior visits
+        # explored these, minus their sleeps — the justified sleep
+        # base for missing-mode children)
         for idx in order:
             j2 = cand[idx]
             excl = m2 if rets[idx] == m1 and m1_count == 1 else m1
             if inv[j2] >= excl:
                 continue
             if preds[j2] & ~mask:
+                if dpor_stats is not None:
+                    dpor_stats["mask_skips"] += 1
+                    _M_MASK.inc(site="dfs")
                 continue  # a must-predecessor is not yet linearized
+            explorable |= 1 << j2
+            if missing and not (missing >> j2) & 1:
+                continue  # revisit: only previously-sleeping
+                # transitions need (re-)exploration
+            if (sleep >> j2) & 1:
+                # sleeping: this continuation was fully covered through
+                # a commuting sibling explored first (analyze/dpor.py)
+                sleep_sets.record_prune()
+                dpor_stats["sleep_prunes"] += 1
+                continue
             new_state = pystep(state, f[j2], v1[j2], v2[j2])
             if new_state is None:
                 continue
-            nk = (mask | (1 << j2), new_state)
-            if nk not in visited:
+            nm = mask | (1 << j2)
+            if cmp_masks is not None:
+                v = new_state[0]
+                if v != dead_tok and v != _NIL:
+                    cmpm = cmp_masks.get(v)
+                    if cmpm is None or (cmpm & ~nm) == 0:
+                        # every row comparing v is linearized: the
+                        # value is observation-dead — collapse onto
+                        # the canonical token so symmetric siblings
+                        # merge in the visited memo
+                        new_state = (dead_tok,)
+                        dpor_stats["dedup_rewrites"] += 1
+                        _M_DEDUP.inc(site="dfs", event="rewrite")
+            pushes.append((j2, (nm, new_state)))
+        # assign child sleep sets: a child pushed at index t is popped
+        # AFTER pushes[t+1:] (stack order), so those siblings' subtrees
+        # are fully explored first and — where they commute with the
+        # taken op at this state — join the child's sleep set
+        child_sleeps = [0] * len(pushes)
+        if sleep_sets is not None and pushes:
+            # on a missing-mode revisit the non-missing candidates were
+            # explored by prior visits, so they are justified sleepers
+            # for the re-explored children
+            prior = (explorable & ~missing) if missing else 0
+            suffix = 0
+            for t in range(len(pushes) - 1, -1, -1):
+                j2 = pushes[t][0]
+                base = (sleep | prior | suffix) & ~(1 << j2)
+                if base:
+                    child_sleeps[t] = sleep_sets.child_sleep(
+                        state, j2, base)
+                suffix |= 1 << j2
+        for (j2, nk), csl in zip(pushes, child_sleeps):
+            if not covered(nk, csl):
                 if nk not in parent_of:
                     parent_of[nk] = (j2, key)
-                stack.append(nk)
+                stack.append((nk[0], nk[1], csl))
+            elif dpor_stats is not None and nk[1] == (dead_tok,) \
+                    and cmp_masks is not None:
+                dpor_stats["dedup_hits"] += 1
+                _M_DEDUP.inc(site="dfs", event="hit")
 
     # reconstruct up to 10 deepest partial linearizations — the analog of
     # knossos's :final-paths, truncated exactly as checker.clj:136-139

@@ -115,6 +115,14 @@ def add_test_opts(p: argparse.ArgumentParser) -> None:
                         "before any engine runs.  Sets JEPSEN_TPU_HB=0 "
                         "fleet-wide; default on, verdict-identical "
                         "either way.")
+    p.add_argument("--no-dpor", action="store_true", default=False,
+                   help="Disable the dynamic partial-order-reduction "
+                        "layer (jepsen_tpu.analyze.dpor): duplicate-op "
+                        "canonical edges, host-DFS sleep sets, the "
+                        "dead-value frontier dedup, and the device "
+                        "must-order mask planes.  Sets "
+                        "JEPSEN_TPU_DPOR=0 fleet-wide; default on, "
+                        "verdict-identical either way.")
     p.add_argument("--audit", action="store_true", default=False,
                    help="Independently audit every verdict's "
                         "certificate (jepsen_tpu.analyze.audit): a "
@@ -208,6 +216,9 @@ def test_opt_fn(parsed: argparse.Namespace) -> dict:
     if opts.pop("no_hb", False):
         os.environ["JEPSEN_TPU_HB"] = "0"
         opts["no_hb"] = True
+    if opts.pop("no_dpor", False):
+        os.environ["JEPSEN_TPU_DPOR"] = "0"
+        opts["no_dpor"] = True
     if opts.pop("audit", False):
         # like --lin-decompose/--explain: suites construct their own
         # checkers, so the audit opt-in travels by env var

@@ -136,3 +136,151 @@ def canonical_key(seq, model: ModelSpec, instates=None) -> str:
     """sha256 hex of the canonical form — the verdict-cache key."""
     payload, _ = canonical_payload(seq, model, instates)
     return hashlib.sha256(payload).hexdigest()
+
+
+# ---------------------------------------------------------------------------
+# Dead-value canonicalization — the in-loop frontier dedup
+# (state-space reduction phase 2; consumed by checker/seq.py,
+# checker/linear.py, and the device kernels' expand_mask)
+# ---------------------------------------------------------------------------
+
+from dataclasses import dataclass, field  # noqa: E402
+
+from ..models import R_CAS, R_READ, R_WRITE  # noqa: E402
+
+#: a cutoff meaning "never dead" (compared by a crashed row, whose
+#: comparison may linearize at any future point)
+NEVER_DEAD = 2**31 - 1
+
+
+@dataclass
+class DeadValues:
+    """Observation-equivalence quotient data for one register history.
+
+    The renaming family's semantics see a state value only through
+    equality tests — a read of v (``state == v``) or a cas expecting v.
+    Once every row comparing v is in the linearized past, two states
+    holding different dead values are bisimilar: every remaining read
+    of a live value fails on both (a live value cannot equal a dead
+    one — being compared later is what "live" means), writes and
+    NIL reads act identically.  So dead states rewrite to one ``token``
+    and collapse in the engines' level dedup — the canonical-state
+    frontier dedup that merges symmetric interleavings BEFORE they are
+    expanded apart.
+
+    ``cutoffs[v]`` = the first determinate prefix position p from
+    which v is dead (every det row comparing v sits at a position
+    < p, hence inside the linearized prefix); :data:`NEVER_DEAD` when
+    a crashed row compares v (crashed comparisons stay pending
+    forever).  ``token`` is a value no row writes, compares, or
+    inits — the one canonical dead state.
+    """
+
+    cutoffs: dict = field(default_factory=dict)
+    token: int = 0
+    #: values a reachable state can actually hold (init + write/cas
+    #: targets) — the DEVICE lookup table only needs to span these;
+    #: compared-but-never-written values (e.g. a corrupt read's
+    #: sentinel) keep dict entries but never occur as states
+    candidates: frozenset = frozenset()
+
+    def dead_at(self, value: int, prefix: int) -> bool:
+        if value == self.token or value == NIL:
+            # token: already canonical; NIL: a crashed cas may compare
+            # NIL at any future point, so NIL states are never folded
+            return False
+        return prefix >= self.cutoffs.get(value, 0)
+
+    def value_range(self) -> tuple[int, int]:
+        """[lo, hi] covering every value a reachable state can hold —
+        candidate write/init values ONLY (the token and compared-only
+        values sit outside the table by design: out-of-range lookups
+        simply never rewrite)."""
+        vals = list(self.candidates) or [0]
+        return min(vals), max(vals)
+
+
+def dead_value_cutoffs(seq, model: ModelSpec) -> DeadValues | None:
+    """Build the dead-value quotient for a width-1 renaming-family
+    history, or None when out of scope (other families, NIL-only
+    value sets, or a value range the token cannot extend).
+
+    Comparing rows: :ok or crashed reads of a concrete value (NIL
+    reads are always-legal and constrain nothing) and every cas row
+    (a cas compares its expected value — including NIL, which is why
+    NIL states are simply never rewritten: the token stands in only
+    for concrete dead values).
+    """
+    if model.name not in RENAME_FAMILY or model.state_width != 1:
+        return None
+    n = len(seq)
+    if n == 0:
+        return None
+    f = np.asarray(seq.f)
+    v1 = np.asarray(seq.v1)
+    v2 = np.asarray(seq.v2)
+    ok = np.asarray(seq.ok, dtype=bool)
+    # det position of each row = count of ok rows before it
+    det_pos = np.cumsum(ok) - ok.astype(np.int64)
+    # candidate state values: what a reachable state can hold
+    candidates: set[int] = set()
+    init = int(model.init[0])
+    if init != NIL:
+        candidates.add(init)
+    cutoffs: dict[int, int] = {}
+
+    def compare(v: int, row: int) -> None:
+        if v == NIL:
+            return  # NIL states are never rewritten; skip the entry
+        if not ok[row]:
+            cutoffs[v] = NEVER_DEAD
+        elif cutoffs.get(v, -1) != NEVER_DEAD:
+            cutoffs[v] = max(cutoffs.get(v, 0), int(det_pos[row]) + 1)
+
+    for i in range(n):
+        fi = int(f[i])
+        if fi == R_WRITE:
+            if int(v1[i]) != NIL:
+                candidates.add(int(v1[i]))
+        elif fi == R_READ:
+            compare(int(v1[i]), i)
+        elif fi == R_CAS:
+            compare(int(v1[i]), i)
+            if int(v2[i]) != NIL:
+                candidates.add(int(v2[i]))
+        else:
+            return None  # foreign op code: out of scope
+    if not candidates:
+        return None  # states can only hold NIL: nothing to quotient
+    # the quotient only ever rewrites reachable states, so the cutoff
+    # map needs entries for candidate values only (plus the NEVER_DEAD
+    # pins already recorded for crash-compared values)
+    for v in candidates:
+        cutoffs.setdefault(v, 0)
+    hi = max(max(cutoffs), max(candidates))
+    token = hi + 1
+    if token >= NEVER_DEAD or token == NIL:
+        return None  # no headroom for a fresh token value
+    return DeadValues(cutoffs=cutoffs, token=token,
+                      candidates=frozenset(candidates))
+
+
+def comparison_row_masks(seq, model: ModelSpec):
+    """The DFS-exact form of the quotient: per concrete value, the
+    bitmask of rows comparing it.  A state value v rewrites to
+    ``dv.token`` exactly when ``masks.get(v, 0) & ~linearized == 0``
+    (every comparer — ok or crashed — already linearized).  Returns
+    ``(masks, DeadValues)`` or None out of scope."""
+    dv = dead_value_cutoffs(seq, model)
+    if dv is None:
+        return None
+    f = np.asarray(seq.f)
+    v1 = np.asarray(seq.v1)
+    masks: dict[int, int] = {}
+    for i in range(len(seq)):
+        fi = int(f[i])
+        if fi == R_READ or fi == R_CAS:
+            v = int(v1[i])
+            if v != NIL:
+                masks[v] = masks.get(v, 0) | (1 << i)
+    return masks, dv

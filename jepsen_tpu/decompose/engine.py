@@ -51,7 +51,8 @@ class _DirectUndecided(Exception):
         self.result = result
 
 
-def _make_default_sub_check(witness: bool, hb: bool | None = None):
+def _make_default_sub_check(witness: bool, hb: bool | None = None,
+                            dpor: bool | None = None):
     from ..checker.linear import check_opseq_linear
 
     cap = DEFAULT_WITNESS_CAP if witness else 0
@@ -60,11 +61,12 @@ def _make_default_sub_check(witness: bool, hb: bool | None = None):
         # lint=False: cells/segments are engine-derived projections
         # whose invariants subseq preserves by construction (the entry
         # seq was linted at the decomposed checker's own boundary).
-        # hb rides through: cells and final segments get their own
-        # happens-before pre-pass (decide-fast + must-order mask)
+        # hb/dpor ride through: cells and final segments get their own
+        # happens-before pre-pass (decide-fast + must-order mask) and
+        # dynamic layer (dup edges + dead-value dedup)
         return check_opseq_linear(sseq, smodel, max_configs=max_configs,
                                   deadline=deadline, witness_cap=cap,
-                                  lint=False, hb=hb)
+                                  lint=False, hb=hb, dpor=dpor)
 
     return sub_check
 
@@ -208,7 +210,8 @@ def check_opseq_decomposed(seq: OpSeq, model: ModelSpec, *,
                            lint: bool | None = None,
                            witness: bool = False,
                            audit: bool | None = None,
-                           hb: bool | None = None) -> dict:
+                           hb: bool | None = None,
+                           dpor: bool | None = None) -> dict:
     """Check ``seq`` via decomposition; verdict-identical to ``direct``.
 
     cache       VerdictCache, a jsonl path, or None (no caching)
@@ -255,7 +258,7 @@ def check_opseq_decomposed(seq: OpSeq, model: ModelSpec, *,
     if isinstance(cache, str):
         cache = VerdictCache(cache)
     if sub_check is None:
-        sub_check = _make_default_sub_check(witness, hb=hb)
+        sub_check = _make_default_sub_check(witness, hb=hb, dpor=dpor)
     stats = {"cells": 0, "segments": 0, "cache_hits": 0,
              "cache_misses": 0, "configs_searched": 0, "methods": []}
     methods: set = set()

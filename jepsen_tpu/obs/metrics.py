@@ -326,6 +326,18 @@ def _declare(reg: Registry) -> None:
     reg.gauge("jtpu_hb_prune_ratio",
               "pruned/raw config-bound ratio of the most recent HB "
               "pre-pass (0 = decided without search)")
+    reg.counter("jtpu_dpor_sleep_prunes_total",
+                "Host-DFS candidates skipped because they were "
+                "sleeping (covered by an explored commuting sibling)")
+    reg.counter("jtpu_dpor_dedup_total",
+                "Canonical-state frontier dedup events, by site/kind",
+                ("site", "event"))
+    reg.counter("jtpu_dpor_mask_total",
+                "Must-order mask effects by site (host frames/DFS "
+                "candidates killed; masked rows shipped to device "
+                "planes)", ("site",))
+    reg.counter("jtpu_dpor_dup_edges_total",
+                "Duplicate-op canonical must-order edges inferred")
     reg.gauge("jtpu_stream_runs_open",
               "Streaming runs currently open in this process")
     reg.histogram("jtpu_fold_seconds",

@@ -200,7 +200,9 @@ class StreamChecker:
                  device_budget: int = 2_000_000,
                  live_path: str | None = None,
                  run_id: str | None = None,
-                 hb: bool | None = None):
+                 hb: bool | None = None,
+                 dpor: bool | None = None):
+        from ..analyze.dpor import resolve_dpor
         from ..analyze.hb import resolve_hb
         from ..analyze.plan import STREAM_INFO_LOOKAHEAD
         from ..decompose.cache import VerdictCache
@@ -212,6 +214,11 @@ class StreamChecker:
         #: finalize's sub-searches inherit the same flag so streamed
         #: results stay bit-identical to the post-hoc engines
         self.hb = resolve_hb(hb)
+        #: dynamic layer (analyze/dpor.py): finalize's sub-searches and
+        #: the per-cell/whole-history direct fallbacks inherit it, so a
+        #: streamed verdict's engines prune exactly like the post-hoc
+        #: ones (bit-identical finals either way by construction)
+        self.dpor = resolve_dpor(dpor)
         if isinstance(cache, str):
             cache = VerdictCache(cache)
         self.cache = cache
@@ -1109,7 +1116,7 @@ class StreamChecker:
         r = check_opseq_linear(cseq, self._cell_model,
                                witness_cap=DEFAULT_WITNESS_CAP
                                if self.witness else 0, lint=False,
-                               hb=self.hb)
+                               hb=self.hb, dpor=self.dpor)
         self._stats["configs_searched"] += int(r.get("configs", 0) or 0)
         v = r.get("valid", "unknown")
         return v, r.get("linearization"), \
@@ -1125,7 +1132,7 @@ class StreamChecker:
         r = check_opseq_linear(self._seq, self.model,
                                witness_cap=DEFAULT_WITNESS_CAP
                                if self.witness else 0, lint=False,
-                               hb=self.hb)
+                               hb=self.hb, dpor=self.dpor)
         self._stats["configs_searched"] += int(r.get("configs", 0) or 0)
         if self.cache is not None and wkey is not None \
                 and r.get("valid") in (True, False):
@@ -1142,7 +1149,7 @@ class StreamChecker:
             return check_opseq_linear(sseq, smodel,
                                       max_configs=max_configs,
                                       witness_cap=cap, lint=False,
-                                      hb=self.hb)
+                                      hb=self.hb, dpor=self.dpor)
 
         return sub
 

@@ -308,6 +308,23 @@ def result_block(result: dict) -> str:
                          f"{cs.get('must_edges', 0)} must-order "
                          f"edge(s) pruned the search "
                          f"{cs.get('edges')}"))
+    dp = result.get("dpor")
+    if isinstance(dp, dict) and dp.get("enabled"):
+        bits = []
+        if dp.get("sleep_prunes"):
+            bits.append(f"{dp['sleep_prunes']} sleep-set prune(s)")
+        if dp.get("dedup_rewrites"):
+            bits.append(f"{dp['dedup_rewrites']} dead-state "
+                        f"rewrite(s), {dp.get('dedup_hits', 0)} "
+                        f"frontier-dedup hit(s)")
+        if dp.get("mask_lanes_killed") or dp.get("mask_skips"):
+            bits.append(f"{dp.get('mask_lanes_killed') or dp.get('mask_skips')} "
+                        f"mask-killed candidate(s)")
+        if dp.get("device_masked"):
+            bits.append(f"{dp.get('device_mask_rows', 0)} device-"
+                        f"masked row(s)")
+        rows.append(("dpor", "; ".join(bits) if bits
+                     else "on (nothing to prune here)"))
     a = result.get("audit")
     if a:
         rows.append(("audit", "ok (checked %s)" % a.get("checked")

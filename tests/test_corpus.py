@@ -219,6 +219,34 @@ def test_corpus_replay_runs_hb_leg_on_decidable_entries(tmp_path):
     assert fuzz_tool.corpus_replay(d) == 0
 
 
+def test_corpus_replay_runs_dpor_leg_with_teeth(tmp_path, monkeypatch):
+    """fuzz --corpus's dedup+DPOR parity leg (phase-2 satellite): every
+    engine entry replays through the host DFS with the dynamic layer
+    forced ON and OFF, bit-identical.  Teeth: a sabotaged sleep-set
+    layer (over-pruning every sibling) flips verdicts, and the replay
+    must catch it as a divergence."""
+    import fuzz as fuzz_tool
+
+    from jepsen_tpu.analyze import dpor as dpor_mod
+    from jepsen_tpu.live import corpus
+
+    rng = random.Random(61)
+    _bank_register(tmp_path, rng, n_ops=20, crash_p=0.0, valid=True)
+    _bank_register(tmp_path, rng, n_ops=20, crash_p=0.1, valid=None,
+                   corrupt=True, nemesis="partition")
+    d = corpus.corpus_dir(str(tmp_path))
+    assert fuzz_tool.corpus_replay(d) == 0
+
+    # sabotage: every child sleeps on everything — the dpor-on DFS
+    # prunes all candidates below depth 1, the valid entry's witness
+    # path dies, and the verdict flips to invalid.  The leg must catch
+    # the on-vs-off divergence.
+    monkeypatch.setattr(
+        dpor_mod.SleepSets, "child_sleep",
+        lambda self, state, taken, base: (1 << 4096) - 1)
+    assert fuzz_tool.corpus_replay(d) == 1
+
+
 def test_corpus_replay_catches_banked_verdict_regression(tmp_path):
     """The net has teeth: an entry whose banked expectation disagrees
     with what the engines say fails the replay loudly."""

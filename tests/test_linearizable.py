@@ -213,7 +213,10 @@ def test_checkpoint_resume(tmp_path, monkeypatch):
     h = corrupt(rng, random_register_history(rng, n_procs=4, n_ops=40))
     model = cas_register()
     s = encode_ops(h, model.f_codes)
-    want = lin.search_opseq(s, model, dims=DIMS)["valid"]
+    # hb=False: the static prepass would decide this corrupt history
+    # outright with zero device slices — this test targets the
+    # checkpoint machinery, which needs real slices to snapshot
+    want = lin.search_opseq(s, model, dims=DIMS, hb=False)["valid"]
 
     ckpt = str(tmp_path / "search.npz")
 
@@ -230,7 +233,8 @@ def test_checkpoint_resume(tmp_path, monkeypatch):
             raise Stop
 
     try:
-        lin.search_opseq(s, model, dims=DIMS, on_slice=save_then_stop)
+        lin.search_opseq(s, model, dims=DIMS, on_slice=save_then_stop,
+                         hb=False)
     except Stop:
         pass
     carry, dims2, name, budget, digest, _pallas = \

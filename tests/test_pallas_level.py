@@ -38,12 +38,9 @@ def _steps(model, dims):
 
 
 def _args(es, esp):
-    return (jnp.asarray(esp.det_f), jnp.asarray(esp.det_v1),
-            jnp.asarray(esp.det_v2), jnp.asarray(esp.det_inv),
-            jnp.asarray(esp.det_ret), jnp.asarray(esp.suffix_min_ret),
-            jnp.asarray(esp.crash_f), jnp.asarray(esp.crash_v1),
-            jnp.asarray(esp.crash_v2), jnp.asarray(esp.crash_inv),
-            jnp.int32(es.n_det), jnp.int32(es.n_crash))
+    # the ONE signature home: identical for the XLA and pallas steps
+    # (reduction planes inert here — unreduced differential runs)
+    return lin.search_args(esp, es)
 
 
 def _lockstep(model, h, *, frontier, bail, slices=12, lvl_cap=8,

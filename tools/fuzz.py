@@ -20,8 +20,10 @@ every banked live-campaign history replays through ALL engine routes —
 direct device BFS, decomposed, bucketed, streaming — with
 verdict-parity assertions, a banked-expectation check, and the
 certificate audit; queue (multiset) entries replay through
-``total_queue``.  Exit 1 on any parity break, expectation mismatch, or
-W-code.
+``total_queue``; engine entries additionally replay through the
+dedup+DPOR route (analyze/dpor.py) forced on AND off as an extra
+bit-identical-parity + audit leg.  Exit 1 on any parity break,
+expectation mismatch, or W-code.
 
 Exit code 0 = no divergence; 1 = divergence found (minimal repro printed
 as JSON ops, replayable via --replay FILE).
@@ -278,6 +280,22 @@ def corpus_replay(pool_dir: str, *, audit: bool = True,
                     hb_decided += 1
                     verdicts["hb"] = hbr["valid"]
                     results.append(("hb", s, model, hbr))
+                # dpor parity leg: the dynamic layer (duplicate-op
+                # edges, sleep sets, dead-value dedup, device mask
+                # planes) must be verdict-transparent on every banked
+                # history — replay the host DFS route with dpor forced
+                # ON and OFF and require bit-identical verdicts; the
+                # dpor-on certificate goes through the audit like any
+                # engine's (regression teeth in tests/test_corpus.py)
+                d_on = oracle.check_opseq(s, model,
+                                          max_configs=ORACLE_CAP,
+                                          dpor=True)
+                d_off = oracle.check_opseq(s, model,
+                                           max_configs=ORACLE_CAP,
+                                           dpor=False)
+                verdicts["dpor"] = d_on["valid"]
+                verdicts["dpor-off"] = d_off["valid"]
+                results.append(("dpor", s, model, d_on))
         except Exception as exc:  # noqa: BLE001 — report, keep going
             print(f"CORPUS FAILURE {label}: replay crashed: "
                   f"{type(exc).__name__}: {exc}", file=sys.stderr)

@@ -87,13 +87,7 @@ def main() -> None:
         # overhead floor at narrow widths; these paired rows are the
         # decisive on-chip measurement (skip all-pairs where its [M,M]
         # intermediates get silly — auto never picks it there either)
-        kargs = (jnp.asarray(esp.det_f), jnp.asarray(esp.det_v1),
-                 jnp.asarray(esp.det_v2), jnp.asarray(esp.det_inv),
-                 jnp.asarray(esp.det_ret),
-                 jnp.asarray(esp.suffix_min_ret),
-                 jnp.asarray(esp.crash_f), jnp.asarray(esp.crash_v1),
-                 jnp.asarray(esp.crash_v2), jnp.asarray(esp.crash_inv),
-                 jnp.int32(es.n_det), jnp.int32(es.n_crash))
+        kargs = lin.search_args(esp, es)
         lvls = jnp.int32(args.levels)
         modes = ["sort"] + (["allpairs"] if S <= lin._ALLPAIRS_MAX
                             else [])
@@ -110,9 +104,11 @@ def main() -> None:
                 carry = tuple(jnp.asarray(c)
                               for c in lin._init_carry(dims, model))
 
+                n_args = len(kargs)
+
                 def level_fn(*a):
-                    return fn(*a[:12], jnp.int32(10**9), lvls,
-                              jnp.bool_(False), *a[12:])
+                    return fn(*a[:n_args], jnp.int32(10**9), lvls,
+                              jnp.bool_(False), *a[n_args:])
 
                 t0 = time.perf_counter()
                 out = level_fn(*kargs, *carry)
@@ -266,14 +262,7 @@ def main() -> None:
                               "dims": str(dimsm)}), flush=True)
             continue
         espm = lin.pad_search(esm, dimsm.n_det_pad, dimsm.n_crash_pad)
-        kargsm = (jnp.asarray(espm.det_f), jnp.asarray(espm.det_v1),
-                  jnp.asarray(espm.det_v2), jnp.asarray(espm.det_inv),
-                  jnp.asarray(espm.det_ret),
-                  jnp.asarray(espm.suffix_min_ret),
-                  jnp.asarray(espm.crash_f), jnp.asarray(espm.crash_v1),
-                  jnp.asarray(espm.crash_v2),
-                  jnp.asarray(espm.crash_inv),
-                  jnp.int32(esm.n_det), jnp.int32(esm.n_crash))
+        kargsm = lin.search_args(espm, esm)
         mode0 = lin._DOMINANCE_MODE
         for engine in ("xla", "pallas"):
             try:

@@ -340,6 +340,30 @@ def _decompositions(seq: OpSeq, model: ModelSpec) -> dict:
     return out
 
 
+def _telemetry_block(engine: str) -> dict:
+    """How this plan's PREDICTIONS become observations: whether the
+    device telemetry layer (obs/telemetry.py) is on, and where its
+    observed twin of the hb/dpor predicted prune ratios will land.
+    Plans are predictions; a run of the predicted engine attaches the
+    measured side, and the two are diffed everywhere downstream
+    (result block, trace_report, obs_guard)."""
+    from ..obs import telemetry as tele
+
+    on = tele.enabled()
+    out = {"enabled": on}
+    if on:
+        out["observed_at"] = (
+            "search_telemetry.observed_prune_ratio on device results "
+            "(prune_ratio_delta vs the predicted ratio above)"
+            if engine == "device-bfs" else
+            "search.telemetry trace span (observed=0 for a "
+            "statically decided / host-routed history)")
+    else:
+        out["note"] = ("JEPSEN_TPU_TELEMETRY=0: predictions will not "
+                       "be observable on results")
+    return out
+
+
 def explain(history, model: ModelSpec, *,
             frontier: int | None = None,
             host_threshold: int = 48) -> dict:
@@ -425,6 +449,7 @@ def explain(history, model: ModelSpec, *,
         "dpor": dpor_block(seq, model, upper, hb_analysis=hbres),
         "decompositions": _decompositions(seq, model),
         "streaming": stream_plan(seq, model),
+        "telemetry": _telemetry_block(engine),
     }
 
 
@@ -656,6 +681,12 @@ def render_plan(plan: dict, *, batch: bool = False) -> str:
             + f", sleep-set bound {dp.get('sleep_set_bound')}, "
               f"pruned bound ~2^"
               f"{_log2(dp.get('pruned_upper_bound', 0))}")
+    tl = plan.get("telemetry")
+    if tl:
+        lines.append(
+            "  telemetry: "
+            + (f"on — observed at {tl.get('observed_at')}"
+               if tl.get("enabled") else f"off ({tl.get('note')})"))
     st = plan.get("streaming")
     if st:
         lines.append(

@@ -82,6 +82,10 @@ from .. import obs
 from ..history import INF_RET, NIL, OpSeq, encode_ops
 from ..models import ModelSpec
 from ..obs import metrics as _obs_metrics
+from ..obs import telemetry as _tele
+from ..obs.telemetry import (C_DEDUP, C_EXP, C_GOAL, C_KILL, C_NEXT,
+                             C_OCC, C_OVF, C_ROUNDS, TELE_COLS,
+                             TELE_ROWS)
 
 #: flight-recorder twin of KERNEL_CACHE_STATS (module handle: a
 #: registry get-or-create per lookup would tax the dispatch path)
@@ -763,7 +767,8 @@ def _succ_block(pieces, frontier, validf, cand2, ns2, cap: int, K: int,
 def build_search_step_fn(model: ModelSpec, dims: SearchDims,
                          batch: int = 1, *, masked: bool = False,
                          masked_crash: bool = False,
-                         dedup: bool = False):
+                         dedup: bool = False,
+                         telemetry: bool = False):
     """Compile one *slice* of the frontier search for a (model, dims) pair.
 
     ``batch`` is a hint for the dominance-prune selector only: a vmapped
@@ -817,7 +822,7 @@ def build_search_step_fn(model: ModelSpec, dims: SearchDims,
     S = 4 * F
     pieces = _make_kernel_pieces(model, dims, masked=masked,
                                  masked_crash=masked_crash,
-                                 dedup=dedup)
+                                 dedup=dedup, telemetry=telemetry)
     # prune implementation per site, decided at BUILD time (consistent
     # with the cache keys, which carry _dominance_key())
     ap_cl = _use_allpairs(2 * F, batch)
@@ -829,8 +834,15 @@ def build_search_step_fn(model: ModelSpec, dims: SearchDims,
              n_det, n_crash, dead_lo, dead_tok,
              budget, lvl_cap, bail,
              frontier, count, status, configs, max_depth, ovf):
+        # telemetry builds thread the per-level aux counter block
+        # (obs/telemetry.py schema) through the loop carry and return
+        # it as a 7th output; the block is write-only — nothing reads
+        # it back, so verdicts stay byte-identical on/off
         carry0 = (frontier, count, status, configs, max_depth, ovf,
                   jnp.int32(0))
+        if telemetry:
+            carry0 = carry0 + (jnp.zeros((TELE_ROWS, TELE_COLS),
+                                         jnp.int32),)
         op_args = (det_f, det_v1, det_v2, det_inv, det_ret, sfx_min,
                    crash_f, crash_v1, crash_v2, crash_inv, det_mpred,
                    det_cpredw, crash_mpred, crash_cpredw, dead_from,
@@ -844,7 +856,7 @@ def build_search_step_fn(model: ModelSpec, dims: SearchDims,
                                cap, K, batch)
 
         def cond(c):
-            _, count, status, configs, _, ovf, lvl = c
+            _, count, status, configs, _, ovf, lvl = c[:7]
             go = ((status == -1) & (count > 0) & (configs < budget)
                   & (lvl < lvl_cap))
             # when a wider re-run is coming (bail), don't waste time on a
@@ -852,7 +864,8 @@ def build_search_step_fn(model: ModelSpec, dims: SearchDims,
             return go & ~(bail & ovf)
 
         def body(c):
-            frontier, count, status, configs, max_depth, ovf, lvl = c
+            frontier, count, status, configs, max_depth, ovf, lvl = c[:7]
+            tele = c[7] if telemetry else None
             # entry snapshot: if THIS level overflows under bail, the
             # level is not committed and the carry exits at the last
             # clean state — the wider re-run resumes with zero lost
@@ -862,7 +875,10 @@ def build_search_step_fn(model: ModelSpec, dims: SearchDims,
                                                  configs, max_depth, ovf)
             alive = jnp.arange(F) < count
 
-            valid2, cand2, ns2, goal2 = mask_phase(frontier, alive)
+            mp = mask_phase(frontier, alive)
+            valid2, cand2, ns2, goal2 = mp[:4]
+            kil = mp[4].sum() if telemetry else None
+            ded = mp[5].sum() if telemetry else None
             found = jnp.any(goal2)
             crash_any = jnp.any(valid2 & (cand2 >= W))
 
@@ -875,7 +891,7 @@ def build_search_step_fn(model: ModelSpec, dims: SearchDims,
 
             def cl_body(cc):
                 (frontier, count, valid2, cand2, ns2, _goal2, configs,
-                 ovf, it, _pr, found) = cc
+                 ovf, it, _pr, found) = cc[:11]
                 alive = jnp.arange(F) < count
                 cvalidf = (valid2 & (cand2 >= W)).reshape(F * K)
                 # crash successors are capped at F rows (not S): they
@@ -910,18 +926,28 @@ def build_search_step_fn(model: ModelSpec, dims: SearchDims,
                 # the (sorted, compacted) frontier rows the det phase
                 # will gather from
                 alive2 = jnp.arange(F) < new_count
-                v2, c2, n2, g2 = mask_phase(new_frontier, alive2)
+                mp2 = mask_phase(new_frontier, alive2)
+                v2, c2, n2, g2 = mp2[:4]
                 found = found | jnp.any(g2)
-                return (new_frontier, new_count, v2, c2, n2, g2,
-                        configs, ovf, it + 1, progress, found)
+                out = (new_frontier, new_count, v2, c2, n2, g2,
+                       configs, ovf, it + 1, progress, found)
+                if telemetry:
+                    # accumulate closure-round mask kills / dedup folds
+                    out = out + (cc[11] + mp2[4].sum(),
+                                 cc[12] + mp2[5].sum())
+                return out
 
             # progress starts False: the first iteration is gated on
             # crash_any, and an unentered loop must exit "closed"
             cc0 = (frontier, count, valid2, cand2, ns2, goal2, configs,
                    ovf, jnp.int32(0), jnp.bool_(False), found)
+            if telemetry:
+                cc0 = cc0 + (kil, ded)
+            ccout = lax.while_loop(cl_cond, cl_body, cc0)
             (frontier, count, valid2, cand2, ns2, goal2, configs, ovf,
-             _it, pr_exit, found) = lax.while_loop(cl_cond, cl_body,
-                                                   cc0)
+             _it, pr_exit, found) = ccout[:11]
+            if telemetry:
+                kil, ded = ccout[11], ccout[12]
             # exiting via the iteration cap while still adding rows
             # means the level was NOT proven closed under crash
             # linearization; that must degrade like an overflow
@@ -955,10 +981,31 @@ def build_search_step_fn(model: ModelSpec, dims: SearchDims,
             new_count = jnp.where(revert, c_in, new_count)
             configs = jnp.where(revert, cfg_in, configs)
             max_depth = jnp.where(revert, md_in, max_depth)
-            return (new_frontier, new_count, status, configs, max_depth,
-                    ovf, lvl + 1)
+            out = (new_frontier, new_count, status, configs, max_depth,
+                   ovf, lvl + 1)
+            if telemetry:
+                # one aux row per level (additive: levels past the
+                # buffer fold into the last row; an uncommitted/bailed
+                # level still records, flagged by overflow=1), built
+                # by column index so kernel row order stays locked to
+                # telemetry.COLUMNS
+                cols = [None] * TELE_COLS
+                cols[C_OCC] = count
+                cols[C_EXP] = jnp.sum(valid2, dtype=jnp.int32)
+                cols[C_KILL] = kil
+                cols[C_DEDUP] = ded
+                cols[C_ROUNDS] = _it
+                cols[C_NEXT] = new_count
+                cols[C_OVF] = (ovf & ~ovf_in).astype(jnp.int32)
+                cols[C_GOAL] = found.astype(jnp.int32)
+                idx = jnp.minimum(lvl, TELE_ROWS - 1)
+                tele = tele.at[idx].add(jnp.stack(cols))
+                out = out + (tele,)
+            return out
 
         out = lax.while_loop(cond, body, carry0)
+        if telemetry:
+            return out[:6] + (out[7],)
         return out[:6]
 
     return step
@@ -973,7 +1020,8 @@ def build_sharded_search_step_fn(model: ModelSpec, dims: SearchDims,
                                  mesh, axis: str = "shard", *,
                                  masked: bool = False,
                                  masked_crash: bool = False,
-                                 dedup: bool = False):
+                                 dedup: bool = False,
+                                 telemetry: bool = False):
     """One *slice* of a search whose frontier is sharded over a mesh.
 
     Each device owns the hash partition ``pw_hash % D`` of the
@@ -1021,7 +1069,7 @@ def build_sharded_search_step_fn(model: ModelSpec, dims: SearchDims,
 
     pieces = _make_kernel_pieces(model, dims, masked=masked,
                                  masked_crash=masked_crash,
-                                 dedup=dedup)
+                                 dedup=dedup, telemetry=telemetry)
     # prune implementation per merge site, decided at BUILD time.  M
     # already counts every row a device can hold after routing (local F
     # + D routing buckets of C rows), and under shard_map each device
@@ -1088,23 +1136,33 @@ def build_sharded_search_step_fn(model: ModelSpec, dims: SearchDims,
 
         carry0 = (frontier, count, status, configs, max_depth, any_ovf,
                   total, jnp.int32(0))
+        if telemetry:
+            # per-SHARD aux block: each device records its local
+            # counters; the host sums shard blocks per level (levels
+            # are lockstep — replicated loop control)
+            carry0 = carry0 + (jnp.zeros((TELE_ROWS, TELE_COLS),
+                                         jnp.int32),)
         op_args = (det_f, det_v1, det_v2, det_inv, det_ret, sfx_min,
                    crash_f, crash_v1, crash_v2, crash_inv, det_mpred,
                    det_cpredw, crash_mpred, crash_cpredw, dead_from,
                    n_det, n_crash, dead_lo, dead_tok)
 
         def cond(c):
-            _, _, status, configs, _, any_ovf, total, lvl = c
+            _, _, status, configs, _, any_ovf, total, lvl = c[:8]
             go = ((status == -1) & (total > 0) & (configs < budget)
                   & (lvl < lvl_cap))
             return go & ~(bail & any_ovf)
 
         def body(c):
             frontier, count, status, configs, max_depth, ovf, _total, \
-                lvl = c
+                lvl = c[:8]
+            tele = c[8] if telemetry else None
+            ovf_in = ovf
             alive = jnp.arange(F) < count
-            valid2, cand2, ns2, goal2 = _level_mask(pieces, op_args,
-                                                    frontier, alive)
+            mp = _level_mask(pieces, op_args, frontier, alive)
+            valid2, cand2, ns2, goal2 = mp[:4]
+            kil = mp[4].sum() if telemetry else None
+            ded = mp[5].sum() if telemetry else None
             found_loc = jnp.any(goal2)
             crash_any = lax.psum(
                 jnp.any(valid2 & (cand2 >= W)).astype(jnp.int32),
@@ -1119,7 +1177,7 @@ def build_sharded_search_step_fn(model: ModelSpec, dims: SearchDims,
 
             def cl_body(cc):
                 (frontier, count, valid2, cand2, ns2, _goal2, ovf,
-                 found_loc, it, _pr) = cc
+                 found_loc, it, _pr) = cc[:10]
                 alive = jnp.arange(F) < count
                 cvalidf = (valid2 & (cand2 >= W)).reshape(F * K)
                 ccfgs, cvalid, n_valid = _succ_block(
@@ -1134,16 +1192,26 @@ def build_sharded_search_step_fn(model: ModelSpec, dims: SearchDims,
                 progress = lax.psum(progress_loc.astype(jnp.int32),
                                     axis) > 0
                 alive2 = jnp.arange(F) < new_count
-                v2, c2, n2, g2 = _level_mask(pieces, op_args,
-                                             new_frontier, alive2)
+                mp2 = _level_mask(pieces, op_args,
+                                  new_frontier, alive2)
+                v2, c2, n2, g2 = mp2[:4]
                 found_loc = found_loc | jnp.any(g2)
-                return (new_frontier, new_count, v2, c2, n2, g2, ovf,
-                        found_loc, it + 1, progress)
+                out = (new_frontier, new_count, v2, c2, n2, g2, ovf,
+                       found_loc, it + 1, progress)
+                if telemetry:
+                    out = out + (cc[10] + mp2[4].sum(),
+                                 cc[11] + mp2[5].sum())
+                return out
 
             cc0 = (frontier, count, valid2, cand2, ns2, goal2, ovf,
                    found_loc, jnp.int32(0), jnp.bool_(False))
+            if telemetry:
+                cc0 = cc0 + (kil, ded)
+            ccout = lax.while_loop(cl_cond, cl_body, cc0)
             (frontier, count, valid2, cand2, ns2, goal2, ovf, found_loc,
-             _it, pr_exit) = lax.while_loop(cl_cond, cl_body, cc0)
+             _it, pr_exit) = ccout[:10]
+            if telemetry:
+                kil, ded = ccout[10], ccout[11]
             # cap-exit while still adding rows: level not proven closed
             # — degrade like an overflow, never decide invalid
             ovf = ovf | pr_exit
@@ -1168,25 +1236,43 @@ def build_sharded_search_step_fn(model: ModelSpec, dims: SearchDims,
             status = jnp.where(found, 2, status)
             total = lax.psum(new_count, axis)
             any_ovf = lax.psum(ovf.astype(jnp.int32), axis) > 0
-            return (new_frontier, new_count, status, configs, max_depth,
-                    any_ovf, total, lvl + 1)
+            out = (new_frontier, new_count, status, configs, max_depth,
+                   any_ovf, total, lvl + 1)
+            if telemetry:
+                cols = [None] * TELE_COLS
+                cols[C_OCC] = count
+                cols[C_EXP] = jnp.sum(valid2, dtype=jnp.int32)
+                cols[C_KILL] = kil
+                cols[C_DEDUP] = ded
+                cols[C_ROUNDS] = _it
+                cols[C_NEXT] = new_count
+                cols[C_OVF] = (ovf & ~ovf_in).astype(jnp.int32)
+                cols[C_GOAL] = found_loc.astype(jnp.int32)
+                idx = jnp.minimum(lvl, TELE_ROWS - 1)
+                out = out + (tele.at[idx].add(jnp.stack(cols)),)
+            return out
 
-        (frontier, count, status, configs, max_depth, any_ovf, total,
-         _lvl) = lax.while_loop(cond, body, carry0)
+        cout = lax.while_loop(cond, body, carry0)
+        (frontier, count, status, configs, max_depth, any_ovf,
+         total) = cout[:7]
 
-        return (frontier, count[None], status, configs, max_depth,
-                any_ovf, total)
+        ret = (frontier, count[None], status, configs, max_depth,
+               any_ovf, total)
+        if telemetry:
+            ret = ret + (cout[8],)
+        return ret
 
     specs = (P(),) * 22
     carry_in = (P(axis), P(axis), P(), P(), P(), P(), P())
+    carry_out = carry_in + ((P(axis),) if telemetry else ())
     try:
         return shard_map(step_device, mesh=mesh,
                          in_specs=specs + carry_in,
-                         out_specs=carry_in, check_vma=False)
+                         out_specs=carry_out, check_vma=False)
     except TypeError:  # pre-0.4.35 jax spells the knob check_rep
         return shard_map(step_device, mesh=mesh,
                          in_specs=specs + carry_in,
-                         out_specs=carry_in, check_rep=False)
+                         out_specs=carry_out, check_rep=False)
 
 
 def _trailing_ones(w):
@@ -1201,7 +1287,8 @@ def _trailing_ones(w):
 def _make_kernel_pieces(model: ModelSpec, dims: SearchDims, *,
                         masked: bool = False,
                         masked_crash: bool = False,
-                        dedup: bool = False):
+                        dedup: bool = False,
+                        telemetry: bool = False):
     """Kernel building blocks shared by the single-device, sharded, and
     batch step functions.
 
@@ -1295,6 +1382,13 @@ def _make_kernel_pieces(model: ModelSpec, dims: SearchDims, *,
         c_lanes = jnp.arange(NC, dtype=jnp.int32)
         c_enabled = (c_lanes < n_crash) & ~crash & (crash_inv < m1_tot)
 
+        if telemetry and masked:
+            # telemetry taps the PRE-mask enabled sets so the mask's
+            # kill count is observable; pure reads — the search math
+            # below is untouched (byte-identity fuzzed)
+            pre_enabled = (det_enabled.sum(dtype=jnp.int32)
+                           + c_enabled.sum(dtype=jnp.int32))
+
         if masked:
             # must-order mask: a lane stays enabled only once every
             # must-predecessor is linearized.  det preds q are done iff
@@ -1372,7 +1466,25 @@ def _make_kernel_pieces(model: ModelSpec, dims: SearchDims, *,
         # no wider re-run would come.
         remaining = n_det - (p + win.sum(dtype=jnp.int32))
         goal = valid & jnp.where(is_det, remaining <= 1, remaining <= 0)
-        return valid, cand, new_state, goal
+        if not telemetry:
+            return valid, cand, new_state, goal
+        # per-config telemetry scalars (aux counter block, obs/
+        # telemetry.py): mask-killed lanes and dead-value folds.
+        # Computed only in telemetry builds — the off-mode kernel is
+        # the exact pre-telemetry graph (separate cache key).
+        zero = jnp.int32(0)
+        if masked:
+            post = (det_enabled.sum(dtype=jnp.int32)
+                    + c_enabled.sum(dtype=jnp.int32))
+            killed = jnp.where(alive, pre_enabled - post, zero)
+        else:
+            killed = zero
+        if dedup:
+            dedupct = jnp.where(
+                alive, (valid & is_dead).sum(dtype=jnp.int32), zero)
+        else:
+            dedupct = zero
+        return valid, cand, new_state, goal, killed, dedupct
 
     def succ_one(cfg, lane, ns):
         """Build one survivor's packed successor words."""
@@ -1515,7 +1627,9 @@ def search_opseq_sharded(seq: OpSeq, model: ModelSpec, mesh, *,
         return maybe_audit(seq, model, attach(out, hbres), audit)
 
     if hbres is not None and hbres.decided is not None:
-        return maybe_audit(seq, model, dict(hbres.decided), audit)
+        return _tele.emit_decided(
+            maybe_audit(seq, model, dict(hbres.decided), audit),
+            hbres=hbres)
     es = encode_search(seq)
     if es.n_det == 0 and es.n_crash == 0:
         return finish({"valid": True, "configs": 0, "max_depth": 0,
@@ -1542,19 +1656,24 @@ def search_opseq_sharded(seq: OpSeq, model: ModelSpec, mesh, *,
     esp = pad_search(es, dims.n_det_pad, dims.n_crash_pad)
     _masked, _mcrash, _dedup, _vt = _reduction_key(esp)
     D = mesh.shape[axis]
+    tele_on = _tele.enabled()
+    acc = _tele.SearchTelemetry("device-sharded") if tele_on else None
     resume = None
     while True:
         bail = dims.frontier < MAX_FRONTIER
         mesh_key = (tuple(mesh.shape.items()),
                     tuple(d.id for d in mesh.devices.flat))
         key = (model.name, dims, axis, mesh_key, _dominance_key(),
-               _masked, _mcrash, _dedup, _vt)
+               _masked, _mcrash, _dedup, _vt, tele_on)
         fn = _SHARDED_CACHE.get(key)
         _kc_record(fn is not None)
         if fn is None:
-            fn = jax.jit(build_sharded_search_step_fn(
-                model, dims, mesh, axis, masked=_masked,
-                masked_crash=_mcrash, dedup=_dedup))
+            with _tele.compile_span(engine="device-sharded",
+                                    frontier=dims.frontier):
+                fn = jax.jit(build_sharded_search_step_fn(
+                    model, dims, mesh, axis, masked=_masked,
+                    masked_crash=_mcrash, dedup=_dedup,
+                    telemetry=tele_on))
             _SHARDED_CACHE[key] = fn
         args = search_args(esp, es)
         if resume is not None:
@@ -1574,8 +1693,22 @@ def search_opseq_sharded(seq: OpSeq, model: ModelSpec, mesh, *,
             return int(np.asarray(carry[i]).reshape(-1)[0])
 
         def call(carry, lvl_cap):
-            return fn(*args, jnp.int32(budget), jnp.int32(lvl_cap),
-                      jnp.bool_(bail), *carry)
+            t0 = time.perf_counter()
+            res = fn(*args, jnp.int32(budget), jnp.int32(lvl_cap),
+                     jnp.bool_(bail), *carry)
+            if acc is not None:
+                # per-shard blocks [D*R, C] -> per-level shard sum
+                # (levels run lockstep under replicated loop control)
+                jax.block_until_ready(res)
+                try:
+                    t = np.asarray(res[7]).reshape(
+                        D, TELE_ROWS, TELE_COLS).sum(axis=0)
+                    acc.add_slice(t, t0, time.perf_counter(),
+                                  frontier=dims.frontier)
+                except Exception:  # noqa: BLE001 — non-addressable
+                    pass           # multihost shards: totals only
+                res = res[:7]
+            return res
 
         def is_active(carry):
             return (sc(carry, 2) == -1 and sc(carry, 6) > 0
@@ -1625,6 +1758,7 @@ def search_opseq_sharded(seq: OpSeq, model: ModelSpec, mesh, *,
         out["witness_dropped"] = WITNESS_DROPPED_DEVICE
     elif out["valid"] is False:
         out["frontier_dropped"] = FRONTIER_DROPPED_DEVICE
+    _tele.finalize_result(out, acc, hbres=hbres)
     return finish(out)
 
 
@@ -1741,6 +1875,7 @@ def _drive_slices(call, carry, is_active, *, on_slice=None,
             carry = call(carry, lvl_cap)
             jax.block_until_ready(carry)
         dt = time.perf_counter() - t0
+        _tele.record_device_seconds(dt)
         if on_slice is not None:
             on_slice(carry)
         if not is_active(carry):
@@ -1850,27 +1985,35 @@ def _reduction_key(esp: EncodedSearch | None) -> tuple:
 
 def get_kernel(model: ModelSpec, dims: SearchDims, *,
                masked: bool = False, masked_crash: bool = False,
-               dedup: bool = False, vt: int = 8):
+               dedup: bool = False, vt: int = 8,
+               telemetry: bool = False):
     use_p = _use_pallas(model, dims, masked=masked, dedup=dedup)
     key = (model.name, dims, _dominance_key(), masked, masked_crash,
-           dedup, vt, "pallas" if use_p else "xla")
+           dedup, vt, telemetry, "pallas" if use_p else "xla")
     fn = _KERNEL_CACHE.get(key)
     _kc_record(fn is not None)
     if fn is None:
-        if use_p:
-            from . import pallas_level
+        # a miss is a trace + XLA compile: the device.compile span is
+        # the cold-start tax's trace evidence (the hit path is a dict
+        # get and never enters here)
+        with _tele.compile_span(engine="pallas" if use_p else "xla",
+                                frontier=dims.frontier,
+                                n_det_pad=dims.n_det_pad):
+            if use_p:
+                from . import pallas_level
 
-            # off-TPU the pallas kernel runs in interpret mode (tests;
-            # forced-engine differential fuzz) — Mosaic lowering needs
-            # the hardware
-            backend = _backend()
-            fn = jax.jit(pallas_level.build_pallas_step_fn(
-                model, dims, interpret=backend != "tpu",
-                masked=masked))
-        else:
-            fn = jax.jit(build_search_step_fn(
-                model, dims, masked=masked,
-                masked_crash=masked_crash, dedup=dedup))
+                # off-TPU the pallas kernel runs in interpret mode
+                # (tests; forced-engine differential fuzz) — Mosaic
+                # lowering needs the hardware
+                backend = _backend()
+                fn = jax.jit(pallas_level.build_pallas_step_fn(
+                    model, dims, interpret=backend != "tpu",
+                    masked=masked, telemetry=telemetry))
+            else:
+                fn = jax.jit(build_search_step_fn(
+                    model, dims, masked=masked,
+                    masked_crash=masked_crash, dedup=dedup,
+                    telemetry=telemetry))
         _KERNEL_CACHE[key] = fn
     return fn
 
@@ -1901,6 +2044,13 @@ def search_args(esp: EncodedSearch, es: EncodedSearch | None = None):
     it; the batch paths stack the same attributes via stack_batch).
     ``es`` supplies the true n_det/n_crash when ``esp`` is padded."""
     src = es if es is not None else esp
+    # byte-counted host->device staging (obs/telemetry.py): these are
+    # the argument tables the next device dispatch uploads
+    _tele.record_transfer(_tele.transfer_bytes(
+        (esp.det_f, esp.det_v1, esp.det_v2, esp.det_inv, esp.det_ret,
+         esp.suffix_min_ret, esp.crash_f, esp.crash_v1, esp.crash_v2,
+         esp.crash_inv, esp.det_mpred, esp.det_cpredw, esp.crash_mpred,
+         esp.crash_cpredw, esp.dead_from)))
     return (
         jnp.asarray(esp.det_f), jnp.asarray(esp.det_v1),
         jnp.asarray(esp.det_v2), jnp.asarray(esp.det_inv),
@@ -2058,6 +2208,8 @@ def _run_kernel(esp: EncodedSearch, es: EncodedSearch, model: ModelSpec,
     per_lvl: float | None = None  # measured seconds/level at width F
     prev_depth = int(np.asarray(carry[4]))
     hard_s = _slice_hard_s()
+    tele_on = _tele.enabled()
+    acc = _tele.SearchTelemetry() if tele_on else None
 
     def _clamp_cap(cap: int) -> int:
         # keep a slice's PREDICTED wall under the worker watchdog; the
@@ -2073,18 +2225,24 @@ def _run_kernel(esp: EncodedSearch, es: EncodedSearch, model: ModelSpec,
         want_pallas = _use_pallas(model, dims, masked=_masked,
                                   dedup=_dedup)
         fn = get_kernel(model, dims, masked=_masked,
-                        masked_crash=_mcrash, dedup=_dedup, vt=_vt)
+                        masked_crash=_mcrash, dedup=_dedup, vt=_vt,
+                        telemetry=tele_on)
         _trace(f"run F={F} cap={lvl_cap} first={int(first)} "
                f"depth={prev_depth}")
         t0 = time.perf_counter()
+        tele_buf = None
         # manual span (not `with`): the slice's wall is t0..dt below,
         # and the except arm re-runs the slice inside the same window
         _slice_span = obs.span("device.slice", cat="device", frontier=F,
                                levels=lvl_cap, first=first)
         _slice_span.__enter__()
         try:
-            carry = fn(*args, jnp.int32(budget), jnp.int32(lvl_cap),
-                       jnp.bool_(bail), *carry)
+            res = fn(*args, jnp.int32(budget), jnp.int32(lvl_cap),
+                     jnp.bool_(bail), *carry)
+            if tele_on:
+                carry, tele_buf = res[:6], res[6]
+            else:
+                carry = res
             jax.block_until_ready(carry)
         except Exception as e:  # noqa: BLE001 — engine fallback
             global _PALLAS_BROKEN
@@ -2102,10 +2260,15 @@ def _run_kernel(esp: EncodedSearch, es: EncodedSearch, model: ModelSpec,
                        "to xla engine")
                 fn = get_kernel(model, dims, masked=_masked,
                                 masked_crash=_mcrash,
-                                dedup=_dedup, vt=_vt)
-                carry = fn(*args, jnp.int32(budget),
-                           jnp.int32(lvl_cap), jnp.bool_(bail),
-                           *carry)
+                                dedup=_dedup, vt=_vt,
+                                telemetry=tele_on)
+                res = fn(*args, jnp.int32(budget),
+                         jnp.int32(lvl_cap), jnp.bool_(bail),
+                         *carry)
+                if tele_on:
+                    carry, tele_buf = res[:6], res[6]
+                else:
+                    carry = res
                 jax.block_until_ready(carry)
             else:
                 raise
@@ -2116,6 +2279,10 @@ def _run_kernel(esp: EncodedSearch, es: EncodedSearch, model: ModelSpec,
         used_pallas = used_pallas or (want_pallas
                                       and not _PALLAS_BROKEN)
         dt = time.perf_counter() - t0
+        _tele.record_device_seconds(dt)
+        if acc is not None and tele_buf is not None:
+            acc.add_slice(np.asarray(tele_buf), t0, t0 + dt,
+                          frontier=F)
         if on_slice is not None:
             _RUN_PALLAS.flag = used_pallas
             try:
@@ -2218,7 +2385,7 @@ def _run_kernel(esp: EncodedSearch, es: EncodedSearch, model: ModelSpec,
             status = UNKNOWN
         else:
             status = UNKNOWN if ovf else INVALID
-    return status, configs, int(carry[4]), dims, used_pallas
+    return status, configs, int(carry[4]), dims, used_pallas, acc
 
 
 def greedy_witness(seq: OpSeq, model: ModelSpec) -> bool:
@@ -2320,7 +2487,12 @@ def search_opseq(seq: OpSeq, model: ModelSpec, *,
         return maybe_audit(seq, model, attach(out, hbres), audit)
 
     if hbres is not None and hbres.decided is not None:
-        return maybe_audit(seq, model, dict(hbres.decided), audit)
+        # statically decided: no device work, but the telemetry span
+        # still records observed=0 vs predicted=0 so traces (and
+        # obs_guard's prune-delta check) cover decided tiers too
+        return _tele.emit_decided(
+            maybe_audit(seq, model, dict(hbres.decided), audit),
+            hbres=hbres)
 
     es = encode_search(seq)
     if es.n_det == 0 and es.n_crash == 0:
@@ -2364,9 +2536,9 @@ def search_opseq(seq: OpSeq, model: ModelSpec, *,
             _M_MASK.inc(dpor_stats["device_mask_rows"],
                         site="device-rows")
     esp = pad_search(es, dims.n_det_pad, dims.n_crash_pad)
-    status, configs, max_depth, dims, used_pallas = _run_kernel(
-        esp, es, model, dims, budget, on_slice=on_slice,
-        deadline=deadline, stop=stop)
+    status, configs, max_depth, dims, used_pallas, tele_acc = \
+        _run_kernel(esp, es, model, dims, budget, on_slice=on_slice,
+                    deadline=deadline, stop=stop)
     out = {"valid": _STATUS[status], "configs": configs,
            "max_depth": max_depth,
            "engine": _engine_label(used_pallas),
@@ -2378,6 +2550,7 @@ def search_opseq(seq: OpSeq, model: ModelSpec, *,
         out["witness_dropped"] = WITNESS_DROPPED_DEVICE
     elif out["valid"] is False:
         out["frontier_dropped"] = FRONTIER_DROPPED_DEVICE
+    _tele.finalize_result(out, tele_acc, hbres=hbres)
     return finish(out)
 
 
@@ -2613,14 +2786,16 @@ def resume_opseq(seq: OpSeq, model: ModelSpec, path: str, *,
             "checkpoint was taken on a different history (digest mismatch)")
     es = encode_search(seq)
     esp = pad_search(es, dims.n_det_pad, dims.n_crash_pad)
-    status, configs, max_depth, dims, used_pallas = _run_kernel(
-        esp, es, model, dims, budget, on_slice=on_slice, resume=carry,
-        deadline=deadline, stop=stop, used_pallas0=prior_pallas)
-    return {"valid": _STATUS[status], "configs": configs,
-            "max_depth": max_depth,
-            "engine": _engine_label(used_pallas, resumed=True),
-            "frontier": dims.frontier,
-            "window": es.window, "concurrency": es.concurrency}
+    status, configs, max_depth, dims, used_pallas, tele_acc = \
+        _run_kernel(esp, es, model, dims, budget, on_slice=on_slice,
+                    resume=carry, deadline=deadline, stop=stop,
+                    used_pallas0=prior_pallas)
+    out = {"valid": _STATUS[status], "configs": configs,
+           "max_depth": max_depth,
+           "engine": _engine_label(used_pallas, resumed=True),
+           "frontier": dims.frontier,
+           "window": es.window, "concurrency": es.concurrency}
+    return _tele.finalize_result(out, tele_acc)
 
 
 # ---------------------------------------------------------------------------
@@ -2665,7 +2840,8 @@ def batch_dead_pad(ess: list[EncodedSearch]) -> int:
 def get_batch_kernel(model: ModelSpec, dims: SearchDims,
                      batch: int = 256, allow_pallas: bool = True,
                      masked: bool = False, masked_crash: bool = False,
-                     dedup: bool = False, vt: int = 8):
+                     dedup: bool = False, vt: int = 8,
+                     telemetry: bool = False):
     # the batch size reaches the built HLO only through the prune and
     # compaction SELECTIONS — the two dominance sites (closure merge at
     # 2F, det expansion at 4F) and the four matrix-compaction sites
@@ -2687,29 +2863,34 @@ def get_batch_kernel(model: ModelSpec, dims: SearchDims,
            _use_matrix_compact(F, 2 * F, batch),
            _use_matrix_compact(F, S, batch))
     key = ("batch", model.name, dims, sel, _dominance_key(),
-           masked, masked_crash, dedup, vt,
+           masked, masked_crash, dedup, vt, telemetry,
            "pallas" if use_p else "xla")
     fn = _KERNEL_CACHE.get(key)
     _kc_record(fn is not None)
     if fn is None:
-        if use_p:
-            # vmap of the fused level-loop kernel: the pallas batching
-            # rule runs one grid program per key, each a whole level
-            # loop with zero per-op overhead (verified row-equal to the
-            # vmapped XLA kernel, tests/test_pallas_level.py)
-            from . import pallas_level
+        with _tele.compile_span(engine="pallas" if use_p else "xla",
+                                batch=batch, frontier=dims.frontier):
+            if use_p:
+                # vmap of the fused level-loop kernel: the pallas
+                # batching rule runs one grid program per key, each a
+                # whole level loop with zero per-op overhead (verified
+                # row-equal to the vmapped XLA kernel,
+                # tests/test_pallas_level.py)
+                from . import pallas_level
 
-            backend = _backend()
-            base = pallas_level.build_pallas_step_fn(
-                model, dims, interpret=backend != "tpu",
-                masked=masked)
-        else:
-            base = build_search_step_fn(model, dims, batch=batch,
-                                        masked=masked,
-                                        masked_crash=masked_crash,
-                                        dedup=dedup)
-        fn = jax.jit(jax.vmap(
-            base, in_axes=(0,) * 19 + (None, None, None) + (0,) * 6))
+                backend = _backend()
+                base = pallas_level.build_pallas_step_fn(
+                    model, dims, interpret=backend != "tpu",
+                    masked=masked, telemetry=telemetry)
+            else:
+                base = build_search_step_fn(model, dims, batch=batch,
+                                            masked=masked,
+                                            masked_crash=masked_crash,
+                                            dedup=dedup,
+                                            telemetry=telemetry)
+            fn = jax.jit(jax.vmap(
+                base,
+                in_axes=(0,) * 19 + (None, None, None) + (0,) * 6))
         _KERNEL_CACHE[key] = fn
     return fn
 
@@ -2729,20 +2910,25 @@ def stack_batch(esps: list[EncodedSearch], *, pad_to: int | None = None):
     n_det = n_crash = 0 — inert pad keys."""
     b = pad_to or len(esps)
     pad = b - len(esps)
+    nbytes = [0]
 
     def st(attr):
         rows = [getattr(e, attr) for e in esps]
         rows += [rows[0]] * pad
-        return jnp.asarray(np.stack(rows))
+        stacked = np.stack(rows)
+        nbytes[0] += stacked.nbytes
+        return jnp.asarray(stacked)
 
     def sc(vals):
         return jnp.asarray(np.array(list(vals) + [0] * pad, np.int32))
 
-    return tuple(st(a) for a in _BATCH_ARG_ATTRS) + (
+    out = tuple(st(a) for a in _BATCH_ARG_ATTRS) + (
         sc(e.n_det for e in esps),
         sc(e.n_crash for e in esps),
         sc(e.dead_lo for e in esps),
         sc(e.dead_tok for e in esps))
+    _tele.record_transfer(nbytes[0])
+    return out
 
 
 def _init_batch_carry(n: int, dims: SearchDims, model: ModelSpec):
@@ -2756,7 +2942,8 @@ def _init_batch_carry(n: int, dims: SearchDims, model: ModelSpec):
 
 
 def _drive_batch_compacting(fn, esps, model: ModelSpec, dims: SearchDims,
-                            budget: int, *, bail: bool = False):
+                            budget: int, *, bail: bool = False,
+                            tele_acc=None):
     """Slice driver for the vmapped batch kernel with active-key
     compaction.
 
@@ -2818,10 +3005,19 @@ def _drive_batch_compacting(fn, esps, model: ModelSpec, dims: SearchDims,
     first = True
     while True:
         t0 = time.perf_counter()
-        carry = fn(*args, jnp.int32(budget), jnp.int32(lvl_cap),
-                   jnp.bool_(bail), *carry)
-        jax.block_until_ready(carry)
+        res = fn(*args, jnp.int32(budget), jnp.int32(lvl_cap),
+                 jnp.bool_(bail), *carry)
+        if tele_acc is not None:
+            # per-lane aux blocks [B, R, C]: keys pace differently, so
+            # only the lane-sum aggregate is meaningful — totals-only
+            carry = res[:6]
+            jax.block_until_ready(carry)
+            tele_acc.add_totals(np.asarray(res[6]))
+        else:
+            carry = res
+            jax.block_until_ready(carry)
         dt = time.perf_counter() - t0
+        _tele.record_device_seconds(dt)
         status = np.asarray(carry[2])
         count = np.asarray(carry[1])
         configs = np.asarray(carry[3])
@@ -3063,13 +3259,16 @@ def search_batch(seqs: list[OpSeq], model: ModelSpec, *,
         # mesh-sharded batches stay on the XLA kernel: partitioning a
         # pallas_call's vmapped grid axis over a mesh is not a path the
         # batching rule guarantees
+        tele_on = _tele.enabled()
+        tele_acc = _tele.SearchTelemetry("device-batch-sharded") \
+            if tele_on else None
         fn = get_batch_kernel(model, dims, batch=len(seqs),
                               allow_pallas=False,
                               masked=any(e.masked for e in ess),
                               masked_crash=any(e.mask_has_crash
                                                for e in ess),
                               dedup=any(e.dedup for e in ess),
-                              vt=dead_pad)
+                              vt=dead_pad, telemetry=tele_on)
         # mesh-sharded batch: fixed size (the key axis must keep
         # covering the mesh), plain slice driver.  Arrays go to the mesh
         # straight from host numpy: in a MULTI-PROCESS job (DCN tier,
@@ -3095,8 +3294,16 @@ def search_batch(seqs: list[OpSeq], model: ModelSpec, *,
         carry = tuple(jax.device_put(c, sharding) for c in carry0)
 
         def call(c, lvl_cap):
-            return fn(*args, jnp.int32(budget), jnp.int32(lvl_cap),
-                      jnp.bool_(False), *c)
+            res = fn(*args, jnp.int32(budget), jnp.int32(lvl_cap),
+                     jnp.bool_(False), *c)
+            if tele_acc is not None:
+                jax.block_until_ready(res[:6])
+                try:
+                    tele_acc.add_totals(np.asarray(res[6]))
+                except Exception:  # noqa: BLE001 — non-addressable
+                    pass           # multi-process shards: skip
+                res = res[:6]
+            return res
 
         # the liveness reduction runs jitted: its output is replicated,
         # so it stays readable when the carry itself is sharded over
@@ -3137,6 +3344,8 @@ def search_batch(seqs: list[OpSeq], model: ModelSpec, *,
                      "engine": "device-batch"}
                 _device_batch_certificate(r)
                 out.append(r)
+        if tele_acc is not None and out:
+            _tele.finalize_result(out[0], tele_acc)
         return _audit_batch(seqs, model, out, audit)
     esps = [pad_search(e, dims.n_det_pad, dims.n_crash_pad,
                        dead_pad=dead_pad) for e in ess]
@@ -3203,6 +3412,8 @@ def _search_batch_ladder(seqs: list[OpSeq], esps: list[EncodedSearch],
     b_dedup = any(e.dedup for e in esps)
     b_vt = len(esps[0].dead_from) if esps else 8
     used_pallas = False  # any rung executed on the pallas engine
+    tele_on = _tele.enabled()
+    acc = _tele.SearchTelemetry("device-batch") if tele_on else None
     while pending:
         d = _dc_replace(dims, frontier=rung)
         want_pallas = _use_pallas(model, d, masked=b_masked,
@@ -3210,11 +3421,11 @@ def _search_batch_ladder(seqs: list[OpSeq], esps: list[EncodedSearch],
         fnr = get_batch_kernel(model, d, batch=len(pending),
                                masked=b_masked,
                                masked_crash=b_mcrash, dedup=b_dedup,
-                               vt=b_vt)
+                               vt=b_vt, telemetry=tele_on)
         try:
             st, ct, cf, dp, ov = _drive_batch_compacting(
                 fnr, [esps[i] for i in pending], model, d, budget,
-                bail=True)
+                bail=True, tele_acc=acc)
         except Exception as e:  # noqa: BLE001 — engine fallback
             if _use_pallas(model, d, masked=b_masked,
                            dedup=b_dedup) and not _PALLAS_BROKEN:
@@ -3228,10 +3439,11 @@ def _search_batch_ladder(seqs: list[OpSeq], esps: list[EncodedSearch],
                                        batch=len(pending),
                                        masked=b_masked,
                                        masked_crash=b_mcrash,
-                                       dedup=b_dedup, vt=b_vt)
+                                       dedup=b_dedup, vt=b_vt,
+                                       telemetry=tele_on)
                 st, ct, cf, dp, ov = _drive_batch_compacting(
                     fnr, [esps[i] for i in pending], model, d,
-                    budget, bail=True)
+                    budget, bail=True, tele_acc=acc)
             else:
                 raise
         used_pallas = used_pallas or (want_pallas
@@ -3282,6 +3494,11 @@ def _search_batch_ladder(seqs: list[OpSeq], esps: list[EncodedSearch],
                  "configs": int(configs[i]),
                  "max_depth": int(depth[i]),
                  "engine": batch_engine}))
+    if acc is not None and out:
+        # batch-aggregate telemetry rides the FIRST result only (the
+        # bucket_batch / decompose_batch convention: one shared stats
+        # dict, not N serialized copies)
+        _tele.finalize_result(out[0], acc)
     return out
 
 

@@ -87,9 +87,10 @@ CLAMP_INF = np.int32(1 << 23)
 #: models whose jstep is elementwise (vmaps to Mosaic-friendly ops)
 SAFE_MODELS = frozenset({"register", "cas-register", "mutex", "noop"})
 
-#: scalar-scratch slots
+#: scalar-scratch slots (12-14 are telemetry-only: level cursor,
+#: per-level crash-closure round count, post-closure occupancy)
 (_CNT, _STA, _CFG, _MD, _OVF, _RUN, _FOUND, _CLGO,
- _CNT0, _CFG0, _MD0, _OVF0) = range(12)
+ _CNT0, _CFG0, _MD0, _OVF0, _TLVL, _TROUNDS, _TOCC) = range(15)
 
 
 def eligible(model, dims, *, masked: bool = False,
@@ -129,18 +130,29 @@ def _iota(n, axis, shape):
 
 
 def build_pallas_step_fn(model, dims, *, interpret: bool = False,
-                         masked: bool = False):
+                         masked: bool = False,
+                         telemetry: bool = False):
     """Build a slice-step function with `build_search_step_fn`'s exact
     signature, backed by one pallas_call running the whole level loop.
 
     ``masked`` is accepted for get_kernel symmetry but must be False —
     masked searches are not pallas-eligible (module doc); the step
     still ACCEPTS the reduction-plane arguments and ignores them, so
-    drivers and differential tests stay signature-uniform."""
+    drivers and differential tests stay signature-uniform.
+
+    ``telemetry`` emits the per-level aux counter block (obs/
+    telemetry.py schema) as an extra output, matching the XLA kernel's
+    telemetry contract.  The block is built from pure elementwise
+    one-hot adds on a tiny [TELE_ROWS, TELE_COLS] plane (no dynamic
+    stores — Mosaic-safe), is write-only, and never feeds back into
+    the search.  mask_killed / dedup_folds are structurally zero here:
+    pallas-eligible searches carry no reductions by design."""
     if masked:
         raise ValueError("masked searches are not pallas-eligible; "
                          "build the XLA kernel instead (see "
                          "pallas_level.eligible)")
+    from ..obs.telemetry import (C_EXP, C_GOAL, C_NEXT, C_OCC, C_OVF,
+                                 C_ROUNDS, TELE_COLS, TELE_ROWS)
     F = dims.frontier
     W = dims.window
     NC = dims.n_crash_pad
@@ -166,8 +178,11 @@ def build_pallas_step_fn(model, dims, *, interpret: bool = False,
     def kernel(scal, tf, tv1, tv2, tinv, tret, sfx, crf, crv1, crv2,
                crinv, p_in, win_in, crash_in, state_in,
                p_out, win_out, crash_out, state_out, scal_out,
-               pc, wc, cc, stc, ps, ws, cs, sts,
-               v2r, g2r, nsr, st):
+               *rest):
+        if telemetry:
+            tele_out = rest[0]
+            rest = rest[1:]
+        (pc, wc, cc, stc, ps, ws, cs, sts, v2r, g2r, nsr, st) = rest
         n_det = scal[5, 0]
         n_crash = scal[6, 0]
         budget = scal[7, 0]
@@ -185,6 +200,9 @@ def build_pallas_step_fn(model, dims, *, interpret: bool = False,
             (scal[1, 0] == -1) & (scal[0, 0] > 0)
             & (scal[2, 0] < budget)
             & ~((bail == 1) & (scal[4, 0] == 1)), 1, 0)
+        if telemetry:
+            tele_out[:] = jnp.zeros((TELE_ROWS, TELE_COLS), jnp.int32)
+            st[_TLVL, 0] = 0
 
         lane_i = _iota(L, 1, (1, L))          # [1, L] candidate lane ids
         is_det_lane = lane_i < W
@@ -392,6 +410,8 @@ def build_pallas_step_fn(model, dims, *, interpret: bool = False,
         def closure_round(_j, carry):
             @pl.when(st[_CLGO, 0] == 1)
             def _():
+                if telemetry:
+                    st[_TROUNDS, 0] = st[_TROUNDS, 0] + 1
                 cvalid = (v2r[:] == 1) & ~is_det_lane
                 p2, w2, c2, s2, svld, ntot = succ_compact(cvalid, F)
                 st[_OVF, 0] = st[_OVF, 0] | jnp.where(ntot > F, 1, 0)
@@ -431,6 +451,8 @@ def build_pallas_step_fn(model, dims, *, interpret: bool = False,
                 st[_CFG0, 0] = st[_CFG, 0]
                 st[_MD0, 0] = st[_MD, 0]
                 st[_OVF0, 0] = st[_OVF, 0]
+                if telemetry:
+                    st[_TROUNDS, 0] = 0
 
                 mask_phase()
                 found0 = jnp.any(g2r[:] == 1)
@@ -452,6 +474,8 @@ def build_pallas_step_fn(model, dims, *, interpret: bool = False,
                 st[_OVF, 0] = st[_OVF, 0] | jnp.where(nk > F, 1, 0)
 
                 count = st[_CNT, 0]
+                if telemetry:
+                    st[_TOCC, 0] = count  # post-closure occupancy
                 aliv = _iota(F, 0, (F, 1)) < count
                 st[_CFG, 0] = st[_CFG, 0] + count
                 st[_MD, 0] = jnp.maximum(
@@ -473,6 +497,28 @@ def build_pallas_step_fn(model, dims, *, interpret: bool = False,
                     (st[_STA, 0] == -1) & (st[_CNT, 0] > 0)
                     & (st[_CFG, 0] < budget)
                     & ~((bail == 1) & (st[_OVF, 0] == 1)), 1, 0)
+                if telemetry:
+                    # one aux row per level, written as a one-hot
+                    # elementwise add on the [TELE_ROWS, TELE_COLS]
+                    # plane (no dynamic stores).  mask_killed (col 2)
+                    # and dedup_folds (col 3) are structurally 0 —
+                    # pallas-eligible searches carry no reductions.
+                    idx = jnp.minimum(st[_TLVL, 0], TELE_ROWS - 1)
+                    roh = (_iota(TELE_ROWS, 0,
+                                 (TELE_ROWS, TELE_COLS)) == idx)
+                    colI = _iota(TELE_COLS, 1, (TELE_ROWS, TELE_COLS))
+                    expd = jnp.sum(v2r[:]).astype(jnp.int32)
+                    vals = (st[_TOCC, 0] * (colI == C_OCC)
+                            + expd * (colI == C_EXP)
+                            + st[_TROUNDS, 0] * (colI == C_ROUNDS)
+                            + st[_CNT, 0] * (colI == C_NEXT)
+                            + jnp.where((st[_OVF, 0] == 1)
+                                        & (st[_OVF0, 0] == 0), 1, 0)
+                            * (colI == C_OVF)
+                            + st[_FOUND, 0] * (colI == C_GOAL))
+                    tele_out[:] = tele_out[:] + jnp.where(
+                        roh, vals.astype(jnp.int32), 0)
+                    st[_TLVL, 0] = st[_TLVL, 0] + 1
             return carry
 
         lax.fori_loop(0, lvl_cap, level, 0)
@@ -493,17 +539,23 @@ def build_pallas_step_fn(model, dims, *, interpret: bool = False,
             raise RuntimeError("pallas tpu unavailable")
         return pltpu.VMEM(shape, dtype)
 
+    out_specs = [pl.BlockSpec(**vmem)] * 4 + [pl.BlockSpec(**smem)]
+    out_shape = [
+        jax.ShapeDtypeStruct((F, 1), jnp.int32),
+        jax.ShapeDtypeStruct((F, W), jnp.int32),
+        jax.ShapeDtypeStruct((F, NC), jnp.int32),
+        jax.ShapeDtypeStruct((F, SW), jnp.int32),
+        jax.ShapeDtypeStruct((5, 1), jnp.int32),
+    ]
+    if telemetry:
+        out_specs.append(pl.BlockSpec(**vmem))
+        out_shape.append(
+            jax.ShapeDtypeStruct((TELE_ROWS, TELE_COLS), jnp.int32))
     call = pl.pallas_call(
         kernel,
         in_specs=[pl.BlockSpec(**smem)] + [pl.BlockSpec(**vmem)] * 14,
-        out_specs=[pl.BlockSpec(**vmem)] * 4 + [pl.BlockSpec(**smem)],
-        out_shape=[
-            jax.ShapeDtypeStruct((F, 1), jnp.int32),
-            jax.ShapeDtypeStruct((F, W), jnp.int32),
-            jax.ShapeDtypeStruct((F, NC), jnp.int32),
-            jax.ShapeDtypeStruct((F, SW), jnp.int32),
-            jax.ShapeDtypeStruct((5, 1), jnp.int32),
-        ],
+        out_specs=out_specs,
+        out_shape=out_shape,
         scratch_shapes=[
             _scratch((F, 1)), _scratch((F, W)), _scratch((F, NC)),
             _scratch((F, SW)),
@@ -548,7 +600,10 @@ def build_pallas_step_fn(model, dims, *, interpret: bool = False,
             crash_f[None, :], crash_v1[None, :], crash_v2[None, :],
             clamp(crash_inv)[None, :],
             p, win, crash, state)
-        p_o, win_o, crash_o, state_o, scal_o = outs
+        if telemetry:
+            p_o, win_o, crash_o, state_o, scal_o, tele_o = outs
+        else:
+            p_o, win_o, crash_o, state_o, scal_o = outs
         # ---- pack planes back to words ----------------------------
         wshift = jnp.asarray(w_bit, jnp.int32)
         cshift = jnp.asarray(c_bit, jnp.int32)
@@ -564,7 +619,10 @@ def build_pallas_step_fn(model, dims, *, interpret: bool = False,
              for wi in range(CW)], axis=1)
         frontier_o = jnp.concatenate(
             [p_o, win_words, crash_words, state_o], axis=1)
-        return (frontier_o, scal_o[0, 0], scal_o[1, 0], scal_o[2, 0],
-                scal_o[3, 0], scal_o[4, 0].astype(bool))
+        out = (frontier_o, scal_o[0, 0], scal_o[1, 0], scal_o[2, 0],
+               scal_o[3, 0], scal_o[4, 0].astype(bool))
+        if telemetry:
+            out = out + (tele_o,)
+        return out
 
     return step

@@ -123,6 +123,17 @@ def add_test_opts(p: argparse.ArgumentParser) -> None:
                         "must-order mask planes.  Sets "
                         "JEPSEN_TPU_DPOR=0 fleet-wide; default on, "
                         "verdict-identical either way.")
+    p.add_argument("--no-telemetry", action="store_true", default=False,
+                   help="Disable the device-search telemetry layer "
+                        "(jepsen_tpu.obs.telemetry): the per-level "
+                        "aux counter block the BFS kernels return "
+                        "next to the carry, the device.level / "
+                        "search.telemetry spans, and the "
+                        "jtpu_search_* metrics.  Sets "
+                        "JEPSEN_TPU_TELEMETRY=0 fleet-wide; default "
+                        "on, verdict-byte-identical either way (off "
+                        "builds are the exact pre-telemetry "
+                        "kernels).")
     p.add_argument("--audit", action="store_true", default=False,
                    help="Independently audit every verdict's "
                         "certificate (jepsen_tpu.analyze.audit): a "
@@ -206,9 +217,14 @@ def test_opt_fn(parsed: argparse.Namespace) -> dict:
         os.environ["JEPSEN_TPU_EXPLAIN"] = "1"
         opts["explain"] = True
     if opts.pop("trace", False):
-        # like --stream: core.run consults the env var, so tracing
-        # reaches every run (and child process) this process starts
+        # env var for children; enable(True) for THIS process — the
+        # env knob is read once and cached (obs/trace.py), so a
+        # process that already consulted enabled() would otherwise
+        # never see the flip
         os.environ["JEPSEN_TPU_TRACE"] = "1"
+        from .obs import trace as _trace
+
+        _trace.enable(True)
         opts["trace"] = True
     if opts.pop("no_lint", False):
         os.environ["JEPSEN_TPU_LINT"] = "0"
@@ -219,6 +235,14 @@ def test_opt_fn(parsed: argparse.Namespace) -> dict:
     if opts.pop("no_dpor", False):
         os.environ["JEPSEN_TPU_DPOR"] = "0"
         opts["no_dpor"] = True
+    if opts.pop("no_telemetry", False):
+        # env var for children; enable(False) for kernels this process
+        # already has a telemetry module loaded for
+        os.environ["JEPSEN_TPU_TELEMETRY"] = "0"
+        from .obs import telemetry as _telemetry
+
+        _telemetry.enable(False)
+        opts["no_telemetry"] = True
     if opts.pop("audit", False):
         # like --lin-decompose/--explain: suites construct their own
         # checkers, so the audit opt-in travels by env var

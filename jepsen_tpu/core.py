@@ -287,8 +287,17 @@ class ClientWorker(Worker):
                     continue
 
             conj_op(test, op)
-            with obs.span(f"op:{op.f}", cat="op",
-                          process=self.process):
+            # gated, not just no-op'd: the span call itself would
+            # build the f-string name and the attrs kwargs dict on
+            # EVERY op even with tracing off — this is the per-op hot
+            # path, and off must allocate nothing (tests/test_obs.py's
+            # overhead guard)
+            if obs.enabled():
+                with obs.span(f"op:{op.f}", cat="op",
+                              process=self.process):
+                    completion = invoke_op(op, test, self.client,
+                                           self.aborting)
+            else:
                 completion = invoke_op(op, test, self.client,
                                        self.aborting)
             _M_OPS.inc(type=completion.type)

@@ -32,6 +32,7 @@ from __future__ import annotations
 import logging
 
 from . import metrics  # noqa: F401  (the registry half)
+from . import telemetry  # noqa: F401  (the device-search aux block)
 from .metrics import REGISTRY  # noqa: F401
 from .trace import (DEFAULT_CAP, SpanRecorder, chrome_trace,  # noqa: F401
                     current_run, drop_recorder, enable, enabled,

@@ -29,6 +29,11 @@ bound — run-scoped detail belongs in spans and result dicts).
 from __future__ import annotations
 
 import threading
+import time
+
+#: process epoch for the derived device-idle fraction (/api/stats):
+#: idle = 1 - device-busy seconds / process uptime
+_PROC_EPOCH = time.monotonic()
 
 
 def _fmt(v: float) -> str:
@@ -270,6 +275,21 @@ def derived_stats(reg: "Registry") -> dict:
     if isinstance(b, Counter):
         out["bucket_padding_efficiency"] = _ratio(
             b.value(kind="useful"), b.value(kind="padded"))
+    # device-idle fraction: of this process's lifetime, the share NOT
+    # spent inside device.slice executions — the fleet strip's
+    # is-the-accelerator-earning-its-keep gauge.  None until any
+    # device time has been recorded (an all-host process is not
+    # "100% idle accelerator", it has no accelerator story at all).
+    ds = reg.get("jtpu_device_seconds_total")
+    if isinstance(ds, Counter):
+        busy = ds.total()
+        up = max(1e-9, time.monotonic() - _PROC_EPOCH)
+        out["device_idle_fraction"] = (
+            round(max(0.0, 1.0 - busy / up), 4) if busy > 0 else None)
+    pr = reg.get("jtpu_search_observed_prune_ratio")
+    if isinstance(pr, Gauge):
+        v = pr.value()
+        out["observed_prune_ratio"] = v if v else None
     return out
 
 
@@ -345,6 +365,39 @@ def _declare(reg: Registry) -> None:
     reg.histogram("jtpu_bucket_seconds",
                   "Wall seconds per bucket stage (prep/device)",
                   ("stage",))
+    # device-search telemetry (obs/telemetry.py): what the kernels did
+    # inside their device.slice windows, level by level
+    reg.counter("jtpu_search_levels_total",
+                "Device BFS levels executed (telemetry-observed)")
+    reg.counter("jtpu_search_expanded_total",
+                "Valid candidate lanes expanded by device BFS levels")
+    reg.counter("jtpu_search_mask_killed_total",
+                "Candidate lanes killed on-device by the hb/dpor "
+                "must-order mask")
+    reg.counter("jtpu_search_dedup_folds_total",
+                "Successor states folded onto the dead-value "
+                "canonical token")
+    reg.counter("jtpu_search_crash_rounds_total",
+                "Crash-closure rounds executed inside device BFS "
+                "levels")
+    reg.counter("jtpu_search_overflows_total",
+                "Device BFS levels that overflowed their frontier "
+                "width")
+    reg.gauge("jtpu_search_observed_prune_ratio",
+              "Observed surviving-lane fraction of the most recent "
+              "device search (0 = decided without search)")
+    reg.histogram("jtpu_search_level_occupancy",
+                  "Live frontier rows per device BFS level",
+                  buckets=(1, 8, 64, 512, 4096, 32768, 262144))
+    # compile/transfer accounting (the fleet-warmup signal)
+    reg.counter("jtpu_device_seconds_total",
+                "Wall seconds spent inside device.slice executions")
+    reg.counter("jtpu_device_transfer_bytes_total",
+                "Host<->device bytes staged for search dispatch, "
+                "by direction", ("direction",))
+    reg.gauge("jtpu_device_memory_bytes",
+              "bytes_in_use reported by the primary device (0 where "
+              "the backend has no memory_stats)")
 
 
 _declare(REGISTRY)

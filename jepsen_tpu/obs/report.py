@@ -41,9 +41,83 @@ def _union_us(ivs: list[tuple[float, float]]) -> float:
     return total + (cur_e - cur_s)
 
 
+def _telemetry_table(events: list) -> dict | None:
+    """The device-search telemetry section of a report, from the
+    ``device.level`` / ``search.telemetry`` / ``device.compile`` /
+    ``device.transfer`` spans a telemetry-on traced run records
+    (obs/telemetry.py).  ``None`` when the trace predates telemetry
+    (or ran with it off) — callers keep their pre-telemetry shape."""
+    levels = [e for e in events if e.get("name") == "device.level"]
+    tele = [e for e in events if e.get("name") == "search.telemetry"]
+    compiles = [e for e in events if e.get("name") == "device.compile"]
+    transfers = [e for e in events
+                 if e.get("name") == "device.transfer"]
+    if not (levels or tele):
+        return None
+    out: dict = {}
+    if levels:
+        per: dict[int, dict] = {}
+        for e in levels:
+            a = e.get("args") or {}
+            lvl = int(a.get("level", 0))
+            r = per.setdefault(lvl, {"level": lvl, "occupancy": 0,
+                                     "expanded": 0, "mask_killed": 0,
+                                     "dedup_folds": 0, "busy_s": 0.0})
+            for k in ("occupancy", "expanded", "mask_killed",
+                      "dedup_folds"):
+                r[k] += int(a.get(k, 0))
+            r["busy_s"] = round(r["busy_s"]
+                                + e.get("dur", 0) / 1e6, 6)
+        rows = [per[k] for k in sorted(per)]
+        for r in rows:
+            den = (r["expanded"] + r["mask_killed"]
+                   + r["dedup_folds"])
+            r["mask_kill_pct"] = (round(100 * r["mask_killed"] / den,
+                                        1) if den else None)
+            r["dedup_fold_pct"] = (round(100 * r["dedup_folds"] / den,
+                                         1) if den else None)
+        out["levels"] = rows
+        out["max_occupancy"] = max(r["occupancy"] for r in rows)
+    if tele:
+        # one span per finished search; totals across the trace plus
+        # the LAST search's predicted-vs-observed prune row (bench
+        # tiers run one search per trace, so last == the search)
+        tot = {"searches": len(tele), "expanded": 0, "mask_killed": 0,
+               "dedup_folds": 0, "overflows": 0}
+        last = (tele[-1].get("args") or {})
+        for e in tele:
+            a = e.get("args") or {}
+            for k in ("expanded", "mask_killed", "dedup_folds",
+                      "overflows"):
+                tot[k] += int(a.get(k, 0) or 0)
+        for k in ("observed_prune_ratio", "predicted_prune_ratio",
+                  "prune_ratio_delta"):
+            if last.get(k) is not None:
+                tot[k] = last[k]
+        if last.get("decided"):
+            tot["decided"] = True
+        out["search"] = tot
+    if compiles:
+        out["compiles"] = {
+            "count": len(compiles),
+            "total_s": round(sum(e.get("dur", 0)
+                                 for e in compiles) / 1e6, 4),
+            "persistent_cache": bool(
+                (compiles[0].get("args") or {}).get(
+                    "persistent_cache"))}
+    if transfers:
+        out["transfer_bytes"] = sum(
+            int((e.get("args") or {}).get("bytes", 0))
+            for e in transfers)
+    return out
+
+
 def phase_table(trace: dict) -> dict:
     """-> {wall_s, phases: [{cat, spans, busy_s, pct}], idle_s,
-    idle_pct, top: [{name, count, total_s}]} for one Chrome trace."""
+    idle_pct, top: [{name, count, total_s}]} for one Chrome trace;
+    traces recorded with device telemetry on additionally carry a
+    ``telemetry`` section (per-level table, predicted-vs-observed
+    prune, compile/transfer accounting)."""
     events = [e for e in trace.get("traceEvents", [])
               if e.get("ph") == "X"]
     if not events:
@@ -78,12 +152,16 @@ def phase_table(trace: dict) -> dict:
                                         for e in es) / 1e6, 4)}
                   for n, es in by_name.items()),
                  key=lambda r: -r["total_s"])[:12]
-    return {"wall_s": round(wall_us / 1e6, 4),
-            "phases": phases,
-            "idle_s": round(idle_us / 1e6, 4),
-            "idle_pct": round(100 * idle_us / wall_us, 1)
-            if wall_us else None,
-            "top": top}
+    out = {"wall_s": round(wall_us / 1e6, 4),
+           "phases": phases,
+           "idle_s": round(idle_us / 1e6, 4),
+           "idle_pct": round(100 * idle_us / wall_us, 1)
+           if wall_us else None,
+           "top": top}
+    t = _telemetry_table(events)
+    if t is not None:
+        out["telemetry"] = t
+    return out
 
 
 def render_report(rep: dict) -> str:
@@ -104,4 +182,57 @@ def render_report(rep: dict) -> str:
         for r in rep["top"]:
             lines.append(f"{r['name']:<32} {r['count']:>6} "
                          f"{r['total_s']:>10.4f}")
+    t = rep.get("telemetry")
+    if t:
+        lines.append("")
+        lines.append("device search telemetry")
+        s = t.get("search")
+        if s:
+            obs_r = s.get("observed_prune_ratio")
+            pred = s.get("predicted_prune_ratio")
+            row = (f"prune ratio: observed "
+                   f"{'n/a' if obs_r is None else obs_r}")
+            if pred is not None:
+                row += f"  predicted {pred}"
+                if s.get("prune_ratio_delta") is not None:
+                    row += f"  delta {s['prune_ratio_delta']}"
+            if s.get("decided"):
+                row += "  (decided statically — no device levels)"
+            lines.append(row)
+            lines.append(f"expanded {s['expanded']}  mask-killed "
+                         f"{s['mask_killed']}  dedup-folds "
+                         f"{s['dedup_folds']}  overflows "
+                         f"{s['overflows']}")
+        c = t.get("compiles")
+        if c:
+            lines.append(f"kernel compiles (cache misses): "
+                         f"{c['count']} in {c['total_s']}s"
+                         + ("  [persistent cache]"
+                            if c.get("persistent_cache") else ""))
+        if t.get("transfer_bytes"):
+            lines.append(f"h2d transfer: {t['transfer_bytes']} bytes")
+        rows = t.get("levels") or []
+        if rows:
+            lines.append(f"{'level':>5} {'occupancy':>9} "
+                         f"{'expanded':>9} {'mask-kill%':>10} "
+                         f"{'dedup%':>7} {'busy_s':>9}")
+
+            def fmt(r):
+                mk = r.get("mask_kill_pct")
+                df = r.get("dedup_fold_pct")
+                return (f"{r['level']:>5} {r['occupancy']:>9} "
+                        f"{r['expanded']:>9} "
+                        f"{'-' if mk is None else mk:>10} "
+                        f"{'-' if df is None else df:>7} "
+                        f"{r['busy_s']:>9.4f}")
+
+            # head + tail, elided middle: a 500-level search must not
+            # print 500 rows
+            if len(rows) <= 24:
+                lines.extend(fmt(r) for r in rows)
+            else:
+                lines.extend(fmt(r) for r in rows[:12])
+                lines.append(f"  ... {len(rows) - 24} level(s) "
+                             f"elided ...")
+                lines.extend(fmt(r) for r in rows[-12:])
     return "\n".join(lines)

@@ -53,22 +53,38 @@ _TRUTHY = ("1", "true", "on", "yes")
 
 #: module override (tests, programmatic enable); None = follow the env
 _forced: bool | None = None
+#: the env knob, read ONCE: ``enabled()`` sits on per-op hot paths
+#: (span per client op, per fold, per slice), and an ``os.environ``
+#: lookup plus ``.strip().lower()`` allocates two strings per call —
+#: with tracing OFF that was the single biggest per-site cost.  The
+#: cached flag makes the off path allocation-free: one function call,
+#: two attribute reads, the shared ``_NOOP`` return.  Processes that
+#: flip the env var mid-run must call ``enable(True)`` /
+#: ``enable(None)`` to apply / re-read it — the CLI's ``--trace``
+#: handler does exactly that for its own process.
+_env_on: bool | None = None
 
 
 def enabled() -> bool:
     """Is tracing on?  ``JEPSEN_TPU_TRACE=1`` (the CLI's ``--trace``)
-    or a programmatic :func:`enable`."""
+    or a programmatic :func:`enable`.  The env knob is cached after
+    the first read (see ``_env_on``)."""
+    global _env_on
     if _forced is not None:
         return _forced
-    return os.environ.get("JEPSEN_TPU_TRACE", "").strip().lower() \
-        in _TRUTHY
+    if _env_on is None:
+        _env_on = os.environ.get(
+            "JEPSEN_TPU_TRACE", "").strip().lower() in _TRUTHY
+    return _env_on
 
 
 def enable(on: bool | None = True) -> None:
     """Force tracing on/off for this process (``None`` reverts to the
-    env knob) — the tests' and REPL's switch."""
-    global _forced
+    env knob, re-read on next use) — the tests' and REPL's switch."""
+    global _forced, _env_on
     _forced = on
+    if on is None:
+        _env_on = None
 
 
 # ---------------------------------------------------------------------------

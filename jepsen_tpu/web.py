@@ -109,7 +109,9 @@ async function pollStats() {
         + " · " + v("jtpu_shed_total") + " shed · watchdog "
         + v("jtpu_watchdog_total")
         + " · corpus " + v("jtpu_corpus_pool_size")
-        + " · rules swept " + v("jtpu_link_rules_swept_total");
+        + " · rules swept " + v("jtpu_link_rules_swept_total")
+        + " · device idle " + (d.device_idle_fraction ?? "n/a")
+        + " · observed prune " + (d.observed_prune_ratio ?? "n/a");
     }
   } catch (e) {}
   setTimeout(pollStats, 5000);
@@ -236,6 +238,40 @@ def campaign_html(base: str, cid: str) -> str:
             + f"</tr>{''.join(rows)}</table></body></html>")
 
 
+#: unicode eighth-blocks for the depth/occupancy sparkline
+_SPARK = "▁▂▃▄▅▆▇█"
+
+
+def _occupancy_sparkline(st: dict, width: int = 60) -> str:
+    """Frontier occupancy per BFS level as a text sparkline — the
+    search's depth profile at a glance (``search_telemetry.per_level``
+    col 0; empty string when the block carries no per-level rows)."""
+    per = st.get("per_level")
+    cols = st.get("per_level_columns") or []
+    try:
+        occ_i = cols.index("occupancy")
+    except ValueError:
+        occ_i = 0
+    if not isinstance(per, list) or not per:
+        return ""
+    try:
+        occ = [int(r[occ_i]) for r in per]
+    except (TypeError, ValueError, IndexError):
+        return ""
+    if len(occ) > width:
+        # fixed-stride downsample keeping the max of each window (a
+        # spike is the interesting part of a depth profile)
+        step = len(occ) / width
+        occ = [max(occ[int(i * step):max(int(i * step) + 1,
+                                         int((i + 1) * step))])
+               for i in range(width)]
+    hi = max(occ) or 1
+    return ("".join(_SPARK[min(len(_SPARK) - 1,
+                               (v * len(_SPARK)) // (hi + 1))]
+                    for v in occ)
+            + f"  ({len(per)} level(s), peak {hi})")
+
+
 def result_block(result: dict) -> str:
     """The verdict panel for a run's result page: validity, engine,
     certificate summary, the static search plan when the result carries
@@ -325,6 +361,29 @@ def result_block(result: dict) -> str:
                         f"masked row(s)")
         rows.append(("dpor", "; ".join(bits) if bits
                      else "on (nothing to prune here)"))
+    st = result.get("search_telemetry")
+    if isinstance(st, dict):
+        # the observed twin of the hb/dpor PREDICTED rows above: what
+        # the device kernel actually did, level by level
+        obs_r = st.get("observed_prune_ratio")
+        pred = st.get("predicted_prune_ratio")
+        line = (f"{st.get('levels', 0)} level(s) / "
+                f"{st.get('slices', 0)} slice(s), max occupancy "
+                f"{st.get('max_occupancy', 0)}; expanded "
+                f"{st.get('expanded', 0)}, mask-killed "
+                f"{st.get('mask_killed', 0)}, dedup-folded "
+                f"{st.get('dedup_folds', 0)}")
+        if obs_r is not None:
+            line += f"; observed prune ratio {obs_r}"
+            if pred is not None:
+                line += (f" vs predicted {pred} "
+                         f"(delta {st.get('prune_ratio_delta')})")
+        if st.get("truncated"):
+            line += " [per-level rows truncated]"
+        rows.append(("device telemetry", line))
+        spark = _occupancy_sparkline(st)
+        if spark:
+            rows.append(("depth/occupancy", spark))
     a = result.get("audit")
     if a:
         rows.append(("audit", "ok (checked %s)" % a.get("checked")

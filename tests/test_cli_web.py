@@ -110,6 +110,72 @@ def test_web_serves_store(tmp_path):
         srv.shutdown()
 
 
+def test_result_block_renders_search_telemetry():
+    """The run result panel shows the device telemetry block: the
+    observed prune ratio diffed against the predicted one, and a
+    depth/occupancy sparkline from the per-level rows."""
+    st = {"levels": 3, "slices": 1, "max_occupancy": 9,
+          "expanded": 40, "mask_killed": 10, "dedup_folds": 2,
+          "crash_rounds": 4, "overflows": 0, "goals": 1,
+          "observed_prune_ratio": 0.769231, "truncated": False,
+          "predicted_prune_ratio": 1.0,
+          "prune_ratio_delta": -0.230769,
+          "per_level": [[3, 12, 4, 0, 1, 6, 0, 0],
+                        [6, 18, 4, 1, 2, 9, 0, 0],
+                        [9, 10, 2, 1, 1, 0, 0, 1]],
+          "per_level_columns": ["occupancy", "expanded",
+                                "mask_killed", "dedup_folds",
+                                "crash_rounds", "next_count",
+                                "overflow", "goal"]}
+    html = web.result_block({"valid": True, "engine": "device-bfs",
+                             "configs": 40,
+                             "search_telemetry": st})
+    assert "device telemetry" in html
+    assert "observed prune ratio 0.769231" in html
+    assert "vs predicted 1.0" in html
+    assert "depth/occupancy" in html
+    assert "peak 9" in html
+    # sparkline math: peak occupancy maps to the tallest block
+    spark = web._occupancy_sparkline(st)
+    assert spark and web._SPARK[-1] in spark
+    # a result without the block renders exactly as before
+    plain = web.result_block({"valid": True, "engine": "device-bfs",
+                              "configs": 40})
+    assert "device telemetry" not in plain
+    assert "depth/occupancy" not in plain
+
+
+def test_api_stats_derived_device_gauges(tmp_path):
+    """/api/stats carries the fleet strip's derived
+    device_idle_fraction and observed_prune_ratio gauges, and the
+    /campaigns page polls them."""
+    import os
+    import urllib.request as rq
+
+    from jepsen_tpu.obs import telemetry as tele
+
+    tele.record_device_seconds(0.01)  # make the idle gauge non-null
+    base = str(tmp_path / "store")
+    os.makedirs(os.path.join(base, "campaigns"), exist_ok=True)
+    srv = web.make_server(host="127.0.0.1", port=0, base=base)
+    port = srv.server_address[1]
+    t = threading.Thread(target=srv.serve_forever, daemon=True)
+    t.start()
+    try:
+        s = json.loads(rq.urlopen(
+            f"http://127.0.0.1:{port}/api/stats").read())
+        d = s["derived"]
+        assert "device_idle_fraction" in d
+        assert 0.0 <= d["device_idle_fraction"] <= 1.0
+        assert "observed_prune_ratio" in d
+        page = rq.urlopen(
+            f"http://127.0.0.1:{port}/campaigns").read().decode()
+        assert "device idle" in page
+        assert "observed prune" in page
+    finally:
+        srv.shutdown()
+
+
 def test_codec_roundtrip():
     for v in [None, 42, "hi", [1, 2, {"a": True}], {"k": [1, None]}]:
         assert codec.decode(codec.encode(v)) == v

@@ -479,4 +479,8 @@ def test_cli_no_dpor_sets_env(monkeypatch):
     assert ns.no_dpor is True
     cli.test_opt_fn(ns)
     assert os.environ.get("JEPSEN_TPU_DPOR") == "0"
-    monkeypatch.delenv("JEPSEN_TPU_DPOR", raising=False)
+    # plain pop, NOT monkeypatch.delenv: test_opt_fn set the var
+    # outside monkeypatch's ledger, so a second delenv records "0" as
+    # the value to RESTORE at teardown — leaking dpor-off into every
+    # test file that runs after this one
+    os.environ.pop("JEPSEN_TPU_DPOR", None)

@@ -140,6 +140,62 @@ def test_tracing_off_is_near_free():
         obs.enable(None)
 
 
+def test_tracing_off_enabled_check_allocates_nothing():
+    """The per-op hot path gates on ``obs.enabled()`` (core.py builds
+    the span name/attrs only inside the gate), so the OFF check itself
+    must do zero allocation per call — the env knob is read once and
+    cached, not ``os.environ.get(...).strip().lower()``ed per op."""
+    import tracemalloc
+
+    obs.enable(False)
+    try:
+        obs.enabled()  # prime any lazy caches outside the window
+        tracemalloc.start()
+        for _ in range(10_000):
+            obs.enabled()
+        _cur, peak = tracemalloc.get_traced_memory()
+        tracemalloc.stop()
+        # tracemalloc's own bookkeeping shows up as a few hundred
+        # bytes; 10k string allocations would be hundreds of KB
+        assert peak < 8_192, f"enabled() allocated {peak}B over 10k " \
+                             f"off-mode calls"
+    finally:
+        obs.enable(None)
+
+
+def test_telemetry_off_is_near_free():
+    """The telemetry knob's off mode (same contract as tracing off):
+    the per-drive gate is one cached flag check, no env lookup, no
+    allocation — off-mode kernels are the exact pre-telemetry builds,
+    so the flag check IS the entire off-mode cost."""
+    import tracemalloc
+
+    from jepsen_tpu.obs import telemetry as tele
+
+    tele.enable(False)
+    try:
+        n = 50_000
+        t0 = time.perf_counter()
+        for _ in range(n):
+            tele.enabled()
+        dt = time.perf_counter() - t0
+        assert dt < 1.0, f"disabled telemetry cost {dt:.3f}s for " \
+                         f"{n} checks"
+        tele.enabled()
+        tracemalloc.start()
+        for _ in range(10_000):
+            tele.enabled()
+        _cur, peak = tracemalloc.get_traced_memory()
+        tracemalloc.stop()
+        assert peak < 8_192, f"telemetry.enabled() allocated " \
+                             f"{peak}B over 10k off-mode calls"
+        # the off-mode accounting helpers are no-ops, not raisers
+        tele.record_device_seconds(0.0)
+        tele.record_transfer(0)
+    finally:
+        tele.enable(None)
+
+
 def test_chrome_trace_schema(tracing):
     run = "t-schema"
     obs.drop_recorder(run)
@@ -494,7 +550,38 @@ def test_phase_table_report(tracing, tmp_path):
     assert rep["idle_s"] < rep["wall_s"]
     assert rep["wall_s"] >= cats["device"]["busy_s"]
     assert "device" in render_report(rep)
+    # a trace with NO telemetry spans keeps the pre-telemetry report
+    # shape — no section in the dict, none in the rendering
+    assert "telemetry" not in rep
+    assert "device search telemetry" not in render_report(rep)
     obs.drop_recorder(run)
+
+
+def test_phase_table_telemetry_section(tracing, tmp_path):
+    """Traces recorded with device telemetry grow the per-level table
+    + predicted-vs-observed prune row (the committed BENCH_trace_1k
+    recording is the canonical instance)."""
+    p = os.path.join(REPO, "BENCH_trace_1k.json")
+    rep = phase_table(json.load(open(p)))
+    t = rep["telemetry"]
+    rows = t["levels"]
+    assert rows and all(r["occupancy"] > 0 for r in rows)
+    assert rows[0]["level"] == 0
+    assert {"mask_kill_pct", "dedup_fold_pct", "busy_s"} \
+        <= set(rows[0])
+    s = t["search"]
+    assert s["observed_prune_ratio"] is not None
+    assert s["prune_ratio_delta"] is not None
+    assert t["compiles"]["count"] >= 1
+    assert t["transfer_bytes"] > 0
+    txt = render_report(rep)
+    assert "device search telemetry" in txt
+    assert "prune ratio: observed" in txt
+    assert "mask-kill%" in txt
+    # the per-level table elides its middle rather than printing
+    # hundreds of rows
+    if len(rows) > 24:
+        assert "elided" in txt
 
 
 def test_trace_report_tool_smoke(tracing, tmp_path):

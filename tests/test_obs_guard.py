@@ -1,0 +1,173 @@
+"""tools/obs_guard.py — the executable bench contract.
+
+The tier-1 gate here is the acceptance criterion itself: the
+committed ``obs_thresholds.json`` must hold against the committed
+``BENCH_trace_*.json`` recordings (including the predicted-vs-
+observed prune-ratio delta rows for the 10k and 10kuniq tiers), and
+the checker's failure modes must actually fire — a contract that
+cannot fail is prose, not a guard.
+"""
+
+import json
+import os
+import sys
+
+import pytest
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+from tools import obs_guard  # noqa: E402
+
+
+# ---------------------------------------------------------------------------
+# The tier-1 gate: committed thresholds hold against committed traces
+# ---------------------------------------------------------------------------
+
+
+def _thresholds():
+    with open(os.path.join(REPO, "obs_thresholds.json")) as f:
+        return json.load(f)
+
+
+def test_committed_bench_contract_holds():
+    th = _thresholds()
+    fails = obs_guard.run_guard({"traces": th["traces"]}, base=REPO)
+    assert fails == [], "the committed bench contract is broken:\n" \
+        + "\n".join(fails)
+
+
+def test_committed_thresholds_cover_prune_delta_tiers():
+    """Acceptance: a recorded predicted-vs-observed prune-ratio delta
+    for at least the 10k and 10kuniq tiers — both the requirement in
+    the threshold file AND the recording in the traces."""
+    th = _thresholds()["traces"]
+    for tier in ("BENCH_trace_10k.json", "BENCH_trace_10kuniq.json"):
+        assert "prune_ratio_delta" in th[tier].get("require", ()), tier
+        with open(os.path.join(REPO, tier)) as f:
+            trace = json.load(f)
+        spans = [e for e in trace["traceEvents"]
+                 if e.get("name") == "search.telemetry"]
+        assert spans, f"{tier}: no search.telemetry span recorded"
+        assert spans[-1]["args"].get("prune_ratio_delta") is not None
+
+
+def test_guard_cli_exit_codes(capsys):
+    assert obs_guard.main([]) == 0
+    out = capsys.readouterr().out
+    assert "ok" in out
+    assert obs_guard.main(["--thresholds", "/nonexistent.json"]) == 2
+
+
+# ---------------------------------------------------------------------------
+# Unit: the failure modes fire
+# ---------------------------------------------------------------------------
+
+
+def test_check_trace_missing_file():
+    fails = obs_guard.check_trace("/nonexistent_trace.json",
+                                  {"min_levels": 1})
+    assert fails and "missing" in fails[0]
+
+
+def _mini_trace(tmp_path, *, with_tele=True, idle=False):
+    """A tiny synthetic trace: one device.slice, two device.level
+    rows, one search.telemetry span."""
+    evs = [{"name": "device.slice", "cat": "device", "ph": "X",
+            "ts": 0.0, "dur": 10.0 if idle else 1_000_000.0,
+            "pid": 1, "tid": 1, "args": {}}]
+    if with_tele:
+        evs += [
+            {"name": "device.level", "cat": "device", "ph": "X",
+             "ts": 0.0, "dur": 500_000.0, "pid": 1, "tid": 1,
+             "args": {"level": 0, "occupancy": 4, "expanded": 6,
+                      "mask_killed": 2, "dedup_folds": 0}},
+            {"name": "device.level", "cat": "device", "ph": "X",
+             "ts": 500_000.0, "dur": 500_000.0, "pid": 1, "tid": 1,
+             "args": {"level": 1, "occupancy": 8, "expanded": 10,
+                      "mask_killed": 6, "dedup_folds": 0}},
+            {"name": "search.telemetry", "cat": "telemetry",
+             "ph": "X", "ts": 1_000_000.0, "dur": 0.0, "pid": 1,
+             "tid": 1,
+             "args": {"levels": 2, "expanded": 16, "mask_killed": 8,
+                      "dedup_folds": 0, "overflows": 0,
+                      "observed_prune_ratio": 0.666667,
+                      "predicted_prune_ratio": 1.0,
+                      "prune_ratio_delta": -0.333333}},
+            {"name": "device.compile", "cat": "device", "ph": "X",
+             "ts": 0.0, "dur": 1000.0, "pid": 1, "tid": 1,
+             "args": {"cache": "miss", "persistent_cache": False}},
+            {"name": "device.transfer", "cat": "device", "ph": "X",
+             "ts": 0.0, "dur": 0.0, "pid": 1, "tid": 1,
+             "args": {"bytes": 1024, "direction": "h2d"}},
+        ]
+    # a padding host span so wall > device busy in the idle case
+    evs.append({"name": "host.pad", "cat": "host", "ph": "X",
+                "ts": 0.0, "dur": 2_000_000.0, "pid": 1, "tid": 2,
+                "args": {}})
+    p = tmp_path / "trace.json"
+    p.write_text(json.dumps({"traceEvents": evs}))
+    return str(p)
+
+
+def test_check_trace_clean_pass(tmp_path):
+    p = _mini_trace(tmp_path)
+    th = {"require": ["telemetry", "prune_ratio_delta"],
+          "max_device_idle_fraction": 0.6, "min_levels": 2,
+          "min_observed_prune_ratio": 0.5,
+          "max_observed_prune_ratio": 1.0,
+          "max_abs_prune_ratio_delta": 0.5,
+          "max_compiles": 1, "min_transfer_bytes": 1024}
+    assert obs_guard.check_trace(p, th) == []
+
+
+def test_check_trace_requires_telemetry(tmp_path):
+    p = _mini_trace(tmp_path, with_tele=False)
+    fails = obs_guard.check_trace(p, {"require": ["telemetry"]})
+    assert fails and "no telemetry" in fails[0]
+    # without the require, a bare trace passes an empty contract
+    assert obs_guard.check_trace(p, {}) == []
+
+
+def test_check_trace_threshold_violations(tmp_path):
+    p = _mini_trace(tmp_path, idle=True)
+    th = {"max_device_idle_fraction": 0.1,
+          "min_levels": 3,
+          "min_observed_prune_ratio": 0.9,
+          "max_abs_prune_ratio_delta": 0.1,
+          "max_compiles": 0,
+          "min_transfer_bytes": 4096}
+    fails = obs_guard.check_trace(p, th)
+    text = "\n".join(fails)
+    for needle in ("device_idle_fraction", "level(s)",
+                   "observed_prune_ratio", "prune_ratio_delta",
+                   "compile(s)", "transfer_bytes"):
+        assert needle in text, f"{needle} check never fired:\n{text}"
+
+
+def test_check_stats_directions_and_null_handling():
+    snap = {"derived": {"kernel_cache_hit_ratio": 0.4,
+                        "device_idle_fraction": 0.95,
+                        "observed_prune_ratio": None}}
+    th = {"min_kernel_cache_hit_ratio": 0.5,
+          "max_device_idle_fraction": 0.9,
+          "min_observed_prune_ratio": 0.1}
+    fails = obs_guard.check_stats(snap, th)
+    text = "\n".join(fails)
+    assert "kernel_cache_hit_ratio" in text
+    assert "device_idle_fraction" in text
+    # null derived gauge is skipped unless required
+    assert "observed_prune_ratio" not in text
+    th["require"] = ["observed_prune_ratio"]
+    fails = obs_guard.check_stats(snap, th)
+    assert any("observed_prune_ratio" in f for f in fails)
+
+
+def test_run_guard_stats_against_live_registry():
+    """With no snapshot supplied the guard reads this process's
+    registry — the in-process smoke path."""
+    fails = obs_guard.run_guard(
+        {"stats": {"max_device_idle_fraction": 1.0}}, base=REPO)
+    assert fails == []

@@ -1,0 +1,248 @@
+#!/usr/bin/env python3
+"""obs_guard — the executable bench contract.
+
+ROADMAP perf claims ("the mask kills X% of lanes", "compiles are
+cache-hits after warmup", "the device is busy, not idle") used to live
+as prose next to BENCH_*.json numbers; nothing re-checked them when
+the kernels changed.  This tool reads a checked-in threshold file and
+fails LOUDLY when a committed bench trace (or a live /api/stats
+snapshot) stops clearing it — wired as a tier-1 test
+(tests/test_obs_guard.py), so a regression shows up as a red test,
+not as a stale paragraph.
+
+  python tools/obs_guard.py                      # obs_thresholds.json
+  python tools/obs_guard.py --thresholds f.json --base /path/to/repo
+  python tools/obs_guard.py --stats stats.json   # /api/stats snapshot
+
+Threshold file schema (JSON)::
+
+  {"traces": {"BENCH_trace_1k.json": {
+       "require": ["telemetry", "prune_ratio_delta"],
+       "max_device_idle_fraction": 0.9,   # 1 - device busy / wall
+       "min_levels": 1,                   # observed BFS levels
+       "min_observed_prune_ratio": 0.01,  # surviving-lane fraction
+       "max_observed_prune_ratio": 1.0,
+       "max_abs_prune_ratio_delta": 1.0,  # |observed - predicted|
+       "max_compiles": 12,                # device.compile spans
+       "min_transfer_bytes": 1}},
+   "stats": {"min_kernel_cache_hit_ratio": 0.5,
+             "min_verdict_cache_hit_ratio": 0.0,
+             "min_bucket_padding_efficiency": 0.0,
+             "max_device_idle_fraction": 1.0,
+             "min_observed_prune_ratio": 0.0}}
+
+Every key is optional; a trace listed with ``{}`` only asserts the
+file exists and parses.  The ``stats`` block checks an ``/api/stats``
+JSON snapshot (``--stats``) — derived gauges that are ``null``
+(nothing recorded yet) fail ``min_*`` checks only when the metric is
+in the block's ``require`` list.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+from jepsen_tpu.obs.report import phase_table  # noqa: E402
+
+DEFAULT_THRESHOLDS = "obs_thresholds.json"
+
+
+def _device_idle_fraction(rep: dict):
+    """1 - device-busy / wall for one folded trace (the trace-local
+    twin of metrics.derived_stats' process-lifetime gauge)."""
+    wall = rep.get("wall_s") or 0.0
+    if wall <= 0:
+        return None
+    busy = sum(p["busy_s"] for p in rep.get("phases", [])
+               if p["cat"] == "device")
+    return round(max(0.0, 1.0 - busy / wall), 4)
+
+
+def check_trace(path: str, th: dict) -> list[str]:
+    """-> failure strings for one trace file against its thresholds
+    (empty = clears the contract)."""
+    name = os.path.basename(path)
+    try:
+        with open(path) as f:
+            rep = phase_table(json.load(f))
+    except FileNotFoundError:
+        return [f"{name}: trace file missing"]
+    except (OSError, ValueError) as e:
+        return [f"{name}: unreadable trace ({e})"]
+    fails = []
+    require = th.get("require", ())
+    tele = rep.get("telemetry")
+    if "telemetry" in require and tele is None:
+        return [f"{name}: no telemetry in trace (recorded with "
+                f"JEPSEN_TPU_TELEMETRY=0, or predates the aux "
+                f"block?)"]
+    tele = tele or {}
+    search = tele.get("search") or {}
+
+    idle = _device_idle_fraction(rep)
+    mx = th.get("max_device_idle_fraction")
+    if mx is not None:
+        if idle is None:
+            fails.append(f"{name}: device_idle_fraction "
+                         f"unmeasurable (empty trace)")
+        elif idle > mx:
+            fails.append(f"{name}: device_idle_fraction {idle} "
+                         f"> max {mx}")
+
+    levels = len(tele.get("levels") or [])
+    mn = th.get("min_levels")
+    if mn is not None and levels < mn:
+        fails.append(f"{name}: {levels} device level(s) "
+                     f"< min {mn}")
+
+    obs_r = search.get("observed_prune_ratio")
+    for key, op, word in (("min_observed_prune_ratio",
+                           lambda v, t: v < t, "<"),
+                          ("max_observed_prune_ratio",
+                           lambda v, t: v > t, ">")):
+        t = th.get(key)
+        if t is None:
+            continue
+        if obs_r is None:
+            fails.append(f"{name}: no observed_prune_ratio in "
+                         f"trace (needed for {key})")
+        elif op(obs_r, t):
+            fails.append(f"{name}: observed_prune_ratio {obs_r} "
+                         f"{word} {key} {t}")
+
+    delta = search.get("prune_ratio_delta")
+    if "prune_ratio_delta" in require and delta is None:
+        fails.append(f"{name}: no predicted-vs-observed "
+                     f"prune_ratio_delta recorded")
+    mx = th.get("max_abs_prune_ratio_delta")
+    if mx is not None and delta is not None and abs(delta) > mx:
+        fails.append(f"{name}: |prune_ratio_delta| {abs(delta)} "
+                     f"> max {mx}")
+
+    mx = th.get("max_compiles")
+    if mx is not None:
+        n = (tele.get("compiles") or {}).get("count", 0)
+        if n > mx:
+            fails.append(f"{name}: {n} kernel compile(s) "
+                         f"> max {mx}")
+
+    mn = th.get("min_transfer_bytes")
+    if mn is not None and tele.get("transfer_bytes", 0) < mn:
+        fails.append(f"{name}: transfer_bytes "
+                     f"{tele.get('transfer_bytes', 0)} < min {mn}")
+    return fails
+
+
+#: stats-block threshold key -> (derived gauge, direction)
+_STATS_CHECKS = {
+    "min_kernel_cache_hit_ratio": ("kernel_cache_hit_ratio", "min"),
+    "min_verdict_cache_hit_ratio": ("verdict_cache_hit_ratio", "min"),
+    "min_bucket_padding_efficiency": ("bucket_padding_efficiency",
+                                      "min"),
+    "max_device_idle_fraction": ("device_idle_fraction", "max"),
+    "min_observed_prune_ratio": ("observed_prune_ratio", "min"),
+    "max_observed_prune_ratio": ("observed_prune_ratio", "max"),
+}
+
+
+def check_stats(snapshot: dict, th: dict) -> list[str]:
+    """-> failure strings for one /api/stats snapshot's derived block
+    against the ``stats`` thresholds."""
+    derived = snapshot.get("derived") or {}
+    require = th.get("require", ())
+    fails = []
+    for key, (gauge, direction) in _STATS_CHECKS.items():
+        t = th.get(key)
+        if t is None:
+            continue
+        v = derived.get(gauge)
+        if v is None:
+            if gauge in require:
+                fails.append(f"stats: derived.{gauge} is null "
+                             f"(required by {key})")
+            continue
+        if direction == "min" and v < t:
+            fails.append(f"stats: derived.{gauge} {v} < {key} {t}")
+        elif direction == "max" and v > t:
+            fails.append(f"stats: derived.{gauge} {v} > {key} {t}")
+    return fails
+
+
+def run_guard(thresholds: dict, *, base: str = ".",
+              stats_snapshot: dict | None = None) -> list[str]:
+    """Every failure across the threshold file (empty = contract
+    holds).  ``base`` anchors relative trace paths."""
+    fails = []
+    for rel, th in (thresholds.get("traces") or {}).items():
+        fails.extend(check_trace(os.path.join(base, rel), th or {}))
+    st = thresholds.get("stats")
+    if st:
+        if stats_snapshot is None:
+            # no snapshot supplied: check THIS process's registry —
+            # meaningful when the caller ran searches first (tests)
+            from jepsen_tpu.obs import metrics as _metrics
+
+            stats_snapshot = _metrics.snapshot()
+        fails.extend(check_stats(stats_snapshot, st))
+    return fails
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(
+        prog="tools/obs_guard.py",
+        description="Check committed bench traces (and optionally an "
+                    "/api/stats snapshot) against the checked-in "
+                    "observability thresholds; exit 1 loudly on any "
+                    "miss.")
+    p.add_argument("--thresholds", default=None,
+                   help=f"threshold JSON (default: "
+                        f"{DEFAULT_THRESHOLDS} next to the traces)")
+    p.add_argument("--base", default=None,
+                   help="directory the trace paths are relative to "
+                        "(default: the thresholds file's directory)")
+    p.add_argument("--stats", default=None,
+                   help="an /api/stats JSON snapshot to check the "
+                        "'stats' thresholds against (default: this "
+                        "process's registry)")
+    args = p.parse_args(argv)
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    tf = args.thresholds or os.path.join(repo, DEFAULT_THRESHOLDS)
+    try:
+        with open(tf) as f:
+            thresholds = json.load(f)
+    except (OSError, ValueError) as e:
+        print(f"obs_guard: cannot read thresholds {tf}: {e}",
+              file=sys.stderr)
+        return 2
+    base = args.base or os.path.dirname(os.path.abspath(tf))
+    snap = None
+    if args.stats:
+        with open(args.stats) as f:
+            snap = json.load(f)
+    fails = run_guard(thresholds, base=base, stats_snapshot=snap)
+    n_traces = len(thresholds.get("traces") or {})
+    if fails:
+        for f in fails:
+            print(f"FAIL {f}", file=sys.stderr)
+        print(f"obs_guard: {len(fails)} threshold(s) violated "
+              f"across {n_traces} trace(s) — the bench contract is "
+              f"BROKEN (re-record BENCH_trace_*.json via "
+              f"`python bench.py --trace` and re-seed "
+              f"{DEFAULT_THRESHOLDS} only if the regression is "
+              f"intended)", file=sys.stderr)
+        return 1
+    print(f"obs_guard: ok — {n_traces} trace(s)"
+          + (" + stats snapshot" if thresholds.get("stats") else "")
+          + " within thresholds")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -2003,6 +2003,17 @@ if __name__ == "__main__":
         from jepsen_tpu.stream.bench import run_stream_tier
 
         run_stream_tier(REPO, quick=QUICK)
+    elif "--fleet-tier" in sys.argv:
+        # the fleet tier (jepsen_tpu/fleet/bench.py): 2 routed
+        # workers behind the rendezvous router, warm-boot first, then
+        # a synthetic client swarm ramp to the throughput knee ->
+        # BENCH_fleet.json + BENCH_trace_fleet.json.  Host-only like
+        # the stream tier; the compile spans in the trace are the
+        # warm-boot evidence either way.
+        os.environ.setdefault("JAX_PLATFORMS", "cpu")
+        from jepsen_tpu.fleet.bench import run_fleet_tier
+
+        run_fleet_tier(REPO, quick=QUICK)
     elif "--run-tier" in sys.argv:
         i = sys.argv.index("--run-tier")
         tier_name = sys.argv[i + 1]

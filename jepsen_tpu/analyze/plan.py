@@ -115,17 +115,59 @@ STREAM_HOST_FOLD_MAX = 1 << 22
 #: only, the pre-lookahead behavior).
 STREAM_INFO_LOOKAHEAD = 16
 
-#: the fork cap: past this many pending `:info` ops the speculative
-#: check is skipped — bounding what the uncertain ops can do is what
-#: keeps the search online (Parsimonious Optimal DPOR's point,
-#: arXiv:2405.11128); the verdict still lands exactly at finalize
+#: the legacy flat fork cap: past this many pending `:info` ops the
+#: speculative check used to be skipped unconditionally — bounding what
+#: the uncertain ops can do is what keeps the search online
+#: (Parsimonious Optimal DPOR's point, arXiv:2405.11128); the verdict
+#: still lands exactly at finalize.  Kept as the characteristic scale
+#: the cost budget below is seeded from (6 pending infos over a
+#: 64-row segment), and as the width-free predicate
+#: :func:`info_fork_gate` still answers.
 STREAM_INFO_FORK_MAX = 6
+
+#: the cost budget the stream engine actually executes now: a fork
+#: check is admitted while ``n_infos * (segment_rows + 1)`` stays under
+#: this.  Seeded at STREAM_INFO_FORK_MAX x a 64-row characteristic
+#: segment, so the old flat cap is recovered at that width while a
+#: narrow crashed cell affords MORE pending infos and a wide one fewer
+#: — the fork's host sub-search sweeps the whole open segment once per
+#: carried state per placement, so infos x rows is its first-order
+#: cost, not infos alone.
+STREAM_INFO_FORK_BUDGET = STREAM_INFO_FORK_MAX * 64
+
+#: absolute `:info` ceiling regardless of segment width: the
+#: sub-search's crash dimension is padded in 32-lane words and capped
+#: at 64 (checker.linearizable.MAX_CRASH); forking past what the
+#: device path could even represent buys nothing
+STREAM_INFO_FORK_HARD_MAX = 32
+
+
+def info_fork_cost(n_infos: int, segment_rows: int) -> int:
+    """The speculative fork check's cost proxy: pending `:info` count
+    times the open segment's row count (+1 so an empty segment still
+    prices each info).  The single number the budget gate compares."""
+    return max(0, n_infos) * (max(0, segment_rows) + 1)
+
+
+def info_fork_budget(n_infos: int, segment_rows: int, *,
+                     budget: int | None = None) -> bool:
+    """May the stream speculatively fork ``n_infos`` pending `:info`
+    ops over a ``segment_rows``-row open segment?  The cost-model
+    replacement for the old flat :func:`info_fork_gate` cap — THE rule
+    the stream engine executes and :func:`stream_plan` predicts: small
+    segments afford more pending infos, wide ones fewer, with
+    :data:`STREAM_INFO_FORK_HARD_MAX` as the absolute ceiling."""
+    cap = STREAM_INFO_FORK_BUDGET if budget is None else budget
+    if not 0 < n_infos <= STREAM_INFO_FORK_HARD_MAX:
+        return False
+    return info_fork_cost(n_infos, segment_rows) <= cap
 
 
 def info_fork_gate(n_infos: int, *, fork_max: int | None = None) -> bool:
-    """May the stream speculatively fork this many pending `:info`
-    ops?  The single rule the stream engine executes and
-    :func:`stream_plan` predicts."""
+    """The legacy width-free predicate: may the stream fork this many
+    pending `:info` ops at the characteristic segment width?  Callers
+    that know their segment width should use :func:`info_fork_budget`;
+    this remains for width-free prediction surfaces."""
     cap = STREAM_INFO_FORK_MAX if fork_max is None else fork_max
     return 0 < n_infos <= cap
 
@@ -180,15 +222,25 @@ def stream_plan(seq: OpSeq, model: ModelSpec, *,
     ttfv_rows = None
     crashed_cells = info_rows = spec_checks = 0
     forkable = True
+    fork_cost_max = 0
     for cseq in cells:
         n = len(cseq)
         if n == 0:
             continue
+        cuts = quiescence_cuts(cseq)
+        bounds = [0, *cuts.tolist(), n]
         infos = int((~cseq.ok).sum())
         if infos:
             crashed_cells += 1
             info_rows += infos
-            if not info_fork_gate(infos):
+            # the fork check sweeps the cell's OPEN segment (rows past
+            # the last quiescence cut) — the budget's width term, and
+            # the same basis the engine uses (its cell buffer holds
+            # exactly the un-folded tail)
+            open_rows = bounds[-1] - bounds[-2]
+            fork_cost_max = max(fork_cost_max,
+                                info_fork_cost(infos, open_rows))
+            if not info_fork_budget(infos, open_rows):
                 forkable = False
             elif horizon:
                 # one speculative fork check per horizon's worth of
@@ -198,8 +250,6 @@ def stream_plan(seq: OpSeq, model: ModelSpec, *,
                 # crash row approximate that)
                 first = int(np.argmax(~cseq.ok))
                 spec_checks += int(cseq.ok[first:].sum()) // horizon
-        cuts = quiescence_cuts(cseq)
-        bounds = [0, *cuts.tolist(), n]
         if len(cuts) and (ttfv_rows is None or int(cuts[0]) < ttfv_rows):
             ttfv_rows = int(cuts[0])
         for i in range(len(bounds) - 1):
@@ -231,6 +281,8 @@ def stream_plan(seq: OpSeq, model: ModelSpec, *,
         "info_lookahead": {
             "horizon": horizon,
             "fork_max": STREAM_INFO_FORK_MAX,
+            "fork_budget": STREAM_INFO_FORK_BUDGET,
+            "fork_cost_max": fork_cost_max,
             "crashed_cells": crashed_cells,
             "info_rows": info_rows,
             "forkable": forkable,

@@ -1998,7 +1998,9 @@ def get_kernel(model: ModelSpec, dims: SearchDims, *,
         # get and never enters here)
         with _tele.compile_span(engine="pallas" if use_p else "xla",
                                 frontier=dims.frontier,
-                                n_det_pad=dims.n_det_pad):
+                                n_det_pad=dims.n_det_pad,
+                                n_crash_pad=dims.n_crash_pad,
+                                window=dims.window, k=dims.k):
             if use_p:
                 from . import pallas_level
 
@@ -2869,7 +2871,10 @@ def get_batch_kernel(model: ModelSpec, dims: SearchDims,
     _kc_record(fn is not None)
     if fn is None:
         with _tele.compile_span(engine="pallas" if use_p else "xla",
-                                batch=batch, frontier=dims.frontier):
+                                batch=batch, frontier=dims.frontier,
+                                n_det_pad=dims.n_det_pad,
+                                n_crash_pad=dims.n_crash_pad,
+                                window=dims.window, k=dims.k):
             if use_p:
                 # vmap of the fused level-loop kernel: the pallas
                 # batching rule runs one grid program per key, each a

@@ -22,17 +22,32 @@ while the live entry set stays flat.  A **size-triggered compaction**
 ``compact_bytes`` / ``JEPSEN_TPU_CACHE_COMPACT_BYTES``) re-reads the
 file (merging entries other processes appended since load), rewrites
 exactly the live set to a temp file, and atomically replaces the jsonl.
-Entries another writer appends *around* a compaction can be lost from
-disk (its handle may briefly point at the replaced inode — every
-writer re-checks its inode each check window and re-points itself) —
-that costs a future cache miss, never a wrong verdict, because
-duplicate keys only ever carry equal values.
+
+Appends and compactions are serialized by an interprocess file lock
+(``flock`` on a ``<path>.lock`` sidecar, plus an in-process RLock for
+threads sharing one instance): an append can no longer race another
+process's merge-read -> replace window, so concurrent writers never
+lose each other's entries — the multi-writer contract the fleet cache
+tier (``jepsen_tpu/fleet/cachestore.py``) builds on.  Every locked
+append re-checks its handle's inode (another process may have
+``os.replace``\\ d the file) and re-points itself before writing.  A
+reader mid-scan of the old file still sees a complete (if stale) view:
+the replace is atomic and the old inode stays readable until its last
+handle closes.  On platforms without ``fcntl`` the lock degrades to
+in-process-only and the old bounded-loss behavior applies.
 """
 
 from __future__ import annotations
 
+import contextlib
 import json
 import os
+import threading
+
+try:
+    import fcntl
+except ImportError:  # pragma: no cover — non-POSIX fallback
+    fcntl = None  # type: ignore[assignment]
 
 from ..obs import metrics as obs_metrics
 
@@ -94,8 +109,54 @@ class VerdictCache:
         self.compacted_away = 0  # superseded lines dropped, lifetime
         self._appends = 0  # since the last size check
         self._fh = None
+        #: interprocess append/compact serialization (satellite of the
+        #: fleet cache tier): flock on <path>.lock + an RLock for
+        #: threads sharing this instance.  The RLock is held across
+        #: the whole critical section so the flock depth counter is
+        #: race-free and reentrant (compact() under _append()).
+        self._tlock = threading.RLock()
+        self._lockfh = None
+        self._lock_depth = 0
         if path is not None:
             self._load(path)
+
+    @contextlib.contextmanager
+    def _locked(self):
+        """Exclusive append/compact section: in-process via the RLock,
+        cross-process via ``flock`` where available."""
+        if self.path is None:
+            yield
+            return
+        with self._tlock:
+            if self._lock_depth == 0 and fcntl is not None:
+                if self._lockfh is None:
+                    os.makedirs(os.path.dirname(self.path) or ".",
+                                exist_ok=True)
+                    self._lockfh = open(f"{self.path}.lock", "a")
+                fcntl.flock(self._lockfh.fileno(), fcntl.LOCK_EX)
+            self._lock_depth += 1
+            try:
+                yield
+            finally:
+                self._lock_depth -= 1
+                if self._lock_depth == 0 and self._lockfh is not None \
+                        and fcntl is not None:
+                    fcntl.flock(self._lockfh.fileno(), fcntl.LOCK_UN)
+
+    def _repoint_fh(self) -> None:
+        """Drop the append handle if another process replaced the file
+        (compaction's ``os.replace``): a handle on the dead inode
+        would silently write every future insert into the void."""
+        if self._fh is None:
+            return
+        try:
+            if os.fstat(self._fh.fileno()).st_ino \
+                    != os.stat(self.path).st_ino:
+                self._fh.close()
+                self._fh = None
+        except OSError:
+            self._fh.close()
+            self._fh = None
 
     def _load(self, path: str) -> None:
         try:
@@ -134,24 +195,19 @@ class VerdictCache:
         if self.path is None:
             return
         self._appends += 1
-        if self._fh is not None and self._appends >= _COMPACT_CHECK_EVERY:
-            # another process may have compacted (os.replace) since we
-            # opened: a handle on the dead inode would silently write
-            # every future insert into the void.  Re-point it — losses
-            # are then bounded to one check window, not a lifetime.
-            try:
-                if os.fstat(self._fh.fileno()).st_ino \
-                        != os.stat(self.path).st_ino:
-                    self._fh.close()
-                    self._fh = None
-            except OSError:
-                self._fh.close()
-                self._fh = None
-        if self._fh is None:
-            os.makedirs(os.path.dirname(self.path) or ".", exist_ok=True)
-            self._fh = open(self.path, "a")
-        self._fh.write(json.dumps(e, separators=(",", ":")) + "\n")
-        self._fh.flush()
+        with self._locked():
+            # under the lock no compaction can be mid-replace, and the
+            # inode re-check runs on EVERY append — an append can
+            # never land on a just-replaced dead inode, so concurrent
+            # writers lose nothing (the pre-lock behavior bounded the
+            # loss to one check window instead)
+            self._repoint_fh()
+            if self._fh is None:
+                os.makedirs(os.path.dirname(self.path) or ".",
+                            exist_ok=True)
+                self._fh = open(self.path, "a")
+            self._fh.write(json.dumps(e, separators=(",", ":")) + "\n")
+            self._fh.flush()
         if self.compact_bytes and self._appends >= _COMPACT_CHECK_EVERY:
             self._appends = 0
             try:
@@ -166,47 +222,56 @@ class VerdictCache:
 
         Entries appended by *other* processes since our load are merged
         in first (a fresh read of the file), so compaction never
-        forgets another writer's verdict it could see.  The replace is
-        atomic (write temp + ``os.replace``), so a concurrent loader
-        always sees either the old or the new complete file."""
+        forgets another writer's verdict it could see.  The whole
+        merge-read -> temp-write -> replace section holds the
+        interprocess lock (:meth:`_locked`), so no other writer can
+        append between our read and our replace — the window the
+        pre-lock code could lose entries in — and two compactors
+        serialize instead of clobbering each other's merges.  The
+        replace itself stays atomic (write temp + ``os.replace``), so
+        a reader mid-scan of the old file finishes its complete (if
+        stale) view and a fresh loader always sees either the old or
+        the new complete file."""
         if self.path is None:
             return 0
-        # merge in other writers' lines (newest-on-disk wins only for
-        # keys we don't hold — ours are equal by determinism anyway)
-        lines = 0
-        try:
-            with open(self.path) as f:
-                for line in f:
-                    line = line.strip()
-                    if not line:
-                        continue
-                    lines += 1
-                    try:
-                        e = json.loads(line)
-                        self._d.setdefault(e["k"], e)
-                    except (ValueError, KeyError):
-                        continue  # torn tail line
-        except OSError:
-            pass
-        tmp = f"{self.path}.compact.{os.getpid()}"
-        try:
-            with open(tmp, "w") as f:
-                for e in self._d.values():
-                    f.write(json.dumps(e, separators=(",", ":")) + "\n")
-                f.flush()
-                os.fsync(f.fileno())
-            os.replace(tmp, self.path)
-        except OSError:
+        with self._locked():
+            # merge in other writers' lines (newest-on-disk wins only
+            # for keys we don't hold — ours are equal by determinism)
+            lines = 0
             try:
-                os.unlink(tmp)
+                with open(self.path) as f:
+                    for line in f:
+                        line = line.strip()
+                        if not line:
+                            continue
+                        lines += 1
+                        try:
+                            e = json.loads(line)
+                            self._d.setdefault(e["k"], e)
+                        except (ValueError, KeyError):
+                            continue  # torn tail line
             except OSError:
                 pass
-            return 0
-        # our append handle points at the replaced inode; reopen so new
-        # inserts land in the compacted file
-        if self._fh is not None:
-            self._fh.close()
-            self._fh = None
+            tmp = f"{self.path}.compact.{os.getpid()}"
+            try:
+                with open(tmp, "w") as f:
+                    for e in self._d.values():
+                        f.write(json.dumps(e, separators=(",", ":"))
+                                + "\n")
+                    f.flush()
+                    os.fsync(f.fileno())
+                os.replace(tmp, self.path)
+            except OSError:
+                try:
+                    os.unlink(tmp)
+                except OSError:
+                    pass
+                return 0
+            # our append handle points at the replaced inode; reopen so
+            # new inserts land in the compacted file
+            if self._fh is not None:
+                self._fh.close()
+                self._fh = None
         dropped = max(0, lines - len(self._d))
         self.compactions += 1
         self.compacted_away += dropped
@@ -242,3 +307,6 @@ class VerdictCache:
         if self._fh is not None:
             self._fh.close()
             self._fh = None
+        if self._lockfh is not None:
+            self._lockfh.close()
+            self._lockfh = None

@@ -1,0 +1,133 @@
+"""Admission control and the fleet's scale signal.
+
+The streaming tier already sheds per-run and per-connection overload
+(op budgets, bounded ingest queues) — but a fleet needs a decision one
+level up: *should this run be admitted at all, and is the tier sized
+right?*  :class:`AdmissionController` folds the aggregated worker
+stats (shed rate, open runs, fold backlog) into one of three
+decisions:
+
+``accept``
+    steady state — route the run.
+``shed``
+    the tier is past its ceiling: refuse the run at the door
+    (the router answers the header with an ``overloaded`` reply)
+    rather than letting it stall every run already admitted.
+``spawn-worker``
+    load is climbing but not critical — admit the run AND signal the
+    supervisor (fleet/__main__.py, or an operator watching
+    ``/api/stats``) to add a worker.  Spawn signals are damped
+    (``min_spawn_interval_s``) so a burst doesn't fork a worker per
+    request.
+
+The controller is deliberately dumb-deterministic: thresholds in, a
+decision out, every decision counted on
+``jtpu_fleet_admission_total`` — an operator can replay why any run
+was shed from the metrics alone.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+from ..obs import metrics as obs_metrics
+
+_M_ADMIT = obs_metrics.REGISTRY.counter(
+    "jtpu_fleet_admission_total",
+    "Fleet admission decisions (accept/shed/spawn-worker)",
+    ("decision",))
+
+
+@dataclasses.dataclass
+class AdmissionPolicy:
+    """Thresholds for the three-way decision.
+
+    ``max_open_runs`` is the hard fleet-wide ceiling (shed past it);
+    ``spawn_open_runs`` the soft one (scale signal).  ``shed_rate``
+    thresholds read the workers' own shed counters as a fraction of
+    ops ingested over the sampling window: workers already shedding
+    means the tier is undersized long before open-runs says so.
+    ``max_fold_backlog`` bounds the summed segment-fold queue depth
+    (jtpu_stream_cells_open) the same way."""
+
+    max_open_runs: int = 512
+    spawn_open_runs: int = 64
+    max_shed_rate: float = 0.5
+    spawn_shed_rate: float = 0.02
+    max_fold_backlog: int = 4096
+    min_spawn_interval_s: float = 10.0
+
+
+def scale_signal(merged: dict) -> dict:
+    """Distill an aggregated ``/api/stats`` snapshot (router's merged
+    worker scrape) into the controller's inputs."""
+
+    def _num(v) -> float:
+        if isinstance(v, dict):
+            return float(sum(_num(x) for x in v.values()))
+        try:
+            return float(v)
+        except (TypeError, ValueError):
+            return 0.0
+
+    values = merged.get("values", merged) or {}
+    return {
+        "open_runs": _num(values.get("jtpu_stream_runs_open", 0)),
+        "fold_backlog": _num(values.get("jtpu_stream_cells_open", 0)),
+        "shed_total": _num(values.get("jtpu_shed_total", 0)),
+        "ops_total": _num(
+            values.get("jtpu_stream_ops_ingested_total", 0)),
+    }
+
+
+class AdmissionController:
+    """Stateful three-way gate over successive :func:`scale_signal`
+    samples.  Shed/ops totals are monotonic counters, so the shed
+    *rate* is computed over the delta between samples."""
+
+    def __init__(self, policy: AdmissionPolicy | None = None,
+                 clock=None):
+        import time
+
+        self.policy = policy or AdmissionPolicy()
+        self._clock = clock or time.monotonic
+        self._last_shed = 0.0
+        self._last_ops = 0.0
+        self._last_spawn = None
+        self.decisions = {"accept": 0, "shed": 0, "spawn-worker": 0}
+
+    def shed_rate(self, signal: dict) -> float:
+        """Shed fraction over the window since the previous sample."""
+        d_shed = max(0.0, signal.get("shed_total", 0.0)
+                     - self._last_shed)
+        d_ops = max(0.0, signal.get("ops_total", 0.0) - self._last_ops)
+        denom = d_shed + d_ops
+        return d_shed / denom if denom else 0.0
+
+    def decide(self, signal: dict) -> str:
+        """One admission decision for the run knocking now."""
+        p = self.policy
+        rate = self.shed_rate(signal)
+        self._last_shed = max(self._last_shed,
+                              signal.get("shed_total", 0.0))
+        self._last_ops = max(self._last_ops,
+                             signal.get("ops_total", 0.0))
+        open_runs = signal.get("open_runs", 0.0)
+        backlog = signal.get("fold_backlog", 0.0)
+        if (open_runs >= p.max_open_runs or rate >= p.max_shed_rate
+                or backlog >= p.max_fold_backlog):
+            decision = "shed"
+        elif open_runs >= p.spawn_open_runs \
+                or rate >= p.spawn_shed_rate:
+            now = self._clock()
+            if self._last_spawn is None or \
+                    now - self._last_spawn >= p.min_spawn_interval_s:
+                self._last_spawn = now
+                decision = "spawn-worker"
+            else:
+                decision = "accept"  # damped: signal already sent
+        else:
+            decision = "accept"
+        self.decisions[decision] += 1
+        _M_ADMIT.inc(decision=decision)
+        return decision

@@ -1,0 +1,291 @@
+"""The fleet bench tier — ``python bench.py --fleet-tier``.
+
+Boots a 2-worker routed fleet IN PROCESS (real TCP between router and
+workers, real sockets from the clients), warm-boots the steady-state
+kernels first, then drives a synthetic client swarm at the router in
+rungs (1, 2, 4, 8 concurrent clients) to find the **throughput knee**
+— the rung past which adding clients stops buying events/sec.  Writes
+``BENCH_fleet.json`` (numbers) and ``BENCH_trace_fleet.json`` (the
+flight recording: ``device.compile`` spans prove the warmup did the
+compiling and the steady state did none).
+
+Three gates ride on the numbers (tools/obs_guard.py enforces them):
+
+  * **parity** — a sample of routed finals is re-checked through a
+    single in-process StreamService; verdict/engine/stream stats
+    (minus cache counters) must match bit-for-bit.  A fleet that
+    answers fast but differently from one service is broken, not fast.
+  * **warmup verified** — the warm-boot report's zero-miss re-probe
+    passed.
+  * **zero steady-state compiles** — the kernel cache's miss counter
+    does not move while the swarm runs: every kernel the steady state
+    needed was compiled at boot.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import random
+import socket
+import tempfile
+import threading
+import time
+
+#: parity re-checks are a full second check each — sample, don't sweep
+_PARITY_SAMPLE = 8
+
+
+def _mk_history(seed: int, n_ops: int):
+    from ..synth import register_history
+
+    rng = random.Random(seed)
+    return register_history(rng, n_ops=n_ops, n_procs=6, overlap=4,
+                            quiesce_every=8, n_values=5, cas=False)
+
+
+def _op_lines(run_id: str, h) -> list[str]:
+    lines = [json.dumps({"run": run_id, "model": "register"})]
+    lines += [json.dumps({"run": run_id, "op": op.to_dict()})
+              for op in h]
+    lines.append(json.dumps({"run": run_id, "end": True}))
+    return lines
+
+
+def _strip_cache(summary: dict) -> dict:
+    """A final summary with the cache counters dropped — they depend
+    on what else the fleet checked, not on this history."""
+    out = dict(summary)
+    stream = dict(out.get("stream") or {})
+    for k in list(stream):
+        if k.startswith("cache_"):
+            stream.pop(k)
+    out["stream"] = stream
+    out.pop("finalized_by", None)
+    return out
+
+
+def _single_service_final(h) -> dict:
+    """The oracle: the same history through ONE in-process service
+    with a fresh in-memory cache."""
+    from ..stream.service import StreamService
+
+    svc = StreamService()
+    replies: list[dict] = []
+    rid = "parity"
+    for line in _op_lines(rid, h):
+        svc.handle_line(line, replies.append)
+    final = [d for d in replies if "final" in d]
+    assert final, "single service never finalized the parity run"
+    return _strip_cache(final[-1]["final"])
+
+
+def _stream_via_router(port: int, runs: list) -> dict:
+    """One synthetic client: stream every (run_id, history) over one
+    router connection; returns finals + shed/error counts."""
+    out = {"finals": {}, "overloaded": 0, "errors": 0}
+    s = socket.create_connection(("127.0.0.1", port), timeout=60)
+    w = s.makefile("w", encoding="utf-8")
+    r = s.makefile("r", encoding="utf-8")
+    for rid, h in runs:
+        for line in _op_lines(rid, h):
+            w.write(line + "\n")
+        w.flush()
+    s.shutdown(socket.SHUT_WR)
+    for raw in r:
+        raw = raw.strip()
+        if not raw:
+            continue
+        d = json.loads(raw)
+        if "final" in d:
+            out["finals"][d["run"]] = d["final"]
+        elif "overloaded" in d:
+            out["overloaded"] += 1
+        elif "error" in d:
+            out["errors"] += 1
+    s.close()
+    return out
+
+
+def _default_warm_shapes(repo: str):
+    """The steady-state shape set: the committed 1k trace's compile
+    spans when present, plus the small-segment shapes the streaming
+    folds actually use (quantized dims for short quiescence runs)."""
+    from .warmup import WarmShape, load_shapes
+
+    shapes = []
+    trace = os.path.join(repo, "BENCH_trace_1k.json")
+    if os.path.exists(trace):
+        try:
+            shapes = load_shapes(trace)
+        except (OSError, ValueError):
+            shapes = []
+    seen = set(shapes)
+    for n_det_pad in (64, 128, 256):
+        for frontier in (64, 128):
+            s = WarmShape(n_det_pad=n_det_pad, frontier=frontier)
+            if s not in seen:
+                seen.add(s)
+                shapes.append(s)
+    return shapes
+
+
+def run_fleet_tier(repo: str, *, quick: bool = False) -> dict:
+    from .. import obs as _obs
+    from ..checker import linearizable as lin
+    from ..stream.service import make_server
+    from .cachestore import FleetCacheStore
+    from .router import FleetRouter, WorkerSpec, make_router_server
+    from .warmup import warm_boot
+
+    _obs.enable(True)
+    n_ops = 120 if quick else 400
+    runs_per_client = 2 if quick else 3
+    rungs = [1, 2, 4] if quick else [1, 2, 4, 8]
+    out: dict = {"metric": "fleet tier: routed multi-worker checking",
+                 "quick": quick, "workers": 2, "n_ops": n_ops,
+                 "runs_per_client": runs_per_client}
+
+    # --- warm boot ----------------------------------------------------
+    shapes = _default_warm_shapes(repo)
+    out["warmup"] = warm_boot(shapes)
+
+    # --- the fleet: 2 workers + router, all in process ----------------
+    tmp = tempfile.mkdtemp(prefix="fleet-bench-")
+    cache_root = os.path.join(tmp, "cache")
+    persist = os.path.join(tmp, "persist")
+    servers = []
+    specs = []
+    caches = []
+    for i in range(2):
+        cache = FleetCacheStore(cache_root, worker_id=f"w{i}")
+        caches.append(cache)
+        srv = make_server("127.0.0.1", 0, cache=cache,
+                          persist_dir=persist)
+        threading.Thread(target=srv.serve_forever,
+                         daemon=True).start()
+        servers.append(srv)
+        specs.append(WorkerSpec(f"w{i}", "127.0.0.1",
+                                srv.server_address[1], persist))
+    router = FleetRouter(specs)
+    router.start_probes()
+    rsrv = make_router_server("127.0.0.1", 0, router)
+    threading.Thread(target=rsrv.serve_forever, daemon=True).start()
+    rport = rsrv.server_address[1]
+
+    # --- the swarm ramp ----------------------------------------------
+    misses0 = lin.KERNEL_CACHE_STATS["misses"]
+    ramp = []
+    all_finals: dict = {}
+    all_hist: dict = {}
+    seed = 1000
+    for clients in rungs:
+        plans = []
+        for c in range(clients):
+            runs = []
+            for j in range(runs_per_client):
+                seed += 1
+                rid = f"s{seed}"
+                h = _mk_history(seed, n_ops)
+                all_hist[rid] = h
+                runs.append((rid, h))
+            plans.append(runs)
+        results: list = [None] * clients
+        t0 = time.perf_counter()
+        threads = [threading.Thread(
+            target=lambda i=i, p=p: results.__setitem__(
+                i, _stream_via_router(rport, p)))
+            for i, p in enumerate(plans)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        wall = time.perf_counter() - t0
+        events = sum(len(h) for p in plans for _rid, h in p)
+        finals = {}
+        shed = errors = 0
+        for res in results:
+            finals.update(res["finals"])
+            shed += res["overloaded"]
+            errors += res["errors"]
+        all_finals.update(finals)
+        expected = clients * runs_per_client
+        ramp.append({
+            "clients": clients,
+            "runs": expected,
+            "finals": len(finals),
+            "events_total": events,
+            "wall_s": round(wall, 4),
+            "events_per_sec": round(events / wall, 1) if wall else None,
+            "overloaded": shed,
+            "errors": errors,
+            "shed_rate": round(shed / max(1, shed + events), 4),
+        })
+    out["steady_state_compile_misses"] = (
+        lin.KERNEL_CACHE_STATS["misses"] - misses0)
+
+    # --- the knee -----------------------------------------------------
+    best = max(ramp, key=lambda r: r["events_per_sec"] or 0)
+    knee = ramp[0]
+    for prev, cur in zip(ramp, ramp[1:]):
+        if (cur["events_per_sec"] or 0) \
+                < 1.15 * (prev["events_per_sec"] or 1):
+            knee = prev
+            break
+        knee = cur
+    out["ramp"] = ramp
+    out["knee"] = {"clients": knee["clients"],
+                   "events_per_sec": knee["events_per_sec"],
+                   "peak_clients": best["clients"],
+                   "peak_events_per_sec": best["events_per_sec"]}
+
+    # --- parity vs one service (sampled) ------------------------------
+    rng = random.Random(7)
+    sample = rng.sample(sorted(all_finals),
+                        min(_PARITY_SAMPLE, len(all_finals)))
+    out["parity_sampled"] = len(sample)
+    out["parity_total_runs"] = len(all_finals)  # not all re-checked
+    parity = True
+    for rid in sample:
+        want = _single_service_final(all_hist[rid])
+        got = _strip_cache(all_finals[rid])
+        if got != want:
+            parity = False
+            out.setdefault("parity_diffs", []).append(
+                {"run": rid, "routed": got, "single": want})
+    out["parity"] = parity
+
+    # --- aggregated scrape sanity ------------------------------------
+    stats = router.aggregate_stats()
+    out["scrape"] = {
+        "n_workers": stats.get("n_workers"),
+        "has_routed_counter":
+            "jtpu_fleet_routed_total" in stats,
+        "has_stream_ops":
+            "jtpu_stream_ops_ingested_total" in stats,
+    }
+
+    # --- teardown -----------------------------------------------------
+    router.stop_probes()
+    rsrv.shutdown()
+    rsrv.server_close()
+    for srv in servers:
+        srv.shutdown()
+        srv.server_close()
+    for cache in caches:
+        cache.close()
+
+    path = os.path.join(repo, "BENCH_fleet.json")
+    with open(path, "w") as f:
+        json.dump(out, f, indent=1)
+    _obs.write_trace(os.path.join(repo, "BENCH_trace_fleet.json"))
+    out["trace"] = "BENCH_trace_fleet.json (device.compile spans: " \
+                   "warmup pays the tax, steady state pays none)"
+    print(json.dumps({
+        "metric": "fleet: routed events/sec at the throughput knee "
+                  f"(2 workers, {n_ops}-op runs)",
+        "value": out["knee"]["events_per_sec"],
+        "unit": "events/sec",
+        "detail": out,
+    }))
+    return out

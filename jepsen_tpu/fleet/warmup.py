@@ -1,0 +1,250 @@
+"""Warm-boot: compile the steady-state bucket kernels BEFORE a worker
+is admitted to the fleet.
+
+A cold ``stream.service`` worker pays the 1.4-2.4s-per-kernel XLA
+compile tax on its first runs — exactly the runs the router just
+routed at it because it looked healthy.  The warm-boot gate inverts
+that: at worker start, :func:`warm_boot` compiles every kernel shape
+the steady state needs (shapes read from a recorded
+``BENCH_trace_*.json``'s ``device.compile`` spans, or from an explicit
+shape manifest) and **verifies** the warmth by re-requesting each
+kernel and asserting a zero miss delta on
+``checker.linearizable.KERNEL_CACHE_STATS``.  Only a verified worker
+is admitted (fleet/__main__.py parses the report line stream/__main__
+prints).
+
+``jax.jit`` is lazy — merely building the jitted callable compiles
+nothing.  Warm-boot therefore *invokes* each kernel once on a minimal
+padded search (one step at the shape's full dims) and blocks until
+ready; the resulting executable lands in the in-process kernel cache
+and, when a persistent XLA compile cache is configured, on disk where
+future worker boots skip the trace+compile entirely (the report's
+``persistent_cache`` field says which regime you're in).
+
+Shape manifest format (JSON)::
+
+    {"shapes": [{"model": ["register", 0, 1], "n_det_pad": 1024,
+                 "n_crash_pad": 32, "window": 32, "k": 4,
+                 "frontier": 128}, ...]}
+
+Trace format: a telemetry trace (``{"traceEvents": [...]}``) whose
+``device.compile`` spans carry ``n_det_pad``/``frontier`` (always) and
+``window``/``n_crash_pad``/``k`` (newer traces); missing fields fall
+back to the steady-state defaults below.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import time
+
+#: steady-state defaults for trace spans predating the wider
+#: compile-span args (window/n_crash_pad/k)
+DEFAULT_WINDOW = 32
+DEFAULT_N_CRASH_PAD = 32
+DEFAULT_K = 4
+DEFAULT_FRONTIER = 64
+DEFAULT_MODEL = ("register", 0, 1)
+
+
+@dataclasses.dataclass(frozen=True)
+class WarmShape:
+    """One kernel shape to compile at boot (mirrors SearchDims plus
+    the model and phase-2 flags of the kernel cache key)."""
+
+    model: tuple = DEFAULT_MODEL  # (name, init, width)
+    n_det_pad: int = 64
+    n_crash_pad: int = DEFAULT_N_CRASH_PAD
+    window: int = DEFAULT_WINDOW
+    k: int = DEFAULT_K
+    frontier: int = DEFAULT_FRONTIER
+    masked: bool = False
+    masked_crash: bool = False
+    dedup: bool = False
+    vt: int = 8
+
+
+def shapes_from_manifest(doc: dict) -> list[WarmShape]:
+    shapes = []
+    for s in doc.get("shapes", []):
+        m = s.get("model", list(DEFAULT_MODEL))
+        shapes.append(WarmShape(
+            model=(str(m[0]), int(m[1]) if len(m) > 1 else 0,
+                   int(m[2]) if len(m) > 2 else 1),
+            n_det_pad=int(s.get("n_det_pad", 64)),
+            n_crash_pad=int(s.get("n_crash_pad",
+                                  DEFAULT_N_CRASH_PAD)),
+            window=int(s.get("window", DEFAULT_WINDOW)),
+            k=int(s.get("k", DEFAULT_K)),
+            frontier=int(s.get("frontier", DEFAULT_FRONTIER)),
+            masked=bool(s.get("masked", False)),
+            masked_crash=bool(s.get("masked_crash", False)),
+            dedup=bool(s.get("dedup", False)),
+            vt=int(s.get("vt", 8)),
+        ))
+    return shapes
+
+
+def shapes_from_trace(doc: dict, *,
+                      model: tuple = DEFAULT_MODEL) -> list[WarmShape]:
+    """The kernel shapes a recorded campaign actually compiled: every
+    ``device.compile`` span in the trace, deduplicated."""
+    out = []
+    seen = set()
+    for ev in doc.get("traceEvents", []):
+        if ev.get("name") != "device.compile":
+            continue
+        args = ev.get("args", {}) or {}
+        if "n_det_pad" not in args:
+            continue  # sharded/batched spans without full dims
+        s = WarmShape(
+            model=tuple(model),
+            n_det_pad=int(args["n_det_pad"]),
+            n_crash_pad=int(args.get("n_crash_pad",
+                                     DEFAULT_N_CRASH_PAD)),
+            window=int(args.get("window", DEFAULT_WINDOW)),
+            k=int(args.get("k", DEFAULT_K)),
+            frontier=int(args.get("frontier", DEFAULT_FRONTIER)),
+        )
+        if s not in seen:
+            seen.add(s)
+            out.append(s)
+    return out
+
+
+def load_shapes(path: str, *,
+                model: tuple = DEFAULT_MODEL) -> list[WarmShape]:
+    """Sniff ``path``: a shape manifest (``{"shapes": [...]}``) or a
+    recorded telemetry trace (``{"traceEvents": [...]}``)."""
+    with open(path) as f:
+        doc = json.load(f)
+    if "shapes" in doc:
+        return shapes_from_manifest(doc)
+    if "traceEvents" in doc:
+        return shapes_from_trace(doc, model=model)
+    raise ValueError(
+        f"{path}: neither a shape manifest ({{'shapes': [...]}}) nor "
+        f"a telemetry trace ({{'traceEvents': [...]}})")
+
+
+def _tiny_seq(model):
+    """A minimal one-op history the model accepts — enough to invoke
+    the kernel once at full padded dims."""
+    from ..history import encode_ops, invoke_op, ok_op
+
+    fc = model.f_codes
+    try:
+        names = list(fc)
+    except TypeError:  # _AnyFCodes (noop model): accepts anything
+        names = ["write"]
+    for cand in ("write", "enqueue", "acquire"):
+        if cand in names:
+            f = cand
+            break
+    else:
+        f = names[0]
+    v = 1 if f in ("write", "enqueue") else None
+    return encode_ops([invoke_op(0, f, v), ok_op(0, f, v)],
+                      fc)
+
+
+def _compile_one(shape: WarmShape, *, telemetry: bool):
+    """Build + INVOKE one kernel at the shape's dims (jit is lazy —
+    invocation is what compiles), blocking until the executable is
+    ready."""
+    import jax
+    import jax.numpy as jnp
+
+    from ..checker import linearizable as lin
+    from ..decompose.schedule import model_from_descriptor
+
+    name, init, width = shape.model
+    model = model_from_descriptor((name, (init,), width))
+    dims = lin.SearchDims(
+        n_det_pad=max(64, int(shape.n_det_pad)),
+        n_crash_pad=max(32, int(shape.n_crash_pad)),
+        window=max(32, int(shape.window)),
+        k=max(1, int(shape.k)),
+        state_width=model.state_width,
+        frontier=max(8, int(shape.frontier)),
+    )
+    es = lin.encode_search(_tiny_seq(model))
+    esp = lin.pad_search(es, dims.n_det_pad, dims.n_crash_pad)
+    fn = lin.get_kernel(model, dims, masked=shape.masked,
+                        masked_crash=shape.masked_crash,
+                        dedup=shape.dedup, vt=shape.vt,
+                        telemetry=telemetry)
+    args = lin.search_args(esp, es)
+    carry = tuple(jnp.asarray(c) for c in lin._init_carry(dims, model))
+    out = fn(*args, jnp.int32(64), jnp.int32(4), jnp.bool_(False),
+             *carry)
+    jax.block_until_ready(out)
+    return dims, model
+
+
+def warm_boot(shapes, *, verify: bool = True) -> dict:
+    """Compile every shape, then verify warmth: a second
+    :func:`get_kernel` pass over the same shapes must be all hits
+    (zero miss delta on ``KERNEL_CACHE_STATS``).
+
+    Returns the admission-gate report::
+
+        {"shapes": N, "compiled": n_misses, "hits": n_hits,
+         "verified": bool, "persistent_cache": bool, "wall_s": float}
+    """
+    from ..checker import linearizable as lin
+    from ..obs import telemetry as _tele
+
+    t0 = time.perf_counter()
+    shapes = list(shapes)
+    telemetry = _tele.enabled()
+    before = dict(lin.KERNEL_CACHE_STATS)
+    warmed = []
+    for s in shapes:
+        warmed.append((s, *_compile_one(s, telemetry=telemetry)))
+    mid = dict(lin.KERNEL_CACHE_STATS)
+    verified = True
+    if verify:
+        # re-request every kernel: each lookup must be a cache hit —
+        # the executable, not just the builder, is resident
+        for s, dims, model in warmed:
+            lin.get_kernel(model, dims, masked=s.masked,
+                           masked_crash=s.masked_crash,
+                           dedup=s.dedup, vt=s.vt,
+                           telemetry=telemetry)
+        after = dict(lin.KERNEL_CACHE_STATS)
+        verified = after["misses"] == mid["misses"]
+    return {
+        "shapes": len(shapes),
+        "compiled": mid["misses"] - before["misses"],
+        "hits": mid["hits"] - before["hits"],
+        "verified": bool(verified),
+        "persistent_cache": _tele.persistent_cache_configured(),
+        "wall_s": round(time.perf_counter() - t0, 6),
+    }
+
+
+def parse_warmup_line(line: str) -> dict | None:
+    """Parse the ``stream service warmup: ...`` stderr line a worker
+    prints (stream/__main__.py) back into a report dict — the fleet
+    admission gate's wire format."""
+    marker = "stream service warmup:"
+    if marker not in line:
+        return None
+    out = {}
+    for tok in line.split(marker, 1)[1].split():
+        if "=" not in tok:
+            continue
+        k, v = tok.split("=", 1)
+        if v in ("true", "false"):
+            out[k] = v == "true"
+        else:
+            try:
+                out[k] = int(v)
+            except ValueError:
+                try:
+                    out[k] = float(v)
+                except ValueError:
+                    out[k] = v
+    return out or None

@@ -398,6 +398,25 @@ def _declare(reg: Registry) -> None:
     reg.gauge("jtpu_device_memory_bytes",
               "bytes_in_use reported by the primary device (0 where "
               "the backend has no memory_stats)")
+    # fleet tier (jepsen_tpu/fleet/): router + admission control
+    reg.counter("jtpu_fleet_routed_total",
+                "Run headers routed to a worker, by worker id",
+                ("worker",))
+    reg.counter("jtpu_fleet_rerouted_total",
+                "Runs re-routed off their worker, by reason",
+                ("reason",))
+    reg.counter("jtpu_fleet_salvaged_total",
+                "Dead-worker open runs finalized from the persist-dir "
+                "salvage path")
+    reg.counter("jtpu_fleet_probe_total",
+                "Worker health probes, by result (ok/failed/dead)",
+                ("result",))
+    reg.counter("jtpu_fleet_admission_total",
+                "Fleet admission decisions (accept/shed/spawn-worker)",
+                ("decision",))
+    reg.gauge("jtpu_fleet_workers",
+              "Live (admitted, probe-passing) workers behind the "
+              "router")
 
 
 _declare(REGISTRY)

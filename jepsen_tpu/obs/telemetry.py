@@ -422,6 +422,22 @@ def transfer_bytes(arrays) -> int:
     return total
 
 
+def persistent_cache_configured() -> bool:
+    """Whether a persistent XLA compile cache is configured — via the
+    ``JEPSEN_TPU_COMPILE_CACHE_DIR`` env or jax's own
+    ``jax_compilation_cache_dir`` knob.  Compile spans record it per
+    miss and the fleet warm-boot gate (fleet/warmup.py) reports it per
+    worker, so cold-start compile tax is attributable either way."""
+    if os.environ.get("JEPSEN_TPU_COMPILE_CACHE_DIR"):
+        return True
+    try:
+        import jax
+
+        return bool(jax.config.jax_compilation_cache_dir)
+    except Exception:  # noqa: BLE001 — old jax without the knob
+        return False
+
+
 def compile_span(**attrs):
     """The ``device.compile`` span wrapping one kernel build+jit on a
     cache MISS (hits never enter it — the lookup is a dict get).  Args
@@ -430,16 +446,9 @@ def compile_span(**attrs):
     trace alone (the fleet-warmup ROADMAP item's signal)."""
     from .. import obs
 
-    persistent = bool(os.environ.get("JEPSEN_TPU_COMPILE_CACHE_DIR"))
-    if not persistent:
-        try:
-            import jax
-
-            persistent = bool(jax.config.jax_compilation_cache_dir)
-        except Exception:  # noqa: BLE001 — old jax without the knob
-            persistent = False
     return obs.span("device.compile", cat="device", cache="miss",
-                    persistent_cache=persistent, **attrs)
+                    persistent_cache=persistent_cache_configured(),
+                    **attrs)
 
 
 def update_device_memory() -> None:

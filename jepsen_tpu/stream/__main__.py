@@ -74,6 +74,21 @@ def main(argv=None) -> int:
                    help="Reap (finalize) runs silent for S seconds: a "
                         "vanished client can't pin an open checker "
                         "forever.  Default: never.")
+    p.add_argument("--fleet-cache", metavar="DIR", default=None,
+                   help="Use the multi-writer fleet cache tier rooted "
+                        "at DIR (fleet/cachestore.py: per-worker "
+                        "write-ahead segments + merge-compaction) "
+                        "instead of the single jsonl --cache.")
+    p.add_argument("--worker-id", default=None,
+                   help="Stable worker id for --fleet-cache segment "
+                        "naming (default: w<pid>).")
+    p.add_argument("--warmup", metavar="MANIFEST", default=None,
+                   help="Warm-boot the steady-state kernels before "
+                        "serving (fleet/warmup.py): MANIFEST is a "
+                        "shape-manifest JSON or a recorded "
+                        "BENCH_trace_*.json; prints a 'stream service "
+                        "warmup:' line to stderr the fleet admission "
+                        "gate parses.")
     args = p.parse_args(argv)
     logging.basicConfig(level=logging.WARNING)
 
@@ -86,13 +101,38 @@ def main(argv=None) -> int:
         model = model_from_descriptor(
             (args.model, (args.init,), args.width))
     cache = None
-    if not args.no_cache:
+    if args.fleet_cache and not args.no_cache:
+        from ..fleet.cachestore import FleetCacheStore
+
+        cache = FleetCacheStore(args.fleet_cache,
+                                worker_id=args.worker_id)
+    elif not args.no_cache:
         path = args.cache
         if path == "store":
             path = default_cache_path()
         cache = VerdictCache(path)
 
+    if args.warmup:
+        # ahead-of-time kernel warmup BEFORE the listen line prints:
+        # the fleet admission gate must not route traffic at a worker
+        # still paying the 1.4-2.4s-per-kernel cold-start tax
+        from ..fleet.warmup import load_shapes, warm_boot
+
+        report = warm_boot(load_shapes(args.warmup))
+        print("stream service warmup: shapes=%d compiled=%d "
+              "verified=%s persistent_cache=%s wall_s=%.3f"
+              % (report["shapes"], report["compiled"],
+                 str(report["verified"]).lower(),
+                 str(report["persistent_cache"]).lower(),
+                 report["wall_s"]),
+              file=sys.stderr, flush=True)
+
     if args.listen:
+        import signal
+        import threading
+
+        from .service import drain_server
+
         host, _, port = args.listen.rpartition(":")
         srv = make_server(host or "127.0.0.1", int(port), model=model,
                           cache=cache,
@@ -104,6 +144,21 @@ def main(argv=None) -> int:
                           ingest_max=args.ingest_queue,
                           persist_dir=args.persist_dir,
                           idle_timeout=args.idle_timeout)
+
+        def _sigterm(_signo, _frame):
+            # graceful drain: finalize every open run (finals still
+            # answered on their own connections), refuse new ones,
+            # then stop serve_forever — the process exits 0.  Run off
+            # the signal frame: drain_server joins handler work and
+            # shutdown() must not be called from the main loop's own
+            # interrupt context.
+            threading.Thread(target=drain_server, args=(srv,),
+                             name="stream-drain", daemon=True).start()
+
+        try:
+            signal.signal(signal.SIGTERM, _sigterm)
+        except ValueError:
+            pass  # not the main thread (embedded use)
         print(f"stream service listening on "
               f"{srv.server_address[0]}:{srv.server_address[1]}",
               file=sys.stderr, flush=True)
@@ -111,6 +166,8 @@ def main(argv=None) -> int:
             srv.serve_forever()
         except KeyboardInterrupt:
             srv.shutdown()
+        if cache is not None:
+            cache.close()
         return 0
 
     service = StreamService(model=model, cache=cache,

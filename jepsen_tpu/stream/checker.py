@@ -673,15 +673,17 @@ class StreamChecker:
         if cell.ok_in_buf - cell.la_checked < h:
             return
         cell.la_checked = cell.ok_in_buf
-        from ..analyze.plan import info_fork_gate
+        from ..analyze.plan import info_fork_budget
 
-        n_infos = sum(1 for r in cell.buf if r.status == "info")
-        if not info_fork_gate(n_infos):
-            # too many uncertain ops to fork online (the POP-DPOR
-            # bound): the verdict still lands exactly at finalize
+        rows = [r for r in cell.buf if r.status in ("ok", "info")]
+        n_infos = sum(1 for r in rows if r.status == "info")
+        if not info_fork_budget(n_infos, len(rows)):
+            # too costly to fork online — the POP-DPOR bound, now a
+            # cost budget (pending infos x open-segment rows, the
+            # sub-search's first-order cost) instead of a flat info
+            # cap: the verdict still lands exactly at finalize
             _M_FORKS.inc(outcome="capped")
             return
-        rows = [r for r in cell.buf if r.status in ("ok", "info")]
         if self._q is not None:
             self._q.put(("spec", cell, rows))
         else:

@@ -14,6 +14,8 @@ Line protocol (one JSON object per line, newline-delimited):
   in   {"process": .., "type": .., ...}                    single-run
                                                            shorthand
   in   {"run": ID, "end": true}                            finalize
+  in   {"drain": true}                 graceful drain: finalize every
+                                       open run, admit no new ones
   out  {"run": ID, "live": {...}}      status changed (open ->
                                        valid-so-far -> invalid)
   out  {"run": ID, "final": {...}}     the final verdict + stream stats
@@ -35,6 +37,15 @@ predictably, not by OOM or unbounded latency.  Two independent guards:
     to the bound, and when the checker can't keep up the line is shed
     with an ``overloaded`` reply instead of stalling the socket (or
     buffering without limit).
+
+Graceful drain (the fleet router's rolling-restart primitive): the
+protocol ``{"drain": true}`` line — or ``SIGTERM`` in ``--listen``
+mode (see __main__.py / :func:`drain_server`) — finalizes every open
+run (finals carry ``finalized_by: "drain"``), then refuses new run
+admissions with an ``{"overloaded": "draining"}`` reply; the process
+exits 0 once drained.  Nothing admitted is ever discarded: every open
+run yields its prefix verdict on the way out, exactly the
+disconnect/EOF salvage contract.
 
 Model names are the shard scheduler's descriptors
 (``decompose.schedule.model_from_descriptor``): register,
@@ -114,8 +125,14 @@ class StreamService:
                  op_budget: int | None = None,
                  persist_dir: str | None = None,
                  idle_timeout: float | None = None,
-                 conn: str | None = None):
+                 conn: str | None = None,
+                 drain_parent=None):
         self.default_model = model
+        #: anything with a truthy ``.draining`` attribute (the TCP
+        #: server in --listen mode): a process-level drain covers
+        #: every connection's service without touching each one
+        self._drain_parent = drain_parent
+        self._draining = False
         #: connection label for log attribution (TCP peer address);
         #: every service log line carries run_id=/conn= via obs.log_ctx
         #: so a multiplexed-run failure names its run and socket
@@ -146,6 +163,22 @@ class StreamService:
     def _log(self, run_id: str | None = None) -> logging.LoggerAdapter:
         """The context-stamped logger for one run's lines."""
         return obs.log_ctx(log, run_id=run_id, conn=self.conn)
+
+    @property
+    def draining(self) -> bool:
+        """New-run admission is closed — this namespace drained, or
+        the owning server is draining process-wide."""
+        return self._draining or bool(
+            getattr(self._drain_parent, "draining", False))
+
+    def drain(self, emit, *, reason: str = "drain") -> None:
+        """Graceful drain: finalize every open run (finals labelled
+        ``finalized_by: reason``) and stop admitting new ones.  The
+        rolling-restart primitive — a drained worker owes nobody a
+        verdict and can exit 0."""
+        with self._lock:
+            self._draining = True
+        self.end_all(emit, reason=reason)
 
     def open_run(self, run_id: str, model) -> None:
         from .checker import StreamChecker
@@ -193,10 +226,17 @@ class StreamService:
             self._handle(d, emit)
 
     def _handle(self, d: dict, emit) -> None:
+        if d.get("drain") and "run" not in d and "op" not in d:
+            self.drain(emit)
+            return
         run_id = d.get("run", DEFAULT_RUN)
         self._last[run_id] = time.monotonic()
         try:
             if "model" in d:
+                if self.draining:
+                    _M_SHED.inc(reason="draining")
+                    emit({"run": run_id, "overloaded": "draining"})
+                    return
                 self.open_run(run_id, self._model_from(d))
                 return
             if d.get("end"):
@@ -211,6 +251,12 @@ class StreamService:
                 return
             chk = self._runs.get(run_id)
             if chk is None:
+                if self.draining:
+                    # a drained namespace admits nothing new — not even
+                    # the bare-op shorthand's implicit open
+                    _M_SHED.inc(reason="draining")
+                    emit({"run": run_id, "overloaded": "draining"})
+                    return
                 if self.default_model is None:
                     emit({"run": run_id,
                           "error": f"unknown run {run_id!r} and no "
@@ -488,7 +534,7 @@ class _Handler(socketserver.StreamRequestHandler):
                                 op_budget=srv.op_budget,
                                 persist_dir=srv.persist_dir,
                                 idle_timeout=srv.idle_timeout,
-                                conn=conn)
+                                conn=conn, drain_parent=srv)
         lock = threading.Lock()
 
         def emit(d: dict) -> None:
@@ -496,6 +542,12 @@ class _Handler(socketserver.StreamRequestHandler):
                 self.wfile.write(
                     (json.dumps(d, separators=(",", ":")) + "\n")
                     .encode())
+
+        # registered so a process-level drain (SIGTERM ->
+        # drain_server) can finalize THIS connection's open runs and
+        # answer on its socket
+        service._drain_emit = emit
+        srv.services.add(service)
 
         import itertools
 
@@ -515,12 +567,45 @@ class _Handler(socketserver.StreamRequestHandler):
             clog.warning("stream service: connection failed",
                          exc_info=True)
         finally:
+            srv.services.discard(service)
             service.abandon()  # no-op when end_all already ran
 
 
 class _TCPServer(socketserver.ThreadingTCPServer):
     allow_reuse_address = True
     daemon_threads = True
+    #: process-level drain flag every connection's StreamService reads
+    #: (via drain_parent); flipped by drain_server
+    draining = False
+
+
+def drain_server(srv: "_TCPServer") -> int:
+    """Gracefully drain a ``--listen`` server: stop admitting new runs
+    on every connection (and every future one), finalize every open
+    run with its final emitted on its own connection, then shut the
+    server down.  Returns how many runs were finalized.  The SIGTERM
+    handler (__main__.py) and the fleet router's rolling worker
+    restarts call this; after it returns the process can exit 0."""
+    srv.draining = True
+    drained = 0
+    for service in list(srv.services):
+        emit = getattr(service, "_drain_emit", None) or (lambda d: None)
+        before = len(service._runs)
+
+        def safe_emit(d, _emit=emit):
+            try:
+                _emit(d)
+            except Exception:  # noqa: BLE001 — client already gone
+                pass
+
+        try:
+            service.drain(safe_emit)
+        except Exception:  # noqa: BLE001 — drain is best-effort per conn
+            log.warning("stream service: drain of one connection "
+                        "failed", exc_info=True)
+        drained += before - len(service._runs)
+    srv.shutdown()
+    return drained
 
 
 def make_server(host: str, port: int, *, model=None, cache=None,
@@ -532,6 +617,8 @@ def make_server(host: str, port: int, *, model=None, cache=None,
                 persist_dir: str | None = None,
                 idle_timeout: float | None = None) -> _TCPServer:
     srv = _TCPServer((host, port), _Handler)
+    srv.draining = False
+    srv.services = set()
     srv.default_model = model
     srv.cache = cache
     srv.witness = witness

@@ -94,3 +94,67 @@ def test_in_memory_cache_compact_is_noop():
     c = VerdictCache(None)
     c.put_verdict("x", True)
     assert c.compact() == 0
+
+
+# ---------------------------------------------------------------------------
+# concurrent writers vs compaction (the interprocess lock)
+# ---------------------------------------------------------------------------
+
+
+def test_concurrent_writer_appends_survive_compaction_race(tmp_path):
+    """The regression the fleet cache tier depends on: a second
+    writer appending WHILE the first compacts must never lose an
+    insert — the lock serializes each append against the
+    merge-read -> replace window, and the per-append inode re-check
+    re-points a handle whose file was just replaced."""
+    import threading
+
+    p = str(tmp_path / "v.jsonl")
+    a = VerdictCache(p, compact_bytes=0)
+    b = VerdictCache(p, compact_bytes=0)
+    n = 200
+    stop = threading.Event()
+
+    def writer():
+        for i in range(n):
+            b.put_verdict(f"b{i}", i % 2 == 0)
+        stop.set()
+
+    def compactor():
+        while not stop.is_set():
+            a.put_verdict("hot", True)
+            a.compact()
+
+    threads = [threading.Thread(target=writer),
+               threading.Thread(target=compactor)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    a.compact()  # final merge picks up b's tail
+    fresh = VerdictCache(p)
+    missing = [i for i in range(n) if fresh.get(f"b{i}") is None]
+    assert missing == [], \
+        f"compaction race lost {len(missing)} concurrent insert(s)"
+    assert fresh.get("hot")["v"] is True
+
+
+def test_reader_mid_scan_sees_complete_old_view(tmp_path):
+    """A loader that opened the file before a compaction keeps reading
+    a complete (stale) view — the replace is atomic and the old inode
+    stays readable; no torn line, no mixed old/new interleaving."""
+    p = str(tmp_path / "v.jsonl")
+    c = VerdictCache(p, compact_bytes=0)
+    for i in range(50):
+        c.put_verdict(f"k{i}", True)
+        c.put_verdict(f"k{i}", False)  # superseded duplicates
+    with open(p) as f:
+        head = [json.loads(f.readline()) for _ in range(10)]
+        c.compact()  # replaces the file under the open handle
+        tail = [json.loads(x) for x in f if x.strip()]
+    # the reader drained the OLD file: every pre-compaction line, in
+    # order, with the superseded duplicates still present
+    assert len(head) + len(tail) == 100
+    assert [e["k"] for e in head] == [f"k{i // 2}" for i in range(10)]
+    # and a fresh loader sees the compacted view
+    assert len(_lines(p)) == 50

@@ -39,6 +39,24 @@ def test_committed_bench_contract_holds():
         + "\n".join(fails)
 
 
+def test_committed_fleet_contract_holds():
+    """Acceptance: BENCH_fleet.json records a throughput knee, a
+    verified warm boot, zero steady-state compile misses, and routed/
+    single-service parity — and the committed thresholds require all
+    of it (a fleet block that doesn't is prose, not a gate)."""
+    th = _thresholds()
+    fleet = th.get("fleet") or {}
+    assert "BENCH_fleet.json" in fleet
+    req = fleet["BENCH_fleet.json"].get("require", ())
+    for key in ("knee", "warmup_verified", "parity"):
+        assert key in req, f"fleet contract does not require {key}"
+    assert fleet["BENCH_fleet.json"][
+        "max_steady_state_compile_misses"] == 0
+    fails = obs_guard.run_guard({"fleet": fleet}, base=REPO)
+    assert fails == [], "the committed fleet contract is broken:\n" \
+        + "\n".join(fails)
+
+
 def test_committed_thresholds_cover_prune_delta_tiers():
     """Acceptance: a recorded predicted-vs-observed prune-ratio delta
     for at least the 10k and 10kuniq tiers — both the requirement in
@@ -163,6 +181,70 @@ def test_check_stats_directions_and_null_handling():
     th["require"] = ["observed_prune_ratio"]
     fails = obs_guard.check_stats(snap, th)
     assert any("observed_prune_ratio" in f for f in fails)
+
+
+def _fleet_doc(**over):
+    doc = {"workers": 2,
+           "warmup": {"shapes": 4, "compiled": 4, "verified": True},
+           "steady_state_compile_misses": 0,
+           "ramp": [{"clients": 1, "shed_rate": 0.0},
+                    {"clients": 2, "shed_rate": 0.0}],
+           "knee": {"clients": 2, "events_per_sec": 5000.0},
+           "parity": True}
+    doc.update(over)
+    return doc
+
+
+_FLEET_TH = {"require": ["knee", "warmup_verified", "parity"],
+             "min_knee_events_per_sec": 1000,
+             "max_warmup_compiles": 8,
+             "max_steady_state_compile_misses": 0,
+             "max_shed_rate": 0.0,
+             "min_workers": 2}
+
+
+def _write_fleet(tmp_path, doc):
+    p = tmp_path / "BENCH_fleet.json"
+    p.write_text(json.dumps(doc))
+    return str(p)
+
+
+def test_check_fleet_clean_pass(tmp_path):
+    p = _write_fleet(tmp_path, _fleet_doc())
+    assert obs_guard.check_fleet(p, _FLEET_TH) == []
+
+
+def test_check_fleet_missing_file():
+    fails = obs_guard.check_fleet("/nonexistent_fleet.json",
+                                  {"require": ["knee"]})
+    assert fails and "missing" in fails[0]
+
+
+def test_check_fleet_failure_modes(tmp_path):
+    p = _write_fleet(tmp_path, _fleet_doc(
+        warmup={"shapes": 4, "compiled": 20, "verified": False},
+        steady_state_compile_misses=3,
+        ramp=[{"clients": 1, "shed_rate": 0.4}],
+        knee={"clients": 1, "events_per_sec": 10.0},
+        parity=False,
+        workers=1))
+    fails = obs_guard.check_fleet(p, _FLEET_TH)
+    text = "\n".join(fails)
+    for needle in ("did not verify", "diverged", "events/sec",
+                   "warm boot compiled", "compile miss", "shed_rate",
+                   "worker(s)"):
+        assert needle in text, f"{needle} check never fired:\n{text}"
+
+
+def test_check_fleet_missing_knee_and_misses(tmp_path):
+    doc = _fleet_doc()
+    doc.pop("knee")
+    doc.pop("steady_state_compile_misses")
+    p = _write_fleet(tmp_path, doc)
+    fails = obs_guard.check_fleet(p, _FLEET_TH)
+    text = "\n".join(fails)
+    assert "no throughput knee" in text
+    assert "not recorded" in text
 
 
 def test_run_guard_stats_against_live_registry():

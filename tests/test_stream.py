@@ -908,40 +908,76 @@ def test_info_lookahead_fuzz_parity_with_finalize_only():
     assert early_la >= 1
 
 
-def test_info_lookahead_respects_fork_cap():
-    """Past STREAM_INFO_FORK_MAX pending :info ops the speculative
-    check is skipped (bounded fork), and the verdict still lands at
-    finalize."""
-    from jepsen_tpu.analyze.plan import STREAM_INFO_FORK_MAX
-
-    m = register(0)
+def _crashed_writer_history(n_infos, n_reads):
+    """One complete write, n_infos crashed writers, n_reads reads with
+    one corrupt value — invalid regardless of how the infos fork."""
     h = [invoke_op(0, "write", 3), ok_op(0, "write", 3)]
-    # more crashed writers than the fork cap
-    for j in range(STREAM_INFO_FORK_MAX + 1):
+    for j in range(n_infos):
         p = 10 + j
         h += [invoke_op(p, "write", 4), info_op(p, "write", 4)]
-    for i in range(40):
+    for i in range(n_reads):
         p = 2 + (i % 3)
         h += [invoke_op(p, "read", None),
               ok_op(p, "read", 2 if i == 5 else 3)]
-    r, at, _ = _stream(h, m, info_lookahead=8)
+    return h
+
+
+def test_info_lookahead_respects_fork_budget():
+    """The speculative fork check is gated by a COST budget (pending
+    :info count x open-segment rows, analyze.plan.info_fork_budget),
+    not a flat info cap: past the budget the check is skipped and the
+    verdict still lands at finalize; under it, a narrow segment
+    affords more pending infos than the old flat cap of 6."""
+    from jepsen_tpu.analyze.plan import (STREAM_INFO_FORK_BUDGET,
+                                         STREAM_INFO_FORK_MAX,
+                                         info_fork_cost)
+
+    m = register(0)
+    # 20 crashed writers: the cost at the first lookahead trigger
+    # (20 infos over a ~28-row open segment) already blows the budget
+    n_infos = 20
+    assert info_fork_cost(n_infos, n_infos + 8) \
+        > STREAM_INFO_FORK_BUDGET
+    h = _crashed_writer_history(n_infos, 40)
+    r, _at, _ = _stream(h, m, info_lookahead=8)
     assert r["stream"]["lookahead_checks"] == 0
     assert r["valid"] is False  # finalize still decides exactly
     d = _direct(encode_ops(h, m.f_codes), m)["valid"]
     assert d is False
+
+    # one past the old flat cap, but the narrow open segment keeps the
+    # cost under budget: the fork now RUNS where it used to be capped
+    h = _crashed_writer_history(STREAM_INFO_FORK_MAX + 1, 40)
+    r, _at, _ = _stream(h, m, info_lookahead=8)
+    assert r["stream"]["lookahead_checks"] >= 1
+    assert r["valid"] is False
+    assert _direct(encode_ops(h, m.f_codes), m)["valid"] is False
 
 
 def test_stream_plan_reports_info_lookahead_gate():
     """analyze.plan.stream_plan predicts the lookahead route with the
     same primitives the checker executes: horizon, fork cap, crashed
     cells, and the speculative-check cadence."""
-    from jepsen_tpu.analyze.plan import (STREAM_INFO_FORK_MAX,
+    from jepsen_tpu.analyze.plan import (STREAM_INFO_FORK_BUDGET,
+                                         STREAM_INFO_FORK_HARD_MAX,
+                                         STREAM_INFO_FORK_MAX,
                                          STREAM_INFO_LOOKAHEAD,
+                                         info_fork_budget,
                                          info_fork_gate, stream_plan)
 
     assert info_fork_gate(1) and info_fork_gate(STREAM_INFO_FORK_MAX)
     assert not info_fork_gate(0)
     assert not info_fork_gate(STREAM_INFO_FORK_MAX + 1)
+
+    # the cost budget: width-scaled, flat-cap-compatible at the
+    # 64-row characteristic width, hard-capped on infos alone
+    assert info_fork_budget(1, 10)
+    assert not info_fork_budget(0, 10)
+    assert info_fork_budget(STREAM_INFO_FORK_MAX, 63)
+    assert not info_fork_budget(STREAM_INFO_FORK_MAX + 1,
+                                STREAM_INFO_FORK_BUDGET)
+    assert info_fork_budget(STREAM_INFO_FORK_MAX + 4, 8)  # narrow
+    assert not info_fork_budget(STREAM_INFO_FORK_HARD_MAX + 1, 0)
 
     m = register(0)
     h = _kill_shaped_history(corrupt=False)
@@ -950,6 +986,8 @@ def test_stream_plan_reports_info_lookahead_gate():
     la = sp["info_lookahead"]
     assert la["horizon"] == STREAM_INFO_LOOKAHEAD
     assert la["fork_max"] == STREAM_INFO_FORK_MAX
+    assert la["fork_budget"] == STREAM_INFO_FORK_BUDGET
+    assert la["fork_cost_max"] >= 1
     assert la["crashed_cells"] == 1
     assert la["info_rows"] == 1
     assert la["forkable"] is True

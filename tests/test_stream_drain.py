@@ -1,0 +1,162 @@
+"""Graceful drain: a checking service must be stoppable without
+losing a verdict.
+
+Contract (stream/service.py): a protocol ``{"drain": true}`` line (or
+SIGTERM in ``--listen`` mode via :func:`drain_server`) flips the
+service to draining — every open run finalizes and answers its
+``final`` on its own connection, new run headers are refused with an
+``overloaded: "draining"`` reply, and the process exits 0.  Rolling
+restarts of fleet workers lose nothing.
+"""
+
+import json
+import os
+import signal
+import socket
+import subprocess
+import sys
+import threading
+import time
+
+from jepsen_tpu.models import register
+from jepsen_tpu.stream.service import (
+    StreamService,
+    drain_server,
+    make_server,
+)
+
+
+def _header(run="r1"):
+    return json.dumps({"run": run, "model": "register", "init": 0})
+
+
+def _op(run, process, typ, f, value):
+    return json.dumps({"run": run,
+                       "op": {"process": process, "type": typ,
+                              "f": f, "value": value}})
+
+
+def _ok_pair(run, process, f, value):
+    return [_op(run, process, "invoke", f, value),
+            _op(run, process, "ok", f, value)]
+
+
+def test_protocol_drain_finalizes_and_refuses_new_runs():
+    svc = StreamService(model=register(0))
+    replies = []
+    svc.handle_line(_header("a"), replies.append)
+    for li in _ok_pair("a", 0, "write", 1):
+        svc.handle_line(li, replies.append)
+    svc.handle_line(json.dumps({"drain": True}), replies.append)
+    finals = [r for r in replies if "final" in r]
+    assert len(finals) == 1 and finals[0]["run"] == "a"
+    assert finals[0]["final"]["valid"] is True
+    assert finals[0]["final"]["finalized_by"] == "drain"
+    # new runs are refused while draining
+    svc.handle_line(_header("b"), replies.append)
+    refused = [r for r in replies if r.get("overloaded")]
+    assert refused and refused[-1]["overloaded"] == "draining"
+    assert refused[-1]["run"] == "b"
+    # and the headerless auto-open path is refused the same way
+    svc2 = StreamService(model=register(0))
+    svc2.drain(replies.append)
+    svc2.handle_line(_op("c", 0, "invoke", "write", 1),
+                     replies.append)
+    assert replies[-1].get("overloaded") == "draining"
+
+
+def test_drain_is_idempotent_and_preserves_prefix_verdict():
+    svc = StreamService(model=register(0))
+    replies = []
+    svc.handle_line(_header("a"), replies.append)
+    for li in _ok_pair("a", 0, "write", 2):
+        svc.handle_line(li, replies.append)
+    # a corrupted read would flip it invalid; drain before the end
+    # yields the verdict of exactly the ingested prefix
+    svc.handle_line(json.dumps({"drain": True}), replies.append)
+    svc.handle_line(json.dumps({"drain": True}), replies.append)
+    finals = [r for r in replies if "final" in r]
+    assert len(finals) == 1  # second drain has nothing left
+
+
+def test_drain_server_over_tcp_finalizes_on_the_connection():
+    srv = make_server("127.0.0.1", 0, model=register(0))
+    t = threading.Thread(target=srv.serve_forever, daemon=True)
+    t.start()
+    port = srv.server_address[1]
+    s = socket.create_connection(("127.0.0.1", port))
+    w = s.makefile("w")
+    r = s.makefile("r")
+    w.write(_header("tcp-run") + "\n")
+    for li in _ok_pair("tcp-run", 0, "write", 1):
+        w.write(li + "\n")
+    w.flush()
+    time.sleep(0.3)  # let the handler ingest before draining
+    drained = drain_server(srv)
+    assert drained == 1
+    # the final arrived on OUR connection, not nowhere
+    s.settimeout(5)
+    reply = json.loads(r.readline())
+    assert reply["run"] == "tcp-run"
+    assert reply["final"]["valid"] is True
+    assert reply["final"]["finalized_by"] == "drain"
+    t.join(timeout=5)
+    assert not t.is_alive(), "serve_forever did not stop"
+    s.close()
+    srv.server_close()
+
+
+def test_drained_server_refuses_new_runs_on_existing_connection():
+    srv = make_server("127.0.0.1", 0, model=register(0))
+    threading.Thread(target=srv.serve_forever, daemon=True).start()
+    port = srv.server_address[1]
+    s = socket.create_connection(("127.0.0.1", port))
+    w = s.makefile("w")
+    r = s.makefile("r")
+    w.write(_header("r0") + "\n")
+    w.flush()
+    time.sleep(0.3)
+    srv.draining = True  # process-level flag (drain_parent chain)
+    w.write(_header("r-new") + "\n")
+    w.flush()
+    s.settimeout(5)
+    reply = json.loads(r.readline())
+    assert reply == {"run": "r-new", "overloaded": "draining"}
+    s.close()
+    srv.shutdown()
+    srv.server_close()
+
+
+def test_sigterm_drains_and_exits_zero(tmp_path):
+    """The process contract end to end: SIGTERM to a listening
+    service finalizes its open runs (finals answered on the live
+    connection) and the process exits 0."""
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "jepsen_tpu.stream",
+         "--listen", "127.0.0.1:0"],
+        stderr=subprocess.PIPE, stdout=subprocess.DEVNULL,
+        text=True, env=env)
+    try:
+        line = proc.stderr.readline()
+        assert "listening on" in line, line
+        port = int(line.rsplit(":", 1)[1])
+        s = socket.create_connection(("127.0.0.1", port))
+        w = s.makefile("w")
+        r = s.makefile("r")
+        w.write(_header("sig-run") + "\n")
+        for li in _ok_pair("sig-run", 0, "write", 3):
+            w.write(li + "\n")
+        w.flush()
+        time.sleep(0.5)
+        proc.send_signal(signal.SIGTERM)
+        s.settimeout(30)
+        reply = json.loads(r.readline())
+        assert reply["run"] == "sig-run"
+        assert reply["final"]["valid"] is True
+        assert reply["final"]["finalized_by"] == "drain"
+        s.close()
+        assert proc.wait(timeout=30) == 0
+    finally:
+        if proc.poll() is None:
+            proc.kill()

@@ -36,6 +36,19 @@ file exists and parses.  The ``stats`` block checks an ``/api/stats``
 JSON snapshot (``--stats``) — derived gauges that are ``null``
 (nothing recorded yet) fail ``min_*`` checks only when the metric is
 in the block's ``require`` list.
+
+A ``fleet`` block checks committed ``BENCH_fleet.json`` summaries
+(the routed-tier contract: warm boots verify, steady state pays zero
+compiles, routed verdicts match a single service, the knee doesn't
+collapse)::
+
+  {"fleet": {"BENCH_fleet.json": {
+       "require": ["knee", "warmup_verified", "parity"],
+       "min_knee_events_per_sec": 2000,
+       "max_warmup_compiles": 24,
+       "max_steady_state_compile_misses": 0,
+       "max_shed_rate": 0.0,
+       "min_workers": 2}}}
 """
 
 from __future__ import annotations
@@ -139,6 +152,73 @@ def check_trace(path: str, th: dict) -> list[str]:
     return fails
 
 
+def check_fleet(path: str, th: dict) -> list[str]:
+    """-> failure strings for one committed BENCH_fleet.json summary
+    against the fleet-tier thresholds (empty = contract holds)."""
+    name = os.path.basename(path)
+    try:
+        with open(path) as f:
+            doc = json.load(f)
+    except FileNotFoundError:
+        return [f"{name}: fleet bench file missing"]
+    except (OSError, ValueError) as e:
+        return [f"{name}: unreadable fleet bench ({e})"]
+    fails = []
+    require = th.get("require", ())
+    warm = doc.get("warmup") or {}
+    knee = doc.get("knee") or {}
+
+    if "knee" in require and not knee:
+        fails.append(f"{name}: no throughput knee recorded")
+    if "warmup_verified" in require and warm.get("verified") \
+            is not True:
+        fails.append(f"{name}: warm boot did not verify "
+                     f"(warmup={warm or None})")
+    if "parity" in require and doc.get("parity") is not True:
+        fails.append(f"{name}: routed verdicts diverged from the "
+                     f"single-service oracle "
+                     f"(parity={doc.get('parity')!r})")
+
+    mn = th.get("min_knee_events_per_sec")
+    if mn is not None:
+        v = knee.get("events_per_sec")
+        if v is None:
+            fails.append(f"{name}: knee has no events_per_sec "
+                         f"(needed for min_knee_events_per_sec)")
+        elif v < mn:
+            fails.append(f"{name}: knee {v} events/sec < min {mn}")
+
+    mx = th.get("max_warmup_compiles")
+    if mx is not None and warm.get("compiled", 0) > mx:
+        fails.append(f"{name}: warm boot compiled "
+                     f"{warm.get('compiled')} kernel(s) > max {mx}")
+
+    mx = th.get("max_steady_state_compile_misses")
+    if mx is not None:
+        n = doc.get("steady_state_compile_misses")
+        if n is None:
+            fails.append(f"{name}: steady_state_compile_misses not "
+                         f"recorded")
+        elif n > mx:
+            fails.append(f"{name}: {n} steady-state kernel compile "
+                         f"miss(es) > max {mx} — warmup no longer "
+                         f"covers the serving shapes")
+
+    mx = th.get("max_shed_rate")
+    if mx is not None:
+        worst = max((r.get("shed_rate", 0.0)
+                     for r in doc.get("ramp") or []), default=0.0)
+        if worst > mx:
+            fails.append(f"{name}: shed_rate {worst} under the ramp "
+                         f"> max {mx}")
+
+    mn = th.get("min_workers")
+    if mn is not None and doc.get("workers", 0) < mn:
+        fails.append(f"{name}: bench ran {doc.get('workers')} "
+                     f"worker(s) < min {mn}")
+    return fails
+
+
 #: stats-block threshold key -> (derived gauge, direction)
 _STATS_CHECKS = {
     "min_kernel_cache_hit_ratio": ("kernel_cache_hit_ratio", "min"),
@@ -181,6 +261,8 @@ def run_guard(thresholds: dict, *, base: str = ".",
     fails = []
     for rel, th in (thresholds.get("traces") or {}).items():
         fails.extend(check_trace(os.path.join(base, rel), th or {}))
+    for rel, th in (thresholds.get("fleet") or {}).items():
+        fails.extend(check_fleet(os.path.join(base, rel), th or {}))
     st = thresholds.get("stats")
     if st:
         if stats_snapshot is None:

@@ -2014,6 +2014,19 @@ if __name__ == "__main__":
         from jepsen_tpu.fleet.bench import run_fleet_tier
 
         run_fleet_tier(REPO, quick=QUICK)
+    elif "--shard-tier" in sys.argv:
+        # the shard tier (jepsen_tpu/checker/shard_bench.py): the
+        # bucket-then-shard scheduler vs the fused single-shape mesh
+        # dispatch over a mixed-size key set -> BENCH_shard.json +
+        # BENCH_trace_shard.json.  Runs on the virtual 8-device CPU
+        # mesh unless real chips are attached — both env knobs must
+        # land before jax imports.
+        os.environ.setdefault("JAX_PLATFORMS", "cpu")
+        os.environ.setdefault(
+            "XLA_FLAGS", "--xla_force_host_platform_device_count=8")
+        from jepsen_tpu.checker.shard_bench import run_shard_tier
+
+        run_shard_tier(REPO, quick=QUICK)
     elif "--run-tier" in sys.argv:
         i = sys.argv.index("--run-tier")
         tier_name = sys.argv[i + 1]

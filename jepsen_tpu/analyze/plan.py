@@ -506,7 +506,8 @@ def explain(history, model: ModelSpec, *,
 
 
 def explain_batch(seqs: list[OpSeq], model: ModelSpec, *,
-                  hb: bool | None = None) -> dict:
+                  hb: bool | None = None,
+                  n_devices: int | None = None) -> dict:
     """The static plan for a BATCH: per-key routing plus the bucketed
     scheduler's exact bucket assignment (checker/bucket.py's
     ``plan_buckets`` over the same keys, merged to the same cap).
@@ -515,6 +516,15 @@ def explain_batch(seqs: list[OpSeq], model: ModelSpec, *,
     host-side, window/crash outliers fall back to the host sweep, and
     the rest group into power-of-two dims buckets, each searched at its
     own tight dims.
+
+    ``n_devices`` switches the mirror to the MESH scheduler
+    (``search_batch_sharded_bucketed`` over that many devices): dims
+    start at the wide frontier, every bucket's lane count rounds up to
+    mesh divisibility (the inert pad lanes bill into ``padded_ops``
+    exactly as the live ``shard_batch`` stats bill them), and the
+    totals carry the fused single-shape counterfactual — so the
+    prediction is field-for-field comparable with the stats the live
+    run reports.
     """
     from ..checker import linearizable as lin
     from ..checker.bucket import _bucket_mode, bucket_key, plan_buckets
@@ -590,15 +600,24 @@ def explain_batch(seqs: list[OpSeq], model: ModelSpec, *,
         "sleep_set_bound": max((b["sleep_set_bound"]
                                 for b in per_key), default=0),
     }
+    frontier = 64 if n_devices else 32
     buckets = []
+    useful_total = padded_total = 0
+    run_all: list[int] = []
     for idxs in plans:
         run = [i for i in idxs if i not in disposed]
-        dims = (lin.batch_dims([ess[i] for i in run], model, frontier=32)
+        dims = (lin.batch_dims([ess[i] for i in run], model,
+                               frontier=frontier)
                 if run else None)
         useful = sum(ess[i].n_det + ess[i].n_crash for i in run)
-        padded = (len(run) * (dims.n_det_pad + dims.n_crash_pad)
+        lanes = (lin._round_up(len(run), n_devices)
+                 if run and n_devices else len(run))
+        padded = (lanes * (dims.n_det_pad + dims.n_crash_pad)
                   if run else 0)
-        buckets.append({
+        useful_total += useful
+        padded_total += padded
+        run_all += run
+        bk = {
             "keys": idxs,
             "n_keys": len(idxs),
             "searched": len(run),
@@ -608,8 +627,12 @@ def explain_batch(seqs: list[OpSeq], model: ModelSpec, *,
             "padded_ops": padded,
             "padding_efficiency": (round(useful / padded, 4)
                                    if padded else None),
-        })
-    return {
+        }
+        if n_devices:
+            bk["lanes"] = lanes if run else 0
+            bk["pad_lanes"] = (lanes - len(run)) if run else 0
+        buckets.append(bk)
+    out = {
         "n_keys": len(seqs),
         "n_buckets": len(plans),
         "bucketing": _enabled,
@@ -621,6 +644,25 @@ def explain_batch(seqs: list[OpSeq], model: ModelSpec, *,
         "dpor": dpor_plan,
         "buckets": buckets,
     }
+    if n_devices:
+        fused_padded = 0
+        if run_all:
+            fdims = lin.batch_dims([ess[i] for i in run_all], model,
+                                   frontier=frontier)
+            fused_padded = lin._round_up(len(run_all), n_devices) \
+                * (fdims.n_det_pad + fdims.n_crash_pad)
+        out.update({
+            "n_devices": n_devices,
+            "useful_ops": useful_total,
+            "padded_ops": padded_total,
+            "padding_efficiency": (round(useful_total / padded_total,
+                                         4) if padded_total else None),
+            "fused_padded_ops": fused_padded or None,
+            "fused_padding_efficiency": (
+                round(useful_total / fused_padded, 4)
+                if fused_padded else None),
+        })
+    return out
 
 
 def _log2(x) -> float:
@@ -638,6 +680,12 @@ def render_plan(plan: dict, *, batch: bool = False) -> str:
                      f"{plan.get('constraint_decided', 0)} "
                      f"constraint-decided, "
                      f"{plan['hard']} host-fallback")
+        if plan.get("n_devices"):
+            lines.append(
+                f"  sharded over {plan['n_devices']} device(s): "
+                f"padding_efficiency={plan.get('padding_efficiency')} "
+                f"(fused counterfactual "
+                f"{plan.get('fused_padding_efficiency')})")
         dp = plan.get("dpor")
         if dp:
             lines.append(

@@ -29,6 +29,13 @@ work instead of one ragged megabatch.
   upfront: bucket PLANNING needs every key's window, which only
   `encode_search` computes.)
 
+The mesh-sharded route gets the same treatment
+(:func:`search_batch_sharded_bucketed`): bucket first, then cover the
+mesh per bucket via ``shard_map`` at that bucket's tight dims, padding
+with inert keys only up to mesh divisibility within the bucket instead
+of one fused batch-wide shape — ScalaBFS's bucket-then-distribute
+applied to the device axis (arXiv:2105.11754).
+
 Bucketing is verdict-identical to the fused batch by construction
 (the searches are exact at any padding, and every key rides the same
 escalation ladder); per-key ``configs``/``engine`` labels come
@@ -61,6 +68,15 @@ _M_BUCKET_OPS = obs_metrics.REGISTRY.counter(
 _M_BUCKET_S = obs_metrics.REGISTRY.histogram(
     "jtpu_bucket_seconds",
     "Wall seconds per bucket stage (prep/device)", ("stage",))
+#: the mesh-sharded twins: rows here include the inert
+#: mesh-divisibility pad lanes in "padded" (billed honestly against
+#: efficiency, though they never touch configs/occupancy counters)
+_M_SHARD_OPS = obs_metrics.REGISTRY.counter(
+    "jtpu_shard_ops_total",
+    "Mesh-sharded bucketed batch rows, useful vs padded", ("kind",))
+_M_SHARD_S = obs_metrics.REGISTRY.histogram(
+    "jtpu_shard_seconds",
+    "Wall seconds per sharded bucket stage (prep/device)", ("stage",))
 
 #: default cap on distinct buckets per batch: each bucket is a device
 #: dispatch (and possibly a compile on first contact), so unbounded
@@ -342,4 +358,229 @@ def search_batch_bucketed(seqs: list[OpSeq], model: ModelSpec, *,
     # object on N results invites spooky cross-key mutation
     if results:
         results[0].setdefault("bucket_batch", stats)
+    return results
+
+
+def search_batch_sharded_bucketed(seqs: list[OpSeq], model: ModelSpec,
+                                  sharding, *,
+                                  budget: int = 2_000_000,
+                                  hb: bool | None = None,
+                                  dpor: bool | None = None
+                                  ) -> list[dict]:
+    """Bucket-then-shard: the mesh analog of `search_batch_bucketed`.
+
+    The fused sharded path pins EVERY key to one batch-wide
+    `SearchDims` "to keep the mesh covered", so one contentious key
+    inflates the padded rows of all shards.  Here keys bucket exactly
+    like the single-device scheduler (same `bucket_key` quantization,
+    same `plan_buckets` merge), and each bucket covers the mesh on its
+    own via `linearizable._search_batch_sharded_fixed` — a `shard_map`
+    dispatch at the bucket's tight dims, padded with inert keys only
+    up to mesh divisibility WITHIN the bucket.  Host prep for bucket
+    k+1 (greedy witness, HB/constraint disposal, DPOR attach, tight
+    pad) pipelines under bucket k's device time on the same
+    one-worker prep thread.
+
+    Verdict- and certificate-identical to the fused sharded path by
+    construction: every key runs the same exact search at its bucket's
+    padding, results carry the same "device-batch" engine label and
+    drop-reason certificates, and overflowed keys take the same solo
+    redo.  The FIRST result carries the ``shard_batch`` stats dict —
+    per-bucket padding efficiency (mesh pad lanes billed in
+    padded_ops), the fused-shape counterfactual, kernel-cache hits,
+    shard count — mirrored exactly by
+    `analyze.plan.explain_batch(..., n_devices=...)`.
+    """
+    from . import linearizable as lin
+    from ..analyze.dpor import resolve_dpor
+    from ..analyze.hb import maybe_hb, resolve_hb
+    from ..obs import telemetry as _tele
+
+    hb = resolve_hb(hb)
+    dpor_on = resolve_dpor(dpor)
+    n = len(seqs)
+    t_start = time.perf_counter()
+    kc0 = lin.kernel_cache_stats()
+    n_dev = getattr(sharding, "num_devices", 1) or 1
+    tele_acc = _tele.SearchTelemetry("device-batch-sharded") \
+        if _tele.enabled() else None
+    ess = [lin.encode_search(s) for s in seqs]
+    results: list = [None] * n
+    hard, fit = [], []
+    for i, e in enumerate(ess):
+        (hard if e.window > lin.MAX_WINDOW
+         or e.n_crash > lin.MAX_CRASH else fit).append(i)
+    _enabled, max_buckets = _bucket_mode()
+    plans = plan_buckets([bucket_key(ess[i]) for i in fit], max_buckets)
+    plans = [[fit[p] for p in grp] for grp in plans]
+
+    stats: dict = {"n_keys": n, "n_buckets": len(plans),
+                   "n_devices": n_dev, "buckets": [],
+                   "greedy": 0, "hard": len(hard), "hb_decided": 0,
+                   "constraint_decided": 0}
+
+    def prep(idxs: list[int]):
+        """Host stage for one bucket — the single-device scheduler's
+        prep with the sharded route's two differences: dims start at
+        the wide frontier (no escalation ladder on a mesh), and DPOR
+        planes are never stripped (the sharded kernel is always XLA,
+        never pallas)."""
+        t_prep = time.perf_counter()
+        with obs.span("shard.prep", cat="host", keys=len(idxs)):
+            ready: dict[int, dict] = {}
+            run: list[int] = []
+            run_mask: dict[int, dict | None] = {}
+            for i in idxs:
+                s = seqs[i]
+                if lin.greedy_witness(s, model):
+                    ready[i] = {"valid": True, "configs": s.n_must,
+                                "max_depth": s.n_must,
+                                "engine": "greedy-witness",
+                                "linearization":
+                                    lin.greedy_linearization(s)}
+                else:
+                    r = mp = None
+                    if hb:
+                        hbres = maybe_hb(s, model, True, dpor)
+                        if hbres is not None and \
+                                hbres.decided is not None:
+                            r = dict(hbres.decided)
+                        elif hbres is not None and hbres.must_pred:
+                            mp = hbres.must_pred
+                    if r is not None:
+                        ready[i] = r
+                    else:
+                        run.append(i)
+                        run_mask[i] = mp
+            if not run:
+                _M_SHARD_S.observe(time.perf_counter() - t_prep,
+                                   stage="prep")
+                return ready, run, None, None, None
+            dims = lin.batch_dims([ess[i] for i in run], model,
+                                  frontier=64)
+            if dpor_on:
+                for i in run:
+                    lin.attach_reductions(ess[i], seqs[i], model,
+                                          run_mask.get(i), dedup=True)
+            dead_pad = lin.batch_dead_pad([ess[i] for i in run])
+            esps = [lin.pad_search(ess[i], dims.n_det_pad,
+                                   dims.n_crash_pad,
+                                   dead_pad=dead_pad) for i in run]
+        _M_SHARD_S.observe(time.perf_counter() - t_prep, stage="prep")
+        return ready, run, dims, esps, dead_pad
+
+    useful_total = padded_total = 0
+    pad_lanes_total = redo_total = 0
+    shard_map_all = True
+    run_all: list[int] = []
+    if plans:
+        ex = ThreadPoolExecutor(max_workers=1,
+                                thread_name_prefix="shard-prep")
+        try:
+            fut = ex.submit(prep, plans[0])
+            for b, idxs in enumerate(plans):
+                ready, run, dims, esps, dead_pad = fut.result()
+                if b + 1 < len(plans):
+                    # bucket b+1's host prep overlaps bucket b's mesh
+                    # execution below
+                    fut = ex.submit(prep, plans[b + 1])
+                for i, r in ready.items():
+                    results[i] = r
+                n_hb = sum(1 for r in ready.values()
+                           if r.get("engine") == "hb-decide")
+                n_cs = sum(1 for r in ready.values()
+                           if r.get("engine") == "constraint-decide")
+                stats["hb_decided"] += n_hb
+                stats["constraint_decided"] += n_cs
+                stats["greedy"] += len(ready) - n_hb - n_cs
+                t0 = time.perf_counter()
+                info = None
+                if run:
+                    with obs.span("shard.device", cat="device",
+                                  bucket=b, keys=len(run),
+                                  shards=n_dev,
+                                  dims=[dims.n_det_pad, dims.window,
+                                        dims.n_crash_pad]):
+                        sub, info = lin._search_batch_sharded_fixed(
+                            [seqs[i] for i in run],
+                            [ess[i] for i in run], model, dims,
+                            sharding, budget, tele_acc=tele_acc,
+                            esps=esps, dead_pad=dead_pad)
+                    for i, r in zip(run, sub):
+                        results[i] = r
+                dt = time.perf_counter() - t0
+                if run:
+                    _M_SHARD_S.observe(dt, stage="device")
+                useful = sum(ess[i].n_det + ess[i].n_crash for i in run)
+                lanes = info["batch_lanes"] if info else 0
+                # mesh-divisibility pad lanes bill into padded_ops
+                # (they occupy device rows) even though they never
+                # touch configs/occupancy counters
+                padded = lanes * (dims.n_det_pad + dims.n_crash_pad) \
+                    if run else 0
+                useful_total += useful
+                padded_total += padded
+                if info:
+                    pad_lanes_total += info["pad_lanes"]
+                    redo_total += info["overflow_redo"]
+                    shard_map_all &= info["shard_map"]
+                run_all += run
+                stats["buckets"].append({
+                    "dims": ([dims.n_det_pad, dims.window,
+                              dims.n_crash_pad] if run else None),
+                    "n_keys": len(idxs), "searched": len(run),
+                    "lanes": lanes,
+                    "pad_lanes": info["pad_lanes"] if info else 0,
+                    "useful_ops": useful, "padded_ops": padded,
+                    "padding_efficiency": (round(useful / padded, 4)
+                                           if padded else None),
+                    "seconds": round(dt, 3)})
+        finally:
+            ex.shutdown(wait=True)
+    if hard:
+        from .linear import check_opseq_linear
+
+        for i in hard:
+            s = seqs[i]
+            if lin.greedy_witness(s, model):
+                results[i] = {"valid": True, "configs": s.n_must,
+                              "max_depth": s.n_must,
+                              "engine": "greedy-witness",
+                              "linearization": lin.greedy_linearization(s)}
+                stats["greedy"] += 1
+                continue
+            r = check_opseq_linear(seqs[i], model, lint=False, hb=hb,
+                                   dpor=dpor)
+            r["engine"] = "host-linear(fallback)"
+            results[i] = r
+    # the fused-shape counterfactual over the SAME device-ridden keys:
+    # one batch at global max dims, rounded up to cover the mesh once
+    fused_padded = 0
+    if run_all:
+        fdims = lin.batch_dims([ess[i] for i in run_all], model,
+                               frontier=64)
+        fused_padded = lin._round_up(len(run_all), n_dev) \
+            * (fdims.n_det_pad + fdims.n_crash_pad)
+    kc1 = lin.kernel_cache_stats()
+    if useful_total or padded_total:
+        _M_SHARD_OPS.inc(useful_total, kind="useful")
+        _M_SHARD_OPS.inc(padded_total, kind="padded")
+    stats.update({
+        "useful_ops": useful_total,
+        "padded_ops": padded_total,
+        "pad_keys": pad_lanes_total,
+        "overflow_redo": redo_total,
+        "shard_map": shard_map_all if run_all else None,
+        "padding_efficiency": (round(useful_total / padded_total, 4)
+                               if padded_total else None),
+        "fused_padded_ops": fused_padded or None,
+        "fused_padding_efficiency": (round(useful_total / fused_padded,
+                                           4) if fused_padded else None),
+        "kernel_cache": {k: kc1[k] - kc0[k] for k in kc1},
+        "seconds": round(time.perf_counter() - t_start, 3),
+    })
+    if tele_acc is not None and results and results[0] is not None:
+        _tele.finalize_result(results[0], tele_acc)
+    if results:
+        results[0].setdefault("shard_batch", stats)
     return results

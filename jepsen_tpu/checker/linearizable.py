@@ -2874,7 +2874,13 @@ def get_batch_kernel(model: ModelSpec, dims: SearchDims,
                                 batch=batch, frontier=dims.frontier,
                                 n_det_pad=dims.n_det_pad,
                                 n_crash_pad=dims.n_crash_pad,
-                                window=dims.window, k=dims.k):
+                                window=dims.window, k=dims.k,
+                                masked=masked,
+                                masked_crash=masked_crash,
+                                dedup=dedup, vt=vt,
+                                model=model.name,
+                                model_init=int(model.init[0]),
+                                model_width=model.state_width):
             if use_p:
                 # vmap of the fused level-loop kernel: the pallas
                 # batching rule runs one grid program per key, each a
@@ -2896,6 +2902,106 @@ def get_batch_kernel(model: ModelSpec, dims: SearchDims,
             fn = jax.jit(jax.vmap(
                 base,
                 in_axes=(0,) * 19 + (None, None, None) + (0,) * 6))
+        _KERNEL_CACHE[key] = fn
+    return fn
+
+
+def _shard_map_target(sharding):
+    """(mesh, axis) when ``sharding`` is a single-axis NamedSharding a
+    batch kernel can be shard_map'd over, else (None, None).
+
+    The bucketed scheduler's per-bucket dispatch wraps the vmapped
+    batch kernel in shard_map so each device loops over ONLY its own
+    lane block (a vmapped while_loop under plain GSPMD runs until the
+    globally slowest lane; under shard_map the cond is local, so a
+    shard whose keys resolve early goes quiet instead of spinning
+    masked).  Meshes with extra axes (the DCN "keys"x"shard" layout)
+    and non-addressable shards keep the device_put/GSPMD path — same
+    math, compiler-chosen partitioning."""
+    mesh = getattr(sharding, "mesh", None)
+    spec = getattr(sharding, "spec", None)
+    if mesh is None or spec is None or getattr(mesh, "empty", False):
+        return None, None
+    if not getattr(sharding, "is_fully_addressable", False):
+        return None, None
+    names = [n for n in spec if n is not None]
+    if len(spec) != 1 or len(names) != 1 \
+            or not isinstance(names[0], str):
+        return None, None
+    axis = names[0]
+    try:
+        if len(mesh.shape) != 1 or mesh.shape[axis] < 1:
+            return None, None
+    except (KeyError, TypeError):
+        return None, None
+    return mesh, axis
+
+
+def get_sharded_batch_kernel(model: ModelSpec, dims: SearchDims, *,
+                             batch: int, mesh, axis: str,
+                             masked: bool = False,
+                             masked_crash: bool = False,
+                             dedup: bool = False, vt: int = 8,
+                             telemetry: bool = False):
+    """The mesh twin of :func:`get_batch_kernel`: the vmapped XLA batch
+    kernel wrapped in ``shard_map`` over the key axis, so every device
+    runs ``batch / D`` lanes at the bucket's tight dims and loops only
+    until ITS lanes resolve.  ``batch`` must be mesh-divisible (the
+    caller pads with inert keys).  Cached under the mesh's device set
+    next to the other kernels, so steady-state bucket shapes are dict
+    hits and warm-bootable (fleet/warmup.py)."""
+    try:
+        from jax import shard_map
+    except ImportError:  # pre-0.4.35 jax: the experimental home
+        from jax.experimental.shard_map import shard_map
+    from jax.sharding import PartitionSpec as P
+
+    D = mesh.shape[axis]
+    per = batch // D
+    F, K = dims.frontier, dims.k
+    S = 4 * F
+    # the prune/compaction selections see the PER-SHARD lane count —
+    # that is the batch the inner kernel is built at
+    sel = (_use_allpairs(2 * F, per),
+           _use_allpairs(S, per),
+           _use_matrix_compact(F, F * K, per),
+           _use_matrix_compact(S, F * K, per),
+           _use_matrix_compact(F, 2 * F, per),
+           _use_matrix_compact(F, S, per))
+    key = ("batch-sharded", model.name, dims, sel, _dominance_key(),
+           masked, masked_crash, dedup, vt, telemetry, axis, D,
+           tuple(d.id for d in mesh.devices.flat))
+    fn = _KERNEL_CACHE.get(key)
+    _kc_record(fn is not None)
+    if fn is None:
+        # the span carries the FULL cache-key coordinates (per-shard
+        # lanes, shard count, phase-2 flags) so fleet/warmup.py can
+        # reconstruct and pre-compile exactly this kernel from a
+        # recorded trace
+        with _tele.compile_span(engine="xla", sharded=True, shards=D,
+                                batch=per, frontier=dims.frontier,
+                                n_det_pad=dims.n_det_pad,
+                                n_crash_pad=dims.n_crash_pad,
+                                window=dims.window, k=dims.k,
+                                masked=masked,
+                                masked_crash=masked_crash,
+                                dedup=dedup, vt=vt,
+                                model=model.name,
+                                model_init=int(model.init[0]),
+                                model_width=model.state_width):
+            base = build_search_step_fn(model, dims, batch=per,
+                                        masked=masked,
+                                        masked_crash=masked_crash,
+                                        dedup=dedup,
+                                        telemetry=telemetry)
+            vm = jax.vmap(base,
+                          in_axes=(0,) * 19 + (None, None, None)
+                          + (0,) * 6)
+            fn = jax.jit(shard_map(
+                vm, mesh=mesh,
+                in_specs=(P(axis),) * 19 + (P(), P(), P())
+                + (P(axis),) * 6,
+                out_specs=P(axis), check_rep=False))
         _KERNEL_CACHE[key] = fn
     return fn
 
@@ -3105,10 +3211,13 @@ def search_batch(seqs: list[OpSeq], model: ModelSpec, *,
     ``bucket`` selects the shape-bucketed scheduler (checker/bucket.py):
     keys group by their power-of-two-rounded SearchDims bucket and each
     bucket runs at its own tight dims with pipelined host prep, instead
-    of every key padding to the batch-wide max.  ``None`` follows the
-    JEPSEN_TPU_BATCH_BUCKETS env knob (default on); bucketing is
-    verdict-identical either way and applies only to the ladder path
-    (explicit ``dims`` or a mesh ``sharding`` pin the fused shape).
+    of every key padding to the batch-wide max.  With a mesh
+    ``sharding`` each bucket covers the mesh via ``shard_map`` at that
+    bucket's dims (inert pad keys only up to mesh divisibility within
+    the bucket); ``bucket=False`` pins the fused single-shape sharded
+    dispatch.  ``None`` follows the JEPSEN_TPU_BATCH_BUCKETS env knob
+    (default on); bucketing is verdict-identical either way; an
+    explicit ``dims`` pins the fused shape.
 
     Per-key certificates: greedy-disposed keys carry their
     ``linearization``, host-fallback keys whatever the host engine
@@ -3163,12 +3272,22 @@ def search_batch(seqs: list[OpSeq], model: ModelSpec, *,
             seqs, model, budget=budget, dims=dims, sharding=sharding,
             cache=decompose_cache, bucket=bucket, hb=hb, dpor=dpor),
             audit)
-    if bucket is None and sharding is None and dims is None \
-            and len(seqs) > 1:
+    if bucket is None and dims is None and len(seqs) > 1:
         from .bucket import bucketing_enabled
 
         bucket = bucketing_enabled()
-    if bucket and sharding is None and dims is None:
+    if bucket and dims is None:
+        if sharding is not None:
+            # bucket-then-shard: each bucket covers the mesh at its
+            # own tight dims (checker/bucket.py), instead of one fused
+            # shape over the whole batch
+            from .bucket import search_batch_sharded_bucketed
+
+            return _audit_batch(seqs, model,
+                                search_batch_sharded_bucketed(
+                                    seqs, model, sharding,
+                                    budget=budget, hb=hb, dpor=dpor),
+                                audit)
         from .bucket import search_batch_bucketed
 
         return _audit_batch(seqs, model,
@@ -3261,94 +3380,11 @@ def search_batch(seqs: list[OpSeq], model: ModelSpec, *,
     dead_pad = batch_dead_pad(ess)
 
     if sharding is not None:
-        # mesh-sharded batches stay on the XLA kernel: partitioning a
-        # pallas_call's vmapped grid axis over a mesh is not a path the
-        # batching rule guarantees
-        tele_on = _tele.enabled()
         tele_acc = _tele.SearchTelemetry("device-batch-sharded") \
-            if tele_on else None
-        fn = get_batch_kernel(model, dims, batch=len(seqs),
-                              allow_pallas=False,
-                              masked=any(e.masked for e in ess),
-                              masked_crash=any(e.mask_has_crash
-                                               for e in ess),
-                              dedup=any(e.dedup for e in ess),
-                              vt=dead_pad, telemetry=tele_on)
-        # mesh-sharded batch: fixed size (the key axis must keep
-        # covering the mesh), plain slice driver.  Arrays go to the mesh
-        # straight from host numpy: in a MULTI-PROCESS job (DCN tier,
-        # distributed.multihost_mesh) each process owns only its
-        # addressable shards, and device_put from replicated host data
-        # is the supported construction path.
-        # the key axis must stay divisible by the mesh: disposal
-        # (greedy/hb) can shrink a batch below it, so pad with inert
-        # keys (n_det = n_crash = 0, status pre-resolved VALID so the
-        # liveness reduction ignores them and no lane spins forever)
-        n_dev = getattr(sharding, "num_devices", 1) or 1
-        b = _round_up(len(seqs), n_dev)
-        args = stack_batch([pad_search(e, dims.n_det_pad,
-                                       dims.n_crash_pad,
-                                       dead_pad=dead_pad)
-                            for e in ess], pad_to=b)
-        args = tuple(jax.device_put(np.asarray(a), sharding)
-                     for a in args)
-        carry0 = [np.asarray(c)
-                  for c in _init_batch_carry(b, dims, model)]
-        carry0[1][len(seqs):] = 0
-        carry0[2][len(seqs):] = VALID
-        carry = tuple(jax.device_put(c, sharding) for c in carry0)
-
-        def call(c, lvl_cap):
-            res = fn(*args, jnp.int32(budget), jnp.int32(lvl_cap),
-                     jnp.bool_(False), *c)
-            if tele_acc is not None:
-                jax.block_until_ready(res[:6])
-                try:
-                    tele_acc.add_totals(np.asarray(res[6]))
-                except Exception:  # noqa: BLE001 — non-addressable
-                    pass           # multi-process shards: skip
-                res = res[:6]
-            return res
-
-        # the liveness reduction runs jitted: its output is replicated,
-        # so it stays readable when the carry itself is sharded over
-        # processes (np.asarray on a non-fully-addressable array throws)
-        active_fn = jax.jit(
-            lambda s, c, g: jnp.any((s == -1) & (c > 0) & (g < budget)))
-
-        def is_active(c):
-            return bool(active_fn(c[2], c[1], c[3]))
-
-        def gather(x):
-            if getattr(x, "is_fully_addressable", True):
-                return np.asarray(x)
-            from jax.experimental import multihost_utils
-
-            return np.asarray(
-                multihost_utils.process_allgather(x, tiled=True))
-
-        carry = _drive_slices(call, carry, is_active)
-        status = gather(carry[2])
-        count = gather(carry[1])
-        configs = gather(carry[3])
-        depth = gather(carry[4])
-        ovf = gather(carry[5])
-        status = _finalize_batch_status(status, count, ovf)
-        out = []
-        for i in range(len(seqs)):
-            if int(status[i]) == UNKNOWN and bool(ovf[i]):
-                # overflowed the fixed mesh shape: redo solo with the
-                # adaptive ladder
-                out.append(search_opseq(seqs[i], model,
-                                        budget=budget, lint=False,
-                                        audit=False))
-            else:
-                r = {"valid": _STATUS[int(status[i])],
-                     "configs": int(configs[i]),
-                     "max_depth": int(depth[i]),
-                     "engine": "device-batch"}
-                _device_batch_certificate(r)
-                out.append(r)
+            if _tele.enabled() else None
+        out, _info = _search_batch_sharded_fixed(
+            seqs, ess, model, dims, sharding, budget,
+            tele_acc=tele_acc)
         if tele_acc is not None and out:
             _tele.finalize_result(out[0], tele_acc)
         return _audit_batch(seqs, model, out, audit)
@@ -3357,6 +3393,148 @@ def search_batch(seqs: list[OpSeq], model: ModelSpec, *,
     return _audit_batch(seqs, model,
                         _search_batch_ladder(seqs, esps, model, dims,
                                              budget), audit)
+
+
+def _search_batch_sharded_fixed(seqs: list[OpSeq],
+                                ess: list, model: ModelSpec,
+                                dims: SearchDims, sharding,
+                                budget: int, *, tele_acc=None,
+                                esps=None, dead_pad=None):
+    """One fixed-shape mesh-sharded batch dispatch at ``dims``.
+
+    The shared device stage of BOTH mesh-sharded batch routes: the
+    fused path (`search_batch(sharding=...)`, one call over global
+    dims) and the bucketed scheduler (`checker/bucket.py`'s
+    `search_batch_sharded_bucketed`, one call per bucket at that
+    bucket's tight dims).  Mesh-sharded batches stay on the XLA
+    kernel: partitioning a pallas_call's vmapped grid axis over a mesh
+    is not a path the batching rule guarantees.
+
+    The key axis must stay divisible by the mesh: disposal (greedy/hb)
+    or a small bucket can shrink a batch below it, so the batch pads
+    with inert keys (n_det = n_crash = 0, status pre-resolved VALID so
+    the liveness reduction ignores them and no lane spins forever).
+    Pad lanes are an artifact of mesh divisibility, NOT state-space
+    work: they are stripped from the aux telemetry block BEFORE the
+    lane-sum (no pad occupancy in ``search_telemetry``) and never read
+    back into per-key ``configs``.
+
+    On a single-axis, fully-addressable mesh the kernel is shard_map'd
+    (`get_sharded_batch_kernel`) so each device loops only until its
+    own lane block resolves; other layouts (the DCN "keys"x"shard"
+    mesh, multi-process shards) take device_put + GSPMD — in a
+    MULTI-PROCESS job each process owns only its addressable shards,
+    and device_put from replicated host data is the supported
+    construction path.
+
+    Returns ``(results, info)``: per-key result dicts aligned with
+    ``seqs`` and the dispatch info (shards, pad lanes, overflow
+    redos) the bucketed scheduler folds into its stats.
+    """
+    tele_on = tele_acc is not None
+    if dead_pad is None:
+        dead_pad = batch_dead_pad(ess)
+    n_dev = getattr(sharding, "num_devices", 1) or 1
+    b = _round_up(len(seqs), n_dev)
+    mesh, axis = _shard_map_target(sharding)
+    n_shards = n_dev
+    if mesh is not None and b % mesh.shape[axis] == 0:
+        n_shards = mesh.shape[axis]
+        fn = get_sharded_batch_kernel(
+            model, dims, batch=b, mesh=mesh, axis=axis,
+            masked=any(e.masked for e in ess),
+            masked_crash=any(e.mask_has_crash for e in ess),
+            dedup=any(e.dedup for e in ess),
+            vt=dead_pad, telemetry=tele_on)
+        used_shard_map = True
+    else:
+        fn = get_batch_kernel(model, dims, batch=len(seqs),
+                              allow_pallas=False,
+                              masked=any(e.masked for e in ess),
+                              masked_crash=any(e.mask_has_crash
+                                               for e in ess),
+                              dedup=any(e.dedup for e in ess),
+                              vt=dead_pad, telemetry=tele_on)
+        used_shard_map = False
+    if esps is None:
+        # the bucketed scheduler pre-pads on its prep thread and hands
+        # esps in; the fused route pads here
+        esps = [pad_search(e, dims.n_det_pad, dims.n_crash_pad,
+                           dead_pad=dead_pad) for e in ess]
+    args = stack_batch(esps, pad_to=b)
+    args = tuple(jax.device_put(np.asarray(a), sharding)
+                 for a in args)
+    carry0 = [np.asarray(c)
+              for c in _init_batch_carry(b, dims, model)]
+    carry0[1][len(seqs):] = 0
+    carry0[2][len(seqs):] = VALID
+    carry = tuple(jax.device_put(c, sharding) for c in carry0)
+
+    def call(c, lvl_cap):
+        t0 = time.perf_counter()
+        res = fn(*args, jnp.int32(budget), jnp.int32(lvl_cap),
+                 jnp.bool_(False), *c)
+        if tele_acc is not None:
+            jax.block_until_ready(res[:6])
+            t1 = time.perf_counter()
+            try:
+                blk = np.asarray(res[6])
+            except Exception:  # noqa: BLE001 — non-addressable
+                pass           # multi-process shards: skip
+            else:
+                # inert mesh-divisibility pad lanes excluded BEFORE
+                # the lane-sum: their rows must not bill occupancy
+                tele_acc.add_totals(blk[:len(seqs)])
+                _tele.emit_shard_levels(blk, len(seqs), n_shards,
+                                        t0, t1)
+            res = res[:6]
+        return res
+
+    # the liveness reduction runs jitted: its output is replicated,
+    # so it stays readable when the carry itself is sharded over
+    # processes (np.asarray on a non-fully-addressable array throws)
+    active_fn = jax.jit(
+        lambda s, c, g: jnp.any((s == -1) & (c > 0) & (g < budget)))
+
+    def is_active(c):
+        return bool(active_fn(c[2], c[1], c[3]))
+
+    def gather(x):
+        if getattr(x, "is_fully_addressable", True):
+            return np.asarray(x)
+        from jax.experimental import multihost_utils
+
+        return np.asarray(
+            multihost_utils.process_allgather(x, tiled=True))
+
+    carry = _drive_slices(call, carry, is_active)
+    status = gather(carry[2])
+    count = gather(carry[1])
+    configs = gather(carry[3])
+    depth = gather(carry[4])
+    ovf = gather(carry[5])
+    status = _finalize_batch_status(status, count, ovf)
+    out = []
+    redo = 0
+    for i in range(len(seqs)):
+        if int(status[i]) == UNKNOWN and bool(ovf[i]):
+            # overflowed the fixed mesh shape: redo solo with the
+            # adaptive ladder
+            redo += 1
+            out.append(search_opseq(seqs[i], model,
+                                    budget=budget, lint=False,
+                                    audit=False))
+        else:
+            r = {"valid": _STATUS[int(status[i])],
+                 "configs": int(configs[i]),
+                 "max_depth": int(depth[i]),
+                 "engine": "device-batch"}
+            _device_batch_certificate(r)
+            out.append(r)
+    info = {"n_shards": int(n_shards), "batch_lanes": int(b),
+            "pad_lanes": int(b - len(seqs)),
+            "shard_map": used_shard_map, "overflow_redo": redo}
+    return out, info
 
 
 def _finalize_batch_status(status, count, ovf):

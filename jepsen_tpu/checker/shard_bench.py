@@ -1,0 +1,231 @@
+"""The shard bench tier — ``python bench.py --shard-tier``.
+
+Measures the bucket-then-shard scheduler
+(:func:`checker.bucket.search_batch_sharded_bucketed`) against the
+fused single-shape sharded dispatch on a mixed-size key set over the
+local device mesh (the virtual 8-device CPU mesh under
+``XLA_FLAGS=--xla_force_host_platform_device_count=8``, or real chips).
+Writes ``BENCH_shard.json`` (numbers) and ``BENCH_trace_shard.json``
+(the flight recording: ``shard.prep``/``shard.device`` spans show the
+pipelining, per-shard ``device.level`` spans the occupancy, and
+``device.compile`` spans that the warm lap paid every compile).
+
+Gates that ride on the numbers (tools/obs_guard.py ``check_shard`` via
+the ``obs_thresholds.json`` "shard" block):
+
+  * **parity** — bucketed-sharded verdicts match the fused sharded
+    route key-for-key, and a sample re-checks against the host oracle.
+  * **padding efficiency** — the bucketed route's useful/padded row
+    ratio (mesh pad lanes billed) clears the floor; the fused
+    counterfactual over the same keys is recorded next to it.
+  * **zero steady-state compiles** — the measured laps re-run the warm
+    lap's shapes and the kernel cache's miss counter must not move.
+  * **warmup round-trip** — `fleet.warmup.shapes_from_trace` over this
+    run's own trace reconstructs the sharded kernel set exactly:
+    `warm_boot` on those shapes reports zero fresh compiles.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import random
+import time
+
+#: oracle re-checks sweep the full config space per key — sample
+_PARITY_SAMPLE = 6
+
+#: per-bucket stats fields that must match `analyze.plan.explain_batch`
+#: field-for-field (the closed-loop cost-model contract)
+_EXPLAIN_BUCKET_FIELDS = ("searched", "dims", "lanes", "pad_lanes",
+                          "useful_ops", "padded_ops")
+_EXPLAIN_TOTAL_FIELDS = ("n_buckets", "greedy", "hb_decided",
+                         "constraint_decided", "hard", "useful_ops",
+                         "padded_ops", "fused_padded_ops")
+
+
+def _mk_keys(*, n_small: int, n_big: int, small_ops: int, big_ops: int,
+             seed0: int):
+    """The mixed-size tier: many small keys + a few big ones, every
+    device-bound key corrupted so none dispose via greedy witness (the
+    whole point is to measure the device path's padding)."""
+    from ..history import encode_ops
+    from ..models import cas_register
+    from ..synth import corrupt_read, register_history
+
+    model = cas_register()
+    seqs = []
+    for k in range(n_small + n_big):
+        rng = random.Random(seed0 + k)
+        n_ops = small_ops if k < n_small else big_ops
+        h = register_history(rng, n_ops=n_ops, n_procs=6, overlap=4)
+        h = corrupt_read(rng, h, at=0.85)
+        seqs.append(encode_ops(h, model.f_codes))
+    return seqs, model
+
+
+def _stats_match_plan(sb: dict, plan: dict) -> tuple[bool, list]:
+    """Field-for-field comparison of the live ``shard_batch`` stats
+    against ``explain_batch(..., n_devices=...)``'s prediction."""
+    diffs = []
+    for f in _EXPLAIN_TOTAL_FIELDS:
+        if sb.get(f) != plan.get(f):
+            diffs.append({"field": f, "live": sb.get(f),
+                          "plan": plan.get(f)})
+    live_b, plan_b = sb.get("buckets", []), plan.get("buckets", [])
+    if len(live_b) != len(plan_b):
+        diffs.append({"field": "len(buckets)", "live": len(live_b),
+                      "plan": len(plan_b)})
+    else:
+        for i, (lb, pb) in enumerate(zip(live_b, plan_b)):
+            for f in _EXPLAIN_BUCKET_FIELDS:
+                if lb.get(f) != pb.get(f):
+                    diffs.append({"field": f"buckets[{i}].{f}",
+                                  "live": lb.get(f),
+                                  "plan": pb.get(f)})
+    return not diffs, diffs
+
+
+def run_shard_tier(repo: str, *, quick: bool = False) -> dict:
+    import numpy as np
+
+    import jax
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec
+
+    from .. import obs as _obs
+    from ..analyze.plan import explain_batch
+    from ..fleet.warmup import shapes_from_trace, warm_boot
+    from ..obs import metrics as obs_metrics
+    from . import linearizable as lin
+    from . import seq as oracle
+
+    _obs.enable(True)
+    devs = jax.devices()
+    mesh = Mesh(np.array(devs), ("shard",))
+    sharding = NamedSharding(mesh, PartitionSpec("shard"))
+    n_dev = len(devs)
+
+    if quick:
+        n_small, n_big, small_ops, big_ops = 16, 4, 74, 120
+    else:
+        # sized so each pow-of-two bucket packs tight: 74-op keys land
+        # ~56 useful rows under (64+32) padded, 240-op keys ~177 under
+        # (256+32) — weighted ~0.59 useful/padded vs the fused ~0.29
+        n_small, n_big, small_ops, big_ops = 40, 8, 74, 240
+    budget = 1_500_000
+    seqs, model = _mk_keys(n_small=n_small, n_big=n_big,
+                           small_ops=small_ops, big_ops=big_ops,
+                           seed0=31000)
+    out: dict = {
+        "metric": "shard tier: bucket-then-shard vs fused mesh batch",
+        "quick": quick, "n_devices": n_dev,
+        "n_keys": len(seqs),
+        "mix": {"small": [n_small, small_ops], "big": [n_big, big_ops]},
+    }
+
+    # --- warm lap: pay every compile once ----------------------------
+    t0 = time.perf_counter()
+    warm_b = lin.search_batch(seqs, model, budget=budget,
+                              sharding=sharding, audit=False)
+    wall_warm_b = time.perf_counter() - t0
+    t0 = time.perf_counter()
+    warm_f = lin.search_batch(seqs, model, budget=budget,
+                              sharding=sharding, bucket=False,
+                              audit=False)
+    wall_warm_f = time.perf_counter() - t0
+    out["warm_lap"] = {"bucketed_wall_s": round(wall_warm_b, 3),
+                       "fused_wall_s": round(wall_warm_f, 3)}
+
+    # --- warmup round-trip: the trace's compile spans reconstruct the
+    # exact sharded kernel set (zero fresh compiles on warm_boot) -----
+    import tempfile
+
+    with tempfile.TemporaryDirectory(prefix="shard-bench-") as td:
+        mid_trace = os.path.join(td, "trace_mid.json")
+        _obs.write_trace(mid_trace)
+        with open(mid_trace) as f:
+            shapes = shapes_from_trace(json.load(f))
+    shard_shapes = [s for s in shapes if s.shards]
+    wrep = warm_boot(shapes)
+    out["warmup"] = wrep
+    out["warmup_shapes"] = {"total": len(shapes),
+                            "sharded": len(shard_shapes)}
+
+    # --- measured laps: same workload, warm cache --------------------
+    misses0 = lin.KERNEL_CACHE_STATS["misses"]
+    t0 = time.perf_counter()
+    got_b = lin.search_batch(seqs, model, budget=budget,
+                             sharding=sharding, audit=True)
+    wall_b = time.perf_counter() - t0
+    t0 = time.perf_counter()
+    got_f = lin.search_batch(seqs, model, budget=budget,
+                             sharding=sharding, bucket=False,
+                             audit=True)
+    wall_f = time.perf_counter() - t0
+    out["steady_state_compile_misses"] = (
+        lin.KERNEL_CACHE_STATS["misses"] - misses0)
+
+    sb = got_b[0].get("shard_batch") or {}
+    out["bucketed"] = {
+        "wall_s": round(wall_b, 3),
+        "padding_efficiency": sb.get("padding_efficiency"),
+        "n_buckets": sb.get("n_buckets"),
+        "pad_keys": sb.get("pad_keys"),
+        "shard_map": sb.get("shard_map"),
+        "overflow_redo": sb.get("overflow_redo"),
+        "kernel_cache": sb.get("kernel_cache"),
+        "buckets": sb.get("buckets"),
+    }
+    out["fused_counterfactual"] = {
+        "wall_s": round(wall_f, 3),
+        "padded_ops": sb.get("fused_padded_ops"),
+        "padding_efficiency": sb.get("fused_padding_efficiency"),
+    }
+    out["speedup_vs_fused"] = (round(wall_f / wall_b, 3)
+                               if wall_b else None)
+
+    # --- parity: bucketed vs fused key-for-key, oracle sampled -------
+    parity = all(rb["valid"] == rf["valid"]
+                 for rb, rf in zip(got_b, got_f))
+    rng = random.Random(11)
+    sample = rng.sample(range(len(seqs)),
+                        min(_PARITY_SAMPLE, len(seqs)))
+    for i in sample:
+        want = oracle.check_opseq(seqs[i], model, dpor=False)["valid"]
+        if got_b[i]["valid"] != want:
+            parity = False
+            out.setdefault("parity_diffs", []).append(
+                {"key": i, "bucketed": got_b[i]["valid"],
+                 "oracle": want})
+    out["parity"] = parity
+    out["parity_oracle_sampled"] = len(sample)
+
+    # --- the closed loop: prediction == observation ------------------
+    plan = explain_batch(seqs, model, n_devices=n_dev)
+    match, diffs = _stats_match_plan(sb, plan)
+    out["explain_match"] = match
+    if diffs:
+        out["explain_diffs"] = diffs[:16]
+
+    out["derived_stats"] = {
+        k: v for k, v in
+        obs_metrics.derived_stats(obs_metrics.REGISTRY).items()
+        if k in ("shard_padding_efficiency", "bucket_padding_efficiency",
+                 "kernel_cache_hit_ratio", "device_idle_fraction")}
+
+    path = os.path.join(repo, "BENCH_shard.json")
+    with open(path, "w") as f:
+        json.dump(out, f, indent=1)
+    _obs.write_trace(os.path.join(repo, "BENCH_trace_shard.json"))
+    out["trace"] = "BENCH_trace_shard.json (shard.prep/shard.device " \
+                   "pipelining, per-shard device.level spans)"
+    print(json.dumps({
+        "metric": "shard: bucketed padding efficiency on the "
+                  f"mixed-size tier ({n_dev} devices; fused "
+                  "counterfactual "
+                  f"{sb.get('fused_padding_efficiency')})",
+        "value": sb.get("padding_efficiency"),
+        "unit": "useful/padded rows",
+        "detail": out,
+    }))
+    return out

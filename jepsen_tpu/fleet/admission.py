@@ -5,7 +5,9 @@ The streaming tier already sheds per-run and per-connection overload
 level up: *should this run be admitted at all, and is the tier sized
 right?*  :class:`AdmissionController` folds the aggregated worker
 stats (shed rate, open runs, fold backlog) into one of three
-decisions:
+decisions (a cold fleet verdict cache additionally damps
+``spawn-worker`` down to ``accept`` — see
+``AdmissionPolicy.spawn_min_cache_hit_ratio``):
 
 ``accept``
     steady state — route the run.
@@ -56,6 +58,15 @@ class AdmissionPolicy:
     spawn_shed_rate: float = 0.02
     max_fold_backlog: int = 4096
     min_spawn_interval_s: float = 10.0
+    #: verdict-cache damping: while the fleet cache's cumulative hit
+    #: ratio sits below this, spawn signals downgrade to ``accept`` —
+    #: a cold cache means the tier is still warming shapes, and a new
+    #: worker would boot even colder (it re-misses everything the
+    #: incumbents are busy inserting).  Only consulted once the cache
+    #: has seen ``cache_signal_min_lookups`` lookups: an empty store
+    #: at boot says nothing about sizing.
+    spawn_min_cache_hit_ratio: float = 0.2
+    cache_signal_min_lookups: int = 256
 
 
 def scale_signal(merged: dict) -> dict:
@@ -70,13 +81,24 @@ def scale_signal(merged: dict) -> dict:
         except (TypeError, ValueError):
             return 0.0
 
+    def _label(v, key) -> float:
+        # a labelled counter merges to {label_value: n}; a worker that
+        # never fired it may report a bare 0
+        return _num(v.get(key, 0)) if isinstance(v, dict) else 0.0
+
     values = merged.get("values", merged) or {}
+    vc = values.get("jtpu_verdict_cache_total", 0)
     return {
         "open_runs": _num(values.get("jtpu_stream_runs_open", 0)),
         "fold_backlog": _num(values.get("jtpu_stream_cells_open", 0)),
         "shed_total": _num(values.get("jtpu_shed_total", 0)),
         "ops_total": _num(
             values.get("jtpu_stream_ops_ingested_total", 0)),
+        # FleetCacheStore lookups ride the same verdict-cache counter
+        # every VerdictCache feeds; hits/misses (not inserts) are the
+        # warmth signal the spawn damping reads
+        "cache_hits": _label(vc, "hit"),
+        "cache_misses": _label(vc, "miss"),
     }
 
 
@@ -104,6 +126,15 @@ class AdmissionController:
         denom = d_shed + d_ops
         return d_shed / denom if denom else 0.0
 
+    def cache_hit_ratio(self, signal: dict) -> float | None:
+        """Cumulative fleet verdict-cache hit ratio, or None while the
+        cache has seen too few lookups to mean anything."""
+        h = signal.get("cache_hits", 0.0)
+        m = signal.get("cache_misses", 0.0)
+        if h + m < self.policy.cache_signal_min_lookups:
+            return None
+        return h / (h + m)
+
     def decide(self, signal: dict) -> str:
         """One admission decision for the run knocking now."""
         p = self.policy
@@ -119,13 +150,21 @@ class AdmissionController:
             decision = "shed"
         elif open_runs >= p.spawn_open_runs \
                 or rate >= p.spawn_shed_rate:
-            now = self._clock()
-            if self._last_spawn is None or \
-                    now - self._last_spawn >= p.min_spawn_interval_s:
-                self._last_spawn = now
-                decision = "spawn-worker"
+            hit_ratio = self.cache_hit_ratio(signal)
+            if hit_ratio is not None \
+                    and hit_ratio < p.spawn_min_cache_hit_ratio:
+                # cold cache: the tier is still warming shapes, and a
+                # fresh worker boots colder still — admit, don't fork
+                decision = "accept"
             else:
-                decision = "accept"  # damped: signal already sent
+                now = self._clock()
+                if self._last_spawn is None or \
+                        now - self._last_spawn \
+                        >= p.min_spawn_interval_s:
+                    self._last_spawn = now
+                    decision = "spawn-worker"
+                else:
+                    decision = "accept"  # damped: signal already sent
         else:
             decision = "accept"
         self.decisions[decision] += 1

@@ -27,6 +27,13 @@ Shape manifest format (JSON)::
                  "n_crash_pad": 32, "window": 32, "k": 4,
                  "frontier": 128}, ...]}
 
+Optional per-shape fields ``batch`` (total lane count — warms the
+vmapped batch kernel instead of the solo one) and ``shards`` (wraps it
+in ``shard_map`` over that many local devices: the bucketed mesh
+scheduler's steady-state shapes).  ``device.compile`` spans from
+sharded runs carry both, so a recorded ``BENCH_trace_shard.json``
+round-trips into exactly the kernel set the scheduler will request.
+
 Trace format: a telemetry trace (``{"traceEvents": [...]}``) whose
 ``device.compile`` spans carry ``n_det_pad``/``frontier`` (always) and
 ``window``/``n_crash_pad``/``k`` (newer traces); missing fields fall
@@ -63,6 +70,12 @@ class WarmShape:
     masked_crash: bool = False
     dedup: bool = False
     vt: int = 8
+    #: batch > 0 warms the vmapped BATCH kernel at that total lane
+    #: count (0 = the solo kernel); shards > 0 additionally wraps it
+    #: in shard_map over that many local devices — the steady-state
+    #: shapes the bucketed mesh scheduler runs
+    batch: int = 0
+    shards: int = 0
 
 
 def shapes_from_manifest(doc: dict) -> list[WarmShape]:
@@ -82,6 +95,8 @@ def shapes_from_manifest(doc: dict) -> list[WarmShape]:
             masked_crash=bool(s.get("masked_crash", False)),
             dedup=bool(s.get("dedup", False)),
             vt=int(s.get("vt", 8)),
+            batch=int(s.get("batch", 0)),
+            shards=int(s.get("shards", 0)),
         ))
     return shapes
 
@@ -97,15 +112,33 @@ def shapes_from_trace(doc: dict, *,
             continue
         args = ev.get("args", {}) or {}
         if "n_det_pad" not in args:
-            continue  # sharded/batched spans without full dims
+            continue  # legacy spans without full dims
+        # sharded spans record PER-SHARD lanes + the shard count; the
+        # batch kernel getter wants the total lane axis back
+        shards = int(args.get("shards", 0) or 0)
+        batch = int(args.get("batch", 0) or 0)
+        # spans stamped with the model descriptor reconstruct against
+        # the model that actually compiled; older spans fall back to
+        # the caller-supplied default
+        mdl = tuple(model)
+        if "model" in args:
+            mdl = (str(args["model"]),
+                   int(args.get("model_init", 0)),
+                   int(args.get("model_width", 1)))
         s = WarmShape(
-            model=tuple(model),
+            model=mdl,
             n_det_pad=int(args["n_det_pad"]),
             n_crash_pad=int(args.get("n_crash_pad",
                                      DEFAULT_N_CRASH_PAD)),
             window=int(args.get("window", DEFAULT_WINDOW)),
             k=int(args.get("k", DEFAULT_K)),
             frontier=int(args.get("frontier", DEFAULT_FRONTIER)),
+            masked=bool(args.get("masked", False)),
+            masked_crash=bool(args.get("masked_crash", False)),
+            dedup=bool(args.get("dedup", False)),
+            vt=int(args.get("vt", 8)),
+            batch=batch * shards if shards else batch,
+            shards=shards,
         )
         if s not in seen:
             seen.add(s)
@@ -152,7 +185,8 @@ def _tiny_seq(model):
 def _compile_one(shape: WarmShape, *, telemetry: bool):
     """Build + INVOKE one kernel at the shape's dims (jit is lazy —
     invocation is what compiles), blocking until the executable is
-    ready."""
+    ready.  Returns ``(dims, model, rerequest)`` where ``rerequest``
+    re-asks the cache for the SAME kernel (warm_boot's verify pass)."""
     import jax
     import jax.numpy as jnp
 
@@ -171,16 +205,55 @@ def _compile_one(shape: WarmShape, *, telemetry: bool):
     )
     es = lin.encode_search(_tiny_seq(model))
     esp = lin.pad_search(es, dims.n_det_pad, dims.n_crash_pad)
-    fn = lin.get_kernel(model, dims, masked=shape.masked,
-                        masked_crash=shape.masked_crash,
-                        dedup=shape.dedup, vt=shape.vt,
-                        telemetry=telemetry)
+    if shape.batch:
+        b = max(1, int(shape.batch))
+        mesh = axis = None
+        if shape.shards:
+            import numpy as np
+            from jax.sharding import Mesh
+
+            devs = jax.devices()
+            if len(devs) >= shape.shards and b % shape.shards == 0:
+                mesh = Mesh(np.array(devs[:shape.shards]), ("shard",))
+                axis = "shard"
+        if mesh is not None:
+            def getter():
+                return lin.get_sharded_batch_kernel(
+                    model, dims, batch=b, mesh=mesh, axis=axis,
+                    masked=shape.masked,
+                    masked_crash=shape.masked_crash,
+                    dedup=shape.dedup, vt=shape.vt,
+                    telemetry=telemetry)
+        else:
+            def getter():
+                return lin.get_batch_kernel(
+                    model, dims, batch=b, allow_pallas=False,
+                    masked=shape.masked,
+                    masked_crash=shape.masked_crash,
+                    dedup=shape.dedup, vt=shape.vt,
+                    telemetry=telemetry)
+        fn = getter()
+        args = lin.stack_batch([esp] * b)
+        carry = tuple(jnp.asarray(c)
+                      for c in lin._init_batch_carry(b, dims, model))
+        out = fn(*args, jnp.int32(64), jnp.int32(4), jnp.bool_(False),
+                 *carry)
+        jax.block_until_ready(out)
+        return dims, model, getter
+
+    def getter():
+        return lin.get_kernel(model, dims, masked=shape.masked,
+                              masked_crash=shape.masked_crash,
+                              dedup=shape.dedup, vt=shape.vt,
+                              telemetry=telemetry)
+
+    fn = getter()
     args = lin.search_args(esp, es)
     carry = tuple(jnp.asarray(c) for c in lin._init_carry(dims, model))
     out = fn(*args, jnp.int32(64), jnp.int32(4), jnp.bool_(False),
              *carry)
     jax.block_until_ready(out)
-    return dims, model
+    return dims, model, getter
 
 
 def warm_boot(shapes, *, verify: bool = True) -> dict:
@@ -208,11 +281,8 @@ def warm_boot(shapes, *, verify: bool = True) -> dict:
     if verify:
         # re-request every kernel: each lookup must be a cache hit —
         # the executable, not just the builder, is resident
-        for s, dims, model in warmed:
-            lin.get_kernel(model, dims, masked=s.masked,
-                           masked_crash=s.masked_crash,
-                           dedup=s.dedup, vt=s.vt,
-                           telemetry=telemetry)
+        for _s, _dims, _model, rerequest in warmed:
+            rerequest()
         after = dict(lin.KERNEL_CACHE_STATS)
         verified = after["misses"] == mid["misses"]
     return {

@@ -275,6 +275,10 @@ def derived_stats(reg: "Registry") -> dict:
     if isinstance(b, Counter):
         out["bucket_padding_efficiency"] = _ratio(
             b.value(kind="useful"), b.value(kind="padded"))
+    sb = reg.get("jtpu_shard_ops_total")
+    if isinstance(sb, Counter):
+        out["shard_padding_efficiency"] = _ratio(
+            sb.value(kind="useful"), sb.value(kind="padded"))
     # device-idle fraction: of this process's lifetime, the share NOT
     # spent inside device.slice executions — the fleet strip's
     # is-the-accelerator-earning-its-keep gauge.  None until any
@@ -322,6 +326,9 @@ def _declare(reg: Registry) -> None:
                 ("event",))
     reg.counter("jtpu_bucket_ops_total",
                 "Bucketed device batch rows, useful vs padded",
+                ("kind",))
+    reg.counter("jtpu_shard_ops_total",
+                "Mesh-sharded bucketed batch rows, useful vs padded",
                 ("kind",))
     reg.counter("jtpu_shed_total",
                 "Ops/lines shed under backpressure, by reason",

@@ -305,6 +305,47 @@ class SearchTelemetry:
         return out
 
 
+def emit_shard_levels(tele: np.ndarray, n_used: int, n_shards: int,
+                      t0: float, t1: float) -> None:
+    """Per-shard ``device.level`` spans from one batched aux block.
+
+    ``tele`` is the [B, TELE_ROWS, TELE_COLS] lane-stacked block a
+    mesh-sharded batch slice returned; the lane axis partitions into
+    ``n_shards`` contiguous device blocks (B divisible by the mesh —
+    that is what the inert pad lanes guarantee).  Lanes at or past
+    ``n_used`` are those mesh-divisibility pads and are EXCLUDED: pad
+    lanes must not appear in observed occupancy.  Each shard's lane-sum
+    unpacks into its own ``device.level`` spans (args carry
+    ``shard=i``), apportioned over the slice window by occupancy — the
+    per-shard twin of :meth:`SearchTelemetry.add_slice`'s emission, so
+    a trace shows which shards carried the level work and which sat on
+    pad-free but idle lanes.  Tracing-gated; totals are NOT tallied
+    here (the caller's accumulator ingests the pad-stripped block)."""
+    if not _trace.enabled():
+        return
+    t = np.asarray(tele)
+    if t.ndim != 3 or n_shards <= 0 or t.shape[0] % n_shards:
+        return
+    per = t.shape[0] // n_shards
+    rec = _trace.recorder(_trace.current_run())
+    span = max(0.0, t1 - t0)
+    for s in range(n_shards):
+        lo = s * per
+        used = min(max(0, n_used - lo), per)
+        if used <= 0:
+            continue  # all-pad shard: nothing real ran here
+        rows = unpack_levels(t[lo:lo + used].sum(axis=0))
+        if not rows:
+            continue
+        occ_sum = sum(r["occupancy"] for r in rows) or 1
+        cur = t0
+        for i, r in enumerate(rows):
+            end = min(t1, cur + span * (r["occupancy"] / occ_sum))
+            rec.record("device.level", "device", cur, end,
+                       {"level": i, "shard": s, "lanes": used, **r})
+            cur = end
+
+
 def _predicted_ratio(result: dict | None, hbres=None):
     """The prepass's predicted prune_ratio for this search, if any —
     preferring the live hb stats (hbres), falling back to the result's

@@ -384,6 +384,21 @@ def result_block(result: dict) -> str:
         spark = _occupancy_sparkline(st)
         if spark:
             rows.append(("depth/occupancy", spark))
+    shb = result.get("shard_batch")
+    if isinstance(shb, dict):
+        # the mesh scheduler's padding story: tight per-bucket shapes
+        # vs the fused single-shape counterfactual
+        line = (f"{shb.get('n_buckets', 0)} bucket(s) over "
+                f"{shb.get('n_devices', 0)} device(s), padding "
+                f"efficiency {shb.get('padding_efficiency')}"
+                f" (fused counterfactual "
+                f"{shb.get('fused_padding_efficiency')}); "
+                f"{shb.get('pad_keys', 0)} inert mesh pad lane(s)")
+        if shb.get("overflow_redo"):
+            line += f", {shb['overflow_redo']} overflow redo(s)"
+        if shb.get("shard_map") is False:
+            line += " [GSPMD fallback]"
+        rows.append(("sharded batch", line))
     a = result.get("audit")
     if a:
         rows.append(("audit", "ok (checked %s)" % a.get("checked")

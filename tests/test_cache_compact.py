@@ -121,9 +121,14 @@ def test_concurrent_writer_appends_survive_compaction_race(tmp_path):
         stop.set()
 
     def compactor():
-        while not stop.is_set():
+        # loop body must run at least once even if the writer wins the
+        # scheduling race and sets `stop` before this thread starts —
+        # the "hot" assertion below depends on one insert happening
+        while True:
             a.put_verdict("hot", True)
             a.compact()
+            if stop.is_set():
+                break
 
     threads = [threading.Thread(target=writer),
                threading.Thread(target=compactor)]

@@ -484,3 +484,79 @@ def test_router_sheds_on_admission_decision():
     assert any(d.get("overloaded") == "admission" for d in out)
     assert not any("final" in d for d in out)
     _teardown(servers, router, rsrv)
+
+
+# ---------------------------------------------------------------------------
+# verdict-cache spawn damping
+# ---------------------------------------------------------------------------
+
+
+def test_scale_signal_extracts_cache_hit_miss_labels():
+    """The FleetCacheStore hit/miss labels ride into the signal; a
+    worker that never fired the counter reports bare 0 -> 0.0."""
+    sig = scale_signal({"values": {
+        "jtpu_verdict_cache_total": {"hit": 40.0, "miss": 160.0,
+                                     "insert": 12.0},
+    }})
+    assert sig["cache_hits"] == 40.0
+    assert sig["cache_misses"] == 160.0
+    assert scale_signal({"values": {
+        "jtpu_verdict_cache_total": 0}})["cache_hits"] == 0.0
+
+
+def test_admission_cold_cache_damps_spawn():
+    """Spawn conditions met, but the fleet verdict cache is cold past
+    the minimum-lookups floor: the controller admits instead of
+    forking a worker that would boot colder still.  A warm cache (or
+    too few lookups to mean anything) leaves spawn undamped."""
+    t = {"now": 0.0}
+
+    def ctl():
+        return AdmissionController(
+            AdmissionPolicy(spawn_open_runs=10,
+                            min_spawn_interval_s=0.0,
+                            spawn_min_cache_hit_ratio=0.2,
+                            cache_signal_min_lookups=256),
+            clock=lambda: t["now"])
+
+    busy = {"open_runs": 50, "fold_backlog": 0,
+            "shed_total": 0, "ops_total": 100}
+    # cold cache, enough lookups: damped to accept
+    cold = {**busy, "cache_hits": 30.0, "cache_misses": 470.0}
+    c = ctl()
+    assert c.cache_hit_ratio(cold) == 0.06
+    assert c.decide(cold) == "accept"
+    # warm cache: spawn goes through
+    warm = {**busy, "cache_hits": 400.0, "cache_misses": 100.0}
+    assert ctl().decide(warm) == "spawn-worker"
+    # cold but below the lookup floor: ratio means nothing -> spawn
+    sparse = {**busy, "cache_hits": 1.0, "cache_misses": 40.0}
+    c = ctl()
+    assert c.cache_hit_ratio(sparse) is None
+    assert c.decide(sparse) == "spawn-worker"
+    # no cache keys at all (legacy signal): unaffected
+    assert ctl().decide(busy) == "spawn-worker"
+
+
+def test_trace_shapes_carry_model_and_shard_coords(tmp_path):
+    """A sharded device.compile span round-trips model descriptor,
+    per-shard lanes and shard count into a WarmShape the warm boot can
+    hand straight to get_sharded_batch_kernel."""
+    from jepsen_tpu.fleet.warmup import load_shapes
+
+    trace = tmp_path / "trace.json"
+    trace.write_text(json.dumps({"traceEvents": [
+        {"name": "device.compile", "args": {
+            "n_det_pad": 64, "n_crash_pad": 32, "window": 32, "k": 4,
+            "frontier": 64, "sharded": True, "shards": 8, "batch": 2,
+            "masked": True, "dedup": True, "vt": 8,
+            "model": "cas-register", "model_init": -2147483648,
+            "model_width": 1}},
+    ]}))
+    shapes = load_shapes(str(trace))
+    assert len(shapes) == 1
+    s = shapes[0]
+    assert s.model == ("cas-register", -2147483648, 1)
+    assert s.shards == 8
+    assert s.batch == 16  # per-shard lanes x shard count
+    assert s.masked and s.dedup

@@ -253,3 +253,103 @@ def test_run_guard_stats_against_live_registry():
     fails = obs_guard.run_guard(
         {"stats": {"max_device_idle_fraction": 1.0}}, base=REPO)
     assert fails == []
+
+
+# ---------------------------------------------------------------------------
+# shard tier (BENCH_shard.json)
+# ---------------------------------------------------------------------------
+
+
+def _shard_doc(**over):
+    doc = {"n_devices": 8,
+           "warmup": {"shapes": 2, "compiled": 0, "verified": True},
+           "warmup_shapes": {"total": 2, "sharded": 2},
+           "steady_state_compile_misses": 0,
+           "bucketed": {"padding_efficiency": 0.58},
+           "fused_counterfactual": {"padding_efficiency": 0.29},
+           "parity": True,
+           "explain_match": True}
+    doc.update(over)
+    return doc
+
+
+_SHARD_TH = {"require": ["bucketed", "fused_counterfactual", "parity",
+                         "explain_match", "warmup_verified"],
+             "min_padding_efficiency": 0.5,
+             "min_efficiency_gain_vs_fused": 1.2,
+             "max_steady_state_compile_misses": 0,
+             "max_warmup_compiles": 0,
+             "min_shards": 2,
+             "min_sharded_warm_shapes": 1}
+
+
+def _write_shard(tmp_path, doc):
+    p = tmp_path / "BENCH_shard.json"
+    p.write_text(json.dumps(doc))
+    return str(p)
+
+
+def test_check_shard_clean_pass(tmp_path):
+    p = _write_shard(tmp_path, _shard_doc())
+    assert obs_guard.check_shard(p, _SHARD_TH) == []
+
+
+def test_check_shard_missing_file():
+    fails = obs_guard.check_shard("/nonexistent_shard.json",
+                                  {"require": ["parity"]})
+    assert fails and "missing" in fails[0]
+
+
+def test_check_shard_failure_modes(tmp_path):
+    p = _write_shard(tmp_path, _shard_doc(
+        bucketed={"padding_efficiency": 0.3},
+        fused_counterfactual={"padding_efficiency": 0.29},
+        parity=False,
+        explain_match=False,
+        warmup={"shapes": 2, "compiled": 2, "verified": False},
+        warmup_shapes={"total": 2, "sharded": 0},
+        steady_state_compile_misses=2,
+        n_devices=1))
+    fails = obs_guard.check_shard(p, _SHARD_TH)
+    text = "\n".join(fails)
+    for needle in ("verdicts diverged", "no longer matches",
+                   "did not verify", "padding_efficiency 0.3",
+                   "efficiency gain", "steady-state kernel compile",
+                   "warm boot compiled", "device(s) < min",
+                   "sharded warm shape"):
+        assert needle in text, f"{needle} check never fired:\n{text}"
+
+
+def test_check_shard_missing_blocks(tmp_path):
+    doc = _shard_doc()
+    doc.pop("bucketed")
+    doc.pop("fused_counterfactual")
+    doc.pop("steady_state_compile_misses")
+    p = _write_shard(tmp_path, doc)
+    fails = obs_guard.check_shard(p, _SHARD_TH)
+    text = "\n".join(fails)
+    assert "no bucketed padding efficiency" in text
+    assert "no fused counterfactual" in text
+    assert "not recorded" in text
+
+
+def test_committed_shard_contract_holds():
+    """Acceptance: the committed BENCH_shard.json clears the committed
+    'shard' thresholds — bucketed padding efficiency over the floor
+    with the fused counterfactual recorded, verdict parity, the
+    explain_batch cost-model match, a verified zero-compile warm boot,
+    and zero steady-state compile misses."""
+    th = _thresholds()
+    shard = th.get("shard") or {}
+    assert "BENCH_shard.json" in shard
+    block = shard["BENCH_shard.json"]
+    req = block.get("require", ())
+    for key in ("bucketed", "fused_counterfactual", "parity",
+                "explain_match", "warmup_verified"):
+        assert key in req, f"shard contract does not require {key}"
+    assert block["max_steady_state_compile_misses"] == 0
+    assert block["max_warmup_compiles"] == 0
+    assert block["min_padding_efficiency"] >= 0.5
+    fails = obs_guard.run_guard({"shard": shard}, base=REPO)
+    assert fails == [], "the committed shard contract is broken:\n" \
+        + "\n".join(fails)

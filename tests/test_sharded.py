@@ -235,3 +235,132 @@ def test_sharded_deadline_and_slice_hook(mesh):
     assert seen, "on_slice never fired"
     shape, f = seen[0]
     assert shape[0] == f * mesh.shape["shard"]
+
+
+# ---------------------------------------------------------------------------
+# bucket-then-shard scheduler (checker/bucket.search_batch_sharded_bucketed)
+# ---------------------------------------------------------------------------
+
+
+def _mixed_batch(seed0, *, n=12):
+    """The differential-fuzz key mix: small/medium/big op counts,
+    :info crashes, corrupt (invalid) and clean (valid) histories, plus
+    non-CAS register keys whose corrupt reads the hb/constraint
+    prepass decides statically — those must dispose BEFORE sharding."""
+    model = cas_register()
+    seqs = []
+    for k in range(n):
+        rng = random.Random(seed0 + k)
+        n_ops = (28, 50, 90)[k % 3]
+        cas = k % 4 != 3
+        h = register_history(rng, n_ops=n_ops, n_procs=5, overlap=4,
+                             crash_p=0.1 if k % 3 == 0 else 0.0,
+                             cas=cas)
+        if k % 2 == 0 or not cas:
+            h = corrupt_read(rng, h, at=0.8)
+        seqs.append(encode_ops(h, model.f_codes))
+    return seqs, model
+
+
+@pytest.mark.parametrize("seed0", [5200, 6300])
+def test_bucketed_sharded_differential_fuzz(mesh, seed0):
+    """Bucketed-sharded vs fused-sharded vs single-device vs oracle:
+    verdict-identical key-for-key on a mixed-size batch, with every
+    certificate audited on all three engine routes."""
+    from jax.sharding import NamedSharding, PartitionSpec
+
+    from jepsen_tpu.analyze.audit import audit as audit_fn
+
+    seqs, model = _mixed_batch(seed0)
+    want = [oracle.check_opseq(s, model, dpor=False)["valid"]
+            for s in seqs]
+    sh = NamedSharding(mesh, PartitionSpec("shard"))
+    got_b = lin.search_batch(seqs, model, budget=400_000, sharding=sh,
+                             audit=True)
+    got_f = lin.search_batch(seqs, model, budget=400_000, sharding=sh,
+                             bucket=False, audit=True)
+    got_1 = lin.search_batch(seqs, model, budget=400_000, audit=True)
+    assert [r["valid"] for r in got_b] == want
+    assert [r["valid"] for r in got_f] == want
+    assert [r["valid"] for r in got_1] == want
+    for k, (s, rb) in enumerate(zip(seqs, got_b)):
+        a = audit_fn(s, model, rb)
+        assert a["ok"], (k, [str(d) for d in a["diagnostics"]])
+    sb = got_b[0].get("shard_batch")
+    assert sb, "bucketed-sharded stats block missing"
+    assert sb["n_devices"] == mesh.shape["shard"]
+    disposed = sb["greedy"] + sb["hb_decided"] \
+        + sb["constraint_decided"] + sb["hard"]
+    searched = sum(b["searched"] for b in sb["buckets"])
+    assert disposed + searched == len(seqs)
+    # non-CAS corrupt keys must never reach a device bucket
+    assert sb["hb_decided"] + sb["constraint_decided"] > 0
+
+
+def test_bucketed_sharded_explain_match(mesh):
+    """explain_batch(n_devices=...)'s prediction matches the live
+    shard_batch stats field-for-field on bench-config keys — the
+    cost-model contract the shard tier gates on."""
+    from jax.sharding import NamedSharding, PartitionSpec
+
+    from jepsen_tpu.analyze.plan import explain_batch
+    from jepsen_tpu.checker.shard_bench import _stats_match_plan
+
+    model = cas_register()
+    seqs = []
+    for k in range(10):
+        rng = random.Random(31000 + k)
+        h = register_history(rng, n_ops=74 if k < 8 else 120,
+                             n_procs=6, overlap=4)
+        h = corrupt_read(rng, h, at=0.85)
+        seqs.append(encode_ops(h, model.f_codes))
+    sh = NamedSharding(mesh, PartitionSpec("shard"))
+    got = lin.search_batch(seqs, model, budget=400_000, sharding=sh,
+                           audit=False)
+    sb = got[0].get("shard_batch")
+    assert sb
+    n_dev = mesh.shape["shard"]
+    plan = explain_batch(seqs, model, n_devices=n_dev)
+    match, diffs = _stats_match_plan(sb, plan)
+    assert match, diffs
+    assert plan["padding_efficiency"] == sb["padding_efficiency"]
+    assert plan["fused_padded_ops"] == sb["fused_padded_ops"]
+
+
+def test_sharded_pad_lanes_inert(mesh):
+    """Mesh-divisibility pad lanes must not bill configs or occupancy:
+    the same keys at the same dims, sharded (5 pad lanes on 8 devices)
+    vs unsharded (no pads), produce identical per-key configs AND an
+    identical telemetry block."""
+    from jax.sharding import NamedSharding, PartitionSpec
+
+    from jepsen_tpu.obs import telemetry as _tele
+
+    model = cas_register()
+    seqs = []
+    for k in range(3):
+        rng = random.Random(7100 + k)
+        h = register_history(rng, n_ops=40, n_procs=5, overlap=4)
+        h = corrupt_read(rng, h, at=0.85)
+        seqs.append(encode_ops(h, model.f_codes))
+    ess = [lin.encode_search(s) for s in seqs]
+    dims = lin.batch_dims(ess, model, frontier=64)
+    sh = NamedSharding(mesh, PartitionSpec("shard"))
+    _tele.enable(True)
+    try:
+        got_s = lin.search_batch(seqs, model, budget=400_000, dims=dims,
+                                 sharding=sh, audit=False)
+        got_1 = lin.search_batch(seqs, model, budget=400_000, dims=dims,
+                                 audit=False)
+    finally:
+        _tele.enable(None)
+    assert [r["valid"] for r in got_s] == [r["valid"] for r in got_1]
+    assert [r.get("configs") for r in got_s] \
+        == [r.get("configs") for r in got_1]
+    ts = got_s[0].get("search_telemetry")
+    t1 = got_1[0].get("search_telemetry")
+    assert ts is not None and t1 is not None
+    for f in ("expanded", "mask_killed", "dedup_folds", "goals",
+              "max_occupancy"):
+        assert ts[f] == t1[f], \
+            (f, ts[f], t1[f], "pad lanes leaked into telemetry")

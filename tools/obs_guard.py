@@ -49,6 +49,22 @@ collapse)::
        "max_steady_state_compile_misses": 0,
        "max_shed_rate": 0.0,
        "min_workers": 2}}}
+
+A ``shard`` block checks committed ``BENCH_shard.json`` summaries
+(the bucket-then-shard contract: bucketed padding efficiency clears
+the floor with the fused counterfactual recorded next to it, verdicts
+match the fused route and the oracle, `explain_batch` predicts the
+live stats exactly, and the measured laps paid zero compiles)::
+
+  {"shard": {"BENCH_shard.json": {
+       "require": ["bucketed", "fused_counterfactual", "parity",
+                   "explain_match", "warmup_verified"],
+       "min_padding_efficiency": 0.5,
+       "min_efficiency_gain_vs_fused": 1.5,
+       "max_steady_state_compile_misses": 0,
+       "max_warmup_compiles": 0,
+       "min_shards": 2,
+       "min_sharded_warm_shapes": 1}}}
 """
 
 from __future__ import annotations
@@ -219,12 +235,99 @@ def check_fleet(path: str, th: dict) -> list[str]:
     return fails
 
 
+def check_shard(path: str, th: dict) -> list[str]:
+    """-> failure strings for one committed BENCH_shard.json summary
+    against the shard-tier thresholds (empty = contract holds)."""
+    name = os.path.basename(path)
+    try:
+        with open(path) as f:
+            doc = json.load(f)
+    except FileNotFoundError:
+        return [f"{name}: shard bench file missing"]
+    except (OSError, ValueError) as e:
+        return [f"{name}: unreadable shard bench ({e})"]
+    fails = []
+    require = th.get("require", ())
+    warm = doc.get("warmup") or {}
+    b = doc.get("bucketed") or {}
+    fc = doc.get("fused_counterfactual") or {}
+    b_eff = b.get("padding_efficiency")
+    f_eff = fc.get("padding_efficiency")
+
+    if "bucketed" in require and b_eff is None:
+        fails.append(f"{name}: no bucketed padding efficiency "
+                     f"recorded")
+    if "fused_counterfactual" in require and f_eff is None:
+        fails.append(f"{name}: no fused counterfactual recorded — "
+                     f"the gain claim is unanchored")
+    if "parity" in require and doc.get("parity") is not True:
+        fails.append(f"{name}: bucketed-sharded verdicts diverged "
+                     f"from the fused route / oracle "
+                     f"(parity={doc.get('parity')!r})")
+    if "explain_match" in require and doc.get("explain_match") \
+            is not True:
+        fails.append(f"{name}: explain_batch prediction no longer "
+                     f"matches the live shard_batch stats "
+                     f"(explain_diffs in the bench file)")
+    if "warmup_verified" in require and warm.get("verified") \
+            is not True:
+        fails.append(f"{name}: trace-shape warm boot did not verify "
+                     f"(warmup={warm or None})")
+
+    mn = th.get("min_padding_efficiency")
+    if mn is not None and b_eff is not None and b_eff < mn:
+        fails.append(f"{name}: bucketed padding_efficiency {b_eff} "
+                     f"< min {mn}")
+
+    mn = th.get("min_efficiency_gain_vs_fused")
+    if mn is not None:
+        if b_eff is None or not f_eff:
+            fails.append(f"{name}: efficiency gain unmeasurable "
+                         f"(bucketed={b_eff}, fused={f_eff})")
+        elif b_eff / f_eff < mn:
+            fails.append(f"{name}: bucketed/fused efficiency gain "
+                         f"{round(b_eff / f_eff, 3)} < min {mn}")
+
+    mx = th.get("max_steady_state_compile_misses")
+    if mx is not None:
+        n = doc.get("steady_state_compile_misses")
+        if n is None:
+            fails.append(f"{name}: steady_state_compile_misses not "
+                         f"recorded")
+        elif n > mx:
+            fails.append(f"{name}: {n} steady-state kernel compile "
+                         f"miss(es) > max {mx} — the warm lap no "
+                         f"longer covers the bucket shapes")
+
+    mx = th.get("max_warmup_compiles")
+    if mx is not None and warm.get("compiled", 0) > mx:
+        fails.append(f"{name}: trace-shape warm boot compiled "
+                     f"{warm.get('compiled')} fresh kernel(s) > max "
+                     f"{mx} — shapes_from_trace no longer "
+                     f"reconstructs the sharded kernel set")
+
+    mn = th.get("min_shards")
+    if mn is not None and doc.get("n_devices", 0) < mn:
+        fails.append(f"{name}: bench ran on {doc.get('n_devices')} "
+                     f"device(s) < min {mn}")
+
+    mn = th.get("min_sharded_warm_shapes")
+    if mn is not None:
+        n = (doc.get("warmup_shapes") or {}).get("sharded", 0)
+        if n < mn:
+            fails.append(f"{name}: {n} sharded warm shape(s) in the "
+                         f"trace manifest < min {mn}")
+    return fails
+
+
 #: stats-block threshold key -> (derived gauge, direction)
 _STATS_CHECKS = {
     "min_kernel_cache_hit_ratio": ("kernel_cache_hit_ratio", "min"),
     "min_verdict_cache_hit_ratio": ("verdict_cache_hit_ratio", "min"),
     "min_bucket_padding_efficiency": ("bucket_padding_efficiency",
                                       "min"),
+    "min_shard_padding_efficiency": ("shard_padding_efficiency",
+                                     "min"),
     "max_device_idle_fraction": ("device_idle_fraction", "max"),
     "min_observed_prune_ratio": ("observed_prune_ratio", "min"),
     "max_observed_prune_ratio": ("observed_prune_ratio", "max"),
@@ -263,6 +366,8 @@ def run_guard(thresholds: dict, *, base: str = ".",
         fails.extend(check_trace(os.path.join(base, rel), th or {}))
     for rel, th in (thresholds.get("fleet") or {}).items():
         fails.extend(check_fleet(os.path.join(base, rel), th or {}))
+    for rel, th in (thresholds.get("shard") or {}).items():
+        fails.extend(check_shard(os.path.join(base, rel), th or {}))
     st = thresholds.get("stats")
     if st:
         if stats_snapshot is None:

@@ -1563,10 +1563,43 @@ def run_dpor_probe(out_path: str | None = None) -> dict:
     return out
 
 
+_DEVLINT_RAN = False
+
+
+def _devlint_preflight():
+    # devlint preflight: --trace re-records the committed
+    # BENCH_trace_*.json evidence, and tools/obs_guard.py holds
+    # those traces to the K007 cache-key contract — recording them
+    # from kernels that FAIL the device-contract lint would bake
+    # drifted compile spans into the repo.  Refuse before spending
+    # any accelerator budget.
+    global _DEVLINT_RAN
+    if "--trace" not in sys.argv or _DEVLINT_RAN:
+        return
+    _DEVLINT_RAN = True
+    from jepsen_tpu.analyze.devlint import run_devlint
+
+    rep = run_devlint()
+    if rep["errors"]:
+        for d in rep["diagnostics"]:
+            print(f"bench: devlint {d['severity'].upper()} "
+                  f"{d['code']} {d['message']}", file=sys.stderr)
+        print(f"bench: refusing --trace tiers — "
+              f"{rep['errors']} device-contract error(s) across "
+              f"route(s) {', '.join(rep['routes'])}; fix (or "
+              f"suppress with a documented `devlint: ok`) and "
+              f"re-run", file=sys.stderr)
+        sys.exit(2)
+    print(f"bench: devlint preflight ok "
+          f"({len(rep['routes'])} kernel route(s) stage clean)",
+          file=sys.stderr)
+
+
 def main():
     global _BEST, _BEST_PRIO, _BEST_TIER, _PROBE
 
     _install_guards()
+    _devlint_preflight()
     probe = _PROBE = start_probe()
 
     tiers = TIERS[:1] if QUICK else TIERS
@@ -1983,6 +2016,21 @@ def main():
 
 
 if __name__ == "__main__":
+    # The host-only tiers force their platform env BEFORE any jax
+    # import; hoisted here because the devlint preflight below stages
+    # kernels (importing jax) and would otherwise pin the platform
+    # first — the shard tier in particular needs its 8-device virtual
+    # mesh.  The per-branch setdefaults stay as documentation.
+    if any(f in sys.argv
+           for f in ("--stream-tier", "--fleet-tier", "--shard-tier")):
+        os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    if "--shard-tier" in sys.argv:
+        os.environ.setdefault(
+            "XLA_FLAGS", "--xla_force_host_platform_device_count=8")
+    # Every dispatch below can write BENCH_trace_*.json under --trace;
+    # all of them go through the device-contract preflight (run-once,
+    # so the main() ladder does not repeat it).
+    _devlint_preflight()
     if "--dpor-probe" in sys.argv:
         # the dynamic-layer probe (ISSUE 14): device-mask / dead-value
         # dedup / dup-edge reductions over the 10k tiers ->

@@ -12,6 +12,16 @@ standalone certificate checker::
     python -m jepsen_tpu.analyze history.jsonl --model cas-register \\
         --audit result.json
 
+``--devlint`` takes no history: it stages every registered kernel
+route (single-XLA, bucketed-batch, mesh-sharded, pallas-fused) over
+representative dims and walks the jaxprs for the K-code device
+contract (host callbacks in level loops, dtype widening, weak-type
+cache-key splits, donation policy, dynamic shapes, in-loop transfers,
+compile-span cache-key drift — see docs/analyze.md)::
+
+    python -m jepsen_tpu.analyze --devlint
+    python -m jepsen_tpu.analyze --devlint --json
+
 Exit codes follow cli.py's contract: 0 clean, 1 lint errors or audit
 W-codes found, 254 bad arguments.
 """
@@ -54,7 +64,9 @@ def main(argv=None) -> int:
         description="Lint a stored history; --explain adds the static "
                     "search plan (dims, bucket, engine route, "
                     "decompositions).")
-    p.add_argument("history", help="history.jsonl path (one op/line)")
+    p.add_argument("history", nargs="?", default=None,
+                   help="history.jsonl path (one op/line); not needed "
+                        "with --devlint")
     p.add_argument("--model", choices=MODELS, default=None,
                    help="Model for the model-facing checks + plan")
     p.add_argument("--model-arg", type=int, default=None,
@@ -68,10 +80,33 @@ def main(argv=None) -> int:
                         "W-code")
     p.add_argument("--json", action="store_true", dest="as_json",
                    help="Machine-readable output")
+    p.add_argument("--devlint", action="store_true",
+                   help="Stage every kernel route and lint the jaxprs "
+                        "for the K-code device contract (no history "
+                        "needed)")
     try:
         opts = p.parse_args(argv)
     except SystemExit as e:
         return 0 if e.code in (0, None) else 254
+
+    if opts.devlint:
+        from .devlint import run_devlint
+
+        rep = run_devlint(live=True)
+        if opts.as_json:
+            print(json.dumps(rep, indent=2, default=str))
+        else:
+            for d in rep["diagnostics"]:
+                print(f"{d['severity'].upper()} {d['code']} "
+                      f"{d['message']}")
+            print(f"devlint: {rep['errors']} error(s), "
+                  f"{rep['warnings']} warning(s) over "
+                  f"{len(rep['routes'])} route(s): "
+                  f"{', '.join(rep['routes'])}")
+        return 1 if rep["errors"] else 0
+    if opts.history is None:
+        print("history path required (or --devlint)", file=sys.stderr)
+        return 254
 
     from .. import store
     from . import analyze

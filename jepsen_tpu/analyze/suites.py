@@ -47,9 +47,36 @@ B003 error    a function writes a file and then ``os.replace``/
               after a crash silently loses the journal
 ==== ======== ==========================================================
 
+T-codes (thread/lock discipline over the service tiers —
+``jepsen_tpu/fleet/``, ``stream/``, ``obs/``, ``decompose/cache.py``,
+``checker/bucket.py`` — via :func:`lint_thread_tier`; a multi-file
+pass that roots a name-based call graph at every
+``threading.Thread(target=...)`` / ``executor.submit(...)`` /
+socketserver ``handle()`` and lints the thread-reachable functions):
+
+==== ======== ==========================================================
+T001 error    module/instance state mutated read-modify-write
+              (``+=``, self-referential assign, check-then-act) from a
+              thread-reachable function without an enclosing lock —
+              the admission/env-knob race class
+T002 error    ``.acquire()`` / ``fcntl.flock(LOCK_EX)`` not covered by
+              try/finally-release or a context manager — an exception
+              between acquire and release deadlocks every other thread
+T003 error    file written under a flock-style lock without
+              ``os.fsync`` before release — the next holder (or a
+              crash) can observe the torn tail the lock was supposed
+              to serialize
+T004 error    ``obs.span(...)`` emitted from a thread-reachable
+              function without the ``run=`` pin — the span attributes
+              to the process-wide current run, which a multiplexing
+              service may have moved by the time the span closes (the
+              PR 17 prep-span race)
+==== ======== ==========================================================
+
 False-positive escape hatch: a line containing ``suite-lint: ok``
-suppresses findings anchored on it (use sparingly, with a comment saying
-why the pattern is sound).
+suppresses S/B findings anchored on it; ``threadlint: ok`` suppresses
+T findings (use sparingly, with a comment saying why the pattern is
+sound).
 """
 
 from __future__ import annotations
@@ -72,6 +99,10 @@ SUITE_CODES = {
     "B001": "LiveBackend subclass missing a protocol member",
     "B002": "broad except in a live module swallowing a crash to :fail",
     "B003": "file written and renamed without fsync in between",
+    "T001": "shared state mutated from a thread without its lock",
+    "T002": "lock acquired without try/finally or context manager",
+    "T003": "file written under flock without fsync-before-release",
+    "T004": "span emitted from a thread without the run= pin",
 }
 
 #: the LiveBackend protocol members a concrete family must provide
@@ -544,4 +575,451 @@ def lint_paths(paths: Sequence[str | Path] | None = None
         diags = lint_file(f)
         if diags:
             out[str(f)] = diags
+    return out
+
+
+# ---------------------------------------------------------------------------
+# T-codes — thread/lock discipline over the service tiers
+# ---------------------------------------------------------------------------
+#
+# The fleet/stream tiers (PRs 16–17) grew threads fast: socketserver
+# connection handlers, probe/pump/reaper loops, the bucket scheduler's
+# prep pipeline.  The races they invite (unlocked read-modify-write of
+# admission state, env-knob caches, span attribution to a moved
+# current-run) are exactly the ones the runtime gates can't see — a
+# torn counter doesn't fail a bench.  This pass is deliberately
+# tier-LEVEL, not file-level: thread reachability crosses files (a
+# router handler thread calls into admission.py), so the call graph is
+# built over the whole tier at once, name-based and over-approximate
+# (a lint, not an alias analysis).
+
+#: the default tier: every package that runs code on threads, relative
+#: to the jepsen_tpu package root
+THREAD_TIER = ("fleet", "stream", "obs", "decompose/cache.py",
+               "checker/bucket.py")
+
+#: substrings marking a with-item's context expr as a lock
+_LOCKISH = ("lock", "mutex", "locked")
+
+#: method names too generic to be call-graph edges — ``self._runs.get``
+#: must not make every function named ``get`` thread-reachable (the
+#: name-based graph has no receiver types, so ubiquitous
+#: container/stdlib names are excluded from edges entirely)
+_GENERIC_NAMES = frozenset({
+    "get", "put", "set", "add", "pop", "append", "extend", "update",
+    "clear", "copy", "close", "open", "read", "write", "send", "recv",
+    "start", "join", "submit", "result", "items", "keys", "values",
+    "setdefault", "discard", "remove", "insert", "index", "count",
+    "inc", "observe", "acquire", "release", "wait", "notify", "run",
+})
+
+
+def _is_lockish(expr: str) -> bool:
+    e = expr.lower()
+    return any(t in e for t in _LOCKISH)
+
+
+def _last_seg(expr_str: str) -> str:
+    return expr_str.split(".")[-1].split("(")[0].strip()
+
+
+def _target_name(node) -> str | None:
+    """Callable-reference name: ``Name`` / ``Attribute`` last segment."""
+    if isinstance(node, ast.Name):
+        return node.id
+    if isinstance(node, ast.Attribute):
+        return node.attr
+    return None
+
+
+class _FnInfo:
+    __slots__ = ("node", "filename", "lines", "cls")
+
+    def __init__(self, node, filename, lines, cls=None):
+        self.node = node
+        self.filename = filename
+        self.lines = lines
+        self.cls = cls
+
+
+def thread_tier_files() -> list[Path]:
+    pkg = Path(__file__).resolve().parent.parent
+    files: list[Path] = []
+    for rel in THREAD_TIER:
+        p = pkg / rel
+        if p.is_dir():
+            files.extend(sorted(p.glob("*.py")))
+        elif p.exists():
+            files.append(p)
+    return files
+
+
+def _index_tier(files: Sequence[Path]):
+    """One parse pass: function defs by bare name, thread-root names,
+    and the name-based call graph."""
+    fns: dict[str, list[_FnInfo]] = {}
+    roots: set[str] = set()
+    calls: dict[int, set[str]] = {}  # id(fn node) -> callee names
+    trees = []
+    for path in files:
+        src = Path(path).read_text()
+        try:
+            tree = ast.parse(src, filename=str(path))
+        except SyntaxError:
+            continue
+        lines = src.splitlines()
+        trees.append((path, tree, lines))
+        # class membership for handler-root detection
+        cls_of: dict[int, ast.ClassDef] = {}
+        for cls in [n for n in ast.walk(tree)
+                    if isinstance(n, ast.ClassDef)]:
+            for m in cls.body:
+                if isinstance(m, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                    cls_of[id(m)] = cls
+        for fn in [n for n in ast.walk(tree)
+                   if isinstance(n, (ast.FunctionDef,
+                                     ast.AsyncFunctionDef))]:
+            info = _FnInfo(fn, str(path), lines, cls_of.get(id(fn)))
+            fns.setdefault(fn.name, []).append(info)
+            callees = set()
+            for c in [n for n in ast.walk(fn)
+                      if isinstance(n, ast.Call)]:
+                leaf = _last_seg(_call_name(c))
+                if leaf and leaf not in _GENERIC_NAMES:
+                    callees.add(leaf)
+            calls[id(fn)] = callees
+        for c in [n for n in ast.walk(tree) if isinstance(n, ast.Call)]:
+            cname = _call_name(c)
+            leaf = _last_seg(cname)
+            if leaf == "Thread":
+                for kw in c.keywords:
+                    if kw.arg == "target":
+                        t = _target_name(kw.value)
+                        if t:
+                            roots.add(t)
+            elif leaf == "submit" and c.args:
+                t = _target_name(c.args[0])
+                if t:
+                    roots.add(t)
+        # socketserver: ThreadingTCPServer runs each connection's
+        # handler on its own thread — handle() is a thread root
+        for cls in [n for n in ast.walk(tree)
+                    if isinstance(n, ast.ClassDef)]:
+            if any("RequestHandler" in b for b in _base_names(cls)):
+                for m in cls.body:
+                    if isinstance(m, ast.FunctionDef) and \
+                            m.name == "handle":
+                        roots.add("handle")
+    return fns, roots, calls, trees
+
+
+def _reachable_names(fns, roots, calls) -> set[str]:
+    seen: set[str] = set()
+    stack = [r for r in roots if r in fns]
+    while stack:
+        name = stack.pop()
+        if name in seen:
+            continue
+        seen.add(name)
+        for info in fns[name]:
+            for callee in calls.get(id(info.node), ()):
+                if callee in fns and callee not in seen:
+                    stack.append(callee)
+    return seen
+
+
+def _fn_call_edges(fn) -> list[tuple[str, bool]]:
+    """(callee name, call site is inside a lock context) for every
+    call in ``fn`` — the raw material for the caller-holds-lock
+    fixpoint (a function whose every in-tier call site holds a lock is
+    as protected as one that takes the lock itself)."""
+    out: list[tuple[str, bool]] = []
+
+    def exprs_calls(node, in_lock):
+        if node is None:
+            return
+        for c in [n for n in ast.walk(node) if isinstance(n, ast.Call)]:
+            leaf = _last_seg(_call_name(c))
+            if leaf and leaf not in _GENERIC_NAMES:
+                out.append((leaf, in_lock))
+
+    def scan(stmts, in_lock):
+        for st in stmts:
+            if isinstance(st, (ast.FunctionDef, ast.AsyncFunctionDef,
+                               ast.ClassDef)):
+                continue
+            if isinstance(st, (ast.With, ast.AsyncWith)):
+                locky = False
+                for it in st.items:
+                    exprs_calls(it.context_expr, in_lock)
+                    try:
+                        locky = locky or _is_lockish(
+                            ast.unparse(it.context_expr))
+                    except Exception:  # noqa: BLE001
+                        pass
+                scan(st.body, in_lock or locky)
+            elif isinstance(st, ast.Try):
+                scan(st.body, in_lock)
+                for h in st.handlers:
+                    scan(h.body, in_lock)
+                scan(st.orelse, in_lock)
+                scan(st.finalbody, in_lock)
+            elif isinstance(st, ast.If):
+                exprs_calls(st.test, in_lock)
+                scan(st.body, in_lock)
+                scan(st.orelse, in_lock)
+            elif isinstance(st, ast.While):
+                exprs_calls(st.test, in_lock)
+                scan(st.body, in_lock)
+                scan(st.orelse, in_lock)
+            elif isinstance(st, (ast.For, ast.AsyncFor)):
+                exprs_calls(st.iter, in_lock)
+                scan(st.body, in_lock)
+                scan(st.orelse, in_lock)
+            else:
+                exprs_calls(st, in_lock)
+
+    scan(fn.body, False)
+    return out
+
+
+def _lock_covered(fns, roots, edges: list[tuple[str, str, bool]]
+                  ) -> set[str]:
+    """Greatest fixpoint of "every in-tier call site holds a lock":
+    start from every called name, drop thread roots (they start on a
+    bare thread), then drop any callee with an unlocked call site from
+    an uncovered caller, until stable."""
+    covered = {callee for _, callee, _ in edges} - set(roots)
+    changed = True
+    while changed:
+        changed = False
+        for caller, callee, locked in edges:
+            if callee in covered and not locked \
+                    and caller not in covered:
+                covered.discard(callee)
+                changed = True
+    return covered
+
+
+def _is_acquire(call: ast.Call) -> bool:
+    name = _call_name(call)
+    if name.endswith(".acquire"):
+        return True
+    if _last_seg(name) == "flock":
+        return any("LOCK_EX" in ast.unparse(a) for a in call.args)
+    return False
+
+
+def _try_releases(node: ast.Try) -> bool:
+    for st in node.finalbody:
+        for c in [n for n in ast.walk(st) if isinstance(n, ast.Call)]:
+            name = _call_name(c)
+            if name.endswith(".release") or (
+                    _last_seg(name) == "flock"
+                    and any("LOCK_UN" in ast.unparse(a)
+                            for a in c.args)):
+                return True
+    return False
+
+
+def _scan_thread_fn(info: _FnInfo, reachable: bool, add, *,
+                    covered: bool = False) -> None:
+    """Walk one function's statements tracking lock context; emit
+    T001/T002/T003/T004 through ``add(code, msg, lineno)``.
+    ``covered`` means every in-tier call site holds a lock, so the
+    T001 shared-state checks are moot."""
+    fn = info.node
+    global_names = {n for node in ast.walk(fn)
+                    if isinstance(node, ast.Global) for n in node.names}
+    # T002 release heuristic is function-scoped: a lock taken in one
+    # branch and released in an enclosing finally (depth-counted CMs
+    # like VerdictCache._locked) is disciplined even though the
+    # acquire's own statement list has no Try sibling
+    fn_releases = any(_try_releases(t) for t in ast.walk(fn)
+                      if isinstance(t, ast.Try))
+
+    def stmt_calls(st):
+        return [n for n in ast.walk(st) if isinstance(n, ast.Call)]
+
+    def is_shared_target(t) -> tuple[bool, str]:
+        """(is shared state, display name) — instance/class attrs and
+        declared-global module names; subscripts of those too."""
+        if isinstance(t, ast.Subscript):
+            return is_shared_target(t.value)
+        if isinstance(t, ast.Attribute):
+            try:
+                return True, ast.unparse(t)
+            except Exception:  # noqa: BLE001
+                return True, t.attr
+        if isinstance(t, ast.Name) and t.id in global_names:
+            return True, t.id
+        return False, ""
+
+    def check_t001(st, in_lock, if_tests):
+        if not reachable or in_lock or covered:
+            return
+        if isinstance(st, ast.AugAssign):
+            shared, name = is_shared_target(st.target)
+            if shared:
+                add("T001",
+                    f"{fn.name}() read-modify-writes {name} from a "
+                    f"thread-reachable path without holding a lock — "
+                    f"concurrent updates lose increments", st.lineno)
+            return
+        if isinstance(st, ast.Assign) and len(st.targets) == 1:
+            shared, name = is_shared_target(st.targets[0])
+            if not shared:
+                return
+            try:
+                val = ast.unparse(st.value)
+            except Exception:  # noqa: BLE001
+                val = ""
+            rmw = name in val
+            check_act = any(name in test for test in if_tests)
+            if rmw or check_act:
+                how = ("self-referential assign" if rmw
+                       else "check-then-act")
+                add("T001",
+                    f"{fn.name}() {how} on {name} from a "
+                    f"thread-reachable path without holding a lock — "
+                    f"two threads can interleave between read and "
+                    f"write", st.lineno)
+
+    def check_t004(st, in_lock):
+        if not reachable:
+            return
+        for c in stmt_calls(st):
+            if _last_seg(_call_name(c)) != "span":
+                continue
+            if not any(kw.arg == "run" for kw in c.keywords):
+                add("T004",
+                    f"{fn.name}() emits a span from a thread-reachable "
+                    f"path without the run= pin — it attributes to the "
+                    f"process-wide current run, which another thread "
+                    f"may have moved", c.lineno)
+
+    def check_t003_with(st: ast.With | ast.AsyncWith):
+        """Write under a flock-style lock without fsync before the
+        lock releases at the with-exit."""
+        ctxs = []
+        for it in st.items:
+            try:
+                ctxs.append(ast.unparse(it.context_expr))
+            except Exception:  # noqa: BLE001
+                pass
+        if not any("flock" in c.lower() or "locked" in c.lower()
+                   for c in ctxs):
+            return
+        writes = []
+        has_fsync = False
+        for sub in st.body:
+            for c in stmt_calls(sub):
+                name = _call_name(c)
+                if name.endswith((".write", ".writelines")):
+                    writes.append(c)
+                if _last_seg(name) == "fsync":
+                    has_fsync = True
+        if writes and not has_fsync:
+            add("T003",
+                f"{fn.name}() writes a file under {ctxs[0]} without "
+                f"os.fsync before the lock releases — the next holder "
+                f"(or a crash) can observe the torn tail the lock was "
+                f"meant to serialize", writes[0].lineno)
+
+    def scan(stmts, in_lock, if_tests, protected):
+        for i, st in enumerate(stmts):
+            if isinstance(st, (ast.FunctionDef, ast.AsyncFunctionDef,
+                               ast.ClassDef)):
+                continue  # nested defs are their own entries
+            # T002: bare acquire must be covered by try/finally
+            acquires = [c for c in stmt_calls(st)
+                        if isinstance(st, ast.Expr) and _is_acquire(c)]
+            for c in acquires:
+                nxt = stmts[i + 1] if i + 1 < len(stmts) else None
+                ok = protected or fn_releases or (
+                    isinstance(nxt, ast.Try) and _try_releases(nxt))
+                if not ok:
+                    add("T002",
+                        f"{fn.name}() acquires a lock with no "
+                        f"try/finally release and no context manager "
+                        f"— an exception here deadlocks every other "
+                        f"thread", c.lineno)
+            if isinstance(st, (ast.With, ast.AsyncWith)):
+                locky = any(_is_lockish(ast.unparse(it.context_expr))
+                            for it in st.items)
+                check_t003_with(st)
+                check_t004(st, in_lock)
+                scan(st.body, in_lock or locky, if_tests, protected)
+            elif isinstance(st, ast.Try):
+                body_protected = protected or _try_releases(st)
+                scan(st.body, in_lock, if_tests, body_protected)
+                for h in st.handlers:
+                    scan(h.body, in_lock, if_tests, protected)
+                scan(st.orelse, in_lock, if_tests, protected)
+                scan(st.finalbody, in_lock, if_tests, protected)
+            elif isinstance(st, ast.If):
+                try:
+                    test = ast.unparse(st.test)
+                except Exception:  # noqa: BLE001
+                    test = ""
+                scan(st.body, in_lock, if_tests + [test], protected)
+                scan(st.orelse, in_lock, if_tests, protected)
+            elif isinstance(st, (ast.For, ast.AsyncFor, ast.While)):
+                scan(st.body, in_lock, if_tests, protected)
+                scan(st.orelse, in_lock, if_tests, protected)
+            else:
+                check_t001(st, in_lock, if_tests)
+                check_t004(st, in_lock)
+
+    scan(fn.body, False, [], False)
+
+
+def lint_thread_tier(paths: Sequence[str | Path] | None = None
+                     ) -> dict[str, list[Diagnostic]]:
+    """The T-code pass: build the tier-wide call graph, mark
+    thread-reachable functions, lint them for lock discipline.
+    Returns {filename: diagnostics} for files with findings only."""
+    files = ([Path(p) for p in paths] if paths
+             else thread_tier_files())
+    all_files: list[Path] = []
+    for p in files:
+        if p.is_dir():
+            all_files.extend(sorted(p.glob("*.py")))
+        else:
+            all_files.append(p)
+    fns, roots, calls, _trees = _index_tier(all_files)
+    reachable = _reachable_names(fns, roots, calls)
+    edges = [(name, callee, locked)
+             for name, infos in fns.items()
+             for info in infos
+             for callee, locked in _fn_call_edges(info.node)
+             if callee in fns]
+    covered = _lock_covered(fns, roots, edges)
+    out: dict[str, list[Diagnostic]] = {}
+    for name, infos in fns.items():
+        for info in infos:
+            lines = info.lines
+
+            def add(code, msg, lineno, _info=info, _lines=lines):
+                # line suppression, or a whole-function suppression on
+                # the def line or in the contiguous comment block just
+                # above it — single-owner-thread functions document
+                # their ownership argument once, not per statement
+                cand = [lineno, _info.node.lineno]
+                ln = _info.node.lineno - 1
+                while 1 <= ln <= len(_lines) \
+                        and _lines[ln - 1].lstrip().startswith("#"):
+                    cand.append(ln)
+                    ln -= 1
+                for ln in cand:
+                    if 1 <= ln <= len(_lines) and \
+                            "threadlint: ok" in _lines[ln - 1]:
+                        return
+                out.setdefault(_info.filename, []).append(Diagnostic(
+                    code, "error", f"{_info.filename}:{lineno}: {msg}",
+                    index=lineno))
+            _scan_thread_fn(info, name in reachable, add,
+                            covered=name in covered)
+    for f in out:
+        out[f].sort(key=lambda d: d.index or 0)
     return out

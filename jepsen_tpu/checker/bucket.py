@@ -192,6 +192,12 @@ def search_batch_bucketed(seqs: list[OpSeq], model: ModelSpec, *,
                    "greedy": 0, "hard": len(hard), "hb_decided": 0,
                    "constraint_decided": 0}
 
+    # pin span attribution to the run that started THIS drive: the
+    # prep closure runs on the pipeline thread, where the process-wide
+    # current run may have moved on under a multiplexing service by
+    # the time the span closes (T001/T004 — the PR 17 race class)
+    run_pin = obs.current_run()
+
     def prep(idxs: list[int]):
         """Host stage for one bucket: greedy-witness disposal, then
         tight dims + padding for the keys that must ride the device.
@@ -199,7 +205,8 @@ def search_batch_bucketed(seqs: list[OpSeq], model: ModelSpec, *,
         the previous bucket executes (its span lands on the prep
         thread's track, so the trace timeline SHOWS the overlap)."""
         t_prep = time.perf_counter()
-        with obs.span("bucket.prep", cat="host", keys=len(idxs)):
+        with obs.span("bucket.prep", cat="host", run=run_pin,
+                      keys=len(idxs)):
             ready: dict[int, dict] = {}
             run: list[int] = []
             run_mask: dict[int, dict | None] = {}
@@ -419,6 +426,11 @@ def search_batch_sharded_bucketed(seqs: list[OpSeq], model: ModelSpec,
                    "greedy": 0, "hard": len(hard), "hb_decided": 0,
                    "constraint_decided": 0}
 
+    # same run pin as the single-device scheduler: prep spans close on
+    # the pipeline thread, which must not read the racy process-wide
+    # current run (T004)
+    run_pin = obs.current_run()
+
     def prep(idxs: list[int]):
         """Host stage for one bucket — the single-device scheduler's
         prep with the sharded route's two differences: dims start at
@@ -426,7 +438,8 @@ def search_batch_sharded_bucketed(seqs: list[OpSeq], model: ModelSpec,
         planes are never stripped (the sharded kernel is always XLA,
         never pallas)."""
         t_prep = time.perf_counter()
-        with obs.span("shard.prep", cat="host", keys=len(idxs)):
+        with obs.span("shard.prep", cat="host", run=run_pin,
+                      keys=len(idxs)):
             ready: dict[int, dict] = {}
             run: list[int] = []
             run_mask: dict[int, dict | None] = {}
@@ -584,3 +597,22 @@ def search_batch_sharded_bucketed(seqs: list[OpSeq], model: ModelSpec,
     if results:
         results[0].setdefault("shard_batch", stats)
     return results
+
+
+# ---------------------------------------------------------------------------
+# kernel route registration — the bucket scheduler's half of the
+# device-contract enumeration (see linearizable.KernelRoute)
+# ---------------------------------------------------------------------------
+
+from . import linearizable as _lin  # noqa: E402
+
+_lin.register_route(_lin.KernelRoute(
+    name="bucketed-batch", engine="xla", span_kind="batch",
+    getter="get_batch_kernel", module=_lin.__name__,
+    build=_lin._build_batch, request=_lin._request_batch,
+    batched=True))
+_lin.register_route(_lin.KernelRoute(
+    name="mesh-sharded", engine="xla", span_kind="batch-sharded",
+    getter="get_sharded_batch_kernel", module=_lin.__name__,
+    build=_lin._build_sharded, request=_lin._request_sharded,
+    batched=True, sharded=True))

@@ -1668,8 +1668,20 @@ def search_opseq_sharded(seq: OpSeq, model: ModelSpec, mesh, *,
         fn = _SHARDED_CACHE.get(key)
         _kc_record(fn is not None)
         if fn is None:
+            # full cache-key coords, like every other route's span —
+            # K007 (analyze/devlint.py) flags a device-sharded compile
+            # span that only names the frontier as coord drift
             with _tele.compile_span(engine="device-sharded",
-                                    frontier=dims.frontier):
+                                    shards=D, frontier=dims.frontier,
+                                    n_det_pad=dims.n_det_pad,
+                                    n_crash_pad=dims.n_crash_pad,
+                                    window=dims.window, k=dims.k,
+                                    masked=_masked,
+                                    masked_crash=_mcrash,
+                                    dedup=_dedup, vt=_vt,
+                                    model=model.name,
+                                    model_init=int(model.init[0]),
+                                    model_width=model.state_width):
                 fn = jax.jit(build_sharded_search_step_fn(
                     model, dims, mesh, axis, masked=_masked,
                     masked_crash=_mcrash, dedup=_dedup,
@@ -1996,11 +2008,20 @@ def get_kernel(model: ModelSpec, dims: SearchDims, *,
         # a miss is a trace + XLA compile: the device.compile span is
         # the cold-start tax's trace evidence (the hit path is a dict
         # get and never enters here)
+        # FULL cache-key coordinates (model descriptor + phase-2 flags
+        # included): fleet/warmup.py reconstructs this exact kernel
+        # from the recorded span, and analyze/devlint.py's K007 check
+        # verifies the coord set against its static cache-key model
         with _tele.compile_span(engine="pallas" if use_p else "xla",
                                 frontier=dims.frontier,
                                 n_det_pad=dims.n_det_pad,
                                 n_crash_pad=dims.n_crash_pad,
-                                window=dims.window, k=dims.k):
+                                window=dims.window, k=dims.k,
+                                masked=masked, masked_crash=masked_crash,
+                                dedup=dedup, vt=vt,
+                                model=model.name,
+                                model_init=int(model.init[0]),
+                                model_width=model.state_width):
             if use_p:
                 from . import pallas_level
 
@@ -3050,6 +3071,194 @@ def _init_batch_carry(n: int, dims: SearchDims, model: ModelSpec):
     return (frontier, np.ones(n, np.int32),
             np.full(n, -1, np.int32), np.zeros(n, np.int32),
             np.zeros(n, np.int32), np.zeros(n, bool))
+
+
+# ---------------------------------------------------------------------------
+# kernel route registry — the static device contract's enumeration
+# ---------------------------------------------------------------------------
+#
+# Every way a compiled search kernel can be requested is one ROUTE:
+# single-device XLA, bucketed batch (vmapped), mesh-sharded batch
+# (shard_map of the vmapped kernel), and the pallas fused level loop.
+# ``analyze/devlint.py`` abstractly stages each route over
+# representative SearchDims and walks the jaxpr for the K-codes; the
+# declared fields ARE the contract the lint checks the live code
+# against (donation policy, int-only dtypes, compile-span coords).
+
+
+@dataclass(frozen=True)
+class KernelRoute:
+    """One kernel dispatch route and its device contract.
+
+    ``build(model, dims)`` returns ``(fn, args)`` — the UNJITTED step
+    callable and the exact positional example arguments the driver
+    passes, so ``jax.make_jaxpr(fn)(*args)`` stages the route the way
+    the driver traces it (weak types and python-scalar leaks included).
+    ``request(model, dims)`` goes through the real cached getter
+    (``get_kernel`` & co.), so a fresh process emits the route's
+    ``device.compile`` span for the K007 coord check.
+
+    ``donate_carry`` is the K004 policy: the slice drivers keep each
+    pre-overflow carry (``prev[0]``) and re-feed it widened after a
+    frontier escalation, so donating the carry buffers would hand XLA
+    a buffer the host still needs — every shipped route declares
+    False, and the lint flags a ``donate_argnums`` in the getter's
+    ``jax.jit`` call as a contract break (and the reverse: a route
+    declaring True whose jit never donates)."""
+
+    name: str
+    engine: str        # "xla" | "pallas"
+    span_kind: str     # compile-span coord generation (devlint model)
+    getter: str        # cache-getter function name (K004 AST anchor)
+    module: str        # dotted module defining the getter
+    build: object      # (model, dims) -> (fn, args) for staging
+    request: object    # (model, dims) -> compiled fn via the cache
+    int_only: bool = True
+    donate_carry: bool = False
+    carry_args: int = 6
+    batched: bool = False
+    sharded: bool = False
+
+
+KERNEL_ROUTES: dict[str, KernelRoute] = {}
+
+
+def register_route(route: KernelRoute) -> KernelRoute:
+    KERNEL_ROUTES[route.name] = route
+    return route
+
+
+def route_sample_inputs(model: ModelSpec, dims: SearchDims, *,
+                        batch: int = 0):
+    """The positional example arguments a route's driver would pass at
+    ``dims`` for a minimal one-op history — shared by devlint staging
+    and the route builders below.  ``batch > 0`` stacks the batch-route
+    form.  Returns the FULL operand tuple
+    ``(*tables, budget, lvl_cap, bail, *carry)``."""
+    from ..history import encode_ops, invoke_op, ok_op
+
+    fc = model.f_codes
+    try:
+        names = list(fc)
+    except TypeError:  # _AnyFCodes (noop model): accepts anything
+        names = ["write"]
+    f = next((c for c in ("write", "enqueue", "acquire")
+              if c in names), names[0])
+    v = 1 if f in ("write", "enqueue") else None
+    seq = encode_ops([invoke_op(0, f, v), ok_op(0, f, v)], fc)
+    es = encode_search(seq)
+    esp = pad_search(es, dims.n_det_pad, dims.n_crash_pad)
+    tail = (jnp.int32(64), jnp.int32(4), jnp.bool_(False))
+    if batch:
+        args = stack_batch([esp] * batch)
+        carry = tuple(jnp.asarray(c)
+                      for c in _init_batch_carry(batch, dims, model))
+        return args + tail + carry
+    args = search_args(esp, es)
+    carry = tuple(jnp.asarray(c) for c in _init_carry(dims, model))
+    return args + tail + carry
+
+
+def _route_mesh():
+    """A minimal single-axis mesh over the local devices (the sharded
+    route's staging target; 1 device is a valid mesh)."""
+    from jax.sharding import Mesh
+
+    devs = jax.devices()
+    return Mesh(np.array(devs[:1]), ("shard",)), "shard", 1
+
+
+def _build_single(model: ModelSpec, dims: SearchDims):
+    fn = build_search_step_fn(model, dims)
+    return fn, route_sample_inputs(model, dims)
+
+
+def _request_single(model: ModelSpec, dims: SearchDims):
+    return get_kernel(model, dims)
+
+
+def _build_pallas(model: ModelSpec, dims: SearchDims):
+    from . import pallas_level
+
+    fn = pallas_level.build_pallas_step_fn(
+        model, dims, interpret=_backend() != "tpu")
+    return fn, route_sample_inputs(model, dims)
+
+
+def _request_pallas(model: ModelSpec, dims: SearchDims):
+    global _ENGINE_MODE
+    prev = _ENGINE_MODE
+    _ENGINE_MODE = "pallas"
+    try:
+        return get_kernel(model, dims)
+    finally:
+        _ENGINE_MODE = prev
+
+
+_ROUTE_BATCH = 4  # representative lane count for the batch routes
+
+
+def _build_batch(model: ModelSpec, dims: SearchDims):
+    base = build_search_step_fn(model, dims, batch=_ROUTE_BATCH)
+    fn = jax.vmap(base, in_axes=(0,) * 19 + (None, None, None)
+                  + (0,) * 6)
+    return fn, route_sample_inputs(model, dims, batch=_ROUTE_BATCH)
+
+
+def _request_batch(model: ModelSpec, dims: SearchDims):
+    return get_batch_kernel(model, dims, batch=_ROUTE_BATCH,
+                            allow_pallas=False)
+
+
+def _build_sharded(model: ModelSpec, dims: SearchDims):
+    try:
+        from jax import shard_map
+    except ImportError:  # pre-0.4.35 jax: the experimental home
+        from jax.experimental.shard_map import shard_map
+    from jax.sharding import PartitionSpec as P
+
+    mesh, axis, d = _route_mesh()
+    per = _ROUTE_BATCH // d or 1
+    base = build_search_step_fn(model, dims, batch=per)
+    vm = jax.vmap(base, in_axes=(0,) * 19 + (None, None, None)
+                  + (0,) * 6)
+    fn = shard_map(vm, mesh=mesh,
+                   in_specs=(P(axis),) * 19 + (P(), P(), P())
+                   + (P(axis),) * 6,
+                   out_specs=P(axis), check_rep=False)
+    return fn, route_sample_inputs(model, dims, batch=per * d)
+
+
+def _request_sharded(model: ModelSpec, dims: SearchDims):
+    mesh, axis, d = _route_mesh()
+    per = _ROUTE_BATCH // d or 1
+    return get_sharded_batch_kernel(model, dims, batch=per * d,
+                                    mesh=mesh, axis=axis)
+
+
+register_route(KernelRoute(
+    name="single-xla", engine="xla", span_kind="solo",
+    getter="get_kernel", module=__name__,
+    build=_build_single, request=_request_single))
+register_route(KernelRoute(
+    name="pallas-fused", engine="pallas", span_kind="solo",
+    getter="get_kernel", module=__name__,
+    build=_build_pallas, request=_request_pallas,
+    # the fused kernel deliberately lowers the level fold through
+    # float32 matmuls (MXU-shaped reductions in pallas_level.py), so
+    # its dtype contract is "no 64-bit widening", not "int lanes only"
+    int_only=False))
+# the two batch routes are dispatched by the bucket scheduler, which
+# registers them on import (checker/bucket.py; kernel_routes() below
+# forces that import so the enumeration is always complete)
+
+
+def kernel_routes() -> dict[str, KernelRoute]:
+    """All registered routes (importing the bucket scheduler so its
+    batch/mesh registrations are in)."""
+    from . import bucket  # noqa: F401 — registers its routes on import
+
+    return dict(KERNEL_ROUTES)
 
 
 def _drive_batch_compacting(fn, esps, model: ModelSpec, dims: SearchDims,

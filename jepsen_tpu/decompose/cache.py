@@ -194,7 +194,7 @@ class VerdictCache:
     def _append(self, e: dict) -> None:
         if self.path is None:
             return
-        self._appends += 1
+        compact_due = False
         with self._locked():
             # under the lock no compaction can be mid-replace, and the
             # inode re-check runs on EVERY append — an append can
@@ -206,15 +206,30 @@ class VerdictCache:
                 os.makedirs(os.path.dirname(self.path) or ".",
                             exist_ok=True)
                 self._fh = open(self.path, "a")
-            self._fh.write(json.dumps(e, separators=(",", ":")) + "\n")
+            # no fsync before release by design: the jsonl contract
+            # tolerates a torn tail (both the loader and compact()
+            # skip unparseable tail lines), so appends buy speed and a
+            # crash costs at most the last buffered entries
+            line = json.dumps(e, separators=(",", ":")) + "\n"
+            self._fh.write(line)  # threadlint: ok — torn-tail contract
             self._fh.flush()
-        if self.compact_bytes and self._appends >= _COMPACT_CHECK_EVERY:
-            self._appends = 0
-            try:
-                if self._fh.tell() > self.compact_bytes:
-                    self.compact()
-            except OSError:
-                pass
+            # compaction bookkeeping under the same lock: _appends is
+            # shared RMW state, and tell() must not race a concurrent
+            # compact() closing the handle (fh is None mid-replace —
+            # the crash the old post-lock check could hit)
+            self._appends += 1
+            if self.compact_bytes \
+                    and self._appends >= _COMPACT_CHECK_EVERY:
+                self._appends = 0
+                try:
+                    compact_due = self._fh.tell() > self.compact_bytes
+                except OSError:
+                    pass
+        if compact_due:
+            # outside the with: _locked() is reentrant per-thread, but
+            # compact() takes its own full section and there is no
+            # reason to hold the append lock across the rewrite
+            self.compact()
 
     def compact(self) -> int:
         """Rewrite the jsonl to exactly the live entry set, dropping
@@ -272,34 +287,40 @@ class VerdictCache:
             if self._fh is not None:
                 self._fh.close()
                 self._fh = None
-        dropped = max(0, lines - len(self._d))
-        self.compactions += 1
-        self.compacted_away += dropped
-        if self.compact_bytes:
-            try:
-                size = os.path.getsize(self.path)
-            except OSError:
-                size = 0
-            if size > self.compact_bytes // 2:
-                # the LIVE set itself is near/past the trigger: raise
-                # the bar, or every 256th append would re-run a full
-                # rewrite that drops ~nothing, forever
-                self.compact_bytes = max(self.compact_bytes, size) * 2
+            # stats + trigger adjustment stay under the lock: two
+            # threads compacting back-to-back would otherwise lose
+            # counter increments and race the compact_bytes doubling
+            dropped = max(0, lines - len(self._d))
+            self.compactions += 1
+            self.compacted_away += dropped
+            if self.compact_bytes:
+                try:
+                    size = os.path.getsize(self.path)
+                except OSError:
+                    size = 0
+                if size > self.compact_bytes // 2:
+                    # the LIVE set itself is near/past the trigger:
+                    # raise the bar, or every 256th append would re-run
+                    # a full rewrite that drops ~nothing, forever
+                    self.compact_bytes = max(self.compact_bytes,
+                                             size) * 2
         return dropped
 
     def put_verdict(self, key: str, valid) -> None:
         if valid not in (True, False):
             return  # "unknown" is a budget artifact, not a verdict
         e = {"k": key, "v": bool(valid)}
-        self._d[key] = e
-        self.inserts += 1
+        with self._tlock:
+            self._d[key] = e
+            self.inserts += 1
         _M_VCACHE.inc(event="insert")
         self._append(e)
 
     def put_states(self, key: str, out_states: list[list[int]]) -> None:
         e = {"k": key, "out": [list(s) for s in out_states]}
-        self._d[key] = e
-        self.inserts += 1
+        with self._tlock:
+            self._d[key] = e
+            self.inserts += 1
         _M_VCACHE.inc(event="insert")
         self._append(e)
 

@@ -109,10 +109,17 @@ class AdmissionController:
 
     def __init__(self, policy: AdmissionPolicy | None = None,
                  clock=None):
+        import threading
         import time
 
         self.policy = policy or AdmissionPolicy()
         self._clock = clock or time.monotonic
+        # decide() runs on every router connection-handler thread
+        # (fleet/router.py _Session.handle_line): the rate window
+        # (_last_shed/_last_ops), the spawn damper (_last_spawn) and
+        # the decision counters are all read-modify-write state, so
+        # one lock serializes the whole decision (T001)
+        self._lock = threading.Lock()
         self._last_shed = 0.0
         self._last_ops = 0.0
         self._last_spawn = None
@@ -136,37 +143,42 @@ class AdmissionController:
         return h / (h + m)
 
     def decide(self, signal: dict) -> str:
-        """One admission decision for the run knocking now."""
+        """One admission decision for the run knocking now.
+        Thread-safe: concurrent handler threads serialize on the
+        controller lock, so the rate window advances once per sample
+        and the spawn damper can't double-fire in a burst."""
         p = self.policy
-        rate = self.shed_rate(signal)
-        self._last_shed = max(self._last_shed,
-                              signal.get("shed_total", 0.0))
-        self._last_ops = max(self._last_ops,
-                             signal.get("ops_total", 0.0))
-        open_runs = signal.get("open_runs", 0.0)
-        backlog = signal.get("fold_backlog", 0.0)
-        if (open_runs >= p.max_open_runs or rate >= p.max_shed_rate
-                or backlog >= p.max_fold_backlog):
-            decision = "shed"
-        elif open_runs >= p.spawn_open_runs \
-                or rate >= p.spawn_shed_rate:
-            hit_ratio = self.cache_hit_ratio(signal)
-            if hit_ratio is not None \
-                    and hit_ratio < p.spawn_min_cache_hit_ratio:
-                # cold cache: the tier is still warming shapes, and a
-                # fresh worker boots colder still — admit, don't fork
-                decision = "accept"
-            else:
-                now = self._clock()
-                if self._last_spawn is None or \
-                        now - self._last_spawn \
-                        >= p.min_spawn_interval_s:
-                    self._last_spawn = now
-                    decision = "spawn-worker"
+        with self._lock:
+            rate = self.shed_rate(signal)
+            self._last_shed = max(self._last_shed,
+                                  signal.get("shed_total", 0.0))
+            self._last_ops = max(self._last_ops,
+                                 signal.get("ops_total", 0.0))
+            open_runs = signal.get("open_runs", 0.0)
+            backlog = signal.get("fold_backlog", 0.0)
+            if (open_runs >= p.max_open_runs or rate >= p.max_shed_rate
+                    or backlog >= p.max_fold_backlog):
+                decision = "shed"
+            elif open_runs >= p.spawn_open_runs \
+                    or rate >= p.spawn_shed_rate:
+                hit_ratio = self.cache_hit_ratio(signal)
+                if hit_ratio is not None \
+                        and hit_ratio < p.spawn_min_cache_hit_ratio:
+                    # cold cache: the tier is still warming shapes, and
+                    # a fresh worker boots colder still — admit, don't
+                    # fork
+                    decision = "accept"
                 else:
-                    decision = "accept"  # damped: signal already sent
-        else:
-            decision = "accept"
-        self.decisions[decision] += 1
+                    now = self._clock()
+                    if self._last_spawn is None or \
+                            now - self._last_spawn \
+                            >= p.min_spawn_interval_s:
+                        self._last_spawn = now
+                        decision = "spawn-worker"
+                    else:
+                        decision = "accept"  # damped: already sent
+            else:
+                decision = "accept"
+            self.decisions[decision] += 1
         _M_ADMIT.inc(decision=decision)
         return decision

@@ -197,9 +197,11 @@ class FleetCacheStore(VerdictCache):
             if self._fh is not None:
                 self._fh.close()
                 self._fh = None
-        dropped = max(0, lines - len(merged))
-        self.compactions += 1
-        self.compacted_away += dropped
+            # counters under the lock: concurrent spill/merge cycles
+            # from two checker threads must not lose increments
+            dropped = max(0, lines - len(merged))
+            self.compactions += 1
+            self.compacted_away += dropped
         return dropped
 
     def close(self) -> None:

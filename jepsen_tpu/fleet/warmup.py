@@ -38,6 +38,18 @@ Trace format: a telemetry trace (``{"traceEvents": [...]}``) whose
 ``device.compile`` spans carry ``n_det_pad``/``frontier`` (always) and
 ``window``/``n_crash_pad``/``k`` (newer traces); missing fields fall
 back to the steady-state defaults below.
+
+Every loaded shape is validated against the **static cache-key model**
+(:func:`jepsen_tpu.analyze.devlint.check_span_args` — the same K007
+contract ``tools/obs_guard.py`` holds committed traces to).  A span or
+manifest entry whose coordinates drifted from the kernel cache key
+used to be *silently dropped or defaulted*, which surfaced much later
+as an unexplained zero-miss-verify failure (warm boot compiled the
+wrong kernel set and the steady state paid fresh compiles anyway).
+Now it is a loud K007: the loaders raise ``ValueError`` naming the bad
+span, or — when the caller passes ``diagnostics=[]`` — append
+:class:`~jepsen_tpu.analyze.lint.Diagnostic` objects and skip only the
+offending shapes.
 """
 
 from __future__ import annotations
@@ -78,7 +90,64 @@ class WarmShape:
     shards: int = 0
 
 
-def shapes_from_manifest(doc: dict) -> list[WarmShape]:
+def _shape_span_args(s: WarmShape) -> dict:
+    """A WarmShape rendered as the ``device.compile`` span-args dict
+    its warmed kernel will stamp — the shared currency between this
+    loader and devlint's static cache-key model."""
+    args = {
+        "engine": "xla",
+        "frontier": s.frontier, "n_det_pad": s.n_det_pad,
+        "n_crash_pad": s.n_crash_pad, "window": s.window, "k": s.k,
+        "masked": s.masked, "masked_crash": s.masked_crash,
+        "dedup": s.dedup, "vt": s.vt,
+        "model": s.model[0], "model_init": s.model[1],
+        "model_width": s.model[2],
+    }
+    if s.batch:
+        args["batch"] = s.batch
+    if s.shards:
+        args["sharded"] = True
+        args["shards"] = s.shards
+        # span convention: sharded spans record PER-SHARD lanes
+        args["batch"] = max(1, s.batch // s.shards)
+    return args
+
+
+def _k007(diagnostics, where: str, errs: list[str]):
+    """Report one shape's cache-key drift: append K007 diagnostics to
+    ``diagnostics`` when the caller collects them, raise otherwise —
+    the drift must never again surface only as a warm boot that
+    compiles the wrong kernel set."""
+    from ..analyze.lint import Diagnostic
+
+    if diagnostics is None:
+        raise ValueError(
+            f"K007 {where}: cache-key coordinates drifted from the "
+            f"static model (analyze/devlint.py): " + "; ".join(errs))
+    for e in errs:
+        diagnostics.append(Diagnostic("K007", "error", f"{where}: {e}"))
+
+
+def validate_shapes(shapes, *,
+                    diagnostics: list | None = None) -> list[WarmShape]:
+    """Filter ``shapes`` to the ones whose coordinates satisfy the
+    static cache-key model; drifted shapes raise (or, with
+    ``diagnostics``, are reported as K007 and dropped)."""
+    from ..analyze.devlint import check_span_args
+
+    good = []
+    for i, s in enumerate(shapes):
+        errs = check_span_args(_shape_span_args(s), strict=True)
+        if errs:
+            _k007(diagnostics, f"warm shape #{i} ({s.model[0]})", errs)
+            continue
+        good.append(s)
+    return good
+
+
+def shapes_from_manifest(doc: dict, *,
+                         diagnostics: list | None = None
+                         ) -> list[WarmShape]:
     shapes = []
     for s in doc.get("shapes", []):
         m = s.get("model", list(DEFAULT_MODEL))
@@ -98,21 +167,42 @@ def shapes_from_manifest(doc: dict) -> list[WarmShape]:
             batch=int(s.get("batch", 0)),
             shards=int(s.get("shards", 0)),
         ))
-    return shapes
+    return validate_shapes(shapes, diagnostics=diagnostics)
 
 
 def shapes_from_trace(doc: dict, *,
-                      model: tuple = DEFAULT_MODEL) -> list[WarmShape]:
+                      model: tuple = DEFAULT_MODEL,
+                      diagnostics: list | None = None
+                      ) -> list[WarmShape]:
     """The kernel shapes a recorded campaign actually compiled: every
-    ``device.compile`` span in the trace, deduplicated."""
+    ``device.compile`` span in the trace, deduplicated.
+
+    Spans whose cache-key coordinates fail the static model (including
+    the pre-coordinate legacy spans the old loader skipped without a
+    word) are K007: raised, or reported-and-skipped when the caller
+    passes ``diagnostics``."""
+    from ..analyze.devlint import check_span_args
+
     out = []
     seen = set()
+    n_span = 0
     for ev in doc.get("traceEvents", []):
         if ev.get("name") != "device.compile":
             continue
         args = ev.get("args", {}) or {}
-        if "n_det_pad" not in args:
-            continue  # legacy spans without full dims
+        n_span += 1
+        # K007 gate: accept any documented cache-key generation (the
+        # committed bench traces span several), but a span that fits
+        # NO generation would reconstruct a kernel the steady state
+        # never requests — report it, don't silently default it.
+        # Trace spans predating the engine coordinate warmed the XLA
+        # route; that default loses nothing (engine is not a dim).
+        qargs = dict(args)
+        qargs.setdefault("engine", "xla")
+        errs = check_span_args(qargs, strict=False)
+        if errs:
+            _k007(diagnostics, f"device.compile span #{n_span}", errs)
+            continue
         # sharded spans record PER-SHARD lanes + the shard count; the
         # batch kernel getter wants the total lane axis back
         shards = int(args.get("shards", 0) or 0)
@@ -147,15 +237,19 @@ def shapes_from_trace(doc: dict, *,
 
 
 def load_shapes(path: str, *,
-                model: tuple = DEFAULT_MODEL) -> list[WarmShape]:
+                model: tuple = DEFAULT_MODEL,
+                diagnostics: list | None = None) -> list[WarmShape]:
     """Sniff ``path``: a shape manifest (``{"shapes": [...]}``) or a
-    recorded telemetry trace (``{"traceEvents": [...]}``)."""
+    recorded telemetry trace (``{"traceEvents": [...]}``).  Shapes are
+    K007-validated against the static cache-key model — see the module
+    docstring for the raise-vs-``diagnostics`` contract."""
     with open(path) as f:
         doc = json.load(f)
     if "shapes" in doc:
-        return shapes_from_manifest(doc)
+        return shapes_from_manifest(doc, diagnostics=diagnostics)
     if "traceEvents" in doc:
-        return shapes_from_trace(doc, model=model)
+        return shapes_from_trace(doc, model=model,
+                                 diagnostics=diagnostics)
     raise ValueError(
         f"{path}: neither a shape manifest ({{'shapes': [...]}}) nor "
         f"a telemetry trace ({{'traceEvents': [...]}})")
@@ -265,12 +359,19 @@ def warm_boot(shapes, *, verify: bool = True) -> dict:
 
         {"shapes": N, "compiled": n_misses, "hits": n_hits,
          "verified": bool, "persistent_cache": bool, "wall_s": float}
-    """
+
+    Shapes that fail the static cache-key model (K007) are not warmed
+    — the kernel they'd compile is one the steady state never requests
+    — and the report carries their messages under ``"k007"`` with
+    ``verified`` forced false, so the admission gate refuses the
+    worker with a cause instead of admitting a boot that silently
+    warmed the wrong kernel set."""
     from ..checker import linearizable as lin
     from ..obs import telemetry as _tele
 
     t0 = time.perf_counter()
-    shapes = list(shapes)
+    k007: list = []
+    shapes = validate_shapes(list(shapes), diagnostics=k007)
     telemetry = _tele.enabled()
     before = dict(lin.KERNEL_CACHE_STATS)
     warmed = []
@@ -285,14 +386,17 @@ def warm_boot(shapes, *, verify: bool = True) -> dict:
             rerequest()
         after = dict(lin.KERNEL_CACHE_STATS)
         verified = after["misses"] == mid["misses"]
-    return {
+    rep = {
         "shapes": len(shapes),
         "compiled": mid["misses"] - before["misses"],
         "hits": mid["hits"] - before["hits"],
-        "verified": bool(verified),
+        "verified": bool(verified) and not k007,
         "persistent_cache": _tele.persistent_cache_configured(),
         "wall_s": round(time.perf_counter() - t0, 6),
     }
+    if k007:
+        rep["k007"] = [d.message for d in k007]
+    return rep
 
 
 def parse_warmup_line(line: str) -> dict | None:

@@ -61,6 +61,7 @@ off costs nothing beyond one cached flag check per drive.
 from __future__ import annotations
 
 import os
+import threading
 import time
 
 import numpy as np
@@ -90,6 +91,10 @@ _forced: bool | None = None
 #: env knob read ONCE (the off-mode fast path must not pay an environ
 #: lookup — or its string allocations — per search drive)
 _env_on: bool | None = None
+#: serializes the one-time env read against concurrent first callers
+#: (fleet/stream threads drive searches too); the hot path stays
+#: lock-free — double-checked locking under the GIL
+_knob_lock = threading.Lock()
 
 
 def enabled() -> bool:
@@ -100,9 +105,11 @@ def enabled() -> bool:
     if _forced is not None:
         return _forced
     if _env_on is None:
-        _env_on = os.environ.get(
-            "JEPSEN_TPU_TELEMETRY", "").strip().lower() \
-            not in ("0", "off", "false", "no")
+        with _knob_lock:
+            if _env_on is None:
+                _env_on = os.environ.get(
+                    "JEPSEN_TPU_TELEMETRY", "").strip().lower() \
+                    not in ("0", "off", "false", "no")
     return _env_on
 
 
@@ -110,9 +117,13 @@ def enable(on: bool | None = True) -> None:
     """Force telemetry on/off for this process (``None`` reverts to
     the env knob, re-read on next use)."""
     global _forced, _env_on
-    _forced = on
-    if on is None:
-        _env_on = None
+    with _knob_lock:
+        if on is None:
+            # clear the cache BEFORE dropping the force: a concurrent
+            # enabled() must not see the stale cached knob with the
+            # force already gone
+            _env_on = None
+        _forced = on
 
 
 # ---------------------------------------------------------------------------

@@ -63,6 +63,12 @@ _forced: bool | None = None
 #: ``enable(None)`` to apply / re-read it — the CLI's ``--trace``
 #: handler does exactly that for its own process.
 _env_on: bool | None = None
+#: serializes the one-time env read against concurrent first callers
+#: (worker/prep/reaper threads all hit ``enabled()`` on their hot
+#: paths); the hot path itself stays lock-free — double-checked
+#: locking, sound here because the GIL makes the ``_env_on`` load
+#: atomic and the value is computed idempotently from the env
+_knob_lock = threading.Lock()
 
 
 def enabled() -> bool:
@@ -73,8 +79,10 @@ def enabled() -> bool:
     if _forced is not None:
         return _forced
     if _env_on is None:
-        _env_on = os.environ.get(
-            "JEPSEN_TPU_TRACE", "").strip().lower() in _TRUTHY
+        with _knob_lock:
+            if _env_on is None:
+                _env_on = os.environ.get(
+                    "JEPSEN_TPU_TRACE", "").strip().lower() in _TRUTHY
     return _env_on
 
 
@@ -82,9 +90,13 @@ def enable(on: bool | None = True) -> None:
     """Force tracing on/off for this process (``None`` reverts to the
     env knob, re-read on next use) — the tests' and REPL's switch."""
     global _forced, _env_on
-    _forced = on
-    if on is None:
-        _env_on = None
+    with _knob_lock:
+        if on is None:
+            # clear the cache BEFORE dropping the force: a concurrent
+            # enabled() must not see the stale cached knob with the
+            # force already gone
+            _env_on = None
+        _forced = on
 
 
 # ---------------------------------------------------------------------------

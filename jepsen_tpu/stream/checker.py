@@ -530,6 +530,12 @@ class StreamChecker:
             self._fold_inner(cell, retained)
         _M_FOLD_S.observe(time.perf_counter() - t0)
 
+    # threadlint: ok — single-owner: folds run only on the dedicated
+    # "stream-fold" worker (or synchronously on the ingest thread when
+    # async folds are off), so _stats/_cstats/cell fold-state have
+    # exactly one writer until _drain_folds() joins the worker; all
+    # cross-thread reads (verdict(), finalize()) take self._lock or
+    # run post-join
     def _fold_inner(self, cell: _Cell, retained: list[_Row]) -> None:
         from ..decompose.canonical import canonical_payload
         from ..decompose.engine import _Inconclusive, _skey, segment_states
@@ -743,8 +749,11 @@ class StreamChecker:
             self._invalid_event = self._events - 1
 
     def _drop(self, kind: str, reason: str) -> None:
+        # first-writer-wins by design: any racing writer's reason is an
+        # equally true first cause, and a lost overwrite is harmless —
+        # the slot only ever goes None -> some-reason, never back
         if self._drops[kind] is None:
-            self._drops[kind] = reason
+            self._drops[kind] = reason  # threadlint: ok — idempotent
 
     # ------------------------------------------------------------------
     # the live provisional verdict
@@ -839,6 +848,9 @@ class StreamChecker:
         return _rows_opseq(self._cells[key].rows, self._enc,
                            value_lane=self._multi)
 
+    # threadlint: ok — callers (finalize, close) serialize on
+    # self._lock / the single finalize path; after the join the fold
+    # worker is gone, so nulling _q/_worker has one writer
     def _drain_folds(self) -> None:
         if self._q is not None:
             self._q.put(None)
@@ -913,6 +925,9 @@ class StreamChecker:
             # under that shape would poison real single-object lookups
             wkey = canonical_key(self._seq, self.model)
 
+        # threadlint: ok — finalize path: runs strictly after
+        # _drain_folds() joined the fold worker, so the process is
+        # single-threaded over this state from here on
         def done(valid, extra: dict | None = None) -> dict:
             st = {
                 "cells": max(1, len(self._cells)),
@@ -1045,6 +1060,8 @@ class StreamChecker:
             extra["final_ops"] = sorted(invalid_frontier)
         return done(verdict, extra=extra or None)
 
+    # threadlint: ok — finalize path (post-_drain_folds join):
+    # single-threaded over _stats/_cstats/_drops by construction
     def _check_final(self, c: _Cell, sub_check, canonical_payload,
                      _skey):
         """-> (verdict | "fallback", cell-pos witness | None,
@@ -1107,6 +1124,8 @@ class StreamChecker:
                        "no frontier")
         return v, lin, (frontier if v is False else None)
 
+    # threadlint: ok — finalize path (post-_drain_folds join):
+    # single-threaded over _stats/_methods by construction
     def _cell_direct(self, c: _Cell):
         """Per-cell direct fallback (independent mode): one ordinary
         check of the cell's full recorded subhistory under the test
@@ -1124,6 +1143,8 @@ class StreamChecker:
         return v, r.get("linearization"), \
             (r.get("final_ops") if v is False else None)
 
+    # threadlint: ok — finalize path (post-_drain_folds join):
+    # single-threaded over _stats/_cstats by construction
     def _finish_fallback(self, wkey):
         """One direct check of the whole recorded history — the
         streamed route hit a budget wall somewhere; the verdict must

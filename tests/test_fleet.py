@@ -133,11 +133,16 @@ def test_cachestore_per_worker_segments_do_not_clobber(tmp_path):
         done.set()
 
     def spiller():
+        # post-test loop condition: always complete at least one
+        # insert+spill even if the writer already finished (the test
+        # asserts a0 reached the base)
         i = 0
-        while not done.is_set():
+        while True:
             a.put_verdict(f"a{i}", True)
             a.compact()  # spill merges EVERY segment into the base
             i += 1
+            if done.is_set():
+                break
 
     threads = [threading.Thread(target=writer),
                threading.Thread(target=spiller)]
@@ -547,9 +552,10 @@ def test_trace_shapes_carry_model_and_shard_coords(tmp_path):
     trace = tmp_path / "trace.json"
     trace.write_text(json.dumps({"traceEvents": [
         {"name": "device.compile", "args": {
-            "n_det_pad": 64, "n_crash_pad": 32, "window": 32, "k": 4,
-            "frontier": 64, "sharded": True, "shards": 8, "batch": 2,
-            "masked": True, "dedup": True, "vt": 8,
+            "engine": "xla", "n_det_pad": 64, "n_crash_pad": 32,
+            "window": 32, "k": 4, "frontier": 64, "sharded": True,
+            "shards": 8, "batch": 2, "masked": True,
+            "masked_crash": False, "dedup": True, "vt": 8,
             "model": "cas-register", "model_init": -2147483648,
             "model_width": 1}},
     ]}))

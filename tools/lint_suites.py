@@ -9,6 +9,13 @@ Files under a ``live/`` directory additionally get the B-code backend
 lint (LiveBackend protocol conformance, crash-to-:fail swallowing,
 fsync-before-rename journal ordering).
 
+The default sweep (no paths) also runs the T-code thread/lock-
+discipline lint over the service tiers (``jepsen_tpu/fleet/``,
+``stream/``, ``obs/``, ``decompose/cache.py``, ``checker/bucket.py``)
+— shared-state RMW without a lock, acquire without try/finally,
+flock'd writes without fsync, spans without the ``run=`` pin.  Skip it
+with ``--no-threads``; run it alone with ``--threads``.
+
 Exit code 0 when no ERROR-severity findings (warnings don't fail the
 run), 1 otherwise.  The same check gates CI through
 tests/test_suite_lint.py, so a new suite cannot merge with protocol
@@ -25,27 +32,44 @@ import sys
 sys.path.insert(0, os.path.dirname(os.path.dirname(
     os.path.abspath(__file__))))
 
-from jepsen_tpu.analyze.suites import SUITE_CODES, lint_paths  # noqa: E402
+from jepsen_tpu.analyze.suites import (  # noqa: E402
+    SUITE_CODES,
+    lint_paths,
+    lint_thread_tier,
+)
 
 
 def main(argv=None) -> int:
     p = argparse.ArgumentParser(
-        description="AST protocol lint over jepsen suites and live "
-                    "backends (S-codes + B-codes; see docs/analyze.md)")
+        description="AST protocol lint over jepsen suites, live "
+                    "backends, and the threaded service tiers "
+                    "(S-/B-/T-codes; see docs/analyze.md)")
     p.add_argument("paths", nargs="*",
                    help="suite files or directories (default: "
                         "jepsen_tpu/suites + jepsen_tpu/live)")
     p.add_argument("--json", action="store_true", dest="as_json",
                    help="machine-readable output")
     p.add_argument("--codes", action="store_true",
-                   help="list the S-/B-codes and exit")
+                   help="list the S-/B-/T-codes and exit")
+    p.add_argument("--threads", action="store_true",
+                   help="run ONLY the T-code thread/lock lint")
+    p.add_argument("--no-threads", action="store_true",
+                   help="skip the T-code lint in the default sweep")
     opts = p.parse_args(argv)
     if opts.codes:
         for code, desc in sorted(SUITE_CODES.items()):
             print(f"{code}  {desc}")
         return 0
 
-    findings = lint_paths(opts.paths)
+    findings: dict = {}
+    if not opts.threads:
+        findings = lint_paths(opts.paths)
+    # thread tier: part of the default sweep (explicit paths mean the
+    # caller scoped the run to specific suites, so leave it out unless
+    # --threads asked for it)
+    if opts.threads or (not opts.paths and not opts.no_threads):
+        for f, ds in lint_thread_tier().items():
+            findings.setdefault(f, []).extend(ds)
     n_err = sum(1 for ds in findings.values()
                 for d in ds if d.severity == "error")
     n_warn = sum(1 for ds in findings.values()

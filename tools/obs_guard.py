@@ -70,6 +70,7 @@ live stats exactly, and the measured laps paid zero compiles)::
 from __future__ import annotations
 
 import argparse
+import glob
 import json
 import os
 import sys
@@ -320,6 +321,28 @@ def check_shard(path: str, th: dict) -> list[str]:
     return fails
 
 
+def check_trace_spans(path: str) -> list[str]:
+    """K007 over one committed trace: every ``device.compile`` span
+    must carry the kernel cache-key coordinate set the static model in
+    :mod:`jepsen_tpu.analyze.devlint` expects (older committed traces
+    may carry a documented legacy generation; anything else means the
+    compile-span instrumentation drifted from the kernel cache keys
+    and warm-boot / zero-miss verification silently stops meaning
+    anything)."""
+    from jepsen_tpu.analyze.devlint import lint_trace_spans
+
+    name = os.path.basename(path)
+    try:
+        with open(path) as f:
+            doc = json.load(f)
+    except FileNotFoundError:
+        return [f"{name}: trace file missing"]
+    except (OSError, ValueError) as e:
+        return [f"{name}: unreadable trace ({e})"]
+    return [f"{d.code} {d.message}"
+            for d in lint_trace_spans(doc, name=name)]
+
+
 #: stats-block threshold key -> (derived gauge, direction)
 _STATS_CHECKS = {
     "min_kernel_cache_hit_ratio": ("kernel_cache_hit_ratio", "min"),
@@ -364,6 +387,13 @@ def run_guard(thresholds: dict, *, base: str = ".",
     fails = []
     for rel, th in (thresholds.get("traces") or {}).items():
         fails.extend(check_trace(os.path.join(base, rel), th or {}))
+    # K007 span-key verification covers EVERY committed trace next to
+    # the thresholds, listed or not — a freshly recorded bench trace
+    # with drifted compile-span keys must not slip past the guard just
+    # because nobody added a thresholds entry for it yet
+    for path in sorted(glob.glob(os.path.join(base,
+                                              "BENCH_trace_*.json"))):
+        fails.extend(check_trace_spans(path))
     for rel, th in (thresholds.get("fleet") or {}).items():
         fails.extend(check_fleet(os.path.join(base, rel), th or {}))
     for rel, th in (thresholds.get("shard") or {}).items():

@@ -22,6 +22,21 @@ compile-span cache-key drift — see docs/analyze.md)::
     python -m jepsen_tpu.analyze --devlint
     python -m jepsen_tpu.analyze --devlint --json
 
+``--mc`` takes no history either: it model-checks the live backend
+state machines at bounded scope (analyze/modelcheck.py, MC1xx codes —
+see docs/analyze.md §11).  The default sweeps every family x mode and
+exits 0 exactly when the matrix matches expectations (clean modes
+violation-free, seeded modes caught with replaying certificates); a
+specific ``--mc-family``/``--mc-mode`` pair exits 1 iff violations
+were found.  ``--replay`` re-executes an emitted schedule
+certificate::
+
+    python -m jepsen_tpu.analyze --mc --json
+    python -m jepsen_tpu.analyze --mc --mc-family replicated \\
+        --mc-mode volatile --mc-bank store
+    python -m jepsen_tpu.analyze --mc --replay cert.json
+    python -m jepsen_tpu.analyze --mc --explain   # scope plan only
+
 Exit codes follow cli.py's contract: 0 clean, 1 lint errors or audit
 W-codes found, 254 bad arguments.
 """
@@ -58,6 +73,102 @@ def _model(name: str, arg: int | None):
     raise ValueError(f"unknown model {name!r}; one of {MODELS}")
 
 
+def _mc_pairs(opts) -> list[tuple]:
+    from .modelcheck import FAMILIES, MODES
+
+    fams = FAMILIES if opts.mc_family == "all" else (opts.mc_family,)
+    pairs = []
+    for fam in fams:
+        for mode in MODES[fam]:
+            if opts.mc_mode in ("all", mode):
+                pairs.append((fam, mode))
+    return pairs
+
+
+def _run_mc_cli(opts) -> int:
+    from . import modelcheck as mc
+
+    dpor = False if opts.no_dpor else None
+    if opts.replay:
+        try:
+            cert = mc.load_certificate(opts.replay)
+        except (OSError, ValueError) as e:
+            print(f"cannot read certificate {opts.replay}: {e}",
+                  file=sys.stderr)
+            return 254
+        try:
+            rep = mc.replay_certificate(cert)
+        except (KeyError, ValueError) as e:
+            print(f"malformed certificate: {e}", file=sys.stderr)
+            return 254
+        if opts.as_json:
+            print(json.dumps(rep, indent=2, default=str))
+        else:
+            print(f"replay: {'reproduced' if rep['reproduced'] else 'DID NOT reproduce'} "
+                  f"{cert.get('code')} (got {rep['code']})")
+        return 0 if rep["reproduced"] else 1
+    pairs = _mc_pairs(opts)
+    if not pairs:
+        print(f"--mc-mode {opts.mc_mode!r} matches no mode of "
+              f"--mc-family {opts.mc_family!r}", file=sys.stderr)
+        return 254
+
+    def scope_for(fam, mode):
+        return mc.scope_from_args(
+            fam, mode, crashes=opts.mc_crashes,
+            partitions=opts.mc_partitions,
+            max_events=opts.mc_max_events,
+            max_states=opts.mc_max_states)
+
+    if opts.explain:
+        blocks = [mc.mc_plan_block(f, m, scope_for(f, m))
+                  for f, m in pairs]
+        if opts.as_json:
+            print(json.dumps({"mc_plan": blocks}, indent=2,
+                             default=str))
+        else:
+            for b in blocks:
+                s = b["scope"]
+                print(f"{b['family']}/{b['mode']}: nodes={s['nodes']} "
+                      f"ops={s['ops']} crashes={s['crashes']} "
+                      f"partitions={s['partitions']} "
+                      f"max_events={s['max_events']}")
+            print(f"codes: {', '.join(blocks[0]['codes'])}")
+        return 0
+    runs = []
+    for fam, mode in pairs:
+        runs.append(mc.run_mc(
+            fam, mode, scope=scope_for(fam, mode), dpor=dpor,
+            bank_base=opts.mc_bank if mode != "clean" else None))
+    sweep = opts.mc_family == "all" and opts.mc_mode == "all"
+    if sweep:
+        # expected-outcome matrix: clean modes pass, seeded modes
+        # caught with replaying certificates
+        ok = all(
+            r["ok"] if r["mode"] == "clean"
+            else (not r["ok"]
+                  and all(c.get("replayed") for c in r["violations"]))
+            for r in runs)
+    else:
+        ok = all(r["ok"] for r in runs)
+    if opts.as_json:
+        print(json.dumps({"ok": ok, "runs": runs}, indent=2,
+                         default=str))
+    else:
+        for r in runs:
+            ex = r["explored"]
+            codes = sorted({c["code"] for c in r["violations"]})
+            verdict = "clean" if r["ok"] else \
+                f"VIOLATIONS {', '.join(codes)}"
+            print(f"{r['family']}/{r['mode']}: {verdict} — "
+                  f"{ex['states']} states, {ex['schedules']} "
+                  f"schedules, prune ratio {ex['prune_ratio']}, "
+                  f"complete={ex['complete']}")
+        print(f"mc: {'ok' if ok else 'FAILED'} "
+              f"({len(runs)} run(s){' , sweep expectations' if sweep else ''})")
+    return 0 if ok else 1
+
+
 def main(argv=None) -> int:
     p = argparse.ArgumentParser(
         prog="python -m jepsen_tpu.analyze",
@@ -84,11 +195,40 @@ def main(argv=None) -> int:
                    help="Stage every kernel route and lint the jaxprs "
                         "for the K-code device contract (no history "
                         "needed)")
+    p.add_argument("--mc", action="store_true",
+                   help="Model-check the live backend state machines "
+                        "at bounded scope (no history needed)")
+    p.add_argument("--mc-family", default="all",
+                   choices=("all", "replicated", "rqueue", "lock"),
+                   help="Backend family for --mc (default: sweep all)")
+    p.add_argument("--mc-mode", default="all",
+                   choices=("all", "clean", "volatile", "split-brain"),
+                   help="Backend mode for --mc (default: every mode "
+                        "of the family)")
+    p.add_argument("--mc-max-events", type=int, default=None,
+                   help="Scope override: schedule depth bound")
+    p.add_argument("--mc-crashes", type=int, default=None,
+                   help="Scope override: crash budget")
+    p.add_argument("--mc-partitions", type=int, default=None,
+                   help="Scope override: partition budget")
+    p.add_argument("--mc-max-states", type=int, default=None,
+                   help="Scope override: state-expansion budget")
+    p.add_argument("--mc-bank", metavar="DIR", default=None,
+                   help="Bank violation histories into this corpus "
+                        "base directory")
+    p.add_argument("--no-dpor", action="store_true",
+                   help="Disable sleep-set reduction for --mc "
+                        "(soundness A/B; same violation set, slower)")
+    p.add_argument("--replay", metavar="CERT_JSON", default=None,
+                   help="Replay a --mc schedule certificate; exits 0 "
+                        "iff it reproduces its recorded MC code")
     try:
         opts = p.parse_args(argv)
     except SystemExit as e:
         return 0 if e.code in (0, None) else 254
 
+    if opts.mc:
+        return _run_mc_cli(opts)
     if opts.devlint:
         from .devlint import run_devlint
 

@@ -175,6 +175,60 @@ def shrink_invalid(seq: OpSeq, model: ModelSpec, *,
     return out
 
 
+def ddmin_list(items: list, still_failing, *,
+               max_checks: int = 200) -> dict:
+    """The bare ddmin chunk loop over an arbitrary item list — the
+    generic core :func:`shrink_invalid`/:func:`shrink_invalid_events`
+    specialize and the model checker's schedule shrinker
+    (``analyze/modelcheck.py``) reuses directly.
+
+    ``still_failing(sub_items) -> bool`` re-validates a candidate; a
+    removal is kept only while it answers True, so the chain starts
+    and ends at a confirmed-failing list.  Returns::
+
+        {"items": minimal list, "n_from": n, "n_to": k,
+         "checks": n_calls, "minimal": 1-minimality proven}
+    """
+    checks = 0
+
+    def check(sub: list) -> bool:
+        nonlocal checks
+        checks += 1
+        try:
+            return bool(still_failing(sub))
+        except Exception:  # noqa: BLE001 — a crashing candidate is
+            return False   # not a confirmed-failing one
+
+    kept = list(items)
+    out = {"items": list(items), "n_from": len(items),
+           "n_to": len(items), "checks": 0, "minimal": False}
+    if not kept or not check(kept):
+        out["checks"] = checks
+        return out
+
+    chunk = max(1, len(kept) // 2)
+    minimal = False
+    while checks < max_checks:
+        i = 0
+        removed = False
+        while i < len(kept) and checks < max_checks:
+            cand = kept[:i] + kept[i + chunk:]
+            if cand and check(cand):
+                kept = cand
+                removed = True
+            else:
+                i += chunk
+        if chunk == 1:
+            if not removed:
+                minimal = True  # a clean single-item pass: 1-minimal
+                break
+        else:
+            chunk = max(1, chunk // 2)
+    out.update({"items": kept, "n_to": len(kept), "checks": checks,
+                "minimal": minimal})
+    return out
+
+
 def shrink_invalid_events(ops: list, check, *,
                           max_checks: int = 200) -> dict:
     """ddmin an EVENT-LEVEL invalid history down to a minimal failing

@@ -73,15 +73,56 @@ T004 error    ``obs.span(...)`` emitted from a thread-reachable
               PR 17 prep-span race)
 ==== ======== ==========================================================
 
+N-codes (``JEPSEN_TPU_*`` knob threading, package-wide — via
+:func:`lint_knobs`; every env knob the package READS must stay
+reachable from the CLI and the docs, or it silently becomes a
+load-bearing secret):
+
+==== ======== ==========================================================
+N001 error    a toggle knob (one read by a zero-arg ``*_enabled()``
+              reader, the repo idiom for feature gates) is never
+              mentioned in ``cli.py`` — the gate cannot be flipped
+              per-run from the command line, only by editing the
+              caller's environment
+N002 error    a knob that ``cli.py`` claims to set is READ at module
+              import time — the CLI applies env mappings after
+              startup, so an import-time freeze turns the flag into a
+              silent no-op depending on import order (env-only tuning
+              constants that deliberately freeze into compile-cache
+              keys are exempt because cli.py never claims them)
+N003 warning  a knob the package reads appears in no ``docs/*.md`` —
+              undocumented knobs rot into tribal knowledge
+              (launcher-managed process-topology plumbing —
+              ``PROC_ID``/``NUM_PROCS``/``COORDINATOR`` — is exempt:
+              the fleet launcher sets it, users never should)
+==== ======== ==========================================================
+
+O-codes (``jtpu_*`` metrics contract — via :func:`lint_metrics`; the
+observability surfaces must agree on which series exist):
+
+==== ======== ==========================================================
+O001 error    a ``jtpu_*`` series referenced by a consumer surface
+              (``web.py``, ``tools/obs_guard.py``,
+              ``obs_thresholds.json``) is registered nowhere in the
+              package — the dashboard panel / guard threshold gates on
+              a series that can never report
+O002 warning  registered series no consumer surface references
+              (aggregated into one finding) — orphans are not wrong,
+              but each one is either a missing dashboard panel or dead
+              instrumentation
+==== ======== ==========================================================
+
 False-positive escape hatch: a line containing ``suite-lint: ok``
 suppresses S/B findings anchored on it; ``threadlint: ok`` suppresses
-T findings (use sparingly, with a comment saying why the pattern is
-sound).
+T findings; ``knoblint: ok`` suppresses N findings and
+``metriclint: ok`` O findings (use sparingly, with a comment saying
+why the pattern is sound).
 """
 
 from __future__ import annotations
 
 import ast
+import re
 from pathlib import Path
 from typing import Sequence
 
@@ -103,6 +144,11 @@ SUITE_CODES = {
     "T002": "lock acquired without try/finally or context manager",
     "T003": "file written under flock without fsync-before-release",
     "T004": "span emitted from a thread without the run= pin",
+    "N001": "toggle knob (*_enabled reader) with no cli.py flag",
+    "N002": "cli.py-claimed knob frozen by an import-time read",
+    "N003": "env knob read by the package but absent from docs/",
+    "O001": "consumer-referenced jtpu_* series registered nowhere",
+    "O002": "registered jtpu_* series no consumer surface references",
 }
 
 #: the LiveBackend protocol members a concrete family must provide
@@ -1022,4 +1068,272 @@ def lint_thread_tier(paths: Sequence[str | Path] | None = None
                             covered=name in covered)
     for f in out:
         out[f].sort(key=lambda d: d.index or 0)
+    return out
+
+
+# ---------------------------------------------------------------------------
+# N-codes — JEPSEN_TPU_* knob threading (package-wide)
+# ---------------------------------------------------------------------------
+#
+# The knob surface grew one env var at a time; nothing ever checked
+# that a knob stayed reachable from cli.py, overridable per-run, and
+# documented.  This pass rebuilds the contract from the source: every
+# os.environ read of a JEPSEN_TPU_* literal is located and classified
+# (toggle reader / import-time freeze / plain read), then checked
+# against cli.py and docs/*.md.  Name-based and literal-only by
+# design — a knob whose name is computed at runtime is already a
+# deeper problem than this lint can state.
+
+#: every package knob starts with this prefix (telemetry scrapes the
+#: whole prefix; the lint only tracks full literal names)
+KNOB_PREFIX = "JEPSEN_TPU_"
+
+#: launcher-managed process-topology plumbing: the fleet launcher sets
+#: these for child processes, users never should — exempt from N003
+KNOB_INTERNAL = frozenset({
+    "JEPSEN_TPU_PROC_ID",
+    "JEPSEN_TPU_NUM_PROCS",
+    "JEPSEN_TPU_COORDINATOR",
+})
+
+
+def _env_read(node) -> str | None:
+    """The knob name when ``node`` READS a ``JEPSEN_TPU_*`` env var
+    (``os.environ.get``/``os.getenv``/``os.environ[...]`` in Load
+    context / ``"X" in os.environ``), else None.  Writes (assignment,
+    ``setdefault``, ``pop``, ``del``) are not reads."""
+    def knob_const(n) -> str | None:
+        if isinstance(n, ast.Constant) and isinstance(n.value, str) \
+                and n.value.startswith(KNOB_PREFIX):
+            return n.value
+        return None
+
+    if isinstance(node, ast.Call) and isinstance(node.func, ast.Attribute) \
+            and node.func.attr in ("get", "getenv") and node.args:
+        name = knob_const(node.args[0])
+        if name is not None:
+            recv = ast.unparse(node.func.value)
+            if "environ" in recv or recv.split(".")[-1] == "os":
+                return name
+    if isinstance(node, ast.Subscript) and isinstance(node.ctx, ast.Load):
+        name = knob_const(node.slice)
+        if name is not None and "environ" in ast.unparse(node.value):
+            return name
+    if isinstance(node, ast.Compare) and len(node.ops) == 1 \
+            and isinstance(node.ops[0], (ast.In, ast.NotIn)):
+        name = knob_const(node.left)
+        if name is not None and "environ" in ast.unparse(
+                node.comparators[0]):
+            return name
+    return None
+
+
+def _knob_reads(tree) -> list[tuple]:
+    """All knob reads in a module: ``(name, lineno, enclosing_fn)``
+    where ``enclosing_fn`` is the innermost FunctionDef (None for a
+    module-import-time read; class bodies without a function count as
+    import time too)."""
+    enclosing: dict[int, object] = {}
+
+    def assign(node, fn):
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                enclosing[id(child)] = fn
+                assign(child, child)
+            else:
+                enclosing[id(child)] = fn
+                assign(child, fn)
+
+    assign(tree, None)
+    out = []
+    for n in ast.walk(tree):
+        name = _env_read(n)
+        if name is not None:
+            out.append((name, getattr(n, "lineno", None),
+                        enclosing.get(id(n))))
+    return out
+
+
+def _is_toggle_reader(fn) -> bool:
+    """The repo idiom for a feature gate: a zero-arg ``*_enabled()``
+    function whose body reads the knob."""
+    if fn is None or not fn.name.endswith("_enabled"):
+        return False
+    a = fn.args
+    return not (a.posonlyargs or a.args or a.kwonlyargs
+                or a.vararg or a.kwarg)
+
+
+def _package_py_files(pkg_root: Path) -> list[Path]:
+    return sorted(p for p in pkg_root.rglob("*.py")
+                  if "__pycache__" not in p.parts)
+
+
+def lint_knobs(pkg_root: str | Path | None = None,
+               cli_text: str | None = None,
+               docs_text: str | None = None
+               ) -> dict[str, list[Diagnostic]]:
+    """The N-code knob-threading lint over every module in the
+    package.  ``cli_text``/``docs_text`` are injectable for tests;
+    defaults read ``jepsen_tpu/cli.py`` and concatenate ``docs/*.md``
+    from the repo root.  Returns {filename: diagnostics} for files
+    with findings only; a line containing ``knoblint: ok`` suppresses
+    findings anchored on it."""
+    pkg = Path(pkg_root) if pkg_root else \
+        Path(__file__).resolve().parent.parent
+    if cli_text is None:
+        cli = pkg / "cli.py"
+        cli_text = cli.read_text() if cli.exists() else ""
+    if docs_text is None:
+        docs = pkg.parent / "docs"
+        docs_text = "\n".join(p.read_text()
+                              for p in sorted(docs.glob("*.md"))) \
+            if docs.is_dir() else ""
+
+    out: dict[str, list[Diagnostic]] = {}
+    documented: set[str] = set()  # first-anchor dedup for N003
+    for f in _package_py_files(pkg):
+        src = f.read_text()
+        if KNOB_PREFIX not in src:
+            continue
+        try:
+            tree = ast.parse(src, filename=str(f))
+        except SyntaxError:
+            continue  # the S-lint owns parse errors
+        lines = src.splitlines()
+
+        def suppressed(lineno):
+            return (lineno is not None and 1 <= lineno <= len(lines)
+                    and "knoblint: ok" in lines[lineno - 1])
+
+        diags: list[Diagnostic] = []
+        for name, lineno, fn in _knob_reads(tree):
+            if suppressed(lineno):
+                continue
+            if _is_toggle_reader(fn) and name not in cli_text:
+                diags.append(Diagnostic(
+                    "N001", "error",
+                    f"{f}:{lineno}: toggle knob {name} is read by "
+                    f"{fn.name}() but never mentioned in cli.py — the "
+                    f"gate cannot be flipped per-run from the command "
+                    f"line", index=lineno))
+            if fn is None and name in cli_text:
+                diags.append(Diagnostic(
+                    "N002", "error",
+                    f"{f}:{lineno}: {name} is set by cli.py but read "
+                    f"at import time — the flag silently no-ops when "
+                    f"this module imports first", index=lineno))
+            if name not in KNOB_INTERNAL and name not in docs_text \
+                    and name not in documented:
+                documented.add(name)
+                diags.append(Diagnostic(
+                    "N003", "warning",
+                    f"{f}:{lineno}: {name} is read here but appears "
+                    f"in no docs/*.md", index=lineno))
+        if diags:
+            out[str(f)] = diags
+    return out
+
+
+# ---------------------------------------------------------------------------
+# O-codes — jtpu_* metrics contract (registration vs consumer surfaces)
+# ---------------------------------------------------------------------------
+
+#: consumer surfaces, relative to the REPO root (pkg_root.parent):
+#: the dashboard, the scrape guard, and the alert thresholds — a
+#: series one of these names must exist, or the panel/threshold gates
+#: on nothing
+METRIC_CONSUMERS = ("jepsen_tpu/web.py", "tools/obs_guard.py",
+                    "obs_thresholds.json")
+
+_METRIC_RE = re.compile(r"\bjtpu_[a-z0-9_]+\b")
+
+#: prometheus exposition suffixes a histogram/counter family implies —
+#: a consumer referencing jtpu_x_seconds_bucket is consuming the
+#: registered jtpu_x_seconds
+_METRIC_SUFFIXES = ("_bucket", "_sum", "_count", "_total")
+
+
+def registered_metrics(pkg_root: str | Path | None = None
+                       ) -> dict[str, tuple]:
+    """Every ``jtpu_*`` series the package registers:
+    {name: (filename, lineno)} from literal first arguments of
+    ``.counter(...)``/``.gauge(...)``/``.histogram(...)`` calls."""
+    pkg = Path(pkg_root) if pkg_root else \
+        Path(__file__).resolve().parent.parent
+    out: dict[str, tuple] = {}
+    for f in _package_py_files(pkg):
+        src = f.read_text()
+        if "jtpu_" not in src:
+            continue
+        try:
+            tree = ast.parse(src, filename=str(f))
+        except SyntaxError:
+            continue
+        for n in ast.walk(tree):
+            if isinstance(n, ast.Call) \
+                    and isinstance(n.func, ast.Attribute) \
+                    and n.func.attr in ("counter", "gauge",
+                                        "histogram") \
+                    and n.args and isinstance(n.args[0], ast.Constant) \
+                    and isinstance(n.args[0].value, str) \
+                    and n.args[0].value.startswith("jtpu_"):
+                out.setdefault(n.args[0].value, (str(f), n.lineno))
+    return out
+
+
+def lint_metrics(pkg_root: str | Path | None = None,
+                 consumers: Sequence[str | Path] | None = None
+                 ) -> dict[str, list[Diagnostic]]:
+    """The O-code metrics-contract lint.  ``consumers`` overrides the
+    default surface list (absolute paths; for tests).  Returns
+    {filename: diagnostics}; ``metriclint: ok`` on a consumer line
+    suppresses O001 findings anchored on it."""
+    pkg = Path(pkg_root) if pkg_root else \
+        Path(__file__).resolve().parent.parent
+    if consumers is None:
+        consumers = [pkg.parent / c for c in METRIC_CONSUMERS]
+    registered = registered_metrics(pkg)
+
+    def base_name(name: str) -> str:
+        for suf in _METRIC_SUFFIXES:
+            if name.endswith(suf) and name[:-len(suf)] in registered:
+                return name[:-len(suf)]
+        return name
+
+    out: dict[str, list[Diagnostic]] = {}
+    referenced: set[str] = set()
+    for c in consumers:
+        c = Path(c)
+        if not c.exists():
+            continue
+        diags: list[Diagnostic] = []
+        seen_here: set[str] = set()
+        for lineno, line in enumerate(c.read_text().splitlines(), 1):
+            for m in _METRIC_RE.finditer(line):
+                name = base_name(m.group(0))
+                referenced.add(name)
+                if name in registered or name in seen_here \
+                        or "metriclint: ok" in line:
+                    continue
+                seen_here.add(name)
+                diags.append(Diagnostic(
+                    "O001", "error",
+                    f"{c}:{lineno}: {m.group(0)} is referenced here "
+                    f"but registered nowhere in the package — the "
+                    f"panel/threshold gates on a series that can "
+                    f"never report", index=lineno))
+        if diags:
+            out[str(c)] = diags
+
+    orphans = sorted(set(registered) - referenced)
+    if orphans:
+        shown = ", ".join(orphans[:6]) + \
+            (f", … ({len(orphans)} total)" if len(orphans) > 6 else "")
+        f0, l0 = registered[orphans[0]]
+        out.setdefault(f0, []).append(Diagnostic(
+            "O002", "warning",
+            f"{len(orphans)} registered jtpu_* series no consumer "
+            f"surface (web.py / obs_guard / thresholds) references: "
+            f"{shown}", index=l0))
     return out

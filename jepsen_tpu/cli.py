@@ -123,6 +123,13 @@ def add_test_opts(p: argparse.ArgumentParser) -> None:
                         "must-order mask planes.  Sets "
                         "JEPSEN_TPU_DPOR=0 fleet-wide; default on, "
                         "verdict-identical either way.")
+    p.add_argument("--no-shrink", action="store_true", default=False,
+                   help="Disable counterexample minimization "
+                        "(jepsen_tpu.analyze.shrink) in failure "
+                        "reports — invalid verdicts keep their full "
+                        "history instead of a ddmin'd minimal core.  "
+                        "Sets JEPSEN_TPU_SHRINK=0 fleet-wide; "
+                        "reporting only, never verdicts.")
     p.add_argument("--no-telemetry", action="store_true", default=False,
                    help="Disable the device-search telemetry layer "
                         "(jepsen_tpu.obs.telemetry): the per-level "
@@ -235,6 +242,11 @@ def test_opt_fn(parsed: argparse.Namespace) -> dict:
     if opts.pop("no_dpor", False):
         os.environ["JEPSEN_TPU_DPOR"] = "0"
         opts["no_dpor"] = True
+    if opts.pop("no_shrink", False):
+        # like --no-lint: shrink_enabled() reads the env per call, so
+        # the opt-out reaches every checker this process constructs
+        os.environ["JEPSEN_TPU_SHRINK"] = "0"
+        opts["no_shrink"] = True
     if opts.pop("no_telemetry", False):
         # env var for children; enable(False) for kernels this process
         # already has a telemetry module loaded for

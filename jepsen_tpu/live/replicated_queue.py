@@ -31,6 +31,12 @@ not reimplemented):
     (→ ``:info``).  Forwards are wrapped in a ``JPROXY`` envelope so
     a confused leadership view can't proxy in a loop.
 
+Like the KV node, the queue brain is a pure core —
+:class:`QueueCore`, the consensus machine of
+:class:`~.replicated_server.ReplicaCore` plus the pending/claimed job
+state — and :class:`QueueReplica` is its daemon shell.
+``analyze/modelcheck.py`` schedules the same core deterministically.
+
 Peer consensus traffic rides the HTTP surface of the base class on
 ``port + PEER_OFFSET`` (vote/ping/append/status), the client surface
 is RESP on ``port`` — both bound to the node's own loopback address.
@@ -64,38 +70,30 @@ from collections import OrderedDict
 from .queue_server import (encode_resp_command, encode_resp_job,
                            read_resp_command)
 from .replicated_server import Handler as PeerHandler
-from .replicated_server import Replica, Server as PeerServer
+from .replicated_server import Replica, ReplicaCore
+from .replicated_server import Server as PeerServer
 from .replicated_server import parse_peers
 
 #: the peer/consensus HTTP surface lives this far above the RESP port
 PEER_OFFSET = 500
 
 
-class QueueReplica(Replica):
-    """The queue state machine over the shared consensus core."""
+class QueueCore(ReplicaCore):
+    """The pure queue state machine over the pure consensus core:
+    committed jobs (pending), leader-local claims with redelivery
+    deadlines, and the prepare half of every client verb.  No clock
+    reads, no wire — the shell (and the model checker) drive it."""
 
-    _REPLAY_OPS = ("add", "ack")
+    REPLAY_OPS = ("add", "ack")
 
-    def __init__(self, node_id: int, resp_peers: list, oplog_path: str,
-                 lease_s: float = 0.7, volatile: bool = False,
-                 host: str = "127.0.0.1"):
-        #: job id -> (body, retry_s): committed, deliverable.  Set up
-        #: BEFORE super().__init__ — the base class replays the oplog
-        #: through _apply_locked during construction.
+    def __init__(self, *args, **kwargs):
+        #: job id -> (body, retry_s): committed, deliverable
         self.pending: OrderedDict[str, tuple[str, float]] = OrderedDict()
         #: job id -> (body, retry_s, redeliver-at): leader-local claims
         self.claimed: dict[str, tuple[str, float, float]] = {}
-        self.resp_peers = [p if isinstance(p, tuple)
-                           else ("127.0.0.1", p) for p in resp_peers]
-        super().__init__(
-            node_id,
-            [(h, p + PEER_OFFSET) for h, p in self.resp_peers],
-            oplog_path, lease_s=lease_s, volatile=volatile, host=host)
-        self.cv = threading.Condition(self.lock)
+        super().__init__(*args, **kwargs)
 
-    # -- the state machine --------------------------------------------
-
-    def _apply_locked(self, e: dict) -> None:
+    def apply(self, e: dict) -> None:
         if e.get("op") == "add":
             if e["jid"] not in self.claimed:
                 self.pending[e["jid"]] = (e["body"],
@@ -105,12 +103,83 @@ class QueueReplica(Replica):
             self.claimed.pop(e["jid"], None)
         self.seq = e["seq"]
 
-    def _expire_claims_locked(self) -> None:
-        now = time.monotonic()
+    def expire_claims(self, now: float) -> None:
+        """Claims past their redelivery deadline go back to pending —
+        at-least-once, by construction."""
         for jid in [j for j, (_, _, t) in self.claimed.items()
                     if t <= now]:
             body, retry_s, _ = self.claimed.pop(jid)
             self.pending[jid] = (body, retry_s)
+
+    def claim(self, now: float) -> tuple[str, str] | None:
+        """Move the oldest pending job to claimed (leader-local, not
+        replicated) -> (jid, body), or None when nothing is pending."""
+        if not self.pending:
+            return None
+        jid, (body, retry_s) = self.pending.popitem(last=False)
+        self.claimed[jid] = (body, retry_s, now + retry_s)
+        return jid, body
+
+    def addjob_prepare(self, body: str, retry_s: float, now: float
+                       ) -> tuple[str, str | None, dict | None]:
+        """ADDJOB up to the commit -> (status, jid, entry); the owner
+        runs the commit protocol when ``entry`` is non-None."""
+        if not self.leader_serving(now):
+            return "noleader", None, None
+        # adopt the shared-oplog tail first: a deposed leader's
+        # un-acked append must not share a seq (or a jid) with this
+        # commit
+        seq = self.next_seq()
+        jid = f"D-{self.term}-{seq}"
+        entry = {"op": "add", "seq": seq,
+                 "term": self.term, "leader": self.id,
+                 "jid": jid, "body": body, "retry": retry_s}
+        return "ok", jid, entry
+
+    def ackjob_prepare(self, jid: str, now: float
+                       ) -> tuple[str, int | None, dict | None]:
+        """ACKJOB up to the commit -> (status, count, entry); a jid
+        this replica has never heard of acks 0 with no commit."""
+        if not self.leader_serving(now):
+            return "noleader", None, None
+        seq = self.next_seq()  # tail first, like addjob
+        if jid not in self.claimed and jid not in self.pending:
+            return "ok", 0, None
+        entry = {"op": "ack", "seq": seq,
+                 "term": self.term, "leader": self.id, "jid": jid}
+        return "ok", 1, entry
+
+    def snapshot(self) -> tuple:
+        return super().snapshot() + (
+            tuple(self.pending.items()),
+            tuple(sorted((j, b, r, round(t, 9))
+                         for j, (b, r, t) in self.claimed.items())))
+
+
+class QueueReplica(Replica):
+    """The queue daemon shell: RESP wire + condvar around a
+    :class:`QueueCore`."""
+
+    CORE_CLS = QueueCore
+
+    def __init__(self, node_id: int, resp_peers: list, oplog_path: str,
+                 lease_s: float = 0.7, volatile: bool = False,
+                 host: str = "127.0.0.1"):
+        self.resp_peers = [p if isinstance(p, tuple)
+                           else ("127.0.0.1", p) for p in resp_peers]
+        super().__init__(
+            node_id,
+            [(h, p + PEER_OFFSET) for h, p in self.resp_peers],
+            oplog_path, lease_s=lease_s, volatile=volatile, host=host)
+        self.cv = threading.Condition(self.lock)
+
+    @property
+    def pending(self):
+        return self.core.pending
+
+    @property
+    def claimed(self):
+        return self.core.claimed
 
     # -- the client surface (leader path) -----------------------------
 
@@ -118,16 +187,10 @@ class QueueReplica(Replica):
         if not self.leader_serving():
             return "noleader", None
         with self.lock:
-            if not self.leader_serving():
-                return "noleader", None
-            # adopt the shared-oplog tail first: a deposed leader's
-            # un-acked append must not share a seq (or a jid) with
-            # this commit
-            seq = self.commit_seq_locked()
-            jid = f"D-{self.term}-{seq}"
-            entry = {"op": "add", "seq": seq,
-                     "term": self.term, "leader": self.id,
-                     "jid": jid, "body": body, "retry": retry_s}
+            st, jid, entry = self.core.addjob_prepare(
+                body, retry_s, time.monotonic())
+            if st != "ok":
+                return st, None
             if not self.commit_locked(entry):
                 return "noquorum", None
             self.cv.notify_all()
@@ -137,19 +200,17 @@ class QueueReplica(Replica):
         deadline = time.monotonic() + timeout_ms / 1000.0
         with self.cv:
             while True:
-                if not self.leader_serving():
+                now = time.monotonic()
+                if not self.core.leader_serving(now):
                     return "noleader", None
-                self._expire_claims_locked()
-                if self.pending:
-                    jid, (body, retry_s) = \
-                        self.pending.popitem(last=False)
-                    self.claimed[jid] = (
-                        body, retry_s, time.monotonic() + retry_s)
-                    return "ok", (jid, body)
+                self.core.expire_claims(now)
+                got = self.core.claim(now)
+                if got is not None:
+                    return "ok", got
                 left = deadline - time.monotonic()
                 if left <= 0:
                     return "ok", None
-                nxt = min([t for _, _, t in self.claimed.values()],
+                nxt = min([t for _, _, t in self.core.claimed.values()],
                           default=deadline) - time.monotonic()
                 # bounded poll: a freshly committed add (or a lost
                 # lease) is noticed within 100ms even with no notify
@@ -159,23 +220,19 @@ class QueueReplica(Replica):
         if not self.leader_serving():
             return "noleader", None
         with self.lock:
-            if not self.leader_serving():
-                return "noleader", None
-            seq = self.commit_seq_locked()  # tail first, like addjob
-            known = jid in self.claimed or jid in self.pending
-            if not known:
-                return "ok", 0
-            entry = {"op": "ack", "seq": seq,
-                     "term": self.term, "leader": self.id, "jid": jid}
-            if not self.commit_locked(entry):
+            st, n, entry = self.core.ackjob_prepare(
+                jid, time.monotonic())
+            if st != "ok":
+                return st, None
+            if entry is not None and not self.commit_locked(entry):
                 return "noquorum", None
-            return "ok", 1
+            return "ok", n
 
     def status(self) -> dict:
         out = super().status()
         with self.lock:
-            out["pending"] = len(self.pending)
-            out["claimed"] = len(self.claimed)
+            out["pending"] = len(self.core.pending)
+            out["claimed"] = len(self.core.claimed)
         return out
 
 

@@ -27,6 +27,16 @@ small consensus group, so the kill-restart and partition nemeses bite
     leader left in the log is adopted consistently by everyone
     (it was ``:info``: "took effect" is legal).
 
+The protocol brain lives in :class:`ReplicaCore` — a PURE state
+machine: no clock reads (time arrives as an explicit ``now``), no
+sockets, no locks, no files.  :class:`Replica` is its daemon shell:
+it owns the lock, the ticker thread, the durable shared oplog, and
+the peer wire, and delegates every state decision to the core.  The
+split is what lets ``analyze/modelcheck.py`` lift the SAME state
+machine into a deterministic single-threaded scheduler and explore
+its interleavings exhaustively at bounded scope — the bugs the model
+checker finds are bugs in exactly the code the daemon runs.
+
 Seeded-bug modes, the campaign's detection targets:
 
   ``volatile``     mutations skip the shared oplog and elections skip
@@ -126,12 +136,287 @@ def http_json(host: str, port: int, path: str, *, method: str = "GET",
 LEADER_MARGIN = 0.5
 
 
-class Replica:
-    """One replica's state machine + consensus bookkeeping."""
+class ReplicaCore:
+    """The pure replica state machine — every consensus decision, no
+    effects.
+
+    Time is an explicit ``now`` argument (the shell passes
+    ``time.monotonic()``; the model checker passes its logical clock),
+    randomness an explicit ``jitter``, and the shared oplog an
+    injected zero-arg ``catch_up`` callable that replays the log tail
+    through :meth:`apply` (the shell binds the fsync'd file, the model
+    checker binds a plain list).  Everything else is deterministic
+    arithmetic over plain attributes, which is what makes bounded
+    exhaustive exploration of THIS object — not a re-implementation —
+    possible."""
 
     #: oplog entry kinds this state machine replays (subclasses — the
-    #: replicated queue — override both this and ``_apply_locked``)
-    _REPLAY_OPS = ("set",)
+    #: replicated queue — override both this and ``apply``)
+    REPLAY_OPS = ("set",)
+
+    def __init__(self, node_id: int, n_nodes: int, *,
+                 lease_s: float = 0.7, volatile: bool = False,
+                 split_brain: bool = False, now: float = 0.0):
+        self.id = node_id
+        self.n_nodes = n_nodes
+        self.lease_s = lease_s
+        self.volatile = volatile
+        self.split_brain = split_brain
+
+        self.state: dict[str, str] = {}
+        self.seq = 0          # last applied entry seq
+        self.term = 0         # highest term seen
+        self.role = "follower"
+        self.leader_id: int | None = None
+        # the election timer starts NOW (not at epoch 0): the id
+        # stagger in election_timeout differentiates who campaigns
+        # first, instead of every fresh replica dueling on tick one
+        self.lease_until = now
+        self.granted_term = 0    # highest term this node voted in
+        #: replay the shared-oplog tail through apply(); injected by
+        #: the owner (Replica binds the durable file under its lock,
+        #: the model checker binds a shared list) — returns the count
+        #: of entries applied
+        self.catch_up = lambda: 0
+
+    # -- log replay ---------------------------------------------------
+
+    def wants(self, e: dict) -> bool:
+        """Replay filter: entry kinds this machine applies, past the
+        applied prefix."""
+        return e.get("op") in self.REPLAY_OPS \
+            and int(e.get("seq", 0)) > self.seq
+
+    def apply(self, e: dict) -> None:
+        self.state[e["k"]] = e["v"]
+        self.seq = e["seq"]
+
+    # -- lease / election ---------------------------------------------
+
+    def majority(self) -> int:
+        return self.n_nodes // 2 + 1
+
+    def election_timeout(self) -> float:
+        # staggered by id so replicas don't duel; ~1.5-2.5 leases
+        return self.lease_s * (1.5 + 0.35 * self.id)
+
+    def step_leader_expiry(self, now: float) -> bool:
+        """A leader whose serving lease lapsed steps down — except the
+        split-brain seeded defect, which never concedes.  True when a
+        step-down happened."""
+        if self.role == "leader" and now > self.lease_until \
+                and not self.split_brain:
+            self.role = "follower"
+            self.leader_id = None
+            return True
+        return False
+
+    def election_due(self, now: float) -> bool:
+        """Should a non-leader campaign now?  The follower lease must
+        have lapsed AND the id-staggered election timer fired."""
+        return self.role != "leader" and now > self.lease_until \
+            and now - self.lease_until > \
+            self.election_timeout() - self.lease_s
+
+    def begin_campaign(self) -> tuple[int, int]:
+        """Open a candidacy: catch up from the shared oplog first (so
+        a won election never resurrects a stale seq in durable mode),
+        bump the term, self-vote.  -> (term, seq) for the ballots."""
+        self.catch_up()
+        self.term += 1
+        self.granted_term = self.term  # self-vote
+        return self.term, self.seq
+
+    def win_campaign(self, term: int, now: float) -> bool:
+        """A majority granted ``term``: become leader (unless the term
+        moved on underneath the ballots)."""
+        if self.term != term:
+            return False
+        self.role = "leader"
+        self.leader_id = self.id
+        self.lease_until = now + self.lease_s * LEADER_MARGIN
+        return True
+
+    def lose_campaign(self, now: float, jitter: float = 0.0) -> None:
+        """Lost ballots: back off the election timer (jittered, id-
+        staggered) instead of re-campaigning every tick and ratcheting
+        terms into a permanent duel.  ``jitter`` is uniform [0,1) —
+        the shell passes random.random(), the model checker 0."""
+        if self.role != "leader":
+            self.lease_until = now + self.lease_s \
+                * (0.3 + 0.3 * self.id + 0.4 * jitter)
+
+    def heartbeat_ack(self, term: int, now: float) -> None:
+        """A heartbeat round for ``term`` got majority grants:
+        followers honor lease_s from *their* grant; the leader trusts
+        only the margin of it."""
+        if self.role == "leader" and self.term == term:
+            self.lease_until = now + self.lease_s * LEADER_MARGIN
+
+    # -- peer surface -------------------------------------------------
+
+    def on_ping(self, term: int, leader: int, leader_seq: int,
+                now: float) -> dict:
+        if term < self.term:
+            return {"granted": False, "term": self.term}
+        if term > self.term or self.role != "leader":
+            if self.role == "leader" and self.split_brain:
+                # the seeded defect: never concede leadership
+                return {"granted": False, "term": self.term}
+            self.term = term
+            self.role = "follower"
+            self.leader_id = leader
+            self.lease_until = now + self.lease_s
+            if leader_seq > self.seq:
+                # an idle cluster still converges: a healed minority
+                # catches up from the shared oplog on the next
+                # heartbeat, not only on the next write
+                self.catch_up()
+            return {"granted": True, "term": self.term,
+                    "seq": self.seq}
+        # same-term second leader can't exist (majority vote), so
+        # this is our own echo shape — grant
+        self.lease_until = now + self.lease_s
+        return {"granted": True, "term": self.term, "seq": self.seq}
+
+    def on_vote(self, term: int, cand: int, cand_seq: int,
+                now: float) -> dict:
+        fresh_leader = now < self.lease_until \
+            and self.leader_id is not None \
+            and self.leader_id != cand
+        if term <= self.granted_term or term < self.term:
+            return {"granted": False, "term": self.term}
+        if fresh_leader and not self.volatile:
+            # don't vote while honoring a live leader — the lease
+            # safety rule that closes the two-leader window
+            return {"granted": False, "term": self.term}
+        if not self.volatile and cand_seq < self.seq:
+            # log completeness: a data-losing candidate loses.
+            # volatile mode SKIPS this — the seeded bug: a freshly
+            # restarted empty node can win and un-write acked data
+            return {"granted": False, "term": self.term,
+                    "seq": self.seq}
+        self.granted_term = term
+        self.term = max(self.term, term)
+        if self.role == "leader" and not self.split_brain:
+            self.role = "follower"
+        self.leader_id = None  # until the winner heartbeats
+        # give the winner a full lease to establish itself before
+        # this granter's own election timer can fire
+        self.lease_until = now + self.lease_s
+        return {"granted": True, "term": self.term}
+
+    def on_append(self, e: dict, now: float) -> tuple[int, dict]:
+        term = int(e.get("term", 0))
+        if term < self.term:
+            return 409, {"term": self.term}
+        if self.role == "leader" and self.split_brain \
+                and int(e.get("leader", -1)) != self.id:
+            # the seeded defect, fully symmetric: a split-brain
+            # leader not only keeps serving, it refuses a rival's
+            # entries — its side of the brain stays frozen
+            return 409, {"term": self.term}
+        self.term = term
+        self.leader_id = int(e.get("leader", -1))
+        if self.role == "leader" and self.leader_id != self.id \
+                and not self.split_brain:
+            self.role = "follower"
+        self.lease_until = now + self.lease_s
+        seq = int(e["seq"])
+        if seq == self.seq + 1:
+            self.apply(e)
+        elif seq > self.seq:
+            self.catch_up()
+            if seq == self.seq + 1 or (self.volatile
+                                       and seq > self.seq):
+                # volatile: nothing durable to catch up from — blind
+                # adoption keeps the cluster moving and plants exactly
+                # the ghost-state divergence the checker exists to
+                # catch
+                self.apply(e)
+        return 200, {"seq": self.seq}
+
+    # -- client surface (leader path) ---------------------------------
+
+    def leader_serving(self, now: float) -> bool:
+        return self.role == "leader" and (
+            self.split_brain or now < self.lease_until)
+
+    def next_seq(self) -> int:
+        """The next commit's seq, with the shared-oplog tail adopted
+        first: a deposed leader's un-acked append may have landed
+        after this leader's election catch-up, and assigning the same
+        seq to a NEW entry would fork the log (catch-up applies
+        whichever came first and skips the other — an acked write
+        could silently lose)."""
+        self.catch_up()
+        return self.seq + 1
+
+    def get(self, key: str, now: float) -> tuple[int, dict]:
+        if not self.leader_serving(now):
+            return 503, {"errorCode": 300, "message": "not leader"}
+        v = self.state.get(key)
+        if v is None:
+            return 404, {"errorCode": 100, "message": "Key not found",
+                         "cause": key}
+        return 200, {"action": "get",
+                     "node": {"key": f"/{key}", "value": v}}
+
+    def put_prepare(self, key: str, value: str, prev: str | None,
+                    now: float) -> tuple[int, dict, dict | None]:
+        """Everything of a PUT up to (not including) the commit:
+        leadership check, shared-tail adoption + seq assignment, CAS
+        compare, entry construction.  -> (status, body, entry);
+        ``entry`` is non-None exactly when the owner must now run the
+        commit protocol (and downgrade to 504/no-quorum on failure)."""
+        if not self.leader_serving(now):
+            return 503, {"errorCode": 300, "message": "not leader"}, \
+                None
+        # adopt the shared-oplog tail BEFORE the CAS compare and the
+        # seq assignment, so neither reads stale state
+        seq = self.next_seq()
+        if prev is not None:
+            cur = self.state.get(key)
+            if cur is None:
+                return 404, {"errorCode": 100,
+                             "message": "Key not found",
+                             "cause": key}, None
+            if cur != prev:
+                return 412, {"errorCode": 101,
+                             "message": "Compare failed",
+                             "cause": f"[{prev} != {cur}]"}, None
+        entry = {"op": "set", "seq": seq, "term": self.term,
+                 "leader": self.id, "k": key, "v": value}
+        body = {"action": "compareAndSwap" if prev is not None
+                else "set",
+                "node": {"key": f"/{key}", "value": value}}
+        return 200, body, entry
+
+    def snapshot(self) -> tuple:
+        """A hashable fingerprint of the whole machine — the model
+        checker's visited-state key and commutativity witness."""
+        return (self.id, self.seq, self.term, self.role,
+                self.leader_id, self.granted_term,
+                round(self.lease_until, 9),
+                tuple(sorted(self.state.items())))
+
+    def status(self, now: float) -> dict:
+        return {"id": self.id, "role": self.role, "term": self.term,
+                "seq": self.seq, "leader": self.leader_id,
+                "lease_remaining_s": round(self.lease_until - now, 3),
+                "volatile": self.volatile,
+                "split_brain": self.split_brain}
+
+
+class Replica:
+    """One replica daemon: the wire, the lock, the ticker thread, and
+    the durable shared oplog around a :class:`ReplicaCore`.  Every
+    state decision is the core's; this shell only supplies effects
+    (HTTP fan-out, fsync, real time, real randomness)."""
+
+    #: the pure state machine this shell drives (the replicated queue
+    #: swaps in QueueCore)
+    CORE_CLS = ReplicaCore
 
     def __init__(self, node_id: int, peers: list, oplog_path: str,
                  lease_s: float = 0.7, volatile: bool = False,
@@ -150,16 +435,14 @@ class Replica:
         self.split_brain = split_brain
 
         self.lock = threading.RLock()
-        self.state: dict[str, str] = {}
-        self.seq = 0          # last applied entry seq
-        self.term = 0         # highest term seen
-        self.role = "follower"
-        self.leader_id: int | None = None
-        # the election timer starts NOW (not at epoch 0): the id
-        # stagger in _election_timeout differentiates who campaigns
-        # first, instead of every fresh replica dueling on tick one
-        self.lease_until = time.monotonic()
-        self.granted_term = 0    # highest term this node voted in
+        self.core = self.CORE_CLS(
+            node_id, len(self.peers), lease_s=lease_s,
+            volatile=volatile, split_brain=split_brain,
+            now=time.monotonic())
+        # the core's log replay is THIS shell's durable tail read;
+        # every core call happens under self.lock, so the binding is
+        # lock-safe by construction
+        self.core.catch_up = self._catch_up_locked
 
         self.log = DurableLog(os.path.dirname(oplog_path) or ".",
                               name=os.path.basename(oplog_path),
@@ -174,11 +457,29 @@ class Replica:
         self._ticker = threading.Thread(target=self._tick_loop,
                                         name="repl-tick", daemon=True)
 
-    # -- log replay / catch-up ----------------------------------------
+    # -- core state, read-only (proxy + status paths) -----------------
 
-    def _apply_locked(self, e: dict) -> None:
-        self.state[e["k"]] = e["v"]
-        self.seq = e["seq"]
+    @property
+    def leader_id(self):
+        return self.core.leader_id
+
+    @property
+    def seq(self):
+        return self.core.seq
+
+    @property
+    def term(self):
+        return self.core.term
+
+    @property
+    def role(self):
+        return self.core.role
+
+    @property
+    def state(self):
+        return self.core.state
+
+    # -- log replay / catch-up ----------------------------------------
 
     def _catch_up_locked(self) -> int:
         """Replay every shared-oplog entry past the applied prefix —
@@ -191,9 +492,8 @@ class Replica:
                 e = json.loads(line)
             except ValueError:
                 continue
-            if e.get("op") in self._REPLAY_OPS \
-                    and int(e.get("seq", 0)) > self.seq:
-                self._apply_locked(e)
+            if self.core.wants(e):
+                self.core.apply(e)
                 applied += 1
         return applied
 
@@ -206,7 +506,7 @@ class Replica:
         self._stop.set()
 
     def _majority(self) -> int:
-        return len(self.peers) // 2 + 1
+        return self.core.majority()
 
     def _peer_get(self, peer: tuple, path: str, timeout: float = 0.4):
         host, port = peer
@@ -215,10 +515,6 @@ class Replica:
         if status >= 400:
             raise OSError(f"peer {host}:{port} -> {status}")
         return out
-
-    def _election_timeout(self) -> float:
-        # staggered by id so replicas don't duel; ~1.5-2.5 leases
-        return self.lease_s * (1.5 + 0.35 * self.id)
 
     def _tick_loop(self) -> None:
         while not self._stop.wait(self.lease_s / 4.0):
@@ -230,25 +526,20 @@ class Replica:
     def _tick(self) -> None:
         now = time.monotonic()
         with self.lock:
-            role, term = self.role, self.term
-            expired = now > self.lease_until
+            role, term = self.core.role, self.core.term
+            campaign_due = self.core.election_due(now)
         if role == "leader":
-            if expired and not self.split_brain:
-                with self.lock:
-                    if self.role == "leader" \
-                            and time.monotonic() > self.lease_until:
-                        self.role = "follower"
-                        self.leader_id = None
-                return
+            with self.lock:
+                if self.core.step_leader_expiry(time.monotonic()):
+                    return
             self._heartbeat(term)
-        elif expired and now - self.lease_until > \
-                self._election_timeout() - self.lease_s:
+        elif campaign_due:
             self._campaign()
 
     def _heartbeat(self, term: int) -> None:
         acks = 1
         with self.lock:
-            seq = self.seq
+            seq = self.core.seq
         for i, peer in enumerate(self.peers):
             if i == self.id:
                 continue
@@ -262,20 +553,11 @@ class Replica:
                 pass
         if acks >= self._majority():
             with self.lock:
-                if self.role == "leader" and self.term == term:
-                    # followers honor lease_s from *their* grant; the
-                    # leader trusts only the margin of it
-                    self.lease_until = time.monotonic() \
-                        + self.lease_s * LEADER_MARGIN
+                self.core.heartbeat_ack(term, time.monotonic())
 
     def _campaign(self) -> None:
         with self.lock:
-            # a candidate first catches up from the shared oplog, so a
-            # won election never resurrects a stale seq (durable mode)
-            self._catch_up_locked()
-            self.term += 1
-            term, seq = self.term, self.seq
-            self.granted_term = term  # self-vote
+            term, seq = self.core.begin_campaign()
         votes = 1
         for i, peer in enumerate(self.peers):
             if i == self.id:
@@ -290,155 +572,53 @@ class Replica:
                 pass
         if votes >= self._majority():
             with self.lock:
-                if self.term == term:
-                    self.role = "leader"
-                    self.leader_id = self.id
-                    self.lease_until = time.monotonic() \
-                        + self.lease_s * LEADER_MARGIN
+                self.core.win_campaign(term, time.monotonic())
             self._heartbeat(term)
         else:
             with self.lock:
-                if self.role != "leader":
-                    # lost: back off the election timer (jittered, id-
-                    # staggered) instead of re-campaigning every tick
-                    # and ratcheting terms into a permanent duel
-                    self.lease_until = time.monotonic() + self.lease_s \
-                        * (0.3 + 0.3 * self.id + 0.4 * random.random())
+                self.core.lose_campaign(time.monotonic(),
+                                        random.random())
 
     # -- peer surface --------------------------------------------------
 
     def on_ping(self, term: int, leader: int,
                 leader_seq: int = 0) -> dict:
         with self.lock:
-            if term < self.term:
-                return {"granted": False, "term": self.term}
-            if term > self.term or self.role != "leader":
-                if self.role == "leader" and self.split_brain:
-                    # the seeded defect: never concede leadership
-                    return {"granted": False, "term": self.term}
-                self.term = term
-                self.role = "follower"
-                self.leader_id = leader
-                self.lease_until = time.monotonic() + self.lease_s
-                if leader_seq > self.seq:
-                    # an idle cluster still converges: a healed
-                    # minority catches up from the shared oplog on the
-                    # next heartbeat, not only on the next write
-                    self._catch_up_locked()
-                return {"granted": True, "term": self.term,
-                        "seq": self.seq}
-            # same-term second leader can't exist (majority vote), so
-            # this is our own echo shape — grant
-            self.lease_until = time.monotonic() + self.lease_s
-            return {"granted": True, "term": self.term, "seq": self.seq}
+            return self.core.on_ping(term, leader, leader_seq,
+                                     time.monotonic())
 
     def on_vote(self, term: int, cand: int, cand_seq: int) -> dict:
         with self.lock:
-            fresh_leader = time.monotonic() < self.lease_until \
-                and self.leader_id is not None \
-                and self.leader_id != cand
-            if term <= self.granted_term or term < self.term:
-                return {"granted": False, "term": self.term}
-            if fresh_leader and not self.volatile:
-                # don't vote while honoring a live leader — the lease
-                # safety rule that closes the two-leader window
-                return {"granted": False, "term": self.term}
-            if not self.volatile and cand_seq < self.seq:
-                # log completeness: a data-losing candidate loses.
-                # volatile mode SKIPS this — the seeded bug: a freshly
-                # restarted empty node can win and un-write acked data
-                return {"granted": False, "term": self.term,
-                        "seq": self.seq}
-            self.granted_term = term
-            self.term = max(self.term, term)
-            if self.role == "leader" and not self.split_brain:
-                self.role = "follower"
-            self.leader_id = None  # until the winner heartbeats
-            # give the winner a full lease to establish itself before
-            # this granter's own election timer can fire
-            self.lease_until = time.monotonic() + self.lease_s
-            return {"granted": True, "term": self.term}
+            return self.core.on_vote(term, cand, cand_seq,
+                                     time.monotonic())
 
     def on_append(self, e: dict) -> tuple[int, dict]:
-        term = int(e.get("term", 0))
         with self.lock:
-            if term < self.term:
-                return 409, {"term": self.term}
-            if self.role == "leader" and self.split_brain \
-                    and int(e.get("leader", -1)) != self.id:
-                # the seeded defect, fully symmetric: a split-brain
-                # leader not only keeps serving, it refuses a rival's
-                # entries — its side of the brain stays frozen
-                return 409, {"term": self.term}
-            self.term = term
-            self.leader_id = int(e.get("leader", -1))
-            if self.role == "leader" and self.leader_id != self.id \
-                    and not self.split_brain:
-                self.role = "follower"
-            self.lease_until = time.monotonic() + self.lease_s
-            seq = int(e["seq"])
-            if seq == self.seq + 1:
-                self._apply_locked(e)
-            elif seq > self.seq:
-                self._catch_up_locked()
-                if seq == self.seq + 1 or (self.volatile
-                                           and seq > self.seq):
-                    # volatile: nothing durable to catch up from —
-                    # blind adoption keeps the cluster moving and
-                    # plants exactly the ghost-state divergence the
-                    # checker exists to catch
-                    self._apply_locked(e)
-            return 200, {"seq": self.seq}
+            return self.core.on_append(e, time.monotonic())
 
     # -- client surface (leader path) ---------------------------------
 
     def leader_serving(self) -> bool:
         with self.lock:
-            return self.role == "leader" and (
-                self.split_brain
-                or time.monotonic() < self.lease_until)
+            return self.core.leader_serving(time.monotonic())
 
     def get(self, key: str) -> tuple[int, dict]:
-        if not self.leader_serving():
-            return 503, {"errorCode": 300, "message": "not leader"}
         with self.lock:
-            v = self.state.get(key)
-        if v is None:
-            return 404, {"errorCode": 100, "message": "Key not found",
-                         "cause": key}
-        return 200, {"action": "get",
-                     "node": {"key": f"/{key}", "value": v}}
+            return self.core.get(key, time.monotonic())
 
     def put(self, key: str, value: str,
             prev: str | None = None) -> tuple[int, dict]:
         if not self.leader_serving():
             return 503, {"errorCode": 300, "message": "not leader"}
         with self.lock:
-            if not self.leader_serving():
-                return 503, {"errorCode": 300, "message": "not leader"}
-            # adopt the shared-oplog tail BEFORE the CAS compare and
-            # the seq assignment, so neither reads stale state
-            seq = self.commit_seq_locked()
-            if prev is not None:
-                cur = self.state.get(key)
-                if cur is None:
-                    return 404, {"errorCode": 100,
-                                 "message": "Key not found",
-                                 "cause": key}
-                if cur != prev:
-                    return 412, {"errorCode": 101,
-                                 "message": "Compare failed",
-                                 "cause": f"[{prev} != {cur}]"}
-            entry = {"op": "set", "seq": seq, "term": self.term,
-                     "leader": self.id, "k": key, "v": value}
-            if not self.commit_locked(entry):
+            status, body, entry = self.core.put_prepare(
+                key, value, prev, time.monotonic())
+            if entry is not None and not self.commit_locked(entry):
                 # the entry is in the shared log — a successor will
                 # adopt it — but THIS client gets indeterminacy (504,
                 # NOT 503: a 503 means "definitely didn't happen")
                 return 504, {"errorCode": 301, "message": "no quorum"}
-            return 200, {"action": "compareAndSwap" if prev is not None
-                         else "set",
-                         "node": {"key": f"/{key}", "value": value}}
+            return status, body
 
     def _replicate_locked(self, entry: dict) -> int:
         """Fan the entry out to every peer (source-bound, so link
@@ -467,33 +647,24 @@ class Replica:
         means no quorum — indeterminate, never "didn't happen" (the
         entry is in the shared log; a successor may adopt it).
 
-        Callers build the entry with ``seq`` = ``self.seq + 1`` under
-        the same lock AFTER :meth:`commit_seq_locked`, which re-reads
-        the shared-oplog tail first: a deposed leader's un-acked
-        append may have landed after this leader's election catch-up,
-        and assigning the same seq to a NEW entry would fork the log
-        (catch-up applies whichever came first and skips the other —
-        an acked write could silently lose)."""
+        Callers build the entry with ``seq`` = ``core.seq + 1`` under
+        the same lock AFTER :meth:`ReplicaCore.next_seq`, which
+        re-reads the shared-oplog tail first (see its docstring for
+        the log-fork hazard)."""
         self.log.append(json.dumps(entry))
         if self._replicate_locked(entry) < self._majority():
             return False
-        self._apply_locked(entry)
+        self.core.apply(entry)
         return True
 
     def commit_seq_locked(self) -> int:
-        """The next commit's seq, with the shared-oplog tail adopted
-        first (see :meth:`commit_locked`); caller holds the lock."""
-        self._catch_up_locked()
-        return self.seq + 1
+        """The next commit's seq (shared-oplog tail adopted first);
+        caller holds the lock."""
+        return self.core.next_seq()
 
     def status(self) -> dict:
         with self.lock:
-            return {"id": self.id, "role": self.role, "term": self.term,
-                    "seq": self.seq, "leader": self.leader_id,
-                    "lease_remaining_s": round(
-                        self.lease_until - time.monotonic(), 3),
-                    "volatile": self.volatile,
-                    "split_brain": self.split_brain}
+            return self.core.status(time.monotonic())
 
 
 class Handler(BaseHTTPRequestHandler):

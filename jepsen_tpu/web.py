@@ -63,10 +63,81 @@ def home_html(base: str) -> str:
     campaigns = ""
     if os.path.isdir(os.path.join(base, "campaigns")):
         campaigns = '<p><a href="/campaigns">fault-injection campaigns</a></p>'
+    campaigns += '<p><a href="/mc">bounded model checker</a></p>'
     return (f"<html><head><title>Jepsen</title><style>{STYLE}</style></head>"
             f"<body><h1>Jepsen results</h1>{campaigns}<table>"
             f"<tr><th>test</th><th>time</th><th>valid?</th><th></th></tr>"
             f"{''.join(rows)}</table></body></html>")
+
+
+# ---------------------------------------------------------------------------
+# bounded model checker panel (analyze/modelcheck.py)
+# ---------------------------------------------------------------------------
+
+#: one sweep per process unless ?refresh=1 — the default scopes finish
+#: in a few seconds, but a dashboard page must not re-search per click
+_MC_CACHE: dict | None = None
+
+
+def mc_html(refresh: bool = False) -> str:
+    """The ``/mc`` page: the family x mode expected-outcome matrix
+    (clean modes must clear their scope; seeded modes must be caught
+    with replaying certificates), explored-scope numbers, and each
+    violation's schedule certificate with its confirm verdicts."""
+    global _MC_CACHE
+    from .analyze import modelcheck as mc
+
+    if _MC_CACHE is None or refresh:
+        _MC_CACHE = mc.run_mc_sweep()
+    sweep = _MC_CACHE
+    rows = []
+    certs = []
+    for r in sweep["runs"]:
+        ex = r["explored"]
+        seeded = r["mode"] != "clean"
+        expected = (not r["ok"] and all(c.get("replayed")
+                                        for c in r["violations"])) \
+            if seeded else r["ok"]
+        cls = "valid-true" if expected else "valid-false"
+        codes = sorted({c["code"] for c in r["violations"]})
+        verdict = ("caught " + ", ".join(codes)) if codes else "clean"
+        rows.append(
+            f'<tr class="{cls}"><td>{html.escape(r["family"])}</td>'
+            f'<td>{html.escape(r["mode"])}</td>'
+            f"<td>{html.escape(verdict)}</td>"
+            f"<td>{ex['states']}</td><td>{ex['schedules']}</td>"
+            f"<td>{ex['prune_ratio']}</td><td>{ex['complete']}</td>"
+            f"<td>{'as expected' if expected else 'UNEXPECTED'}</td>"
+            f"</tr>")
+        for c in r["violations"]:
+            sched = " → ".join(f"{e[0]}({e[1]})" if e[1] is not None
+                               else e[0] for e in c["schedule"])
+            conf = c.get("confirm") or {}
+            certs.append(
+                f"<h3>{html.escape(c['code'])} — "
+                f"{html.escape(r['family'])}/{html.escape(r['mode'])}"
+                f"</h3><p>{html.escape(c['detail'])}</p>"
+                f"<p><code>{html.escape(sched)}</code> "
+                f"({c['shrunk']['n_from']} → {c['shrunk']['n_to']} "
+                f"events, minimal={c['shrunk']['minimal']}, "
+                f"replayed={c['replayed']})</p>"
+                f"<p>confirm [{html.escape(str(conf.get('route')))}]: "
+                f"engine valid={conf.get('engine_valid')}, "
+                f"audit ok={conf.get('audit_ok')} "
+                f"(checked {conf.get('audit_checked')})</p>")
+    status = "ok — every mode behaved as expected" if sweep["ok"] \
+        else "FAILED — some mode deviated from its expected outcome"
+    return (f"<html><head><title>model checker</title>"
+            f"<style>{STYLE}</style></head><body>"
+            f"<h1>Bounded model checker</h1>"
+            f'<p><a href="/">home</a> · '
+            f'<a href="/mc?refresh=1">re-run sweep</a></p>'
+            f"<p>{html.escape(status)} (MC1xx codes, schedule "
+            f"certificates — docs/analyze.md §11)</p><table>"
+            f"<tr><th>family</th><th>mode</th><th>verdict</th>"
+            f"<th>states</th><th>schedules</th><th>prune ratio</th>"
+            f"<th>complete</th><th>expected?</th></tr>"
+            f"{''.join(rows)}</table>{''.join(certs)}</body></html>")
 
 
 # ---------------------------------------------------------------------------
@@ -747,6 +818,11 @@ class Handler(BaseHTTPRequestHandler):
             return
         if path == "/campaigns" or path == "/campaigns/":
             self._send(200, campaigns_html(self.base).encode())
+            return
+        if path == "/mc" or path == "/mc/":
+            refresh = "refresh=1" in (parsed.query or "")
+            self._send(200, mc_html(refresh=refresh).encode(),
+                       extra={"Cache-Control": "no-store"})
             return
         if path == "/metrics":
             # the flight recorder's Prometheus scrape surface: this

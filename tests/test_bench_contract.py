@@ -3,6 +3,11 @@
 The benchmark's labels must not overstate the verified work: a tier
 named "1k" must carry EXACTLY 1000 encoded ops, and the per-core batch
 accounting must bill only workers that actually ran.
+
+The in-process label/accounting contracts ride tier-1; the tests that
+spawn real ``bench.py`` child processes (checkpoint/resume, decided
+carries, decomposed cold+warm) run under ``-m slow`` — they cost
+10-50s each and were pushing the fast tier past its wall-clock budget.
 """
 
 import os
@@ -82,6 +87,7 @@ def _run_tier_child(tmp_path, tier_s, **extra_env):
     return json.loads(out.stdout.strip().splitlines()[-1])
 
 
+@pytest.mark.slow
 def test_checkpoint_resumes_across_prune_modes(tmp_path):
     """A carry accumulated under one prune implementation resumes under
     the other (the cross-backend reality: a TPU window checkpoints with
@@ -198,6 +204,7 @@ def test_compact_emit_tpu_result_carries_no_banked():
     assert "banked_tpu" not in c["detail"]
 
 
+@pytest.mark.slow
 def test_decided_pending_tpu_checkpoint_is_left_alone(tmp_path):
     """ADVICE r4 bench.py:570: a CPU child deciding a search that TPU
     windows accumulated must bank the carry ONCE (marked decided) and
@@ -231,6 +238,7 @@ def test_decided_pending_tpu_checkpoint_is_left_alone(tmp_path):
     assert json.loads(meta_p.read_text())["decided_pending_tpu"] is True
 
 
+@pytest.mark.slow
 def test_orphan_meta_is_discarded(tmp_path):
     """A meta file whose npz is gone (unlink raced or failed) must not
     leak stale accounting — phantom elapsed/backends — into a fresh
@@ -260,6 +268,7 @@ def test_wide_tier_host_comparator_always_present(monkeypatch):
     assert row["configs"] > 0
 
 
+@pytest.mark.slow
 def test_tier_child_checkpoints_and_resumes(tmp_path):
     """A deadline-killed tier child leaves a checkpoint; the next child
     resumes it (reporting resumed+cumulative time) and a decided run
@@ -285,6 +294,7 @@ def test_tier_child_checkpoints_and_resumes(tmp_path):
     assert not (tmp_path / "1k.npz.meta.json").exists()
 
 
+@pytest.mark.slow
 def test_batch_child_reports_decomposed_cold_and_warm(tmp_path):
     """ISSUE 1 config 3 contract: the batch tier child must report the
     decomposed-vs-direct comparison — cold pass filling the canonical-

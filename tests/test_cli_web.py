@@ -197,3 +197,52 @@ def test_repl_last_test(tmp_path):
     store.save_2(test, {"valid": False})
     out = repl.last_test(base)
     assert out["results"]["valid"] is False
+
+
+def test_web_mc_panel(tmp_path, monkeypatch):
+    """/mc renders the model-checker matrix; the sweep is stubbed so
+    the page test doesn't pay for a real bounded search."""
+    fake = {"ok": True, "runs": [
+        {"family": "lock", "mode": "clean", "ok": True,
+         "violations": [],
+         "explored": {"states": 42, "schedules": 7, "events": 99,
+                      "sleep_prunes": 3, "dedup": 1,
+                      "prune_ratio": 0.03, "complete": True}},
+        {"family": "lock", "mode": "volatile", "ok": False,
+         "violations": [{
+             "code": "MC106", "detail": "double grant",
+             "schedule": [["op", 0], ["crash", 0], ["restart", 0],
+                          ["op", 1]],
+             "shrunk": {"n_from": 6, "n_to": 4, "checks": 9,
+                        "minimal": True},
+             "replayed": True,
+             "confirm": {"route": "engine", "engine_valid": False,
+                         "audit_ok": True, "audit_checked": 1}}],
+         "explored": {"states": 50, "schedules": 9, "events": 120,
+                      "sleep_prunes": 12, "dedup": 2,
+                      "prune_ratio": 0.09, "complete": True}},
+    ]}
+    from jepsen_tpu.analyze import modelcheck
+    monkeypatch.setattr(modelcheck, "run_mc_sweep", lambda: fake)
+    monkeypatch.setattr(web, "_MC_CACHE", None)
+    page = web.mc_html()
+    assert "MC106" in page and "caught MC106" in page
+    assert "as expected" in page and "UNEXPECTED" not in page
+    assert "op(0) → crash(0) → restart(0) → op(1)" in page
+    assert "engine valid=False" in page and "audit ok=True" in page
+    # the home page links the panel
+    monkeypatch.setattr(web, "_MC_CACHE", fake)
+    srv = web.make_server(host="127.0.0.1", port=0,
+                          base=str(tmp_path))
+    port = srv.server_address[1]
+    t = threading.Thread(target=srv.serve_forever, daemon=True)
+    t.start()
+    try:
+        home = urllib.request.urlopen(
+            f"http://127.0.0.1:{port}/").read().decode()
+        assert '<a href="/mc">' in home
+        page = urllib.request.urlopen(
+            f"http://127.0.0.1:{port}/mc").read().decode()
+        assert "Bounded model checker" in page
+    finally:
+        srv.shutdown()

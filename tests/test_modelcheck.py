@@ -1,0 +1,257 @@
+"""Bounded model checker (jepsen_tpu/analyze/modelcheck.py) — the CI
+gate for the MC1xx layer.
+
+Three tiers of guarantees:
+
+* **Soundness of the reduction** — sleep sets prune *transitions*,
+  never reachable states, so the (code, state-fingerprint) violation
+  set must be bit-identical with DPOR on and off at the same scope.
+* **Seeded-bug acceptance** — each seeded live mode (``volatile``,
+  ``split-brain``, ``rqueue_volatile``-style queue volatility, lock
+  volatility) is caught at the default bounded scope with a schedule
+  certificate that replays deterministically, shrinks to a small core,
+  renders as a jepsen history the linearizability engine re-confirms
+  INVALID (audit passing), and banks into a corpus.
+* **Clean-backend verdicts** — the un-seeded modes clear the same
+  scope with zero violations, a complete search, and a nonzero
+  sleep-set prune ratio (the reduction must actually bite).
+
+The fast tests run the default scopes (sub-second each); ``-m slow``
+widens the budgets (deeper schedules, extra crash) for the full
+matrix.
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+from jepsen_tpu.analyze import modelcheck as mc  # noqa: E402
+from jepsen_tpu.analyze import __main__ as analyze_cli  # noqa: E402
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def violation_set(result: dict) -> set:
+    return {(v["code"], v["state"]) for v in result["violations"]}
+
+
+def run_cli(*args, env=None):
+    e = dict(os.environ, JAX_PLATFORMS="cpu")
+    if env:
+        e.update(env)
+    return subprocess.run(
+        [sys.executable, "-m", "jepsen_tpu.analyze", *args],
+        capture_output=True, text=True, cwd=REPO, env=e)
+
+
+def run_cli_inproc(capsys, *args):
+    # same entry point as the subprocess path, minus the interpreter
+    # + jax import tax; keeps tier-1 wall time down
+    rc = analyze_cli.main(list(args))
+    return rc, capsys.readouterr().out
+
+
+# ---------------------------------------------------------------------------
+# reduction soundness: sleep sets prune transitions, never states
+# ---------------------------------------------------------------------------
+
+@pytest.mark.parametrize("family,mode", [
+    ("replicated", "volatile"),
+    ("lock", "volatile"),
+    ("rqueue", "volatile"),
+])
+def test_dpor_soundness_seeded(family, mode):
+    scope = mc.default_scope(family, mode)
+    on = mc.explore(family, mode, scope, dpor=True,
+                    max_violations=10_000)
+    off = mc.explore(family, mode, scope, dpor=False,
+                     max_violations=10_000)
+    assert on["explored"]["complete"] and off["explored"]["complete"]
+    assert violation_set(on) == violation_set(off)
+    assert on["violations"], f"{family}/{mode}: seeded bug not found"
+    # the reduction must have actually pruned something
+    assert on["explored"]["sleep_prunes"] > 0
+    assert on["explored"]["events"] <= off["explored"]["events"]
+
+
+@pytest.mark.parametrize("family", mc.FAMILIES)
+def test_dpor_soundness_clean(family):
+    scope = mc.default_scope(family, "clean")
+    on = mc.explore(family, "clean", scope, dpor=True)
+    off = mc.explore(family, "clean", scope, dpor=False)
+    assert not on["violations"] and not off["violations"]
+    assert on["explored"]["complete"] and off["explored"]["complete"]
+
+
+# ---------------------------------------------------------------------------
+# clean backends clear the bounded scope
+# ---------------------------------------------------------------------------
+
+@pytest.mark.parametrize("family", mc.FAMILIES)
+def test_clean_mode_passes_with_reduction_biting(family):
+    r = mc.run_mc(family, "clean", dpor=True)
+    assert r["ok"], r
+    assert r["explored"]["complete"]
+    assert r["explored"]["prune_ratio"] > 0
+    assert r["explored"]["states"] > 10
+
+
+# ---------------------------------------------------------------------------
+# seeded-bug acceptance: catch -> shrink -> replay -> confirm -> bank
+# ---------------------------------------------------------------------------
+
+def _accept(family, mode, want_code, tmp_path, route):
+    r = mc.run_mc(family, mode, dpor=True,
+                  bank_base=str(tmp_path / "corpus"))
+    assert not r["ok"]
+    codes = {v["code"] for v in r["violations"]}
+    assert want_code in codes, (codes, r["violations"][:1])
+    v = next(v for v in r["violations"] if v["code"] == want_code)
+    # the shrunk schedule still replays deterministically
+    assert v["replayed"]
+    assert v["shrunk"]["n_to"] <= v["shrunk"]["n_from"]
+    assert len(v["schedule"]) == v["shrunk"]["n_to"]
+    # the rendered history is engine-confirmed INVALID, audit passing
+    c = v["confirm"]
+    assert c["route"] == route
+    assert c["engine_valid"] is False
+    assert c["audit_ok"] is True and c["audit_checked"]
+    # and it banked into the corpus
+    assert v["banked"]["banked"] >= 1
+    assert (tmp_path / "corpus").exists()
+    return v
+
+
+def test_seeded_kv_volatile_caught(tmp_path):
+    v = _accept("replicated", "volatile", "MC102", tmp_path, "engine")
+    # lost-write histories need at least a write and the probe read
+    fs = [op["f"] for op in v["history"]]
+    assert "read" in fs
+
+
+def test_seeded_kv_split_brain_caught(tmp_path):
+    _accept("replicated", "split-brain", "MC101", tmp_path, "engine")
+
+
+def test_seeded_rqueue_volatile_caught(tmp_path):
+    v = _accept("rqueue", "volatile", "MC104", tmp_path, "queue")
+    fs = [op["f"] for op in v["history"]]
+    assert "enqueue" in fs and "drain" in fs
+
+
+def test_seeded_lock_volatile_caught(tmp_path):
+    _accept("lock", "volatile", "MC106", tmp_path, "engine")
+
+
+def test_certificate_replays_via_module_api(tmp_path):
+    r = mc.run_mc("lock", "volatile", dpor=True)
+    v = r["violations"][0]
+    rep = mc.replay_certificate(v)
+    assert rep["reproduced"] and rep["code"] == v["code"]
+    # a truncated schedule must NOT claim reproduction
+    broken = dict(v, schedule=v["schedule"][:1])
+    assert not mc.replay_certificate(broken)["reproduced"]
+
+
+@pytest.mark.slow
+def test_sweep_expectation_matrix():
+    # per-cell coverage rides tier-1 (clean modes + every seeded
+    # acceptance test above); the whole-matrix sweep is the slow tier
+    s = mc.run_mc_sweep()
+    assert s["ok"], [(r["family"], r["mode"], r["ok"])
+                     for r in s["runs"]]
+
+
+# ---------------------------------------------------------------------------
+# CLI exit codes (`python -m jepsen_tpu.analyze --mc`)
+# ---------------------------------------------------------------------------
+
+def test_cli_seeded_pair_exits_1_and_replay_round_trips(
+        tmp_path, capsys):
+    # the seeded half stays a real subprocess: it pins the actual
+    # process exit code of `python -m jepsen_tpu.analyze --mc`
+    p = run_cli("--mc", "--mc-family", "lock", "--mc-mode",
+                "volatile", "--json")
+    assert p.returncode == 1, p.stderr
+    out = json.loads(p.stdout)
+    assert out["ok"] is False
+    cert = out["runs"][0]["violations"][0]
+    cert_path = tmp_path / "cert.json"
+    cert_path.write_text(json.dumps(cert))
+    rc, rep_out = run_cli_inproc(capsys, "--mc", "--replay",
+                                 str(cert_path))
+    assert rc == 0, rep_out
+    assert "reproduced" in rep_out
+
+
+def test_cli_clean_pair_exits_0(capsys):
+    rc, out = run_cli_inproc(
+        capsys, "--mc", "--mc-family", "lock", "--mc-mode", "clean")
+    assert rc == 0, out
+
+
+def test_cli_bad_args(capsys):
+    # lock has no split-brain mode: the pair matches nothing
+    rc, _ = run_cli_inproc(capsys, "--mc", "--mc-family", "lock",
+                           "--mc-mode", "split-brain")
+    assert rc == 254
+    rc, _ = run_cli_inproc(capsys, "--mc", "--replay",
+                           "/nonexistent/cert.json")
+    assert rc == 254
+
+
+def test_cli_explain_prints_scope_plan(capsys):
+    rc, out = run_cli_inproc(capsys, "--mc", "--explain", "--json")
+    assert rc == 0
+    plan = json.loads(out)["mc_plan"]
+    assert {(b["family"], b["mode"]) for b in plan} == {
+        (f, m) for f in mc.FAMILIES for m in mc.MODES[f]}
+
+
+@pytest.mark.slow
+def test_cli_full_sweep_exits_0():
+    p = run_cli("--mc", "--json")
+    assert p.returncode == 0, p.stdout[-2000:] + p.stderr[-2000:]
+    out = json.loads(p.stdout)
+    assert out["ok"] is True
+    assert len(out["runs"]) == sum(len(m) for m in mc.MODES.values())
+
+
+# ---------------------------------------------------------------------------
+# full matrix at widened scope (slow tier)
+# ---------------------------------------------------------------------------
+
+@pytest.mark.slow
+@pytest.mark.parametrize("family", mc.FAMILIES)
+def test_slow_clean_matrix_deeper(family):
+    scope = mc.scope_from_args(family, "clean", max_events=7)
+    r = mc.run_mc(family, "clean", scope=scope, dpor=True)
+    assert r["ok"], r["violations"][:1]
+    assert r["explored"]["complete"]
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("family,mode", [
+    (f, m) for f in mc.FAMILIES for m in mc.MODES[f] if m != "clean"])
+def test_slow_seeded_matrix_deeper(family, mode):
+    scope = mc.scope_from_args(family, mode, max_events=7)
+    r = mc.run_mc(family, mode, scope=scope, dpor=True,
+                  shrink=False, confirm=False)
+    assert not r["ok"]
+    assert all(v["replayed"] for v in r["violations"])
+
+
+@pytest.mark.slow
+def test_slow_dpor_soundness_deeper():
+    scope = mc.scope_from_args("replicated", "volatile", max_events=7)
+    on = mc.explore("replicated", "volatile", scope, dpor=True,
+                    max_violations=100_000)
+    off = mc.explore("replicated", "volatile", scope, dpor=False,
+                     max_violations=100_000)
+    assert violation_set(on) == violation_set(off)

@@ -515,3 +515,181 @@ def test_lint_suites_json_exit_1_on_b002_fixture(tmp_path):
     assert rc == 1
     found = {d["code"] for ds in payload["files"].values() for d in ds}
     assert "B002" in found
+
+
+# ---------------------------------------------------------------------------
+# N-codes — JEPSEN_TPU_* knob threading
+# ---------------------------------------------------------------------------
+
+from jepsen_tpu.analyze.suites import (  # noqa: E402
+    lint_knobs,
+    lint_metrics,
+    registered_metrics,
+)
+
+
+def _knob_pkg(tmp_path, src):
+    pkg = tmp_path / "pkg"
+    pkg.mkdir()
+    (pkg / "mod.py").write_text(src)
+    return pkg
+
+
+def _all(diags_by_file):
+    return [d for ds in diags_by_file.values() for d in ds]
+
+
+def test_n001_toggle_without_cli_flag(tmp_path):
+    pkg = _knob_pkg(tmp_path, (
+        "import os\n"
+        "def foo_enabled():\n"
+        "    return os.environ.get('JEPSEN_TPU_FOO', '') != '0'\n"))
+    out = _all(lint_knobs(pkg, cli_text="",
+                          docs_text="JEPSEN_TPU_FOO"))
+    assert {d.code for d in out} == {"N001"}
+    # a cli.py mention clears it
+    out = _all(lint_knobs(pkg, cli_text="JEPSEN_TPU_FOO",
+                          docs_text="JEPSEN_TPU_FOO"))
+    assert out == []
+
+
+def test_n001_needs_the_enabled_idiom(tmp_path):
+    # a plain function read is not a toggle: no N001
+    pkg = _knob_pkg(tmp_path, (
+        "import os\n"
+        "def depth():\n"
+        "    return int(os.environ.get('JEPSEN_TPU_DEPTH', '4'))\n"))
+    out = _all(lint_knobs(pkg, cli_text="",
+                          docs_text="JEPSEN_TPU_DEPTH"))
+    assert out == []
+
+
+def test_n002_import_time_read_of_cli_claimed_knob(tmp_path):
+    pkg = _knob_pkg(tmp_path, (
+        "import os\n"
+        "MODE = os.environ.get('JEPSEN_TPU_BAR', 'auto')\n"))
+    out = _all(lint_knobs(pkg, cli_text="JEPSEN_TPU_BAR",
+                          docs_text="JEPSEN_TPU_BAR"))
+    assert {d.code for d in out} == {"N002"}
+    # env-only tuning constants (no cli.py claim) are exempt
+    out = _all(lint_knobs(pkg, cli_text="",
+                          docs_text="JEPSEN_TPU_BAR"))
+    assert out == []
+
+
+def test_n003_undocumented_knob_and_internal_exemption(tmp_path):
+    pkg = _knob_pkg(tmp_path, (
+        "import os\n"
+        "def f():\n"
+        "    a = os.environ['JEPSEN_TPU_MYSTERY']\n"
+        "    b = os.environ.get('JEPSEN_TPU_PROC_ID')\n"
+        "    return a, b\n"))
+    out = _all(lint_knobs(pkg, cli_text="", docs_text=""))
+    assert [(d.code, d.severity) for d in out] == [("N003", "warning")]
+    assert "JEPSEN_TPU_MYSTERY" in out[0].message
+
+
+def test_n003_membership_test_counts_as_read(tmp_path):
+    pkg = _knob_pkg(tmp_path, (
+        "import os\n"
+        "def f():\n"
+        "    return 'JEPSEN_TPU_GHOST' in os.environ\n"))
+    out = _all(lint_knobs(pkg, cli_text="", docs_text=""))
+    assert {d.code for d in out} == {"N003"}
+
+
+def test_knoblint_suppression(tmp_path):
+    pkg = _knob_pkg(tmp_path, (
+        "import os\n"
+        "def foo_enabled():\n"
+        "    return os.environ.get('JEPSEN_TPU_FOO') == '1'"
+        "  # knoblint: ok\n"))
+    out = _all(lint_knobs(pkg, cli_text="", docs_text=""))
+    assert out == []
+
+
+def test_package_knobs_are_threaded():
+    """The CI gate: every knob the package reads has its CLI flag, no
+    cli-claimed knob freezes at import, everything is documented."""
+    out = _all(lint_knobs())
+    assert [str(d) for d in out if d.severity == "error"] == []
+    assert [str(d) for d in out if d.severity == "warning"] == []
+
+
+# ---------------------------------------------------------------------------
+# O-codes — jtpu_* metrics contract
+# ---------------------------------------------------------------------------
+
+def _metrics_pkg(tmp_path, *names):
+    pkg = tmp_path / "pkg"
+    pkg.mkdir()
+    body = "from x import REGISTRY\n" + "".join(
+        f"M{i} = REGISTRY.counter('{n}', 'h')\n"
+        for i, n in enumerate(names))
+    (pkg / "m.py").write_text(body)
+    return pkg
+
+
+def test_o001_consumer_references_unregistered_series(tmp_path):
+    pkg = _metrics_pkg(tmp_path, "jtpu_real_total")
+    web = tmp_path / "web.py"
+    web.write_text("PANEL = ['jtpu_real_total', 'jtpu_ghost_total']\n")
+    out = _all(lint_metrics(pkg, consumers=[web]))
+    o001 = [d for d in out if d.code == "O001"]
+    assert len(o001) == 1 and "jtpu_ghost_total" in o001[0].message
+
+
+def test_o001_histogram_suffixes_resolve_to_family(tmp_path):
+    pkg = _metrics_pkg(tmp_path, "jtpu_lat_seconds")
+    web = tmp_path / "web.py"
+    web.write_text("Q = 'jtpu_lat_seconds_bucket'\n")
+    out = [d for d in _all(lint_metrics(pkg, consumers=[web]))
+           if d.code == "O001"]
+    assert out == []
+
+
+def test_o002_orphans_aggregate_into_one_warning(tmp_path):
+    pkg = _metrics_pkg(tmp_path, "jtpu_used_total",
+                       "jtpu_orphan_a_total", "jtpu_orphan_b_total")
+    web = tmp_path / "web.py"
+    web.write_text("P = 'jtpu_used_total'\n")
+    out = _all(lint_metrics(pkg, consumers=[web]))
+    o002 = [d for d in out if d.code == "O002"]
+    assert len(o002) == 1 and o002[0].severity == "warning"
+    assert "jtpu_orphan_a_total" in o002[0].message
+    assert "jtpu_orphan_b_total" in o002[0].message
+
+
+def test_metriclint_suppression(tmp_path):
+    pkg = _metrics_pkg(tmp_path, "jtpu_real_total")
+    web = tmp_path / "web.py"
+    web.write_text("G = 'jtpu_ghost_total'  # metriclint: ok\n")
+    out = [d for d in _all(lint_metrics(pkg, consumers=[web]))
+           if d.code == "O001"]
+    assert out == []
+
+
+def test_package_metrics_contract_holds():
+    """The CI gate: every series a consumer surface references is
+    registered (O001 clean); the mc layer's own series are present."""
+    out = _all(lint_metrics())
+    assert [str(d) for d in out if d.severity == "error"] == []
+    reg = registered_metrics()
+    for name in ("jtpu_mc_states_total", "jtpu_mc_schedules_total",
+                 "jtpu_mc_violations_total", "jtpu_mc_prune_ratio"):
+        assert name in reg, name
+
+
+def test_new_codes_registered_and_cli_runs(capsys):
+    for code in ("N001", "N002", "N003", "O001", "O002"):
+        assert code in SUITE_CODES
+    import importlib.util
+
+    spec = importlib.util.spec_from_file_location(
+        "lint_suites_cli", os.path.join(REPO, "tools",
+                                        "lint_suites.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    assert mod.main(["--knobs", "--json"]) == 0
+    payload = json.loads(capsys.readouterr().out)
+    assert payload["errors"] == 0

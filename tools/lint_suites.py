@@ -16,6 +16,14 @@ discipline lint over the service tiers (``jepsen_tpu/fleet/``,
 flock'd writes without fsync, spans without the ``run=`` pin.  Skip it
 with ``--no-threads``; run it alone with ``--threads``.
 
+The default sweep also runs the N-code knob-threading lint (every
+``JEPSEN_TPU_*`` env knob the package reads must be CLI-reachable,
+not frozen at import time when cli.py claims it, and documented) and
+the O-code metrics-contract lint (every ``jtpu_*`` series a consumer
+surface references must be registered; registered-but-unreferenced
+orphans are flagged once, aggregated).  Skip with ``--no-knobs`` /
+``--no-metrics``; run alone with ``--knobs`` / ``--metrics``.
+
 Exit code 0 when no ERROR-severity findings (warnings don't fail the
 run), 1 otherwise.  The same check gates CI through
 tests/test_suite_lint.py, so a new suite cannot merge with protocol
@@ -34,6 +42,8 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(
 
 from jepsen_tpu.analyze.suites import (  # noqa: E402
     SUITE_CODES,
+    lint_knobs,
+    lint_metrics,
     lint_paths,
     lint_thread_tier,
 )
@@ -55,20 +65,36 @@ def main(argv=None) -> int:
                    help="run ONLY the T-code thread/lock lint")
     p.add_argument("--no-threads", action="store_true",
                    help="skip the T-code lint in the default sweep")
+    p.add_argument("--knobs", action="store_true",
+                   help="run ONLY the N-code knob-threading lint")
+    p.add_argument("--no-knobs", action="store_true",
+                   help="skip the N-code lint in the default sweep")
+    p.add_argument("--metrics", action="store_true",
+                   help="run ONLY the O-code metrics-contract lint")
+    p.add_argument("--no-metrics", action="store_true",
+                   help="skip the O-code lint in the default sweep")
     opts = p.parse_args(argv)
     if opts.codes:
         for code, desc in sorted(SUITE_CODES.items()):
             print(f"{code}  {desc}")
         return 0
 
+    only = opts.threads or opts.knobs or opts.metrics
     findings: dict = {}
-    if not opts.threads:
+    if not only:
         findings = lint_paths(opts.paths)
-    # thread tier: part of the default sweep (explicit paths mean the
-    # caller scoped the run to specific suites, so leave it out unless
-    # --threads asked for it)
-    if opts.threads or (not opts.paths and not opts.no_threads):
+    # tier-wide passes: part of the default sweep (explicit paths mean
+    # the caller scoped the run to specific suites, so leave them out
+    # unless their --flag asked for them)
+    sweep = not opts.paths and not only
+    if opts.threads or (sweep and not opts.no_threads):
         for f, ds in lint_thread_tier().items():
+            findings.setdefault(f, []).extend(ds)
+    if opts.knobs or (sweep and not opts.no_knobs):
+        for f, ds in lint_knobs().items():
+            findings.setdefault(f, []).extend(ds)
+    if opts.metrics or (sweep and not opts.no_metrics):
+        for f, ds in lint_metrics().items():
             findings.setdefault(f, []).extend(ds)
     n_err = sum(1 for ds in findings.values()
                 for d in ds if d.severity == "error")

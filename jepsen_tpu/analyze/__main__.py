@@ -32,10 +32,15 @@ were found.  ``--replay`` re-executes an emitted schedule
 certificate::
 
     python -m jepsen_tpu.analyze --mc --json
+    python -m jepsen_tpu.analyze --mc --mc-scope shell   # MC2xx layer
     python -m jepsen_tpu.analyze --mc --mc-family replicated \\
         --mc-mode volatile --mc-bank store
     python -m jepsen_tpu.analyze --mc --replay cert.json
     python -m jepsen_tpu.analyze --mc --explain   # scope plan only
+
+``--mc-scope`` picks the checked layer: ``core`` (the lifted state
+machines, MC1xx), ``shell`` (the daemons' request-dispatch shells
+under a simulated transport — analyze/simnet.py, MC2xx), or ``all``.
 
 Exit codes follow cli.py's contract: 0 clean, 1 lint errors or audit
 W-codes found, 254 bad arguments.
@@ -74,12 +79,16 @@ def _model(name: str, arg: int | None):
 
 
 def _mc_pairs(opts) -> list[tuple]:
-    from .modelcheck import FAMILIES, MODES
+    from .modelcheck import ALL_FAMILIES, ALL_MODES, FAMILIES, \
+        SHELL_FAMILIES
 
-    fams = FAMILIES if opts.mc_family == "all" else (opts.mc_family,)
+    scoped = {"core": FAMILIES, "shell": SHELL_FAMILIES,
+              "all": ALL_FAMILIES}[opts.mc_scope]
+    # a named family always runs, whatever the scope filter says
+    fams = scoped if opts.mc_family == "all" else (opts.mc_family,)
     pairs = []
     for fam in fams:
-        for mode in MODES[fam]:
+        for mode in ALL_MODES[fam]:
             if opts.mc_mode in ("all", mode):
                 pairs.append((fam, mode))
     return pairs
@@ -198,11 +207,22 @@ def main(argv=None) -> int:
     p.add_argument("--mc", action="store_true",
                    help="Model-check the live backend state machines "
                         "at bounded scope (no history needed)")
+    p.add_argument("--mc-scope", default="core",
+                   choices=("core", "shell", "all"),
+                   help="Which layer to check: the lifted cores "
+                        "(default), the daemon shells under the "
+                        "simulated transport (analyze/simnet.py), or "
+                        "both")
     p.add_argument("--mc-family", default="all",
-                   choices=("all", "replicated", "rqueue", "lock"),
-                   help="Backend family for --mc (default: sweep all)")
+                   choices=("all", "replicated", "rqueue", "lock",
+                            "shell-kv", "shell-queue",
+                            "shell-replicated", "shell-rqueue"),
+                   help="Backend family for --mc (default: sweep the "
+                        "--mc-scope families)")
     p.add_argument("--mc-mode", default="all",
-                   choices=("all", "clean", "volatile", "split-brain"),
+                   choices=("all", "clean", "volatile", "split-brain",
+                            "session-leak", "proxy-loop",
+                            "stale-proxy"),
                    help="Backend mode for --mc (default: every mode "
                         "of the family)")
     p.add_argument("--mc-max-events", type=int, default=None,

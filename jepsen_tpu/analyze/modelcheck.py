@@ -97,6 +97,16 @@ MC_CODES = {
              "never added or was already acked",
     "MC106": "double grant: the lock server granted while another "
              "client still holds an unreleased grant",
+    "MC201": "non-idempotent retry: one client op (one request id) "
+             "committed twice across a retransmission",
+    "MC202": "acked reply lost then lied: a committed write's retry "
+             "was answered with a failure",
+    "MC203": "proxy loop: a forwarded client request was re-forwarded "
+             "past every node in the mesh",
+    "MC204": "session leak: a connection reset left server-side "
+             "session state (a claim) owned by a dead connection",
+    "MC205": "stale-leader serving: a deposed leader answered a "
+             "proxied/direct read outside the possible set",
 }
 
 _M_STATES = REGISTRY.counter(
@@ -129,6 +139,22 @@ MODES = {
     "rqueue": ("clean", "volatile"),
     "lock": ("clean", "volatile"),
 }
+
+#: the shell-layer scope (analyze/simnet.py): the daemons' actual
+#: request-dispatch code paths under a simulated transport.  Seeded
+#: modes re-open the retry-idempotency / session-lifecycle bugs the
+#: live shells fix; clean modes prove the fixed shells hold at the
+#: same bounds.
+SHELL_FAMILIES = ("shell-kv", "shell-queue", "shell-replicated",
+                  "shell-rqueue")
+SHELL_MODES = {
+    "shell-kv": ("clean", "volatile"),
+    "shell-queue": ("clean", "volatile", "session-leak"),
+    "shell-replicated": ("clean", "proxy-loop", "stale-proxy"),
+    "shell-rqueue": ("clean", "volatile"),
+}
+ALL_FAMILIES = FAMILIES + SHELL_FAMILIES
+ALL_MODES = {**MODES, **SHELL_MODES}
 
 #: the one key the kv program exercises — a single register is where
 #: every seeded backend defect already shows
@@ -188,6 +214,25 @@ def default_scope(family: str, mode: str) -> Scope:
     if family == "rqueue":
         return Scope(nodes=3, ops=(("add", 1),), crashes=1,
                      max_events=6)
+    if family == "shell-kv":
+        # drop(reply) + retry: one partition token, depth 7
+        return Scope(nodes=1, ops=(("cas", 1, 2),), crashes=0,
+                     partitions=1, max_events=7)
+    if family == "shell-queue":
+        # MC204 needs: add acked (3) + get claimed (2) + reset (1)
+        # + retry/deliver/deliver (3) = 9 events
+        return Scope(nodes=1, ops=(("add", 1), ("get",)), crashes=1,
+                     partitions=1, max_events=9)
+    if family == "shell-rqueue":
+        return Scope(nodes=2, ops=(("add", 1),), crashes=0,
+                     partitions=1, max_events=7)
+    if family == "shell-replicated":
+        if mode == "proxy-loop":
+            # elect, learn, elect, op — two leadership moves
+            return Scope(nodes=3, ops=(("w", 1),), crashes=2,
+                         max_events=6)
+        return Scope(nodes=3, ops=(("w", 1), ("w", 2), ("r",)),
+                     crashes=1, max_events=6)
     if mode == "split-brain":
         return Scope(nodes=3, ops=(("w", 1), ("w", 2)), crashes=0,
                      partitions=1, max_events=6)
@@ -669,10 +714,17 @@ class LockWorld:
 
 
 def make_world(family: str, mode: str, scope: Scope):
-    if family not in FAMILIES:
+    if family not in ALL_FAMILIES:
         raise ValueError(f"unknown family {family!r}")
-    if mode not in MODES[family]:
+    if mode not in ALL_MODES[family]:
         raise ValueError(f"mode {mode!r} invalid for {family!r}")
+    if family in SHELL_FAMILIES:
+        from . import simnet
+        cls = {"shell-kv": simnet.ShellKVWorld,
+               "shell-queue": simnet.ShellQueueWorld,
+               "shell-replicated": simnet.ShellReplWorld,
+               "shell-rqueue": simnet.ShellRqueueWorld}[family]
+        return cls(family, mode, scope)
     if family == "lock":
         return LockWorld(family, mode, scope)
     return ClusterWorld(family, mode, scope)
@@ -714,8 +766,11 @@ def explore(family: str, mode: str, scope: Scope, *,
     complete = True
 
     def commutes(world, a: tuple, b: tuple) -> bool:
-        """Concrete commutation: both orders enabled and landing on
-        the same fingerprint.  Conservative False on anything else."""
+        """Concrete commutation: both orders enabled, landing on the
+        same fingerprint, and VIOLATION-FREE — a violating transition
+        ends its DFS path, so its subtree never covers the sibling
+        order and sleeping on it would prune a distinct violating
+        state.  Conservative False on anything else."""
         key = (world.fingerprint(), a, b) if a <= b \
             else (world.fingerprint(), b, a)
         hit = commute_memo.get(key)
@@ -724,15 +779,16 @@ def explore(family: str, mode: str, scope: Scope, *,
         out = False
         wa = world.clone()
         if a in wa.enabled():
-            wa.execute(a)
-            if b in wa.enabled():
-                wa.execute(b)
+            va = wa.execute(a)
+            if va is None and b in wa.enabled():
+                vab = wa.execute(b)
                 wb = world.clone()
-                if b in wb.enabled():
-                    wb.execute(b)
-                    if a in wb.enabled():
-                        wb.execute(a)
-                        out = wa.fingerprint() == wb.fingerprint()
+                if vab is None and b in wb.enabled():
+                    vb = wb.execute(b)
+                    if vb is None and a in wb.enabled():
+                        vba = wb.execute(a)
+                        out = vba is None \
+                            and wa.fingerprint() == wb.fingerprint()
         commute_memo[key] = out
         return out
 
@@ -854,22 +910,42 @@ def _shrink_schedule(family: str, mode: str, scope: Scope,
     return ddmin_list([tuple(e) for e in schedule], still)
 
 
-def _confirm_kv_lock(family: str, ops: list) -> dict:
+def _confirm_engine(ops: list, model) -> dict:
     """The independent validation loop for engine-route histories:
     the linearizability engine must answer invalid and the audit
     must accept its certificate."""
     from ..checker.seq import check_opseq
     from ..history import encode_ops
-    from ..models import mutex, register
     from .audit import audit
 
-    model = mutex() if family == "lock" else register(ABSENT)
     seq = encode_ops(ops, model.f_codes)
     res = check_opseq(seq, model, lint=False)
     a = audit(ops, model, res)
     return {"route": "engine", "engine_valid": res.get("valid"),
             "audit_ok": bool(a.get("ok")),
             "audit_checked": a.get("checked")}
+
+
+def _confirm_kv_lock(family: str, ops: list) -> dict:
+    from ..models import mutex, register
+
+    return _confirm_engine(
+        ops, mutex() if family == "lock" else register(ABSENT))
+
+
+def _confirm_queue_engine(ops: list) -> dict:
+    """The MC201 route: duplicate delivery is invisible to the
+    tolerant total-queue multiset (at-least-once admits duplicates),
+    so double-commits confirm through the ENGINE over an unordered
+    queue — a dequeue with no remaining enqueue to justify it has no
+    linearization."""
+    from ..checker.basic import expand_queue_drain_ops
+    from ..models import unordered_queue
+
+    flat = expand_queue_drain_ops(ops)
+    n_enq = sum(1 for op in flat
+                if op.f == "enqueue" and op.type == "invoke")
+    return _confirm_engine(flat, unordered_queue(max(2, n_enq + 1)))
 
 
 def _confirm_queue(ops: list) -> dict:
@@ -906,7 +982,30 @@ def _confirm_queue(ops: list) -> dict:
             "audit_checked": a.get("checked")}
 
 
-def confirm_certificate(family: str, ops: list) -> dict:
+def confirm_certificate(family: str, ops: list, code: str | None = None,
+                        replayed: bool | None = None) -> dict:
+    """Route a certificate's history to its independent validator.
+    Shell codes pick their route by invariant (MC201 → engine over an
+    unordered queue, MC202/MC205 → engine over a register, MC204 →
+    total-queue multiset); MC203 has no invalid client history — a
+    loop amplifies without lying to anyone — so deterministic replay
+    IS its confirmation (route "loop")."""
+    if code == "MC201":
+        return _confirm_queue_engine(ops)
+    if code == "MC202":
+        from ..models import cas_register
+
+        return _confirm_engine(ops, cas_register(1))
+    if code == "MC203":
+        return {"route": "loop", "engine_valid": False,
+                "audit_ok": bool(replayed),
+                "audit_checked": "loop-replay"}
+    if code == "MC204":
+        return _confirm_queue(ops)
+    if code == "MC205":
+        from ..models import register
+
+        return _confirm_engine(ops, register(ABSENT))
     if family == "rqueue":
         return _confirm_queue(ops)
     return _confirm_kv_lock(family, ops)
@@ -918,10 +1017,16 @@ def bank_certificate(family: str, mode: str, ops: list,
     (the same pool campaign failures land in, so the corpus replayer
     regression-checks model-checker finds too)."""
     from ..live import corpus
-    from ..models import mutex, register
+    from ..models import cas_register, mutex, register
 
-    model = None if family == "rqueue" else (
-        mutex() if family == "lock" else register(ABSENT))
+    if family in ("rqueue", "shell-queue", "shell-rqueue"):
+        model = None  # the queue families bank through total-queue
+    elif family == "lock":
+        model = mutex()
+    elif family == "shell-kv":
+        model = cas_register(1)
+    else:
+        model = register(ABSENT)
     entries = corpus.entries_from_test(
         {"history": ops, "model": model},
         {"family": f"mc-{family}", "nemesis": f"mc-{mode}",
@@ -963,8 +1068,9 @@ def run_mc(family: str, mode: str, *, scope: Scope | None = None,
         cert["replayed"] = rv is not None and rv["code"] == v["code"]
         cert["history"] = [op.to_dict() for op in world.history]
         if confirm:
-            cert["confirm"] = confirm_certificate(family,
-                                                  world.history)
+            cert["confirm"] = confirm_certificate(
+                family, world.history, code=v["code"],
+                replayed=cert["replayed"])
         if bank_base:
             cert["banked"] = bank_certificate(family, mode,
                                               world.history,
@@ -990,7 +1096,7 @@ def run_mc_sweep(families=FAMILIES, *, modes: dict | None = None,
     runs = []
     ok = True
     for family in families:
-        for mode in (modes or MODES)[family]:
+        for mode in (modes or ALL_MODES)[family]:
             r = run_mc(family, mode, scope=scope, dpor=dpor,
                        bank_base=bank_base if mode != "clean"
                        else None)
@@ -1021,10 +1127,16 @@ def mc_plan_block(family: str, mode: str,
     """The static 'what would --mc do' block for explain()/plan
     output: the scope bounds and invariant set, no exploration."""
     scope = scope or default_scope(family, mode)
+    if family == "shell-replicated":
+        events = ["op", "elect", "learn"]
+    elif family in SHELL_FAMILIES:
+        events = ["send", "deliver", "drop", "dup", "reset", "retry",
+                  "giveup"]
+    else:
+        events = ["hb", "campaign", "op", "crash", "restart",
+                  "isolate", "heal"]
     return {"family": family, "mode": mode, "scope": scope.to_dict(),
-            "codes": sorted(MC_CODES),
-            "events": ["hb", "campaign", "op", "crash", "restart",
-                       "isolate", "heal"]}
+            "codes": sorted(MC_CODES), "events": events}
 
 
 def load_certificate(path: str) -> dict:

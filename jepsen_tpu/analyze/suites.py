@@ -112,11 +112,34 @@ O002 warning  registered series no consumer surface references
               instrumentation
 ==== ======== ==========================================================
 
+R-codes (retry idempotency — via :func:`lint_retry`; the reconnect
+layer retries automatically, so whatever it retries had better be
+safe to run twice):
+
+==== ======== ==========================================================
+R001 error    a non-idempotent operation (name carries a mutation
+              verb: write/put/add/enqueue/...) is retried by an
+              automatic construct — a ``Backoff.run(fn)`` /
+              ``with_conn(f)`` call, or a loop whose broad except
+              handler silently goes around again — in a function with
+              no ``"info"`` completion anywhere: a retransmitted
+              mutation that already applied double-commits, and the
+              history can't even say "maybe"
+R002 error    a bounded retry loop whose broad except handler swallows
+              the exception and whose function never re-raises after
+              the loop — when the budget runs out the op silently
+              becomes a no-op with no completion at all
+==== ======== ==========================================================
+
+(The model checker proves the dynamic twin of R001: MC201 in
+docs/analyze.md §12 is this exact double-commit, caught by running the
+live shell code under the simulated transport.)
+
 False-positive escape hatch: a line containing ``suite-lint: ok``
 suppresses S/B findings anchored on it; ``threadlint: ok`` suppresses
-T findings; ``knoblint: ok`` suppresses N findings and
-``metriclint: ok`` O findings (use sparingly, with a comment saying
-why the pattern is sound).
+T findings; ``knoblint: ok`` suppresses N findings,
+``metriclint: ok`` O findings and ``retrylint: ok`` R findings (use
+sparingly, with a comment saying why the pattern is sound).
 """
 
 from __future__ import annotations
@@ -149,6 +172,9 @@ SUITE_CODES = {
     "N003": "env knob read by the package but absent from docs/",
     "O001": "consumer-referenced jtpu_* series registered nowhere",
     "O002": "registered jtpu_* series no consumer surface references",
+    "R001": "non-idempotent op retried automatically without "
+            ":info ambiguity handling",
+    "R002": "bounded retry loop swallowing the final exception",
 }
 
 #: the LiveBackend protocol members a concrete family must provide
@@ -1336,4 +1362,281 @@ def lint_metrics(pkg_root: str | Path | None = None,
             f"{len(orphans)} registered jtpu_* series no consumer "
             f"surface (web.py / obs_guard / thresholds) references: "
             f"{shown}", index=l0))
+    return out
+
+
+# ---------------------------------------------------------------------------
+# R-codes — retry idempotency (reconnect.Backoff / with_conn / retry loops)
+# ---------------------------------------------------------------------------
+#
+# The reconnect layer makes retries AUTOMATIC: Backoff.run(fn) calls
+# fn up to max_attempts times, with_conn reopens under the caller's
+# loop, and ad-hoc `while ...: try: op() except Exception: continue`
+# loops go around on any crash.  A retried READ is harmless.  A
+# retried MUTATION that already applied on the server is a duplicate
+# commit — exactly the bug the model checker's MC201 certificate
+# exhibits dynamically (a timed-out ADDJOB retransmitted after its
+# first copy was delivered).  The static contract this pass enforces:
+# an automatically retried mutation must live in a function that can
+# complete the ambiguous outcome as :info (the repo idiom — a string
+# constant "info" somewhere in the function), or carry a
+# ``retrylint: ok`` waiver explaining why the op is idempotent (e.g.
+# a server-side reqId dedup cache).
+
+#: identifier segments that mark a callable as a mutation — matched
+#: against whole ``_``/camelCase segments, never substrings ("address"
+#: does not contain the verb "add")
+RETRY_MUTATION_VERBS = frozenset({
+    "write", "put", "add", "addjob", "enqueue", "dequeue", "insert",
+    "update", "delete", "ack", "ackjob", "cas", "commit", "post",
+    "send", "execute", "push", "create", "set", "transfer", "upsert",
+})
+
+#: wrapper callables whose FIRST argument is the thing actually
+#: retried — the lint digs through them one level
+_RETRY_WRAPPERS = ("with_conn", "run")
+
+
+def _ident_segments(name: str) -> list[str]:
+    """``addJob_once`` → ["add", "job", "once"] — underscore and
+    camelCase boundaries both split."""
+    snake = re.sub(r"(?<=[a-z0-9])(?=[A-Z])", "_", name)
+    return [s.lower() for s in re.split(r"[_\W]+", snake) if s]
+
+
+def _is_mutation_name(name: str) -> bool:
+    return any(seg in RETRY_MUTATION_VERBS
+               for seg in _ident_segments(name))
+
+
+def _callable_names(node) -> list[tuple[str, int]]:
+    """Names a retried callable argument could invoke: a bare
+    Name/Attribute is itself; a Lambda is every call in its body."""
+    if isinstance(node, ast.Name):
+        return [(node.id, node.lineno)]
+    if isinstance(node, ast.Attribute):
+        return [(node.attr, node.lineno)]
+    if isinstance(node, ast.Lambda):
+        out = []
+        for c in ast.walk(node.body):
+            if isinstance(c, ast.Call):
+                n = _call_name(c).split(".")[-1]
+                if n:
+                    out.append((n, c.lineno))
+        return out
+    return []
+
+
+def _retried_names_in_call(call: ast.Call) -> list[tuple[str, int]]:
+    """For a retry-construct call, the names of what it retries.
+
+    ``<backoffish>.run(fn)`` and ``*.with_conn(f)`` retry their first
+    argument; anything else retries nothing."""
+    if not isinstance(call.func, ast.Attribute) or not call.args:
+        return []
+    attr = call.func.attr
+    if attr == "with_conn":
+        return _callable_names(call.args[0])
+    if attr == "run":
+        try:
+            recv = ast.unparse(call.func.value).lower()
+        except Exception:  # noqa: BLE001 — exotic receiver exprs
+            return []
+        if "backoff" in recv:
+            return _callable_names(call.args[0])
+    return []
+
+
+def _own_stmt_nodes(root) -> list:
+    """Nodes belonging to ``root`` itself — nested function/class
+    bodies excluded (they get their own scan)."""
+    out: list = []
+
+    def rec(node):
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                  ast.ClassDef)):
+                continue
+            out.append(child)
+            rec(child)
+
+    rec(root)
+    return out
+
+
+def _handler_retries(handler: ast.ExceptHandler) -> bool:
+    """Does this broad handler just go around the loop again?  Any
+    raise/return/break anywhere in it means the loop has an explicit
+    failure path — conservative: uncertainty never produces a
+    finding."""
+    return not any(isinstance(n, (ast.Raise, ast.Return, ast.Break))
+                   for n in ast.walk(handler))
+
+
+def _handler_captured(handler: ast.ExceptHandler) -> str | None:
+    """The name the handler saves the exception under
+    (``except Exception as e: last = e`` → "last"), or None.  A loop
+    that keeps the last error is retry-shaped, and the kept name being
+    USED after the loop is the non-swallowing exit path R002 wants."""
+    if not handler.name:
+        return None
+    for n in ast.walk(handler):
+        if isinstance(n, ast.Assign) \
+                and isinstance(n.value, ast.Name) \
+                and n.value.id == handler.name:
+            for t in n.targets:
+                if isinstance(t, ast.Name):
+                    return t.id
+    return None
+
+
+def _loop_is_retry(loop) -> bool:
+    """Attempt-shaped loop header: ``for attempt in range(...)``,
+    ``while not bo.exhausted()``, anything mentioning the retry
+    vocabulary.  Plain per-item scans (``for f in files``) are NOT
+    retry loops — a broad `continue` there skips a bad item, it does
+    not re-run one."""
+    parts = [loop.target, loop.iter] if isinstance(loop, ast.For) \
+        else [loop.test]
+    try:
+        header = " ".join(ast.unparse(p) for p in parts).lower()
+    except Exception:  # noqa: BLE001 — exotic header exprs
+        return False
+    return any(k in header for k in ("attempt", "retr", "backoff",
+                                     "exhaust"))
+
+
+def lint_retry_source(src: str, filename: str = "<string>"
+                      ) -> list[Diagnostic]:
+    """R-code lint for one module's source (see the module docstring's
+    R-code table).  ``retrylint: ok`` on the anchored line
+    suppresses."""
+    diags: list[Diagnostic] = []
+    try:
+        tree = ast.parse(src, filename=filename)
+    except SyntaxError:
+        return []  # the S-lint owns parse errors
+    lines = src.splitlines()
+
+    def suppressed(lineno: int | None) -> bool:
+        return (lineno is not None and 1 <= lineno <= len(lines)
+                and "retrylint: ok" in lines[lineno - 1])
+
+    def add(code, msg, lineno):
+        if not suppressed(lineno):
+            diags.append(Diagnostic(code, "error",
+                                    f"{filename}:{lineno}: {msg}",
+                                    index=lineno))
+
+    #: scan units: every function, plus the module top level
+    units = [n for n in ast.walk(tree)
+             if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))]
+    units.append(tree)
+
+    for fn in units:
+        fn_name = getattr(fn, "name", "<module>")
+        if fn_name == "run" and isinstance(fn, ast.FunctionDef):
+            # Backoff.run itself IS the retry machinery — it re-raises
+            # the last error internally; linting it against itself
+            # would flag the mechanism, not a use of it
+            continue
+        own = _own_stmt_nodes(fn)
+        # the repo idiom for acknowledged ambiguity: the function
+        # completes (or can complete) the op as :info somewhere
+        has_info = any(isinstance(n, ast.Constant) and n.value == "info"
+                       for n in ast.walk(fn))
+
+        # --- construct A: Backoff.run(fn) / with_conn(f) -------------
+        for call in [n for n in own if isinstance(n, ast.Call)]:
+            for name, lineno in _retried_names_in_call(call):
+                if _is_mutation_name(name) and not has_info:
+                    add("R001",
+                        f"{fn_name}() auto-retries {name}() (reconnect "
+                        f"schedule) but can never complete :info — a "
+                        f"retransmitted mutation that already applied "
+                        f"double-commits; complete ambiguous outcomes "
+                        f"as :info or mark the op idempotent with "
+                        f"`retrylint: ok`", lineno)
+
+        # --- construct B: retry loop + try + broad handler that
+        # goes around again --------------------------------------------
+        for loop in [n for n in own if isinstance(n, (ast.For,
+                                                      ast.While))]:
+            for tr in [n for n in ast.walk(loop)
+                       if isinstance(n, ast.Try)]:
+                retry_handlers = [h for h in tr.handlers
+                                  if _is_broad(h) and
+                                  _handler_retries(h)]
+                if not retry_handlers:
+                    continue
+                kept = [k for k in map(_handler_captured,
+                                       retry_handlers) if k]
+                if not _loop_is_retry(loop) and not kept:
+                    continue  # a per-item scan, not a retry loop
+                # R001: a mutation inside the retried try body
+                if not has_info:
+                    seen: set = set()
+                    for c in [n for st in tr.body
+                              for n in ast.walk(st)
+                              if isinstance(n, ast.Call)]:
+                        names = _retried_names_in_call(c) or \
+                            [(_call_name(c).split(".")[-1], c.lineno)]
+                        for name, lineno in names:
+                            if _is_mutation_name(name) and \
+                                    name not in seen:
+                                seen.add(name)
+                                add("R001",
+                                    f"{fn_name}() retries {name}() in "
+                                    f"a broad-except loop but can "
+                                    f"never complete :info — a crash "
+                                    f"after the op applied retries a "
+                                    f"committed mutation; complete "
+                                    f"ambiguous outcomes as :info or "
+                                    f"waive with `retrylint: ok`",
+                                    lineno)
+                # R002: a bounded loop whose budget can run out with
+                # the last error discarded and never re-raised
+                unbounded = isinstance(loop, ast.While) and \
+                    isinstance(loop.test, ast.Constant) and \
+                    bool(loop.test.value)
+                if unbounded:
+                    continue  # while True never exits by exhaustion
+                loop_end = getattr(loop, "end_lineno", loop.lineno)
+                reraises_after = any(
+                    isinstance(n, ast.Raise) and n.lineno > loop_end
+                    for n in ast.walk(fn))
+                # the kept last-error being read after the loop is the
+                # other legitimate exit: completing :info/:fail WITH
+                # the error instead of raising it
+                kept_used = any(
+                    isinstance(n, ast.Name) and n.id in kept
+                    and isinstance(n.ctx, ast.Load)
+                    and n.lineno > loop_end
+                    for n in ast.walk(fn))
+                if not reraises_after and not kept_used:
+                    h0 = retry_handlers[0]
+                    add("R002",
+                        f"{fn_name}()'s bounded retry loop swallows "
+                        f"every crash and never re-raises after the "
+                        f"loop — when the budget runs out the op "
+                        f"silently becomes a no-op; keep the last "
+                        f"error and raise it (Backoff.run semantics)",
+                        h0.lineno)
+    return diags
+
+
+def lint_retry(pkg_root: str | Path | None = None
+               ) -> dict[str, list[Diagnostic]]:
+    """The R-code retry-idempotency lint over every module in the
+    package.  Returns {filename: diagnostics} for files with findings
+    only; a line containing ``retrylint: ok`` suppresses findings
+    anchored on it."""
+    pkg = Path(pkg_root) if pkg_root else \
+        Path(__file__).resolve().parent.parent
+    out: dict[str, list[Diagnostic]] = {}
+    for f in _package_py_files(pkg):
+        src = f.read_text()
+        diags = lint_retry_source(src, filename=str(f))
+        if diags:
+            out[str(f)] = diags
     return out

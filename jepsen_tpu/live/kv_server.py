@@ -20,6 +20,19 @@ Status mapping (the v2 API shape V2Client's error handling relies on):
   PUT  prevValue mismatch          -> 412 {"errorCode": 101}
   PUT  prevValue on a missing key  -> 404 {"errorCode": 100}
 
+Retry idempotency: a PUT may carry a ``reqId`` query parameter; the
+store remembers the reply it sent for each reqId (durably, alongside
+the write) and answers a retransmission of the same reqId with the
+SAME reply instead of re-running the op — the MC202 class of bug
+(commit succeeded, reply lost, retry answers differently) is closed by
+this cache.  ``volatile`` skips the cache too (the seeded MC202 mode).
+
+The request-dispatch logic is a pure function of (method, path, body,
+store) — :func:`dispatch` — that both the real HTTP handler below and
+the model checker's simulated transport (`analyze/simnet.py`) call, so
+the checked code path IS the served code path (the shell-lifting
+contract, docs/analyze.md §12).
+
 Usage:  python -m jepsen_tpu.live.kv_server PORT DATA_DIR [volatile]
 """
 
@@ -42,7 +55,10 @@ class Store:
         from .oplog import DurableLog
 
         self.lock = threading.Lock()
+        self.volatile = volatile
         self.state: dict[str, str] = {}
+        #: reqId -> (status, body) — the reply each idempotency key got
+        self.replies: dict[str, tuple[int, dict]] = {}
         self.log = DurableLog(data_dir, volatile=volatile)
         for line in self.log.replay():
             try:
@@ -51,6 +67,8 @@ class Store:
                 continue
             if e.get("op") == "set":
                 self.state[e["k"]] = e["v"]
+            elif e.get("op") == "reply":
+                self.replies[e["id"]] = (e["s"], e["b"])
         self.log.open()
 
     def _durable(self, entry: dict) -> None:
@@ -60,24 +78,85 @@ class Store:
         with self.lock:
             return self.state.get(key)
 
-    def put(self, key: str, value: str,
-            prev: str | None = None) -> tuple[int, dict]:
-        """(status, body) — durable before return (the reply follows)."""
+    def put(self, key: str, value: str, prev: str | None = None,
+            reqid: str | None = None) -> tuple[int, dict]:
+        """(status, body) — durable before return (the reply follows).
+
+        With ``reqid``, the reply is cached (durably) under that
+        idempotency key: a client retransmission after a lost reply
+        gets the ORIGINAL answer, not a second application (or a lying
+        412).  Volatile mode skips the cache — the seeded MC202 bug."""
         with self.lock:
+            if reqid is not None and not self.volatile \
+                    and reqid in self.replies:
+                return self.replies[reqid]
             if prev is not None:
                 cur = self.state.get(key)
                 if cur is None:
-                    return 404, {"errorCode": 100,
-                                 "message": "Key not found", "cause": key}
+                    status, body = 404, {"errorCode": 100,
+                                         "message": "Key not found",
+                                         "cause": key}
+                    return self._remember(reqid, status, body)
                 if cur != prev:
-                    return 412, {"errorCode": 101,
-                                 "message": "Compare failed",
-                                 "cause": f"[{prev} != {cur}]"}
+                    status, body = 412, {"errorCode": 101,
+                                         "message": "Compare failed",
+                                         "cause": f"[{prev} != {cur}]"}
+                    return self._remember(reqid, status, body)
             self._durable({"op": "set", "k": key, "v": value})
             self.state[key] = value
-            return 200, {"action": "compareAndSwap" if prev is not None
-                         else "set",
-                         "node": {"key": f"/{key}", "value": value}}
+            status = 200
+            body = {"action": "compareAndSwap" if prev is not None
+                    else "set",
+                    "node": {"key": f"/{key}", "value": value}}
+            return self._remember(reqid, status, body)
+
+    def _remember(self, reqid: str | None, status: int,
+                  body: dict) -> tuple[int, dict]:
+        """Cache the reply under the idempotency key (all statuses: a
+        retried CAS must see its own 412 again, not a fresh compare
+        against state its first attempt already moved).  Caller holds
+        the lock."""
+        if reqid is not None and not self.volatile:
+            self._durable({"op": "reply", "id": reqid,
+                           "s": status, "b": body})
+            self.replies[reqid] = (status, body)
+        return status, body
+
+
+def _path_key(parsed) -> str | None:
+    if not parsed.path.startswith(PREFIX):
+        return None
+    return urllib.parse.unquote(parsed.path[len(PREFIX):]) or None
+
+
+def dispatch(store: Store, method: str, path: str,
+             raw_body: bytes) -> tuple[int, dict]:
+    """One request against the store: (status, reply body).  Pure in
+    (method, path, body, store) — no socket, no wall clock — so the
+    real HTTP handler and the simnet transport share it verbatim."""
+    parsed = urllib.parse.urlparse(path)
+    key = _path_key(parsed)
+    if key is None:
+        return 404, {"errorCode": 100, "message": "bad path"}
+    if method == "GET":
+        v = store.get(key)
+        if v is None:
+            return 404, {"errorCode": 100,
+                         "message": "Key not found", "cause": key}
+        return 200, {"action": "get",
+                     "node": {"key": f"/{key}", "value": v}}
+    if method == "PUT":
+        try:
+            form = urllib.parse.parse_qs(
+                raw_body.decode("utf-8", "replace"))
+            value = form["value"][0]
+        except (ValueError, KeyError, IndexError):
+            return 400, {"errorCode": 209, "message": "bad form"}
+        query = urllib.parse.parse_qs(parsed.query)
+        prev = query.get("prevValue", [None])[0]
+        reqid = query.get("reqId", [None])[0]
+        return store.put(key, value, prev, reqid)
+    return 404, {"errorCode": 100, "message": "bad path"}
 
 
 class Handler(BaseHTTPRequestHandler):
@@ -94,43 +173,13 @@ class Handler(BaseHTTPRequestHandler):
         self.end_headers()
         self.wfile.write(data)
 
-    def _key(self, parsed) -> str | None:
-        if not parsed.path.startswith(PREFIX):
-            return None
-        return urllib.parse.unquote(parsed.path[len(PREFIX):]) or None
-
     def do_GET(self):  # noqa: N802 (stdlib API)
-        parsed = urllib.parse.urlparse(self.path)
-        key = self._key(parsed)
-        if key is None:
-            self._reply(404, {"errorCode": 100, "message": "bad path"})
-            return
-        v = self.server.store.get(key)
-        if v is None:
-            self._reply(404, {"errorCode": 100,
-                              "message": "Key not found", "cause": key})
-            return
-        self._reply(200, {"action": "get",
-                          "node": {"key": f"/{key}", "value": v}})
+        self._reply(*dispatch(self.server.store, "GET", self.path, b""))
 
     def do_PUT(self):  # noqa: N802 (stdlib API)
-        parsed = urllib.parse.urlparse(self.path)
-        key = self._key(parsed)
-        if key is None:
-            self._reply(404, {"errorCode": 100, "message": "bad path"})
-            return
-        try:
-            n = int(self.headers.get("Content-Length") or 0)
-            form = urllib.parse.parse_qs(
-                self.rfile.read(n).decode("utf-8", "replace"))
-            value = form["value"][0]
-        except (ValueError, KeyError, IndexError):
-            self._reply(400, {"errorCode": 209, "message": "bad form"})
-            return
-        query = urllib.parse.parse_qs(parsed.query)
-        prev = query.get("prevValue", [None])[0]
-        status, body = self.server.store.put(key, value, prev)
-        self._reply(status, body)
+        n = int(self.headers.get("Content-Length") or 0)
+        body = self.rfile.read(n)
+        self._reply(*dispatch(self.server.store, "PUT", self.path, body))
 
 
 class Server(ThreadingHTTPServer):

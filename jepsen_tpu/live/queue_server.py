@@ -4,7 +4,8 @@ One logical node of the live queue family: a REAL OS process speaking
 the RESP subset the disque suite's wire client (suites/disque.py:
 ``RespConn``/``DisqueClient``) already uses —
 
-  ADDJOB <queue> <body> <timeout_ms> [RETRY s] [REPLICATE n]  -> +id
+  ADDJOB <queue> <body> <timeout_ms> [RETRY s] [REPLICATE n]
+         [REQID id]                            -> +id
   GETJOB TIMEOUT <ms> COUNT <n> FROM <queue>  -> [[queue id body]] | nil
   ACKJOB <id>                                 -> :n
 
@@ -20,6 +21,20 @@ survives kill -9 (in-flight ops are the checker's :info case) and
 startup replays adds minus acks back into the pending set.  With
 ``volatile``, nothing is logged — enqueues acked to the client vanish
 on crash: the seeded data-loss bug a queue checker exists to catch.
+
+Retry idempotency: ADDJOB may carry ``REQID <id>``; the store
+remembers which jid each reqid minted (durably) and answers a
+retransmission with the SAME jid instead of enqueueing a second copy —
+the MC201 double-commit class.  ``volatile`` skips the cache (the
+seeded MC201 mode).
+
+Two shell-layer pieces are deliberately factored for the model
+checker (``analyze/simnet.py``): :func:`dispatch` is the pure
+per-command request logic (args in, reply payload out, no socket),
+and the connection handler's claim-release path — a GETJOB whose
+reply never reached the client returns its claim to pending instead
+of leaving the job invisibly claimed for the whole retry window (the
+disque-drain defect class; MC204).
 
 Usage:  python -m jepsen_tpu.live.queue_server PORT DATA_DIR [volatile]
 """
@@ -41,6 +56,11 @@ class Store:
 
         self.lock = threading.Lock()
         self.cv = threading.Condition(self.lock)
+        #: injectable clock (the model checker freezes it; claims then
+        #: never expire inside a bounded schedule, keeping redelivery
+        #: an explicit event instead of a wall-clock race)
+        self.now = time.monotonic
+        self.volatile = volatile
         self.next_id = 0
         #: job id -> (body, retry_s), FIFO-ish delivery order
         #: (redeliveries rejoin at the tail, like disque's best-effort
@@ -48,6 +68,8 @@ class Store:
         self.pending: OrderedDict[str, tuple[str, float]] = OrderedDict()
         #: job id -> (body, retry_s, redeliver-at-monotonic)
         self.claimed: dict[str, tuple[str, float, float]] = {}
+        #: ADDJOB reqid -> jid it minted (idempotent retry dedup)
+        self.replies: dict[str, str] = {}
         self.log = DurableLog(data_dir, volatile=volatile)
         acked: set = set()
         adds: OrderedDict[str, str] = OrderedDict()
@@ -59,6 +81,8 @@ class Store:
                 self.next_id = max(self.next_id, n + 1)
             elif len(parts) >= 2 and parts[0] == "K":
                 acked.add(parts[1])
+            elif len(parts) == 3 and parts[0] == "R":
+                self.replies[parts[1]] = parts[2]
         for jid, body in adds.items():
             if jid not in acked:
                 self.pending[jid] = (body, 1.0)
@@ -70,24 +94,31 @@ class Store:
     def _expire_claims(self) -> None:
         """Redeliver claims whose retry window lapsed (caller holds
         the lock)."""
-        now = time.monotonic()
+        now = self.now()
         for jid in [j for j, (_, _, t) in self.claimed.items()
                     if t <= now]:
             body, retry_s, _ = self.claimed.pop(jid)
             self.pending[jid] = (body, retry_s)
 
-    def addjob(self, body: str, retry_s: float) -> str:
+    def addjob(self, body: str, retry_s: float,
+               reqid: str | None = None) -> str:
         with self.cv:
+            if reqid is not None and not self.volatile \
+                    and reqid in self.replies:
+                return self.replies[reqid]
             jid = f"D-{self.next_id}"
             self.next_id += 1
             # durable BEFORE the reply: the linearization point
             self._durable(f"A {jid} {body}\n")
+            if reqid is not None and not self.volatile:
+                self._durable(f"R {reqid} {jid}\n")
+                self.replies[reqid] = jid
             self.pending[jid] = (body, retry_s)
             self.cv.notify()
             return jid
 
     def getjob(self, timeout_ms: int) -> tuple[str, str] | None:
-        deadline = time.monotonic() + timeout_ms / 1000.0
+        deadline = self.now() + timeout_ms / 1000.0
         with self.cv:
             while True:
                 self._expire_claims()
@@ -95,16 +126,15 @@ class Store:
                     jid, (body, retry_s) = \
                         self.pending.popitem(last=False)
                     self.claimed[jid] = (
-                        body, retry_s, time.monotonic() + retry_s)
+                        body, retry_s, self.now() + retry_s)
                     return jid, body
-                left = deadline - time.monotonic()
+                left = deadline - self.now()
                 if left <= 0:
                     return None
                 # wake early enough to notice an expiring claim
                 nxt = min([t for _, _, t in self.claimed.values()],
                           default=deadline)
-                self.cv.wait(max(0.01, min(left,
-                                           nxt - time.monotonic())))
+                self.cv.wait(max(0.01, min(left, nxt - self.now())))
 
     def ackjob(self, jid: str) -> int:
         with self.cv:
@@ -113,6 +143,17 @@ class Store:
             self.claimed.pop(jid, None)
             self.pending.pop(jid, None)
             return 1 if known else 0
+
+    def unclaim(self, jid: str) -> None:
+        """Return a claim to pending NOW — the delivery provably never
+        reached the client (its connection died before the reply was
+        sent), so holding the claim for the retry window only makes
+        the job invisible to every consumer for no reason."""
+        with self.cv:
+            if jid in self.claimed:
+                body, retry_s, _ = self.claimed.pop(jid)
+                self.pending[jid] = (body, retry_s)
+                self.cv.notify()
 
 
 # -- RESP framing, shared with live/replicated_queue.py ---------------
@@ -156,6 +197,45 @@ def encode_resp_job(queue: str, jid: str, body: str) -> bytes:
     return b"".join(out)
 
 
+def parse_addjob(args: list[str]) -> tuple[str, float, str | None]:
+    """ADDJOB options: (body, retry_s, reqid).  Shared with the
+    replicated queue's dispatch."""
+    retry_s = 1.0
+    reqid = None
+    rest = [a.upper() for a in args[4:]]
+    if "RETRY" in rest:
+        retry_s = float(args[4 + rest.index("RETRY") + 1])
+    if "REQID" in rest:
+        reqid = args[4 + rest.index("REQID") + 1]
+    return args[2], retry_s, reqid
+
+
+def dispatch(store: Store,
+             args: list[str]) -> tuple[bytes, str | None]:
+    """One command against the store: (reply payload, jid claimed by
+    THIS command or None).  Pure in (args, store) — the real handler
+    and the simnet transport share it; the claimed jid is what the
+    caller must unclaim if the reply cannot be delivered."""
+    cmd = args[0].upper() if args else ""
+    if cmd == "ADDJOB" and len(args) >= 4:
+        body, retry_s, reqid = parse_addjob(args)
+        jid = store.addjob(body, retry_s, reqid)
+        return f"+{jid}\r\n".encode(), None
+    if cmd == "GETJOB":
+        u = [a.upper() for a in args]
+        timeout_ms = int(args[u.index("TIMEOUT") + 1]) \
+            if "TIMEOUT" in u else 0
+        queue = args[u.index("FROM") + 1] if "FROM" in u else "jepsen"
+        got = store.getjob(timeout_ms)
+        if got is None:
+            return b"*-1\r\n", None
+        jid, body = got
+        return encode_resp_job(queue, jid, body), jid
+    if cmd == "ACKJOB" and len(args) >= 2:
+        return f":{store.ackjob(args[1])}\r\n".encode(), None
+    return f"-ERR unknown command {cmd!r}\r\n".encode(), None
+
+
 class Handler(socketserver.StreamRequestHandler):
     """The RESP framing RespConn emits: arrays of bulk strings in, one
     reply out per command."""
@@ -176,41 +256,22 @@ class Handler(socketserver.StreamRequestHandler):
                 return
             if args is None:
                 return
-            cmd = args[0].upper() if args else ""
+            claimed = None
             try:
-                if cmd == "ADDJOB" and len(args) >= 4:
-                    retry_s = 1.0
-                    rest = [a.upper() for a in args[4:]]
-                    if "RETRY" in rest:
-                        retry_s = float(args[4 + rest.index("RETRY") + 1])
-                    jid = store.addjob(args[2], retry_s)
-                    self._send(f"+{jid}\r\n".encode())
-                elif cmd == "GETJOB":
-                    u = [a.upper() for a in args]
-                    timeout_ms = int(args[u.index("TIMEOUT") + 1]) \
-                        if "TIMEOUT" in u else 0
-                    queue = args[u.index("FROM") + 1] if "FROM" in u \
-                        else "jepsen"
-                    got = store.getjob(timeout_ms)
-                    if got is None:
-                        self._send(b"*-1\r\n")
-                    else:
-                        jid, body = got
-                        self._send(encode_resp_job(queue, jid, body))
-                elif cmd == "ACKJOB" and len(args) >= 2:
-                    self._send(f":{store.ackjob(args[1])}\r\n".encode())
-                else:
-                    self._send(f"-ERR unknown command {cmd!r}\r\n"
-                               .encode())
-            except (BrokenPipeError, ConnectionResetError):
-                return
+                payload, claimed = dispatch(store, args)
             except Exception as e:  # noqa: BLE001 — one command, not
                 # the server: a malformed arg must not kill the node
-                try:
-                    self._send(f"-ERR {type(e).__name__}: {e}\r\n"
-                               .encode())
-                except OSError:
-                    return
+                payload = f"-ERR {type(e).__name__}: {e}\r\n".encode()
+            try:
+                self._send(payload)
+            except OSError:
+                # the reply never left: a job claimed by THIS command
+                # was never delivered — release it now instead of
+                # letting it sit invisibly claimed for the whole retry
+                # window (the MC204 session-leak class)
+                if claimed is not None:
+                    store.unclaim(claimed)
+                return
 
 
 class Server(socketserver.ThreadingTCPServer):

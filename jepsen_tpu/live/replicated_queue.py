@@ -68,7 +68,7 @@ import time
 from collections import OrderedDict
 
 from .queue_server import (encode_resp_command, encode_resp_job,
-                           read_resp_command)
+                           parse_addjob, read_resp_command)
 from .replicated_server import Handler as PeerHandler
 from .replicated_server import Replica, ReplicaCore
 from .replicated_server import Server as PeerServer
@@ -172,6 +172,11 @@ class QueueReplica(Replica):
             [(h, p + PEER_OFFSET) for h, p in self.resp_peers],
             oplog_path, lease_s=lease_s, volatile=volatile, host=host)
         self.cv = threading.Condition(self.lock)
+        #: ADDJOB REQID -> the exact reply bytes it earned; a client
+        #: retransmission after a lost reply relays the original ack
+        #: instead of committing a second copy (volatile skips it —
+        #: the seeded MC201 mode)
+        self.reply_cache: dict[str, bytes] = {}
 
     @property
     def pending(self):
@@ -263,6 +268,78 @@ def read_raw_reply(buf) -> bytes:
     raise ValueError(f"bad reply line {line!r}")
 
 
+def _forward_to_leader(rep: QueueReplica, args: list[str],
+                       forward) -> bytes:
+    """The proxy decision around a transport-supplied ``forward(lid,
+    args) -> raw reply bytes``.  Exception mapping is the protocol:
+    ``ConnectionRefusedError`` means nothing accepted the bytes
+    (definitely didn't happen → NOLEADER, DisqueClient maps to
+    :fail); any other ``OSError``/``ValueError`` means the leader may
+    have processed the command (indeterminate → NOREPL → :info)."""
+    with rep.lock:
+        lid = rep.leader_id
+    if lid is None or lid == rep.id:
+        return b"-ERR NOLEADER no leader known\r\n"
+    try:
+        return forward(lid, args)
+    except ConnectionRefusedError:
+        return b"-ERR NOLEADER leader refused\r\n"
+    except (OSError, ValueError):
+        return b"-NOREPL proxy indeterminate\r\n"
+
+
+def dispatch_resp(rep: QueueReplica, args: list[str], *,
+                  proxied: bool, forward) -> bytes:
+    """One RESP command against the replica: the raw reply payload.
+    Pure in (args, replica, forward) — the real handler and the model
+    checker's simnet both call it, so the proxy relay AND the REQID
+    dedup run inside the verified boundary.  ``proxied`` commands
+    (JPROXY-wrapped forwards) are answered locally no matter what, so
+    confused leadership views can't loop."""
+    cmd = args[0].upper() if args else ""
+    if cmd == "ADDJOB" and len(args) >= 4:
+        body, retry_s, reqid = parse_addjob(args)
+        if reqid is not None and not rep.volatile:
+            with rep.lock:
+                cached = rep.reply_cache.get(reqid)
+            if cached is not None:
+                return cached
+        st, jid = rep.addjob(body, retry_s)
+        if st == "ok":
+            payload = f"+{jid}\r\n".encode()
+            if reqid is not None and not rep.volatile:
+                with rep.lock:
+                    rep.reply_cache[reqid] = payload
+            return payload
+        if st == "noquorum":
+            return b"-NOREPL no quorum\r\n"
+        return b"-ERR NOLEADER not the leader\r\n" if proxied \
+            else _forward_to_leader(rep, args, forward)
+    if cmd == "GETJOB":
+        u = [a.upper() for a in args]
+        timeout_ms = int(args[u.index("TIMEOUT") + 1]) \
+            if "TIMEOUT" in u else 0
+        queue = args[u.index("FROM") + 1] if "FROM" in u \
+            else "jepsen"
+        st, got = rep.getjob(timeout_ms)
+        if st == "ok":
+            if got is None:
+                return b"*-1\r\n"
+            jid, body = got
+            return encode_resp_job(queue, jid, body)
+        return b"-ERR NOLEADER not the leader\r\n" if proxied \
+            else _forward_to_leader(rep, args, forward)
+    if cmd == "ACKJOB" and len(args) >= 2:
+        st, n = rep.ackjob(args[1])
+        if st == "ok":
+            return f":{n}\r\n".encode()
+        if st == "noquorum":
+            return b"-NOREPL no quorum\r\n"
+        return b"-ERR NOLEADER not the leader\r\n" if proxied \
+            else _forward_to_leader(rep, args, forward)
+    return f"-ERR unknown command {cmd!r}\r\n".encode()
+
+
 class RespHandler(socketserver.StreamRequestHandler):
     """Dispatch RespConn commands onto the replica; proxy when not
     leader."""
@@ -271,30 +348,22 @@ class RespHandler(socketserver.StreamRequestHandler):
         self.wfile.write(payload)
         self.wfile.flush()
 
-    def _proxy(self, rep: QueueReplica, args: list[str]) -> bytes:
-        """Forward to the believed leader; returns the raw reply to
-        relay.  Never loops: the forward is wrapped in JPROXY and a
-        JPROXY'd command is answered locally no matter what."""
-        with rep.lock:
-            lid = rep.leader_id
-        if lid is None or lid == rep.id:
-            return b"-ERR NOLEADER no leader known\r\n"
+    def _forward(self, lid: int, args: list[str]) -> bytes:
+        """The real-TCP forward leg dispatch_resp drives: JPROXY
+        envelope over a socket source-bound to the node's own address
+        (the forward rides the same per-peer links the partitioner
+        cuts); exceptions propagate — _forward_to_leader owns the
+        refused-vs-indeterminate mapping."""
+        rep: QueueReplica = self.server.replica
         host, port = rep.resp_peers[lid]
         s = None
         try:
             s = socket.socket()
             s.settimeout(1.5)
-            s.bind((rep.host, 0))  # the forward rides the peer links
+            s.bind((rep.host, 0))
             s.connect((host, port))
             s.sendall(encode_resp_command(["JPROXY", *args]))
             return read_raw_reply(s.makefile("rb"))
-        except ConnectionRefusedError:
-            # nothing accepted the bytes: definitely didn't happen
-            return b"-ERR NOLEADER leader refused\r\n"
-        except (OSError, ValueError):
-            # sent but no (clean) reply: the leader may have processed
-            # it — indeterminate, and DisqueClient maps NOREPL to :info
-            return b"-NOREPL proxy indeterminate\r\n"
         finally:
             if s is not None:
                 try:
@@ -314,9 +383,9 @@ class RespHandler(socketserver.StreamRequestHandler):
             proxied = bool(args) and args[0].upper() == "JPROXY"
             if proxied:
                 args = args[1:]
-            cmd = args[0].upper() if args else ""
             try:
-                self._send(self._dispatch(rep, cmd, args, proxied))
+                self._send(dispatch_resp(rep, args, proxied=proxied,
+                                         forward=self._forward))
             except (BrokenPipeError, ConnectionResetError):
                 return
             except Exception as e:  # noqa: BLE001 — one command, not
@@ -326,44 +395,6 @@ class RespHandler(socketserver.StreamRequestHandler):
                                .encode())
                 except OSError:
                     return
-
-    def _dispatch(self, rep: QueueReplica, cmd: str, args: list[str],
-                  proxied: bool) -> bytes:
-        if cmd == "ADDJOB" and len(args) >= 4:
-            retry_s = 1.0
-            rest = [a.upper() for a in args[4:]]
-            if "RETRY" in rest:
-                retry_s = float(args[4 + rest.index("RETRY") + 1])
-            st, jid = rep.addjob(args[2], retry_s)
-            if st == "ok":
-                return f"+{jid}\r\n".encode()
-            if st == "noquorum":
-                return b"-NOREPL no quorum\r\n"
-            return b"-ERR NOLEADER not the leader\r\n" if proxied \
-                else self._proxy(rep, args)
-        if cmd == "GETJOB":
-            u = [a.upper() for a in args]
-            timeout_ms = int(args[u.index("TIMEOUT") + 1]) \
-                if "TIMEOUT" in u else 0
-            queue = args[u.index("FROM") + 1] if "FROM" in u \
-                else "jepsen"
-            st, got = rep.getjob(timeout_ms)
-            if st == "ok":
-                if got is None:
-                    return b"*-1\r\n"
-                jid, body = got
-                return encode_resp_job(queue, jid, body)
-            return b"-ERR NOLEADER not the leader\r\n" if proxied \
-                else self._proxy(rep, args)
-        if cmd == "ACKJOB" and len(args) >= 2:
-            st, n = rep.ackjob(args[1])
-            if st == "ok":
-                return f":{n}\r\n".encode()
-            if st == "noquorum":
-                return b"-NOREPL no quorum\r\n"
-            return b"-ERR NOLEADER not the leader\r\n" if proxied \
-                else self._proxy(rep, args)
-        return f"-ERR unknown command {cmd!r}\r\n".encode()
 
 
 class RespServer(socketserver.ThreadingTCPServer):

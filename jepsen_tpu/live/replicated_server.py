@@ -667,6 +667,58 @@ class Replica:
             return self.core.status(time.monotonic())
 
 
+def handle_client_request(rep: Replica, method: str, path: str,
+                          raw_body: bytes | None, *, proxied: bool,
+                          forward) -> tuple[int, dict]:
+    """One client request (GET/PUT of a key) against a replica:
+    (status, reply body).  Pure in (request, replica, forward) — the
+    real HTTP handler and the model checker's simnet both call it, so
+    the follower→leader proxy decision is inside the verified
+    boundary (the shell-lifting contract, docs/analyze.md §12).
+
+    ``forward(lid, method, path, raw_body) -> (status, body)`` sends
+    the request to the believed leader; it raises
+    ``ConnectionRefusedError`` when nothing accepted the bytes (the op
+    definitely didn't happen — safe to fall back to the local 503) and
+    any other ``OSError`` when the outcome is indeterminate (it may
+    have fired AFTER the leader processed the op — the client gets a
+    504, never a 503 that would let it record :fail for a write that
+    actually committed).  A ``proxied`` request is never re-proxied,
+    so confused leader views can't loop."""
+    parsed = urllib.parse.urlparse(path)
+    if not parsed.path.startswith(PREFIX):
+        return 404, {"errorCode": 100, "message": "bad path"}
+    key = urllib.parse.unquote(parsed.path[len(PREFIX):]) or None
+    if key is None:
+        return 404, {"errorCode": 100, "message": "bad path"}
+    if method == "GET":
+        status, body = rep.get(key)
+    elif method == "PUT":
+        try:
+            form = urllib.parse.parse_qs(
+                (raw_body or b"").decode("utf-8", "replace"))
+            value = form["value"][0]
+        except (ValueError, KeyError, IndexError):
+            return 400, {"errorCode": 209, "message": "bad form"}
+        prev = urllib.parse.parse_qs(parsed.query).get(
+            "prevValue", [None])[0]
+        status, body = rep.put(key, value, prev)
+    else:
+        return 404, {"errorCode": 100, "message": "bad path"}
+    if status != 503 or proxied:
+        return status, body
+    with rep.lock:
+        lid = rep.leader_id
+    if lid is None or lid == rep.id:
+        return status, body  # no usable leader: the local 503 stands
+    try:
+        return forward(lid, method, path, raw_body)
+    except ConnectionRefusedError:
+        return status, body
+    except OSError:
+        return 504, {"errorCode": 301, "message": "proxy indeterminate"}
+
+
 class Handler(BaseHTTPRequestHandler):
     protocol_version = "HTTP/1.1"
 
@@ -681,50 +733,29 @@ class Handler(BaseHTTPRequestHandler):
         self.end_headers()
         self.wfile.write(data)
 
-    def _key(self, parsed) -> str | None:
-        if not parsed.path.startswith(PREFIX):
-            return None
-        return urllib.parse.unquote(parsed.path[len(PREFIX):]) or None
-
     # -- proxy: follower forwards client ops to its leader ------------
 
-    def _proxy(self, rep: Replica, body: bytes | None) -> bool:
-        """Forward this request to the believed leader; False when no
-        usable leader (caller replies 503).  A proxied request is never
-        re-proxied (X-Repl-Proxied), so confused views can't loop."""
-        if self.headers.get("X-Repl-Proxied"):
-            return False
-        with rep.lock:
-            lid = rep.leader_id
-        if lid is None or lid == rep.id:
-            return False
+    def _forward(self, lid: int, method: str, path: str,
+                 raw_body: bytes | None) -> tuple[int, dict]:
+        """The real-TCP forward leg handle_client_request drives:
+        source-bound like every peer request (a proxied client op is
+        inter-node traffic and must ride the same links the
+        partitioner cuts)."""
+        rep: Replica = self.server.replica
         host, port = rep.peers[lid]
-        try:
-            # source-bound like every peer request: a proxied client op
-            # is inter-node traffic and must ride the same links the
-            # partitioner cuts
-            status, out = http_json(
-                host, port, self.path, method=self.command, data=body,
-                timeout=1.5, src=rep.host,
-                headers={"X-Repl-Proxied": "1",
-                         "Content-Type": self.headers.get(
-                             "Content-Type")
-                         or "application/octet-stream"})
-            self._reply(status, out)
-            return True
-        except ConnectionRefusedError:
-            # nothing accepted the forwarded bytes: the op definitely
-            # didn't happen — safe to fall back to the caller's 503
-            return False
-        except OSError:
-            # anything else (timeout, reset, a malformed reply body)
-            # may have fired AFTER the leader processed the op —
-            # indeterminate, never "didn't happen" (a 503 would let
-            # the client record :fail for a write that actually
-            # committed: a false violation)
-            self._reply(504, {"errorCode": 301,
-                              "message": "proxy indeterminate"})
-            return True
+        return http_json(
+            host, port, path, method=method, data=raw_body,
+            timeout=1.5, src=rep.host,
+            headers={"X-Repl-Proxied": "1",
+                     "Content-Type": self.headers.get("Content-Type")
+                     or "application/octet-stream"})
+
+    def _client(self, method: str, raw_body: bytes | None) -> None:
+        rep: Replica = self.server.replica
+        self._reply(*handle_client_request(
+            rep, method, self.path, raw_body,
+            proxied=bool(self.headers.get("X-Repl-Proxied")),
+            forward=self._forward))
 
     # -- HTTP dispatch -------------------------------------------------
 
@@ -745,14 +776,7 @@ class Handler(BaseHTTPRequestHandler):
                                          int(q["cand"][0]),
                                          int(q["seq"][0])))
             return
-        key = self._key(parsed)
-        if key is None:
-            self._reply(404, {"errorCode": 100, "message": "bad path"})
-            return
-        status, body = rep.get(key)
-        if status == 503 and self._proxy(rep, None):
-            return
-        self._reply(status, body)
+        self._client("GET", None)
 
     def do_POST(self):  # noqa: N802 (stdlib API)
         rep: Replica = self.server.replica
@@ -769,26 +793,8 @@ class Handler(BaseHTTPRequestHandler):
         self._reply(404, {"errorCode": 100, "message": "bad path"})
 
     def do_PUT(self):  # noqa: N802 (stdlib API)
-        rep: Replica = self.server.replica
-        parsed = urllib.parse.urlparse(self.path)
-        key = self._key(parsed)
-        if key is None:
-            self._reply(404, {"errorCode": 100, "message": "bad path"})
-            return
         n = int(self.headers.get("Content-Length") or 0)
-        raw = self.rfile.read(n)
-        try:
-            form = urllib.parse.parse_qs(raw.decode("utf-8", "replace"))
-            value = form["value"][0]
-        except (ValueError, KeyError, IndexError):
-            self._reply(400, {"errorCode": 209, "message": "bad form"})
-            return
-        prev = urllib.parse.parse_qs(parsed.query).get(
-            "prevValue", [None])[0]
-        status, body = rep.put(key, value, prev)
-        if status == 503 and self._proxy(rep, raw):
-            return
-        self._reply(status, body)
+        self._client("PUT", self.rfile.read(n))
 
 
 class Server(ThreadingHTTPServer):

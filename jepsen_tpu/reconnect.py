@@ -105,6 +105,19 @@ class Backoff:
         """Re-arm the stateful schedule (successful health check)."""
         self.attempt = 0
 
+    def clone(self) -> "Backoff":
+        """A state-identical copy: same cursor AND the same rng stream
+        position (``delay`` draws from the rng even at ``jitter=0``, so
+        two schedules only stay in lockstep if the stream is copied).
+        The model checker clones worlds mid-schedule; a shallow copy
+        sharing the rng would let one branch advance another's."""
+        b = Backoff(base=self.base, cap=self.cap, factor=self.factor,
+                    max_attempts=self.max_attempts, jitter=self.jitter,
+                    rng=random.Random())
+        b.rng.setstate(self.rng.getstate())
+        b.attempt = self.attempt
+        return b
+
     def run(self, fn: Callable[[], Any], *, desc: str = "retry",
             sleep: Callable[[float], None] = time.sleep):
         """Call ``fn`` until it returns without raising; sleep the

@@ -74,26 +74,40 @@ def home_html(base: str) -> str:
 # bounded model checker panel (analyze/modelcheck.py)
 # ---------------------------------------------------------------------------
 
-#: one sweep per process unless ?refresh=1 — the default scopes finish
-#: in a few seconds, but a dashboard page must not re-search per click
+#: one sweep per process per scope unless ?refresh=1 — the default
+#: scopes finish in a few seconds, but a dashboard page must not
+#: re-search per click.  Keyed by scope ("core" / "shell").
 _MC_CACHE: dict | None = None
 
 
-def mc_html(refresh: bool = False) -> str:
+def mc_html(refresh: bool = False, scope: str = "core") -> str:
     """The ``/mc`` page: the family x mode expected-outcome matrix
     (clean modes must clear their scope; seeded modes must be caught
     with replaying certificates), explored-scope numbers, and each
-    violation's schedule certificate with its confirm verdicts."""
+    violation's schedule certificate with its confirm verdicts.
+
+    ``scope`` picks the family set: ``core`` runs the abstract
+    MC1xx worlds, ``shell`` lifts the live daemons' dispatch code
+    onto the simulated transport (MC2xx, docs/analyze.md §12)."""
     global _MC_CACHE
     from .analyze import modelcheck as mc
 
-    if _MC_CACHE is None or refresh:
-        _MC_CACHE = mc.run_mc_sweep()
-    sweep = _MC_CACHE
+    if scope not in ("core", "shell"):
+        scope = "core"
+    if not isinstance(_MC_CACHE, dict) or "runs" in _MC_CACHE:
+        # unset, or a bare sweep dict left by an older caller —
+        # promote to the per-scope cache shape
+        _MC_CACHE = {}
+    if scope not in _MC_CACHE or refresh:
+        _MC_CACHE[scope] = (mc.run_mc_sweep(mc.SHELL_FAMILIES)
+                            if scope == "shell" else mc.run_mc_sweep())
+    sweep = _MC_CACHE[scope]
+    shell_families = set(getattr(mc, "SHELL_FAMILIES", ()))
     rows = []
     certs = []
     for r in sweep["runs"]:
         ex = r["explored"]
+        r_scope = "shell" if r["family"] in shell_families else "core"
         seeded = r["mode"] != "clean"
         expected = (not r["ok"] and all(c.get("replayed")
                                         for c in r["violations"])) \
@@ -102,7 +116,8 @@ def mc_html(refresh: bool = False) -> str:
         codes = sorted({c["code"] for c in r["violations"]})
         verdict = ("caught " + ", ".join(codes)) if codes else "clean"
         rows.append(
-            f'<tr class="{cls}"><td>{html.escape(r["family"])}</td>'
+            f'<tr class="{cls}"><td>{r_scope}</td>'
+            f'<td>{html.escape(r["family"])}</td>'
             f'<td>{html.escape(r["mode"])}</td>'
             f"<td>{html.escape(verdict)}</td>"
             f"<td>{ex['states']}</td><td>{ex['schedules']}</td>"
@@ -131,10 +146,14 @@ def mc_html(refresh: bool = False) -> str:
             f"<style>{STYLE}</style></head><body>"
             f"<h1>Bounded model checker</h1>"
             f'<p><a href="/">home</a> · '
-            f'<a href="/mc?refresh=1">re-run sweep</a></p>'
-            f"<p>{html.escape(status)} (MC1xx codes, schedule "
-            f"certificates — docs/analyze.md §11)</p><table>"
-            f"<tr><th>family</th><th>mode</th><th>verdict</th>"
+            f'<a href="/mc?scope=core">core scope</a> · '
+            f'<a href="/mc?scope=shell">shell scope</a> · '
+            f'<a href="/mc?scope={scope}&refresh=1">re-run sweep</a></p>'
+            f"<p>scope: {scope} — {html.escape(status)} "
+            f"(MC1xx/MC2xx codes, schedule certificates — "
+            f"docs/analyze.md §11–§12)</p><table>"
+            f"<tr><th>scope</th><th>family</th><th>mode</th>"
+            f"<th>verdict</th>"
             f"<th>states</th><th>schedules</th><th>prune ratio</th>"
             f"<th>complete</th><th>expected?</th></tr>"
             f"{''.join(rows)}</table>{''.join(certs)}</body></html>")
@@ -820,8 +839,11 @@ class Handler(BaseHTTPRequestHandler):
             self._send(200, campaigns_html(self.base).encode())
             return
         if path == "/mc" or path == "/mc/":
-            refresh = "refresh=1" in (parsed.query or "")
-            self._send(200, mc_html(refresh=refresh).encode(),
+            q = urllib.parse.parse_qs(parsed.query or "")
+            refresh = q.get("refresh", ["0"])[0] == "1"
+            scope = q.get("scope", ["core"])[0]
+            self._send(200,
+                       mc_html(refresh=refresh, scope=scope).encode(),
                        extra={"Cache-Control": "no-store"})
             return
         if path == "/metrics":

@@ -693,3 +693,140 @@ def test_new_codes_registered_and_cli_runs(capsys):
     assert mod.main(["--knobs", "--json"]) == 0
     payload = json.loads(capsys.readouterr().out)
     assert payload["errors"] == 0
+
+
+# ---------------------------------------------------------------------------
+# R-codes — retry idempotency
+# ---------------------------------------------------------------------------
+
+from jepsen_tpu.analyze.suites import (  # noqa: E402
+    lint_retry,
+    lint_retry_source,
+)
+
+
+def test_r001_backoff_run_of_mutation_without_info():
+    src = (
+        "class AClient(Client):\n"
+        "    def invoke(self, test, op):\n"
+        "        self.backoff.run(lambda: self.conn.put(op.value))\n"
+        "        return replace(op, type='ok')\n")
+    assert codes(lint_retry_source(src, "fix.py"),
+                 "error") == {"R001"}
+    # same construct with an :info completion path is the idiom — clean
+    ok = src + (
+        "\n"
+        "    def invoke2(self, test, op):\n"
+        "        try:\n"
+        "            self.backoff.run(lambda: self.conn.put(op.value))\n"
+        "            return replace(op, type='ok')\n"
+        "        except Exception:\n"
+        "            return replace(op, type='info')\n")
+    diags = lint_retry_source(ok, "fix.py")
+    assert [d for d in diags if "invoke2" in d.message] == []
+
+
+def test_r001_with_conn_of_mutation():
+    src = (
+        "def invoke(self, test, op):\n"
+        "    self.wrapper.with_conn(lambda c: c.write(op.value))\n"
+        "    return replace(op, type='ok')\n")
+    assert codes(lint_retry_source(src, "fix.py"),
+                 "error") == {"R001"}
+    # reads through the same wrapper are idempotent — clean
+    read = src.replace("c.write", "c.read")
+    assert lint_retry_source(read, "fix.py") == []
+
+
+def test_r001_attempt_loop_mutation_and_r002_swallow():
+    src = (
+        "def do(conn, op):\n"
+        "    for attempt in range(3):\n"
+        "        try:\n"
+        "            conn.enqueue(op)\n"
+        "            return 'ok'\n"
+        "        except Exception:\n"
+        "            continue\n")
+    got = codes(lint_retry_source(src, "fix.py"), "error")
+    assert got == {"R001", "R002"}
+
+
+def test_r002_only_when_loop_is_retry_shaped():
+    # a per-item scan skipping bad items is NOT a retry loop
+    scan = (
+        "def sweep(files):\n"
+        "    for f in files:\n"
+        "        try:\n"
+        "            load(f)\n"
+        "        except Exception:\n"
+        "            continue\n")
+    assert lint_retry_source(scan, "fix.py") == []
+    # kept-last-error used after the loop is the legitimate exit
+    kept = (
+        "def do(conn, op):\n"
+        "    last = None\n"
+        "    for attempt in range(3):\n"
+        "        try:\n"
+        "            return conn.read(op)\n"
+        "        except Exception as e:\n"
+        "            last = e\n"
+        "    return replace(op, type='fail', error=str(last))\n")
+    assert lint_retry_source(kept, "fix.py") == []
+    # re-raise after the loop is Backoff.run semantics — clean
+    rr = (
+        "def do(conn, op):\n"
+        "    for attempt in range(3):\n"
+        "        try:\n"
+        "            return conn.read(op)\n"
+        "        except Exception:\n"
+        "            continue\n"
+        "    raise RuntimeError('budget')\n")
+    assert lint_retry_source(rr, "fix.py") == []
+
+
+def test_r_probe_loops_and_backoff_run_itself_are_clean():
+    probe = (
+        "def wait(self):\n"
+        "    while not self.bo.exhausted():\n"
+        "        try:\n"
+        "            self.health_check()\n"
+        "            return\n"
+        "        except Exception:\n"
+        "            sleep(self.bo.step())\n"
+        "    raise RuntimeError('dead')\n")
+    assert lint_retry_source(probe, "fix.py") == []
+
+
+def test_retrylint_suppression():
+    src = (
+        "def do(conn, op):\n"
+        "    for attempt in range(3):\n"
+        "        try:\n"
+        "            conn.enqueue(op)  # server dedups; retrylint: ok\n"
+        "            return 'ok'\n"
+        "        except Exception:\n"
+        "            continue\n"
+        "    raise RuntimeError('budget')\n")
+    assert lint_retry_source(src, "fix.py") == []
+
+
+def test_package_retry_discipline_holds():
+    """The CI gate: no automatically retried mutation in the package
+    without :info handling (the reconnect layer, health probes, and
+    queue clients must all classify clean)."""
+    out = _all(lint_retry())
+    assert [str(d) for d in out
+            if d.severity == "error"] == []
+
+
+def test_lint_suites_cli_retry_flag(capsys):
+    import importlib.util
+
+    spec = importlib.util.spec_from_file_location(
+        "lint_suites_cli_r", os.path.join(REPO, "tools",
+                                          "lint_suites.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    assert mod.main(["--retry", "--json"]) == 0
+    payload = json.loads(capsys.readouterr().out)
+    assert payload["errors"] == 0

@@ -24,6 +24,14 @@ surface references must be registered; registered-but-unreferenced
 orphans are flagged once, aggregated).  Skip with ``--no-knobs`` /
 ``--no-metrics``; run alone with ``--knobs`` / ``--metrics``.
 
+The default sweep also runs the R-code retry-idempotency lint (a
+mutation retried automatically — ``Backoff.run``, ``with_conn``, or
+an attempt-shaped broad-except loop — must be able to complete
+``:info`` on the ambiguous outcome; a bounded retry loop must not
+swallow its final error).  Skip with ``--no-retry``; run alone with
+``--retry``.  The model checker's MC201 certificate is the dynamic
+twin of R001 (docs/analyze.md §12).
+
 Exit code 0 when no ERROR-severity findings (warnings don't fail the
 run), 1 otherwise.  The same check gates CI through
 tests/test_suite_lint.py, so a new suite cannot merge with protocol
@@ -45,6 +53,7 @@ from jepsen_tpu.analyze.suites import (  # noqa: E402
     lint_knobs,
     lint_metrics,
     lint_paths,
+    lint_retry,
     lint_thread_tier,
 )
 
@@ -73,13 +82,17 @@ def main(argv=None) -> int:
                    help="run ONLY the O-code metrics-contract lint")
     p.add_argument("--no-metrics", action="store_true",
                    help="skip the O-code lint in the default sweep")
+    p.add_argument("--retry", action="store_true",
+                   help="run ONLY the R-code retry-idempotency lint")
+    p.add_argument("--no-retry", action="store_true",
+                   help="skip the R-code lint in the default sweep")
     opts = p.parse_args(argv)
     if opts.codes:
         for code, desc in sorted(SUITE_CODES.items()):
             print(f"{code}  {desc}")
         return 0
 
-    only = opts.threads or opts.knobs or opts.metrics
+    only = opts.threads or opts.knobs or opts.metrics or opts.retry
     findings: dict = {}
     if not only:
         findings = lint_paths(opts.paths)
@@ -95,6 +108,9 @@ def main(argv=None) -> int:
             findings.setdefault(f, []).extend(ds)
     if opts.metrics or (sweep and not opts.no_metrics):
         for f, ds in lint_metrics().items():
+            findings.setdefault(f, []).extend(ds)
+    if opts.retry or (sweep and not opts.no_retry):
+        for f, ds in lint_retry().items():
             findings.setdefault(f, []).extend(ds)
     n_err = sum(1 for ds in findings.values()
                 for d in ds if d.severity == "error")
